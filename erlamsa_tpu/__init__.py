@@ -1,0 +1,26 @@
+"""erlamsa_tpu — a TPU-native general-purpose fuzzing framework.
+
+A ground-up redesign of the capabilities of Darkkey/erlamsa (an Erlang
+radamsa-descendant fuzzer: mutation pipeline, fuzzing proxy, fuzz-as-a-service,
+distributed nodes, crash monitors) around JAX/XLA on TPU:
+
+- the per-sample mutation pipeline (generators -> patterns -> mutators ->
+  outputs, reference src/erlamsa_main.erl:124) becomes a single jittable
+  batched program ``fuzz_batch`` over ``uint8[B, L]`` corpus buffers driven
+  by a counter-based PRNG (`erlamsa_tpu.ops.pipeline`),
+- sharded over a device mesh with `jax.sharding` (`erlamsa_tpu.parallel`),
+- with a sequential CPU oracle reproducing the reference's exact
+  AS183-driven byte stream for parity (`erlamsa_tpu.oracle`),
+- and a host shell: CLI, IO writers, proxy, FaaS, monitors, distribution
+  (`erlamsa_tpu.services`).
+
+Layout:
+    ops/       device compute path: mutator kernels, scheduler, patterns
+    models/    format-aware engines (json/sgml/strlex/tree/uri/b64/zip/gf)
+    parallel/  mesh sharding, batching, multi-host
+    utils/     AS183 PRNG, byte helpers, shared constants
+    oracle/    sequential parity pipeline (byte-identical replay path)
+    services/  host shell: cli, out, proxy, faas, monitors, logger, dist
+"""
+
+__version__ = "0.1.0"

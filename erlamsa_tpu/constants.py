@@ -1,0 +1,60 @@
+"""Shared engine constants.
+
+Values mirror the reference's tunables (reference: src/erlamsa.hrl:44-66) so
+the mutation-site distribution and block envelopes match; TPU-side batch
+capacities are new.
+"""
+
+# Basic patterns trigger a mutation on a block with probability 1/rand(INITIAL_IP)
+# (reference: src/erlamsa.hrl:44, src/erlamsa_patterns.erl:271).
+INITIAL_IP = 24
+
+# Probability that a "many" pattern keeps mutating (reference: src/erlamsa.hrl:45).
+REMUTATE_PROBABILITY = (4, 5)
+
+# Upper bound on burst/many rounds on the device path. The reference's
+# geometric chain is unbounded; on TPU we truncate (P(chain > 16) = (4/5)^16
+# ~ 2.8%, folded into the final round) (reference: src/erlamsa.hrl:46).
+MAX_BURST_MUTATIONS = 16
+
+# Generator block envelope (reference: src/erlamsa.hrl:47-50).
+MIN_BLOCK_SIZE = 256
+AVG_BLOCK_SIZE = 2048
+MAX_BLOCK_SIZE = 2 * AVG_BLOCK_SIZE
+
+# Hard cap on a single mutable block (reference: src/erlamsa.hrl:51-52).
+ABSMAXHALF_BINARY_BLOCK = 500_000
+ABSMAX_BINARY_BLOCK = 2 * ABSMAXHALF_BINARY_BLOCK
+
+# Mutator self-adjusting score range (reference: src/erlamsa_mutations.erl:42-43).
+MIN_SCORE = 2.0
+MAX_SCORE = 10.0
+
+# Sizer / checksum field search limits (reference: src/erlamsa.hrl:57-58).
+SIZER_MAX_FIRST_BYTES = 512
+PREAMBLE_MAX_BYTES = 32
+
+# Service-side timeouts, in seconds (reference: src/erlamsa_cmdparse.erl:109-111,
+# src/erlamsa_fsupervisor.erl:83-86).
+DEFAULT_MAX_RUNNING_TIME = 30.0
+FAAS_REQUEST_TIMEOUT = 90.0
+
+# Output failure tolerance (reference: src/erlamsa.hrl:55, src/erlamsa_main.erl:170-175).
+TOO_MANY_FAILED_ATTEMPTS = 10
+
+# Logging payload cap (reference: src/erlamsa.hrl:56).
+MAX_LOG_DATA = 10_000_000
+
+# Distributed nodes keepalive/eviction, seconds (reference: src/erlamsa.hrl:64-66).
+NODE_ALIVE_DELTA = 17.0
+NODE_KEEPALIVE = 15.0
+NODES_CHECKTIMER = 5.0
+
+# Connect-monitor default port, advertised to SSRF/shell-inject payload builders
+# (reference: src/erlamsa_mon_connect.erl:27-29, src/erlamsa_mutations.erl:703).
+DEFAULT_CM_PORT = 51234
+
+# Default TPU batch capacity classes: sample buffers are padded to the
+# smallest class >= seed length * growth slack.  TPU-native choice: lane
+# dimension multiples of 128 keep layouts tight.
+CAPACITY_CLASSES = (256, 1024, 4096, 16384, 65536, 262144, ABSMAX_BINARY_BLOCK)

@@ -1,0 +1,1 @@
+"""Host-side utilities: oracle PRNG, byte helpers."""

@@ -1,0 +1,71 @@
+"""Byte-stream helpers shared by oracle and host shell.
+
+Mirrors erlamsa_utils.erl where behavior matters for parity.
+"""
+
+from __future__ import annotations
+
+from ..constants import AVG_BLOCK_SIZE
+
+
+def binarish(data: bytes) -> bool:
+    """Quick peek whether data looks binary: NUL or high bit in the first 8
+    bytes, except UTF BOMs (reference: src/erlamsa_utils.erl:237-247).
+
+    The reference's BOM clauses are re-tried at every recursion step, so a
+    BOM at any offset < 8 also classifies as text. Note it checks
+    ``<<16#FE, 16#F, ...>>`` (0xFE 0x0F) for the "UTF-16 BOM" — a typo for
+    0xFF, kept for parity.
+    """
+    for i in range(len(data) + 1):
+        rest = data[i:]
+        if rest.startswith(b"\xef\xbb\xbf") or rest.startswith(b"\xfe\x0f"):
+            return False
+        if i >= 8 or not rest:
+            return False
+        b = rest[0]
+        if b == 0 or b & 0x80:
+            return True
+    return False
+
+
+def flush_bvecs(data: bytes, tail: list[bytes]) -> list[bytes]:
+    """Re-split an oversized block into AVG_BLOCK_SIZE chunks ahead of tail
+    (reference: src/erlamsa_utils.erl:168-175)."""
+    out: list[bytes] = []
+    while len(data) >= AVG_BLOCK_SIZE:
+        out.append(data[:AVG_BLOCK_SIZE])
+        data = data[AVG_BLOCK_SIZE:]
+    out.append(data)
+    return out + list(tail)
+
+
+def halve(lst: bytes | list) -> tuple:
+    """Split into two halves; for odd length the SECOND half gets the extra
+    element, i.e. len(a) = floor(n/2), matching list_halves_walk
+    (reference: src/erlamsa_utils.erl:133-146)."""
+    n = len(lst)
+    a = n // 2
+    return lst[:a], lst[a:]
+
+
+def merge(a: bytes | None, b: bytes) -> bytes:
+    if not a:
+        return b
+    return a + b
+
+
+def applynth(i: int, lst: list, fun) -> list:
+    """1-indexed splice: fun(elem, rest) -> new rest of list
+    (reference: src/erlamsa_utils.erl:191-192)."""
+    return lst[: i - 1] + fun(lst[i - 1], lst[i:])
+
+
+def hexstr_to_bin(s: str) -> bytes:
+    if len(s) % 2:
+        s += "0"
+    return bytes.fromhex(s)
+
+
+def bin_to_hexstr(b: bytes) -> str:
+    return b.hex().upper()

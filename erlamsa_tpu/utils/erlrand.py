@@ -1,0 +1,243 @@
+"""Sequential oracle PRNG: Wichmann-Hill AS183 plus the erlamsa helper layer.
+
+The reference seeds Erlang's legacy ``random`` module (AS183) and derives all
+mutation decisions from it (reference: src/erlamsa_rnd.erl:72-78); the byte
+stream of a fixed-seed run is therefore a pure function of this generator.
+The sequential parity path ("oracle") replays that stream exactly; the TPU
+throughput path uses a counter-based PRNG instead (erlamsa_tpu/ops/prng.py).
+
+``ErlRand`` reproduces OTP's ``random`` module semantics:
+
+  seed(A1,A2,A3) clamps each component into [1, prime-1]; ``uniform/0``
+  advances the three Lehmer streams and returns the fractional part of the
+  combined sum; ``uniform/1`` is ``trunc(uniform()*N)+1``.
+
+The helper methods mirror erlamsa_rnd one-for-one, including its quirks
+(e.g. ``rand_occurs_fixed(1, D)`` fires with probability (D-1)/D, reference:
+src/erlamsa_rnd.erl:122-130; ``random_numbers`` returns generation order
+reversed, reference: src/erlamsa_rnd.erl:177-183).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Callable, Sequence
+
+_P1, _P2, _P3 = 30269, 30307, 30323
+SEED0 = (3172, 9814, 20125)
+
+# erlamsa_rnd.erl:46-47
+_P_WEAKLY_USUALLY_NOM = 11
+_P_WEAKLY_USUALLY_DENOM = 20
+
+from ..constants import ABSMAXHALF_BINARY_BLOCK
+
+
+class ErlRand:
+    """Stateful AS183 stream with the erlamsa_rnd helper API."""
+
+    __slots__ = ("a1", "a2", "a3")
+
+    def __init__(self, seed: tuple[int, int, int] | None = None):
+        if seed is None:
+            self.a1, self.a2, self.a3 = SEED0
+        else:
+            self.seed(seed)
+
+    # --- OTP `random` module core -------------------------------------
+
+    def seed(self, seed: tuple[int, int, int]) -> None:
+        a1, a2, a3 = seed
+        self.a1 = (abs(a1) % (_P1 - 1)) + 1
+        self.a2 = (abs(a2) % (_P2 - 1)) + 1
+        self.a3 = (abs(a3) % (_P3 - 1)) + 1
+
+    def getstate(self) -> tuple[int, int, int]:
+        return (self.a1, self.a2, self.a3)
+
+    def setstate(self, st: tuple[int, int, int]) -> None:
+        self.a1, self.a2, self.a3 = st
+
+    def uniform(self) -> float:
+        """random:uniform/0 — float in [0.0, 1.0)."""
+        self.a1 = (self.a1 * 171) % _P1
+        self.a2 = (self.a2 * 172) % _P2
+        self.a3 = (self.a3 * 170) % _P3
+        r = self.a1 / _P1 + self.a2 / _P2 + self.a3 / _P3
+        return r - math.floor(r)
+
+    def uniform_n(self, n: int) -> int:
+        """random:uniform/1 — integer in [1, N]."""
+        return int(self.uniform() * n) + 1
+
+    # --- erlamsa_rnd helpers ------------------------------------------
+
+    def rand(self, n: int) -> int:
+        """Uniform in [0, N) (erlamsa_rnd.erl:76-78)."""
+        if n == 0:
+            return 0
+        return self.uniform_n(n) - 1
+
+    def erand(self, n: int) -> int:
+        """Uniform in [1, N] (erlamsa_rnd.erl:81-83)."""
+        if n == 0:
+            return 0
+        return self.uniform_n(n)
+
+    def rand_range(self, l: int, r: int) -> int:
+        """Uniform in [L, R) (erlamsa_rnd.erl:86-92)."""
+        if r > l:
+            return self.rand(r - l) + l
+        if r == l:
+            return l
+        return 0
+
+    def rand_span(self, l: int, r: int) -> int:
+        return self.rand_range(l, r + 1)
+
+    def rand_float(self) -> float:
+        return self.uniform()
+
+    def rand_bit(self) -> int:
+        # round/1 rounds half away from zero; uniform() < 0.5 -> 0.
+        return 1 if self.uniform() >= 0.5 else 0
+
+    def rand_occurs_fixed(self, nom: int, denom: int) -> bool:
+        """Nom/Denom occurrence check with the nom==1 quirk
+        (erlamsa_rnd.erl:122-130)."""
+        n = self.rand(denom)
+        if nom == 1:
+            return n != 0
+        return n < nom
+
+    def rand_occurs(self, prob: Any) -> bool:
+        if isinstance(prob, tuple):
+            nom, denom = prob
+            return self.rand_occurs_fixed(nom, denom)
+        if isinstance(prob, float):
+            pre_nom = math.trunc(prob * 100)
+            g = math.gcd(pre_nom, 100)
+            if g == 0:
+                return False
+            return self.rand_occurs_fixed(pre_nom // g, 100 // g)
+        return False
+
+    def rand_nbit(self, n: int) -> int:
+        """Random exactly-n-bit number (erlamsa_rnd.erl:133-137)."""
+        if n == 0:
+            return 0
+        hi = 1 << (n - 1)
+        return hi | self.rand(hi)
+
+    def rand_log(self, n: int) -> int:
+        """2^rand(n)-scale number (erlamsa_rnd.erl:140-143)."""
+        if n == 0:
+            return 0
+        return self.rand_nbit(self.rand(n))
+
+    def rand_elem(self, lst: Sequence) -> Any:
+        """Random element; [] -> [] (erlamsa_rnd.erl:147-151)."""
+        if not lst:
+            return []
+        return lst[self.uniform_n(len(lst)) - 1]
+
+    def random_block(self, n: int) -> bytes:
+        """N random bytes. The reference builds the list back-to-front
+        (erlamsa_rnd.erl:172-174): the LAST byte is drawn first."""
+        out = bytearray(n)
+        for i in range(n - 1, -1, -1):
+            out[i] = self.rand(256)
+        return bytes(out)
+
+    def fast_pseudorandom_block(self, n: int) -> bytes:
+        """>=500KB blocks are mostly constant padding (erlamsa_rnd.erl:154-160).
+
+        The reference writes ``<<42:(N-500000)>>`` — an (N-500000)-BIT zero
+        field ending in 42 — ahead of 500000 random bytes; we keep the
+        observable "zeros then 42 then random" shape, byte-aligned.
+        """
+        if n < ABSMAXHALF_BINARY_BLOCK:
+            return self.random_block(n)
+        blk = self.random_block(ABSMAXHALF_BINARY_BLOCK)
+        pad_bits = n - ABSMAXHALF_BINARY_BLOCK
+        pad_bytes = pad_bits // 8
+        if pad_bytes <= 0:
+            return blk
+        return b"\x00" * (pad_bytes - 1) + b"\x2a" + blk
+
+    def random_bitstring(self, bits: int) -> int:
+        return self.rand_range(0, round(math.pow(2, bits)))
+
+    def random_numbers(self, bound: int, cnt: int) -> list[int]:
+        """cnt draws of rand(bound), list in REVERSE generation order
+        (erlamsa_rnd.erl:177-183)."""
+        acc = [self.rand(bound) for _ in range(cnt)]
+        return acc[::-1]
+
+    def random_permutation(self, lst: list) -> list:
+        """Key-sort shuffle; forced coin-flip swap for 2 elements
+        (erlamsa_rnd.erl:189-196)."""
+        if len(lst) == 2:
+            if self.rand(2) == 1:
+                return [lst[1], lst[0]]
+            return list(lst)
+        keyed = [(self.uniform(), x) for x in lst]
+        keyed.sort(key=lambda p: p[0])
+        return [x for _, x in keyed]
+
+    def reservoir_sample(self, ll: list, k: int) -> list:
+        """Classic reservoir sampling (erlamsa_rnd.erl:200-214)."""
+        n = len(ll)
+        if k >= n:
+            return list(ll)
+        r = list(ll[:k])
+        for i in range(k + 1, n + 1):
+            j = self.erand(i)
+            if j <= k:
+                r[j - 1] = ll[i - 1]
+        return r
+
+    def rand_delta(self) -> int:
+        """+1 / -1 (erlamsa_rnd.erl:223-231)."""
+        return 1 if self.rand_bit() == 0 else -1
+
+    def rand_delta_up(self) -> int:
+        """+1 with slight positive bias (erlamsa_rnd.erl:234-242)."""
+        occ = self.rand_occurs_fixed(_P_WEAKLY_USUALLY_NOM, _P_WEAKLY_USUALLY_DENOM)
+        return 1 if occ else -1
+
+    # --- genfuzz helpers (erlamsa_rnd.erl:248-261) --------------------
+
+    def rbyte(self) -> bytes:
+        return self.random_block(1)
+
+    def rword(self) -> bytes:
+        return self.random_block(2)
+
+    def rdword(self) -> bytes:
+        return self.random_block(4)
+
+    def rddword(self) -> bytes:
+        return self.random_block(8)
+
+    def rand_repeat(self, num: int, fun: Callable[[], Any]) -> list:
+        return [fun() for _ in range(num)]
+
+
+def gen_urandom_seed() -> tuple[int, int, int]:
+    """Entropy-derived seed triple (erlamsa_rnd.erl:50-62)."""
+    import os
+
+    def word() -> int:
+        b = os.urandom(2)
+        return b[1] + (b[0] << 8)
+
+    return (word(), word(), word())
+
+
+def parse_seed(s: str) -> tuple[int, int, int]:
+    """Parse the CLI 'a,b,c' seed form."""
+    parts = [int(x) for x in s.split(",")]
+    if len(parts) != 3:
+        raise ValueError(f"seed must be three comma-separated integers, got {s!r}")
+    return (parts[0], parts[1], parts[2])

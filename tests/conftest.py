@@ -1,0 +1,14 @@
+"""Test configuration: force a virtual 8-device CPU platform.
+
+Multi-chip hardware is not available in CI; sharding tests run over an
+8-device CPU mesh per the build rules. This must run before jax imports.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()

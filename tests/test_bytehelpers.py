@@ -1,0 +1,50 @@
+"""Byte helper semantics vs reference erlamsa_utils.erl."""
+
+from erlamsa_tpu.utils.bytehelpers import (
+    applynth,
+    binarish,
+    flush_bvecs,
+    halve,
+    merge,
+)
+
+
+def test_binarish_basic():
+    assert binarish(b"hello world") is False
+    assert binarish(b"\x00x") is True
+    assert binarish(b"\xffabc") is True
+    assert binarish(b"") is False
+
+
+def test_binarish_first_8_only():
+    # high bit beyond the first 8 bytes is ignored (erlamsa_utils.erl:243)
+    assert binarish(b"12345678\xff") is False
+    assert binarish(b"1234567\xff") is True
+
+
+def test_binarish_bom_any_offset():
+    # BOM clauses re-try at every recursion step (erlamsa_utils.erl:241-242)
+    assert binarish(b"\xef\xbb\xbfbinary\x00") is False
+    assert binarish(b"A\xef\xbb\xbfhello") is False
+    assert binarish(b"x\xfe\x0fabc") is False
+
+
+def test_flush_bvecs():
+    assert flush_bvecs(b"abc", [b"t"]) == [b"abc", b"t"]
+    out = flush_bvecs(b"a" * 5000, [])
+    assert [len(x) for x in out] == [2048, 2048, 904]
+    out = flush_bvecs(b"a" * 2048, [])
+    assert [len(x) for x in out] == [2048, 0]
+
+
+def test_halve():
+    assert halve(b"abc") == (b"a", b"bc")
+    assert halve(b"abcd") == (b"ab", b"cd")
+    assert halve([]) == ([], [])
+
+
+def test_merge_applynth():
+    assert merge(None, b"x") == b"x"
+    assert merge(b"a", b"b") == b"ab"
+    assert applynth(1, [1, 2, 3], lambda e, r: r) == [2, 3]
+    assert applynth(3, [1, 2, 3], lambda e, r: [e, e]) == [1, 2, 3, 3]
