@@ -1,0 +1,14 @@
+"""Device compute path: vmapped uint8 mutation kernels, scheduler, patterns.
+
+Everything here is shape-static and jit/vmap/shard_map-safe. The unit of work
+is one padded sample ``(data: uint8[L], n: int32)``; the pipeline vmaps over
+the batch dimension and pjit-shards it over the device mesh.
+
+x64 is enabled package-wide: the textual-number mutator needs int64 value
+arithmetic (the reference uses bignums, src/erlamsa_mutations.erl:92-112).
+Hot-path kernels pin int32/uint8 dtypes explicitly so index math stays cheap.
+"""
+
+import jax
+
+jax.config.update("jax_enable_x64", True)

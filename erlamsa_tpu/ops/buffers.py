@@ -1,0 +1,71 @@
+"""Padded batch buffers: the device-side corpus representation.
+
+A corpus batch lives on device as ``data: uint8[B, L]`` plus ``lens:
+int32[B]`` — the TPU-native replacement for the reference's lazy lists of
+variable-sized binaries (src/erlamsa_gen.erl:59-88). L is drawn from
+CAPACITY_CLASSES so XLA compiles one program per class, and mutations that
+grow data get real slack instead of dynamic shapes.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..constants import CAPACITY_CLASSES
+
+
+class Batch(NamedTuple):
+    """A batch of byte samples. NamedTuple => automatically a pytree."""
+
+    data: jax.Array  # uint8[B, L]
+    lens: jax.Array  # int32[B]
+
+    @property
+    def capacity(self) -> int:
+        return self.data.shape[-1]
+
+    @property
+    def batch_size(self) -> int:
+        return self.data.shape[0]
+
+
+def capacity_for(max_len: int, slack: float = 2.0) -> int:
+    """Smallest capacity class holding max_len * slack."""
+    want = max(1, int(max_len * slack))
+    for c in CAPACITY_CLASSES:
+        if c >= want:
+            return c
+    return CAPACITY_CLASSES[-1]
+
+
+def pack(seeds: Sequence[bytes], capacity: int | None = None) -> Batch:
+    """Host -> device: pad/pack a list of byte strings."""
+    if not seeds:
+        raise ValueError("empty corpus")
+    max_len = max(len(s) for s in seeds)
+    cap = capacity or capacity_for(max_len)
+    if max_len > cap:
+        raise ValueError(f"seed of {max_len}B exceeds capacity {cap}")
+    arr = np.zeros((len(seeds), cap), dtype=np.uint8)
+    lens = np.empty(len(seeds), dtype=np.int32)
+    for i, s in enumerate(seeds):
+        arr[i, : len(s)] = np.frombuffer(s, dtype=np.uint8)
+        lens[i] = len(s)
+    return Batch(jnp.asarray(arr), jnp.asarray(lens))
+
+
+def unpack(batch: Batch) -> list[bytes]:
+    """Device -> host: strip padding."""
+    data = np.asarray(batch.data)
+    lens = np.asarray(batch.lens)
+    return [data[i, : lens[i]].tobytes() for i in range(data.shape[0])]
+
+
+def mask_tail(data: jax.Array, n: jax.Array) -> jax.Array:
+    """Zero bytes at and beyond n (keeps padding canonical for comparisons)."""
+    idx = jnp.arange(data.shape[-1], dtype=jnp.int32)
+    return jnp.where(idx < n, data, jnp.uint8(0))

@@ -1,0 +1,110 @@
+"""Single-byte mutator kernels: bd bei bed br bf bi ber.
+
+TPU re-expression of the reference's edit_byte_vector family
+(src/erlamsa_mutations.erl:54-61, 175-223): instead of splitting a binary at
+a random position, every kernel computes a per-output-position source index
+and gathers — one fused vector op over the padded sample, identical cost for
+any position, no dynamic shapes.
+
+Kernel contract (single sample; the pipeline vmaps over the batch):
+
+    kernel(key, data: uint8[L], n: int32) -> (uint8[L], int32 n', int32 delta)
+
+On empty input (n == 0) kernels return the input unchanged with delta -1,
+which makes the scheduler treat them as failed and move on — the batch
+analogue of mux_fuzzers retrying (src/erlamsa_mutations.erl:1267-1280).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from . import prng
+
+
+def _positions(L: int) -> jax.Array:
+    return jnp.arange(L, dtype=jnp.int32)
+
+
+def _guard_empty(data, n, out, n_out, delta):
+    """n == 0 -> unchanged/failed."""
+    empty = n <= 0
+    return (
+        jnp.where(empty, data, out),
+        jnp.where(empty, n, n_out),
+        jnp.where(empty, -1, delta),
+    )
+
+
+def byte_drop(key, data, n):
+    """bd: drop the byte at a random position (erlamsa_mutations.erl:183-185)."""
+    L = data.shape[0]
+    p = prng.rand(prng.sub(key, prng.TAG_POS), n)
+    i = _positions(L)
+    src = jnp.where(i >= p, jnp.minimum(i + 1, L - 1), i)
+    out = data[src]
+    n_out = n - 1
+    out = jnp.where(i < n_out, out, jnp.uint8(0))
+    return _guard_empty(data, n, out, n_out, prng.rand_delta(key))
+
+
+def _edit_at(key, data, n, new_byte_fn):
+    """Replace data[p] with new_byte_fn(old_byte, key)."""
+    p = prng.rand(prng.sub(key, prng.TAG_POS), n)
+    old = data[p]
+    new = new_byte_fn(old, key)
+    out = data.at[p].set(new)
+    return _guard_empty(data, n, out, n, prng.rand_delta(key))
+
+
+def byte_inc(key, data, n):
+    """bei: increment a byte mod 256 (erlamsa_mutations.erl:187-189)."""
+    return _edit_at(key, data, n, lambda b, k: b + jnp.uint8(1))
+
+
+def byte_dec(key, data, n):
+    """bed: decrement a byte mod 256 (erlamsa_mutations.erl:191-193)."""
+    return _edit_at(key, data, n, lambda b, k: b - jnp.uint8(1))
+
+
+def byte_flip(key, data, n):
+    """bf: flip one random bit (erlamsa_mutations.erl:199-207)."""
+
+    def flip(b, k):
+        bit = prng.rand(prng.sub(k, prng.TAG_VAL), 8)
+        return b ^ jnp.left_shift(jnp.uint8(1), bit.astype(jnp.uint8))
+
+    return _edit_at(key, data, n, flip)
+
+
+def byte_random(key, data, n):
+    """ber: replace a byte with a random one (erlamsa_mutations.erl:217-223)."""
+    return _edit_at(
+        key, data, n, lambda b, k: prng.rand_byte(prng.sub(k, prng.TAG_VAL))
+    )
+
+
+def _insert_at(key, data, n, inserted_fn):
+    """Insert inserted_fn(data[p]) before position p; clips at capacity."""
+    L = data.shape[0]
+    p = prng.rand(prng.sub(key, prng.TAG_POS), n)
+    i = _positions(L)
+    src = jnp.where(i > p, i - 1, i)
+    out = data[src]
+    out = jnp.where(i == p, inserted_fn(data[p], key), out)
+    n_out = jnp.minimum(n + 1, L)
+    out = jnp.where(i < n_out, out, jnp.uint8(0))
+    return _guard_empty(data, n, out, n_out, prng.rand_delta(key))
+
+
+def byte_insert(key, data, n):
+    """bi: insert a random byte (erlamsa_mutations.erl:209-215)."""
+    return _insert_at(
+        key, data, n, lambda b, k: prng.rand_byte(prng.sub(k, prng.TAG_VAL))
+    )
+
+
+def byte_repeat(key, data, n):
+    """br: duplicate the byte at a random position (erlamsa_mutations.erl:195-197)."""
+    return _insert_at(key, data, n, lambda b, k: b)

@@ -1,0 +1,189 @@
+"""Line mutator kernels: ld lds lr2 lri lr ls lp lis lrs.
+
+Reference: split on '\\n' keeping terminators, apply a generic list op, and
+re-join (src/erlamsa_mutations.erl:320-378 + src/erlamsa_generic.erl:52-162).
+
+TPU re-expression: lines become *segments* described by start/length arrays
+computed with one cumulative-sum pass; every list op is expressed as an
+``out_src`` mapping (output line j <- source line out_src[j]); rendering is
+a single searchsorted + gather over the byte buffer. No per-line Python, no
+ragged shapes.
+
+The stateful variants lis/lrs keep the reference's 10-slot reservoir idea
+but draw donor lines from the *current* sample rather than a cross-case
+reservoir (src/erlamsa_generic.erl:118-162) — a per-batch design choice
+documented as a divergence; the oracle implements the sequential reservoir.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from . import prng
+from .byte_mutators import _guard_empty, _positions
+from .num_mutators import _device_binarish
+
+# extra line slots to absorb list_repeat growth (N <= 2^10)
+_EXTRA_LINES = 1024
+
+
+def _line_table(data, n):
+    """starts/lens/count of '\\n'-terminated segments."""
+    L = data.shape[0]
+    i = _positions(L)
+    valid = i < n
+    is_nl = (data == 10) & valid
+    start_mask = valid & ((i == 0) | jnp.concatenate([jnp.zeros(1, bool), is_nl[:-1]]))
+    nl_count = jnp.sum(start_mask).astype(jnp.int32)
+    # k-th start position: scatter i into slot (rank of start)
+    rank = (jnp.cumsum(start_mask) - 1).astype(jnp.int32)
+    # non-start positions scatter to index L, which mode="drop" discards
+    starts = jnp.zeros(L, jnp.int32).at[jnp.where(start_mask, rank, L)].set(
+        i, mode="drop"
+    )
+    next_start = jnp.concatenate([starts[1:], jnp.zeros(1, jnp.int32)])
+    k = _positions(L)
+    lens = jnp.where(
+        k < nl_count - 1, next_start - starts, jnp.where(k == nl_count - 1, n - starts, 0)
+    )
+    return starts, lens.astype(jnp.int32), nl_count
+
+
+def _render(data, n, starts, lens, out_src, nl_out):
+    """Concatenate lines out_src[0..nl_out) into a fresh byte buffer."""
+    L = data.shape[0]
+    NL = out_src.shape[0]
+    j = jnp.arange(NL, dtype=jnp.int32)
+    src = jnp.clip(out_src, 0, L - 1)
+    out_lens = jnp.where(j < nl_out, lens[src], 0)
+    cum = jnp.cumsum(out_lens).astype(jnp.int32)  # cum[j] = bytes after line j
+    total = jnp.where(nl_out > 0, cum[jnp.clip(nl_out - 1, 0, NL - 1)], 0)
+    i = _positions(L)
+    line_of = jnp.searchsorted(cum, i, side="right").astype(jnp.int32)
+    line_of = jnp.clip(line_of, 0, NL - 1)
+    prev_cum = jnp.where(line_of > 0, cum[jnp.clip(line_of - 1, 0, NL - 1)], 0)
+    byte_src = starts[jnp.clip(out_src[line_of], 0, L - 1)] + (i - prev_cum)
+    out = data[jnp.clip(byte_src, 0, L - 1)]
+    n_out = jnp.minimum(total, L)
+    out = jnp.where(i < n_out, out, jnp.uint8(0))
+    return out, n_out
+
+
+def _line_kernel(make_out_src, key, data, n):
+    L = data.shape[0]
+    starts, lens, nl = _line_table(data, n)
+    out_src, nl_out = make_out_src(key, nl, L + _EXTRA_LINES)
+    out, n_out = _render(data, n, starts, lens, out_src, nl_out)
+    ok = (nl > 0) & ~_device_binarish(data, n)
+    out = jnp.where(ok, out, data)
+    n_out = jnp.where(ok, n_out, n)
+    delta = jnp.where(ok, 1, -1).astype(jnp.int32)
+    return _guard_empty(data, n, out, n_out, delta)
+
+
+def _identity_src(NL):
+    return jnp.arange(NL, dtype=jnp.int32)
+
+
+def _src_line_del(key, nl, NL):
+    """ld (erlamsa_generic.erl:52-57)."""
+    p = prng.erand(prng.sub(key, prng.TAG_POS), nl) - 1
+    j = _identity_src(NL)
+    return j + (j >= p), jnp.maximum(nl - 1, 0)
+
+
+def _src_line_del_seq(key, nl, NL):
+    """lds (erlamsa_generic.erl:59-66): delete cnt lines from 1-based start."""
+    start = prng.erand(prng.sub(key, prng.TAG_POS), nl)
+    cnt = prng.erand(prng.sub(key, prng.TAG_LEN), nl - start + 1)
+    d0 = start - 1
+    j = _identity_src(NL)
+    return j + jnp.where(j >= d0, cnt, 0), jnp.maximum(nl - cnt, 0)
+
+
+def _src_line_dup(key, nl, NL):
+    """lr2 (erlamsa_generic.erl:68-73)."""
+    p = prng.erand(prng.sub(key, prng.TAG_POS), nl) - 1
+    j = _identity_src(NL)
+    return jnp.where(j <= p, j, jnp.where(j == p + 1, p, j - 1)), nl + 1
+
+
+def _src_line_clone(key, nl, NL):
+    """lri (erlamsa_generic.erl:84-91): OVERWRITE line To with a copy of
+    line From (applynth drops the element at To), line count unchanged."""
+    frm = prng.erand(prng.sub(key, prng.TAG_POS), nl) - 1
+    to = prng.erand(prng.sub(key, prng.TAG_VAL), nl) - 1
+    j = _identity_src(NL)
+    return jnp.where(j == to, frm, j), nl
+
+
+def _src_line_repeat(key, nl, NL):
+    """lr (erlamsa_generic.erl:75-82): replace line p with N copies."""
+    p = prng.erand(prng.sub(key, prng.TAG_POS), nl) - 1
+    reps = jnp.maximum(2, prng.rand_log(prng.sub(key, prng.TAG_VAL), 10))
+    reps = jnp.minimum(reps, _EXTRA_LINES)
+    j = _identity_src(NL)
+    return (
+        jnp.where(j < p, j, jnp.where(j < p + reps, p, j - (reps - 1))),
+        nl + reps - 1,
+    )
+
+
+def _src_line_swap(key, nl, NL):
+    """ls (erlamsa_generic.erl:93-99): swap adjacent lines p, p+1."""
+    p = prng.erand(prng.sub(key, prng.TAG_POS), jnp.maximum(nl - 1, 0)) - 1
+    j = _identity_src(NL)
+    swapped = jnp.where(j == p, p + 1, jnp.where(j == p + 1, p, j))
+    return jnp.where(nl < 2, j, swapped), nl
+
+
+def _src_line_perm(key, nl, NL):
+    """lp (erlamsa_generic.erl:101-116): permute a run of N lines from From."""
+    frm = prng.erand(prng.sub(key, prng.TAG_POS), jnp.maximum(nl - 1, 0)) - 1
+    # reference: A = rand_range(2, Len - From) with 1-based From, i.e.
+    # nl - frm - 1 for 0-based frm
+    a = prng.rand_range(
+        prng.sub(key, prng.TAG_LEN), 2, jnp.maximum(nl - frm - 1, 2)
+    )
+    b = prng.rand_log(prng.sub(key, prng.TAG_VAL), 10)
+    cnt = jnp.maximum(2, jnp.minimum(a, b))
+    j = _identity_src(NL)
+    in_run = (j >= frm) & (j < frm + cnt) & (j < nl)
+    u = prng.uniform_f32(prng.sub(key, prng.TAG_PERM), (NL,))
+    sortkey = jnp.where(in_run, u, 2.0 + j.astype(jnp.float32))
+    order = jnp.argsort(sortkey).astype(jnp.int32)
+    src = jnp.where(in_run, order[jnp.clip(j - frm, 0, NL - 1)], j)
+    return jnp.where(nl < 3, j, src), nl
+
+
+def _src_line_ins(key, nl, NL):
+    """lis: insert a donor line at a random position (per-sample donor)."""
+    donor = prng.erand(prng.sub(key, prng.TAG_AUX), nl) - 1
+    to = prng.erand(prng.sub(key, prng.TAG_POS), nl) - 1
+    j = _identity_src(NL)
+    return (
+        jnp.where(j < to, j, jnp.where(j == to, donor, j - 1)),
+        nl + 1,
+    )
+
+
+def _src_line_replace(key, nl, NL):
+    """lrs: overwrite a random line with a donor line (per-sample donor)."""
+    donor = prng.erand(prng.sub(key, prng.TAG_AUX), nl) - 1
+    to = prng.erand(prng.sub(key, prng.TAG_POS), nl) - 1
+    j = _identity_src(NL)
+    return jnp.where(j == to, donor, j), nl
+
+
+line_del = partial(_line_kernel, _src_line_del)
+line_del_seq = partial(_line_kernel, _src_line_del_seq)
+line_dup = partial(_line_kernel, _src_line_dup)
+line_clone = partial(_line_kernel, _src_line_clone)
+line_repeat = partial(_line_kernel, _src_line_repeat)
+line_swap = partial(_line_kernel, _src_line_swap)
+line_perm = partial(_line_kernel, _src_line_perm)
+line_ins = partial(_line_kernel, _src_line_ins)
+line_replace = partial(_line_kernel, _src_line_replace)

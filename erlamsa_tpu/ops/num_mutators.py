@@ -1,0 +1,197 @@
+"""Textual-number mutator kernel (num).
+
+Reference: sed_num walks the bytes, collects ASCII integer runs (optionally
+'-'-signed), mutates one uniformly-chosen run with 12 strategies including
+"interesting numbers" 2^k±1, and splices the decimal rendering back
+(src/erlamsa_mutations.erl:63-169).
+
+TPU re-expression: digit-run detection is a couple of shifted compares plus
+a cumulative sum (one VPU pass), run selection is a masked argmax, value
+parse/render are short fori_loops over at most 18/20 digit slots, and the
+splice is the shared masked gather. No scanning loop over the buffer.
+
+Documented divergences from the oracle (erlamsa_tpu/oracle): values are
+int64-clamped (reference: bignum), runs longer than 18 digits parse their
+first 18 digits, and a lone '-' chain collapses to one sign.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import prng
+from .byte_mutators import _guard_empty, _positions
+from .utf8_mutators import splice
+
+_MAX_PARSE_DIGITS = 18
+_SCRATCH = 24  # renders up to 20 chars (sign + 19 digits)
+
+# Python ints / numpy here on purpose: module import must not touch the JAX
+# backend (conversion happens at trace time inside the kernels).
+INT64_MAX = 2**63 - 1
+
+
+def _interesting_numbers() -> "np.ndarray":
+    """2^k-1, 2^k, 2^k+1 for k in the reference list, int64-clamped
+    (erlamsa_mutations.erl:67-75)."""
+    vals = []
+    for k in [1, 7, 8, 15, 16, 31, 32, 63, 64, 127, 128]:
+        x = 1 << k
+        for v in (x - 1, x, x + 1):
+            vals.append(min(v, INT64_MAX))
+    return np.asarray(vals, np.int64)
+
+
+_INTERESTING_NP = _interesting_numbers()
+
+
+def _rand_log_i64(key, n) -> jax.Array:
+    """rand_log with the result clamped into int64 (reference draws up to
+    2^127 bignums; we cap the bit width at 62)."""
+    bits = prng.rand(prng.sub(key, 1), n)
+    bits = jnp.minimum(bits, 62)
+    hi = jnp.left_shift(jnp.int64(1), jnp.maximum(bits - 1, 0).astype(jnp.int64))
+    lo_bits = jax.random.randint(
+        prng.sub(key, 2), (), 0, jnp.maximum(hi, 1), dtype=jnp.int64
+    )
+    return jnp.where(bits <= 0, jnp.int64(0), hi | lo_bits)
+
+
+def _mutate_num(key, v: jax.Array) -> jax.Array:
+    """The 12 strategies of mutate_num (erlamsa_mutations.erl:95-112).
+    Strategy ids 6 and 11 both take the +/- rand_log(rand_range(1,129))
+    catch-all, as in the reference's clause order."""
+    t = prng.rand(prng.sub(key, prng.TAG_VAL), 12)
+    ki = prng.sub(key, prng.TAG_AUX)
+    interesting_tbl = jnp.asarray(_INTERESTING_NP)
+    interesting = interesting_tbl[
+        prng.rand(prng.sub(ki, 1), interesting_tbl.shape[0])
+    ]
+    interesting2 = interesting_tbl[
+        prng.rand(prng.sub(ki, 2), interesting_tbl.shape[0])
+    ]
+    absv2 = jnp.minimum(jnp.abs(v), INT64_MAX // 2) * 2
+    rnd_abs = jax.random.randint(
+        prng.sub(ki, 3), (), 0, jnp.maximum(absv2, 1), dtype=jnp.int64
+    )
+    sign = jnp.where(v >= 0, jnp.int64(1), jnp.int64(-1))
+    n129 = prng.rand_range(prng.sub(ki, 4), 1, 129)
+    lg = _rand_log_i64(prng.sub(ki, 5), n129)
+    s3 = prng.rand(prng.sub(ki, 6), 3)
+    catch_all = jnp.where(s3 == 0, v - lg, v + lg)
+
+    return jnp.select(
+        [t == 0, t == 1, t == 2, t == 3, (t == 4) | (t == 5),
+         t == 7, t == 8, t == 9, t == 10],
+        [v + 1, v - 1, jnp.int64(0), jnp.int64(1), interesting,
+         v + interesting2, v - interesting2, v - rnd_abs * sign, -v],
+        catch_all,
+    )
+
+
+def _render_decimal(v: jax.Array):
+    """int64 -> ASCII scratch row [SCRATCH] + length."""
+    neg = v < 0
+    mag = jnp.where(neg, -jnp.maximum(v, -INT64_MAX), v).astype(jnp.int64)
+
+    def digit_body(k, carry):
+        mag_k, digits = carry
+        digits = digits.at[k].set((mag_k % 10).astype(jnp.uint8) + jnp.uint8(48))
+        return mag_k // 10, digits
+
+    mag_end, rev_digits = jax.lax.fori_loop(
+        0, 20, digit_body, (mag, jnp.zeros(20, jnp.uint8))
+    )
+    ndig = jnp.maximum(
+        20 - jnp.argmax(jnp.flip(rev_digits) != jnp.uint8(48)), 1
+    ).astype(jnp.int32)
+    ndig = jnp.where(mag == 0, 1, ndig)
+    total = ndig + neg.astype(jnp.int32)
+
+    i = jnp.arange(_SCRATCH, dtype=jnp.int32)
+    # scratch[0] = '-' if neg; digits follow most-significant first
+    digit_idx = jnp.clip(ndig - 1 - (i - neg.astype(jnp.int32)), 0, 19)
+    out = jnp.where(
+        (i == 0) & neg, jnp.uint8(45), rev_digits[digit_idx]
+    )
+    out = jnp.where(i < total, out, jnp.uint8(0))
+    return out, total
+
+
+def _device_binarish(data, n):
+    """Device analogue of erlamsa_utils:binarish: NUL or high bit within the
+    first 8 bytes means binary, unless a UTF BOM *starts at or before* the
+    first bad byte — the reference retries its BOM clauses at every scan
+    offset (erlamsa_utils.erl:241-247)."""
+    b = data[:10].astype(jnp.int32)  # 8 scan offsets + 2 lookahead for BOM
+    i = jnp.arange(8, dtype=jnp.int32)
+    valid = i < jnp.minimum(n, 8)
+    bad = ((b[:8] == 0) | (b[:8] >= 128)) & valid
+    bom = (
+        ((b[:8] == 0xEF) & (b[1:9] == 0xBB) & (b[2:10] == 0xBF))
+        | ((b[:8] == 0xFE) & (b[1:9] == 0x0F))
+    ) & valid
+    first_bad = jnp.where(jnp.any(bad), jnp.argmax(bad), 8)
+    first_bom = jnp.where(jnp.any(bom), jnp.argmax(bom), 8)
+    return (first_bad < 8) & (first_bad < first_bom)
+
+
+def sed_num(key, data, n):
+    """num: mutate one textual number (erlamsa_mutations.erl:153-169)."""
+    L = data.shape[0]
+    i = _positions(L)
+    valid = i < n
+    is_digit = (data >= 48) & (data <= 57) & valid
+    prev_digit = jnp.concatenate([jnp.zeros(1, bool), is_digit[:-1]])
+    starts = is_digit & ~prev_digit
+    run_count = jnp.sum(starts).astype(jnp.int32)
+
+    which = prng.rand(prng.sub(key, prng.TAG_POS), run_count)
+    # the reference's leftover-Which indexes numbers from the END
+    target = run_count - 1 - which
+    cs = jnp.cumsum(starts).astype(jnp.int32)
+    a = jnp.argmax(starts & (cs == target + 1)).astype(jnp.int32)
+    # end of run: first non-digit at or after a
+    break_mask = (i >= a) & ~is_digit
+    b_end = jnp.where(jnp.any(break_mask), jnp.argmax(break_mask), n).astype(
+        jnp.int32
+    )
+    # count consecutive '-' immediately before a (reference get_num consumes
+    # leading dashes as sign); i plays the role of distance-1 here
+    is_dash_before = jnp.where(
+        (i < a) & (a - 1 - i >= 0), data[jnp.clip(a - 1 - i, 0, L - 1)] == 45, False
+    )
+    # consecutive prefix of True in is_dash_before ordered by distance
+    dash_count = jnp.argmin(
+        jnp.concatenate([is_dash_before, jnp.zeros(1, bool)])
+    ).astype(jnp.int32)
+    neg = dash_count > 0
+    a_ext = a - dash_count
+
+    # parse value (first _MAX_PARSE_DIGITS digits)
+    def parse_body(k, v):
+        idx = jnp.clip(a + k, 0, L - 1)
+        take = a + k < b_end
+        d = (data[idx] - 48).astype(jnp.int64)
+        return jnp.where(take & (k < _MAX_PARSE_DIGITS), v * 10 + d, v)
+
+    mag = jax.lax.fori_loop(0, _MAX_PARSE_DIGITS, parse_body, jnp.int64(0))
+    value = jnp.where(neg, -mag, mag)
+
+    new_value = _mutate_num(key, value)
+    repl, repl_len = _render_decimal(new_value)
+    out, n_out = splice(data, n, a_ext, repl, repl_len, b_end - a_ext)
+
+    mutated = run_count > 0
+    out = jnp.where(mutated, out, data)
+    n_out = jnp.where(mutated, n_out, n)
+
+    # delta accounting (erlamsa_mutations.erl:158-169)
+    r10 = prng.rand(prng.sub(key, prng.TAG_DELTA), 10)
+    delta_nonum = jnp.where(r10 == 0, -1, 0)
+    isbin = _device_binarish(out, n_out)
+    delta_num = jnp.where(isbin, -1, 2)
+    delta = jnp.where(mutated, delta_num, delta_nonum).astype(jnp.int32)
+    return _guard_empty(data, n, out, n_out, delta)

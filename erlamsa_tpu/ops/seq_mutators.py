@@ -1,0 +1,131 @@
+"""Byte-sequence (span) mutator kernels: sp sr sd snand srnd.
+
+Reference semantics: pick span start S = rand(size), length
+L = 1 + rand(size - S), then permute / repeat / drop / randmask the span
+(src/erlamsa_mutations.erl:230-318). Device re-expression: per-position
+index arithmetic and masked gathers; the permutation uses a keyed argsort
+(random sort keys inside the span, +inf outside) instead of a sequential
+Fisher-Yates.
+
+Divergences from the reference, both documented here on purpose:
+- `sr` repeat growth clips at buffer capacity (the reference grows up to
+  2^10 copies of an arbitrary span; capacity slack absorbs typical cases).
+- `snand`/`srnd` draw their mask op per *sample* rather than once per
+  mutator construction (src/erlamsa_mutations.erl:309-312) — a batch has no
+  single construction event; per-sample keeps batches iid.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from . import prng
+from .byte_mutators import _guard_empty, _positions
+
+
+def _span(key, n):
+    """S = rand(n), L = rand_range(1, n - S + 1) (erlamsa_mutations.erl:238-239)."""
+    s = prng.rand(prng.sub(key, prng.TAG_POS), n)
+    l = prng.rand(prng.sub(key, prng.TAG_LEN), n - s) + 1
+    return s, l
+
+
+def seq_drop(key, data, n):
+    """sd: delete span [S, S+L) (erlamsa_mutations.erl:272-276)."""
+    L = data.shape[0]
+    s, l = _span(key, n)
+    i = _positions(L)
+    src = jnp.where(i >= s, jnp.minimum(i + l, L - 1), i)
+    out = data[src]
+    n_out = n - l
+    out = jnp.where(i < n_out, out, jnp.uint8(0))
+    return _guard_empty(data, n, out, n_out, prng.rand_delta(key))
+
+
+def seq_repeat(key, data, n):
+    """sr: repeat span N = max(2, rand_log(10)) times
+    (erlamsa_mutations.erl:262-270); growth clips at capacity."""
+    L = data.shape[0]
+    s, l = _span(key, n)
+    reps = jnp.maximum(2, prng.rand_log(prng.sub(key, prng.TAG_VAL), 10))
+    i = _positions(L)
+    rep_end = s + reps * l  # may exceed L; clipped by capacity masking below
+    in_rep = (i >= s) & (i < rep_end)
+    src = jnp.where(
+        in_rep,
+        s + jnp.mod(i - s, jnp.maximum(l, 1)),
+        jnp.where(i >= rep_end, i - (reps - 1) * l, i),
+    )
+    src = jnp.clip(src, 0, L - 1)
+    out = data[src]
+    n_out = jnp.minimum(n + (reps - 1) * l, L)
+    out = jnp.where(i < n_out, out, jnp.uint8(0))
+    return _guard_empty(data, n, out, n_out, prng.rand_delta(key))
+
+
+def seq_perm(key, data, n):
+    """sp: permute bytes inside the span (erlamsa_mutations.erl:251-260).
+
+    Keyed argsort: positions in the span get random float keys, positions
+    outside get ordered keys > 1, so argsort yields the span's indices in
+    random order first. Output position s+j then gathers data[order[j]].
+    """
+    L = data.shape[0]
+    s, l = _span(key, n)
+    i = _positions(L)
+    in_span = (i >= s) & (i < s + l)
+    u = prng.uniform_f32(prng.sub(key, prng.TAG_PERM), (L,))
+    sortkey = jnp.where(in_span, u, 2.0 + i.astype(jnp.float32))
+    order = jnp.argsort(sortkey).astype(jnp.int32)  # first l entries = span perm
+    j = jnp.clip(i - s, 0, L - 1)
+    src = jnp.where(in_span, order[j], i)
+    out = data[src]
+    return _guard_empty(data, n, out, n, prng.rand_delta(key))
+
+
+# --- randmask family (erlamsa_mutations.erl:279-318) ----------------------
+
+MASK_NAND, MASK_OR, MASK_XOR, MASK_REPLACE = 0, 1, 2, 3
+
+
+def _randmask(key, data, n, ops):
+    """Apply a random mask op to span bytes with prob erand(100)/100 each
+    (with the nom==1 quirk) (erlamsa_mutations.erl:279-291)."""
+    L = data.shape[0]
+    s, l = _span(key, n)
+    i = _positions(L)
+    in_span = (i >= s) & (i < s + l)
+
+    op = jnp.asarray(ops, jnp.int32)[
+        prng.rand(prng.sub(key, prng.TAG_MASK), len(ops))
+    ]
+    mask_prob = prng.erand(prng.sub(key, prng.TAG_PROB), 100)
+
+    kb = jax.random.split(prng.sub(key, prng.TAG_VAL), 3)
+    # per-byte draws, all shape [L]
+    occurs_n = jax.random.randint(kb[0], (L,), 0, 100, dtype=jnp.int32)
+    occurs = jnp.where(mask_prob == 1, occurs_n != 0, occurs_n < mask_prob)
+    bit = jax.random.randint(kb[1], (L,), 0, 8, dtype=jnp.int32)
+    rnd_byte = jax.random.randint(kb[2], (L,), 0, 256, dtype=jnp.int32).astype(
+        jnp.uint8
+    )
+    one = jnp.left_shift(jnp.uint8(1), bit.astype(jnp.uint8))
+
+    masked = jnp.select(
+        [op == MASK_NAND, op == MASK_OR, op == MASK_XOR],
+        [data & ~one, data | one, data ^ one],
+        rnd_byte,
+    )
+    out = jnp.where(in_span & occurs, masked, data)
+    return _guard_empty(data, n, out, n, prng.rand_delta(key))
+
+
+def seq_randmask_bits(key, data, n):
+    """snand: NAND/OR/XOR random span bytes with single-bit masks."""
+    return _randmask(key, data, n, (MASK_NAND, MASK_OR, MASK_XOR))
+
+
+def seq_randmask_replace(key, data, n):
+    """srnd: replace random span bytes with random values."""
+    return _randmask(key, data, n, (MASK_REPLACE,))

@@ -1,7 +1,15 @@
 """Test configuration: force a virtual 8-device CPU platform.
 
 Multi-chip hardware is not available in CI; sharding tests run over an
-8-device CPU mesh per the build rules. This must run before jax imports.
+8-device CPU mesh per the build rules.
+
+Two layers of defense, because the axon TPU harness (sitecustomize)
+registers its backend in every interpreter and its relay connection can be
+slow or wedged:
+- env vars are set before jax import for fresh interpreters,
+- jax.config.update("jax_platforms", "cpu") after import overrides any
+  platform selection the harness forced, so backends() never initializes
+  the axon client during tests.
 """
 
 import os
@@ -12,3 +20,7 @@ if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

@@ -1,0 +1,23 @@
+"""Shared helper for driving device kernels in tests: compile once per
+kernel (jit caches per wrapper object, so a fresh jax.jit(jax.vmap(k)) per
+call would recompile every time)."""
+
+from functools import cache
+
+import jax
+import numpy as np
+
+from erlamsa_tpu.ops import prng
+from erlamsa_tpu.ops.buffers import Batch, pack, unpack
+
+
+@cache
+def compiled(kernel):
+    return jax.jit(jax.vmap(kernel))
+
+
+def run_kernel(kernel, seeds, seed=7, case=0, capacity=256):
+    batch = pack(seeds, capacity=capacity)
+    keys = prng.sample_keys(prng.case_key(prng.base_key(seed), case), len(seeds))
+    data, lens, delta = compiled(kernel)(keys, batch.data, batch.lens)
+    return unpack(Batch(data, lens)), np.asarray(delta)

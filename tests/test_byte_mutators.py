@@ -1,0 +1,148 @@
+"""Invariant tests for the device byte/seq kernels, mirroring the reference's
+eunit invariants (src/erlamsa_mutations_test.erl:239-277: drop => size-1,
+inc => sum+1 mod 256, etc.), but run batched under jit/vmap."""
+
+import numpy as np
+import pytest
+
+from erlamsa_tpu.ops import byte_mutators as bm
+from erlamsa_tpu.ops import seq_mutators as sm
+
+from kernel_harness import run_kernel
+
+B, L = 64, 256
+
+
+def rand_seeds(rng, count=B, lo=1, hi=200):
+    return [rng.integers(0, 256, rng.integers(lo, hi), dtype=np.uint8).tobytes()
+            for _ in range(count)]
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(1234)
+
+
+def test_byte_drop_size(rng):
+    seeds = rand_seeds(rng)
+    outs, _ = run_kernel(bm.byte_drop, seeds)
+    for s, o in zip(seeds, outs):
+        assert len(o) == len(s) - 1
+
+
+def test_byte_drop_is_subsequence(rng):
+    seeds = rand_seeds(rng, lo=5, hi=50)
+    outs, _ = run_kernel(bm.byte_drop, seeds)
+    for s, o in zip(seeds, outs):
+        # o must be s with exactly one byte removed
+        found = any(s[:i] + s[i + 1 :] == o for i in range(len(s)))
+        assert found
+
+
+def test_byte_inc_dec_sum(rng):
+    seeds = rand_seeds(rng)
+    outs, _ = run_kernel(bm.byte_inc, seeds)
+    for s, o in zip(seeds, outs):
+        assert len(o) == len(s)
+        assert (sum(o) - sum(s)) % 256 == 1
+    outs, _ = run_kernel(bm.byte_dec, seeds)
+    for s, o in zip(seeds, outs):
+        assert (sum(s) - sum(o)) % 256 == 1
+
+
+def test_byte_flip_one_bit(rng):
+    seeds = rand_seeds(rng)
+    outs, _ = run_kernel(bm.byte_flip, seeds)
+    for s, o in zip(seeds, outs):
+        assert len(o) == len(s)
+        diff = [a ^ b for a, b in zip(s, o)]
+        nz = [d for d in diff if d]
+        assert len(nz) == 1 and bin(nz[0]).count("1") == 1
+
+
+def test_byte_insert_size(rng):
+    seeds = rand_seeds(rng)
+    outs, _ = run_kernel(bm.byte_insert, seeds)
+    for s, o in zip(seeds, outs):
+        assert len(o) == len(s) + 1
+        # removing one byte must recover s
+        assert any(o[:i] + o[i + 1 :] == s for i in range(len(o)))
+
+
+def test_byte_repeat_doubles_a_byte(rng):
+    seeds = rand_seeds(rng, lo=2, hi=60)
+    outs, _ = run_kernel(bm.byte_repeat, seeds)
+    for s, o in zip(seeds, outs):
+        assert len(o) == len(s) + 1
+        found = any(
+            s[:i] + s[i : i + 1] + s[i:] == o for i in range(len(s))
+        )
+        assert found
+
+
+def test_byte_random_size_pos(rng):
+    seeds = rand_seeds(rng)
+    outs, _ = run_kernel(bm.byte_random, seeds)
+    for s, o in zip(seeds, outs):
+        assert len(o) == len(s)
+        assert sum(1 for a, b in zip(s, o) if a != b) <= 1
+
+
+def test_empty_input_fails_cleanly():
+    outs, delta = run_kernel(bm.byte_drop, [b"", b"ab"])
+    assert outs[0] == b""
+    assert delta[0] == -1
+    assert len(outs[1]) == 1
+
+
+def test_seq_drop(rng):
+    seeds = rand_seeds(rng, lo=2)
+    outs, _ = run_kernel(sm.seq_drop, seeds)
+    for s, o in zip(seeds, outs):
+        assert 0 <= len(o) < len(s)
+        # o = prefix + suffix of s
+        found = any(
+            s[:i] + s[i + k :] == o
+            for i in range(len(s))
+            for k in range(1, len(s) - i + 1)
+        )
+        assert found
+
+
+def test_seq_repeat_grows(rng):
+    seeds = rand_seeds(rng, lo=2, hi=40)
+    outs, _ = run_kernel(sm.seq_repeat, seeds)
+    for s, o in zip(seeds, outs):
+        assert len(o) > len(s) or len(o) == L  # grew, or clipped at capacity
+        assert len(o) <= L
+
+
+def test_seq_perm_multiset(rng):
+    seeds = rand_seeds(rng, lo=3)
+    outs, _ = run_kernel(sm.seq_perm, seeds)
+    for s, o in zip(seeds, outs):
+        assert len(o) == len(s)
+        assert sorted(s) == sorted(o)
+
+
+def test_seq_randmask_size(rng):
+    seeds = rand_seeds(rng)
+    for kern in (sm.seq_randmask_bits, sm.seq_randmask_replace):
+        outs, _ = run_kernel(kern, seeds)
+        for s, o in zip(seeds, outs):
+            assert len(o) == len(s)
+
+
+def test_determinism_same_key():
+    seeds = [b"deterministic-seed-data" * 3] * 4
+    o1, _ = run_kernel(sm.seq_randmask_bits, seeds, seed=42)
+    o2, _ = run_kernel(sm.seq_randmask_bits, seeds, seed=42)
+    assert o1 == o2
+    o3, _ = run_kernel(sm.seq_randmask_bits, seeds, seed=43)
+    assert o1 != o3
+
+
+def test_distinct_samples_get_distinct_mutations():
+    seeds = [b"x" * 100] * 32
+    outs, _ = run_kernel(bm.byte_flip, seeds, seed=5)
+    assert len(set(outs)) > 4  # flips land at different positions per sample
