@@ -1,0 +1,78 @@
+"""Benchmark: mutated samples/sec on one chip, 4KB seeds.
+
+Prints ONE JSON line {"metric", "value", "unit", "vs_baseline"}.
+
+The reference publishes no numbers (BASELINE.md); vs_baseline is measured
+against the north-star target of 100k mutated 4KB samples/sec (v5e-8), i.e.
+vs_baseline = value / 100_000. Runs on whatever jax.devices() offers (the
+real TPU chip under the driver; CPU as fallback).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+# env-overridable for smoke runs on weak hosts (CPU fallback)
+BATCH = int(os.environ.get("ERLAMSA_BENCH_BATCH", 2048))
+SEED_LEN = int(os.environ.get("ERLAMSA_BENCH_SEED_LEN", 4096))
+CAPACITY = int(os.environ.get("ERLAMSA_BENCH_CAPACITY", 16384))  # 4x growth slack
+WARMUP = 2
+ITERS = int(os.environ.get("ERLAMSA_BENCH_ITERS", 10))
+
+
+def main() -> None:
+    import jax
+
+    from erlamsa_tpu.ops import prng
+    from erlamsa_tpu.ops.buffers import pack
+    from erlamsa_tpu.ops.pipeline import make_fuzzer
+    from erlamsa_tpu.ops.scheduler import init_scores
+
+    rng = np.random.default_rng(42)
+    # realistic 4KB seeds: text/binary mix like an AFL-style corpus
+    seeds = []
+    for i in range(BATCH):
+        if i % 2:
+            seeds.append(rng.integers(0, 256, SEED_LEN, dtype=np.uint8).tobytes())
+        else:
+            line = b"field=%d value=12345 name=test-%d\n" % (i, i)
+            seeds.append((line * (SEED_LEN // len(line) + 1))[:SEED_LEN])
+
+    batch = pack(seeds, capacity=CAPACITY)
+    base = prng.base_key((1, 2, 3))
+    scores = init_scores(jax.random.fold_in(base, 999), BATCH)
+    step, _ = make_fuzzer(CAPACITY, BATCH)
+
+    data, lens = batch.data, batch.lens
+    for case in range(WARMUP):
+        out = step(base, case, data, lens, scores)
+        jax.block_until_ready(out)
+        scores = out[2]
+
+    t0 = time.perf_counter()
+    for case in range(WARMUP, WARMUP + ITERS):
+        out = step(base, case, data, lens, scores)
+        scores = out[2]
+    jax.block_until_ready(out)
+    dt = time.perf_counter() - t0
+
+    samples_per_sec = BATCH * ITERS / dt
+    print(
+        json.dumps(
+            {
+                "metric": "mutated samples/sec/chip (4KB seeds)",
+                "value": round(samples_per_sec, 1),
+                "unit": "samples/sec",
+                "vs_baseline": round(samples_per_sec / 100_000.0, 4),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    sys.exit(main())

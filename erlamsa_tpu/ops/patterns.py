@@ -1,0 +1,91 @@
+"""Device mutation patterns: od nd bu sk nu co.
+
+Reference semantics (src/erlamsa_patterns.erl:299-405): a pattern decides
+how many mutation events hit a sample and where — once (od), a geometric
+chain with 4/5 continue probability (nd), a burst of >=2 (bu), skip a
+random prefix then continue with another pattern (sk), none (nu), or a
+coin flip between nu and od (co).
+
+Device re-expression: a pattern evaluates, per sample, to
+  (rounds, skip): number of scheduler events (<= MAX_BURST_MUTATIONS, the
+  geometric tail truncated — P(chain > 16) ~ 2.8% folds into round 16) and
+  a protected prefix length.
+The pipeline then runs a fori_loop of masked scheduler steps on the
+suffix. The archiver/compressed/sizer/checksum patterns (ar cp sz cs) are
+host-side (erlamsa_tpu/models/, like the reference's zip/zlib paths).
+
+The reference picks the pattern by priority out of {od:1, nd:2, bu:1,
+sk:2, sz:2, cs:1, ar:1, cp:1, co:0, nu:0} (src/erlamsa_patterns.erl:394-405);
+the device table carries od nd bu sk nu co with those weights.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..constants import MAX_BURST_MUTATIONS, REMUTATE_PROBABILITY
+from . import prng
+
+PATTERNS = ("od", "nd", "bu", "sk", "nu", "co")
+DEFAULT_PATTERN_PRI_NP = np.asarray([1, 2, 1, 2, 0, 0], np.int32)
+NUM_PATTERNS = len(PATTERNS)
+
+_OD, _ND, _BU, _SK, _NU, _CO = range(NUM_PATTERNS)
+
+
+def _geometric_rounds(key, base):
+    """base unconditional rounds + a Geom(4/5) tail, truncated at
+    MAX_BURST_MUTATIONS. nd = 1 + tail (pat_many_dec_cont,
+    src/erlamsa_patterns.erl:314-326); bu = 2 + tail (pat_burst_cont forces
+    one continue via N<2, src/erlamsa_patterns.erl:330-344)."""
+    nom, denom = REMUTATE_PROBABILITY
+    ks = jax.random.split(key, MAX_BURST_MUTATIONS - 1)
+    occurs = jax.vmap(lambda k: prng.rand_occurs_fixed(k, nom, denom))(ks)
+    run = jnp.where(
+        jnp.all(occurs), MAX_BURST_MUTATIONS - 1, jnp.argmin(occurs)
+    ).astype(jnp.int32)
+    return jnp.minimum(base + run, MAX_BURST_MUTATIONS)
+
+
+def choose_pattern(key, pat_pri):
+    """Priority-weighted pattern choice (mux_patterns,
+    src/erlamsa_patterns.erl:437-443): pick index by cumulative priority."""
+    total = jnp.sum(pat_pri)
+    r = prng.rand(prng.sub(key, prng.TAG_POS), total)
+    cum = jnp.cumsum(pat_pri)
+    return jnp.argmax(r < cum).astype(jnp.int32)
+
+
+def pattern_plan(key, n, pat_pri):
+    """Per-sample plan: (pattern_id, rounds, skip_prefix_len)."""
+    pat = choose_pattern(key, pat_pri)
+    kg = prng.sub(key, prng.TAG_ROUNDS)
+
+    nd_rounds = _geometric_rounds(prng.sub(kg, _ND), 1)
+    bu_rounds = _geometric_rounds(prng.sub(kg, _BU), 2)  # 2 + tail
+    co_is_muta = prng.erand(prng.sub(kg, _CO), 2) != 1  # 1 -> nomuta
+
+    # sk: random prefix protected, then an od/nd/bu continuation
+    # (make_pat_skip draws a random continuation pattern,
+    # src/erlamsa_patterns.erl:352-361; device set restricts to od/nd/bu)
+    skip = prng.rand(prng.sub(kg, _SK), jnp.maximum(n // 2, 1))
+    cont = prng.rand(prng.sub(kg, _SK + 16), 3)  # 0 od, 1 nd, 2 bu
+    sk_rounds = jnp.select(
+        [cont == 0, cont == 1], [jnp.int32(1), nd_rounds], bu_rounds
+    )
+
+    rounds = jnp.select(
+        [pat == _OD, pat == _ND, pat == _BU, pat == _SK, pat == _NU],
+        [
+            jnp.int32(1),
+            nd_rounds,
+            bu_rounds,
+            sk_rounds,
+            jnp.int32(0),
+        ],
+        jnp.where(co_is_muta, 1, 0),
+    )
+    skip = jnp.where(pat == _SK, skip, 0)
+    return pat, rounds, skip

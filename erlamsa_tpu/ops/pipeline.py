@@ -1,0 +1,136 @@
+"""fuzz_batch: the end-to-end jittable mutation step.
+
+This is the device program that replaces the reference's per-case process
+(seed -> generator blocks -> pattern -> mux_fuzzers -> mutated bytes,
+src/erlamsa_main.erl:180-221): one call mutates a whole [B, L] corpus batch.
+
+Per sample: derive a counter key, draw a pattern plan (how many mutation
+events, protected prefix), then run a masked fori_loop of scheduler steps.
+The skip pattern is handled by shifting the suffix to offset 0 before the
+rounds and splicing the protected prefix back afterwards — kernels never
+need to know about offsets.
+
+Sharding: the batch dimension is fully data-parallel; see
+erlamsa_tpu/parallel/mesh.py for pjit/shard_map placement.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..constants import MAX_BURST_MUTATIONS
+from . import prng
+from .patterns import DEFAULT_PATTERN_PRI_NP, pattern_plan
+from .registry import DEFAULT_DEVICE_PRI, NUM_DEVICE_MUTATORS
+from .scheduler import init_scores, mutate_step
+
+
+class FuzzMeta(NamedTuple):
+    """Per-sample decision record (the reference's meta_list analogue,
+    src/erlamsa.hrl:120-122): which pattern ran and which mutators applied
+    per round (-1 = inactive round / nothing applicable)."""
+
+    pattern: jax.Array  # int32[B]
+    applied: jax.Array  # int32[B, MAX_BURST_MUTATIONS]
+
+
+def _shift_left(data, n, s):
+    """Drop the first s bytes (suffix to offset 0)."""
+    L = data.shape[0]
+    i = jnp.arange(L, dtype=jnp.int32)
+    out = data[jnp.clip(i + s, 0, L - 1)]
+    n_out = jnp.maximum(n - s, 0)
+    return jnp.where(i < n_out, out, jnp.uint8(0)), n_out
+
+
+def _splice_prefix(orig, mutated, s, n_mut):
+    """Reassemble: first s original bytes, then the mutated suffix."""
+    L = orig.shape[0]
+    i = jnp.arange(L, dtype=jnp.int32)
+    out = jnp.where(i < s, orig, mutated[jnp.clip(i - s, 0, L - 1)])
+    n_out = jnp.minimum(s + n_mut, L)
+    return jnp.where(i < n_out, out, jnp.uint8(0)), n_out
+
+
+def fuzz_sample(key, data, n, scores, pri, pat_pri):
+    """Mutate one sample end-to-end. vmapped by fuzz_batch."""
+    pat, rounds, skip = pattern_plan(prng.sub(key, prng.TAG_PROB), n, pat_pri)
+
+    work, wn = _shift_left(data, n, skip)
+
+    def body(r, carry):
+        wdata, wlen, sc, log = carry
+        active = r < rounds
+        kr = prng.sub(prng.sub(key, prng.TAG_SITE), r)
+        nd, nn, nsc, applied = mutate_step(kr, wdata, wlen, sc, pri)
+        wdata = jnp.where(active, nd, wdata)
+        wlen = jnp.where(active, nn, wlen)
+        sc = jnp.where(active, nsc, sc)
+        log = log.at[r].set(jnp.where(active, applied, -1))
+        return wdata, wlen, sc, log
+
+    log0 = jnp.full((MAX_BURST_MUTATIONS,), -1, jnp.int32)
+    work, wn, scores, log = jax.lax.fori_loop(
+        0, MAX_BURST_MUTATIONS, body, (work, wn, scores, log0)
+    )
+
+    out, n_out = _splice_prefix(data, work, skip, wn)
+    return out, n_out, scores, pat, log
+
+
+def fuzz_batch(keys, data, lens, scores, pri, pat_pri):
+    """One device call: mutate a [B, L] batch.
+
+    Args:
+      keys: per-sample PRNG keys [B] (prng.sample_keys).
+      data: uint8[B, L]; lens: int32[B].
+      scores: int32[B, M] scheduler state (scheduler.init_scores).
+      pri: int32[M] mutator priorities; pat_pri: int32[P] pattern priorities.
+
+    Returns (data', lens', scores', FuzzMeta).
+    """
+    out, n_out, sc, pat, log = jax.vmap(
+        fuzz_sample, in_axes=(0, 0, 0, 0, None, None)
+    )(keys, data, lens, scores, pri, pat_pri)
+    return out, n_out, sc, FuzzMeta(pat, log)
+
+
+def make_fuzzer(capacity: int, batch: int, mutator_pri=None, pattern_pri=None):
+    """Host convenience: returns (jitted_step, initial_state_fn).
+
+    jitted_step(case_idx, data, lens, scores) -> (data', lens', scores', meta)
+    with keys derived from (base_seed, case_idx, sample_idx) — the resume
+    format is just (seed, case counter), like the reference's
+    last_seed.txt + --skip (SURVEY.md §5.4).
+    """
+    from .patterns import NUM_PATTERNS
+
+    pri = np.asarray(
+        mutator_pri if mutator_pri is not None else DEFAULT_DEVICE_PRI,
+        np.int32,
+    )
+    pat_pri = np.asarray(
+        pattern_pri if pattern_pri is not None else DEFAULT_PATTERN_PRI_NP,
+        np.int32,
+    )
+    if pri.shape != (NUM_DEVICE_MUTATORS,):
+        raise ValueError(f"mutator_pri must have {NUM_DEVICE_MUTATORS} entries")
+    if pat_pri.shape != (NUM_PATTERNS,):
+        raise ValueError(f"pattern_pri must have {NUM_PATTERNS} entries")
+
+    def step(base, case_idx, data, lens, scores):
+        if data.shape != (batch, capacity):
+            raise ValueError(
+                f"batch shape {data.shape} != ({batch}, {capacity})"
+            )
+        ckey = prng.case_key(base, case_idx)
+        keys = prng.sample_keys(ckey, batch)
+        return fuzz_batch(
+            keys, data, lens, scores, jnp.asarray(pri), jnp.asarray(pat_pri)
+        )
+
+    return jax.jit(step), init_scores

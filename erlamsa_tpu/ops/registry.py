@@ -1,0 +1,136 @@
+"""Device mutator registry: codes, kernels, priorities, applicability.
+
+Mirrors the reference's mutations() table (src/erlamsa_mutations.erl:1283-1332)
+for every mutator that runs on device. Structured/format-aware mutators
+(sgm js ab ad tr2 td ts1 ts2 tr ft fn fo len b64 uri zip) run in the host
+engines (erlamsa_tpu/models) and are listed in HOST_CODES so the CLI can
+route between the two sets.
+
+Applicability predicates are the batch analogue of mux_fuzzers' retry loop
+(src/erlamsa_mutations.erl:1267-1280): the reference applies a mutator and
+moves on if the data didn't change; on device we instead precompute, for
+each mutator, whether it *can* change this sample, and the scheduler picks
+the first applicable mutator in weighted order. Each predicate is O(L)
+vector work, evaluated once per sample per round.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, NamedTuple
+
+import jax.numpy as jnp
+
+from . import byte_mutators as bm
+from . import line_mutators as lm
+from . import num_mutators as nm
+from . import seq_mutators as sm
+from . import utf8_mutators as um
+
+
+class DeviceMutator(NamedTuple):
+    code: str  # CLI name, same as the reference's
+    kernel: Callable  # (key, data[L], n) -> (data[L], n, delta)
+    default_pri: int  # reference default priority
+    pred: int  # applicability predicate id (see predicates())
+
+
+# predicate ids
+P_NONEMPTY = 0  # n > 0
+P_PAIR = 1  # n >= 2 (span permute needs 2 bytes to change anything)
+P_HAS_DIGIT = 2  # ASCII digit present
+P_TEXT = 3  # line-based text (not binarish, n > 0)
+P_TEXT_2L = 4  # text with >= 2 lines
+P_TEXT_3L = 5  # text with >= 3 lines
+P_WIDENABLE = 6  # a byte < 0x40 present
+P_NEVER = 7  # never applicable (nil debug mutator)
+
+NUM_PREDS = 8
+
+
+def _nomutation(key, data, n):
+    """nil: passes data through (src/erlamsa_mutations.erl:1103-1105).
+    Never chosen (P_NEVER) — the reference's mux also never commits it
+    because unchanged data reads as a failed try."""
+    return data, n, jnp.int32(-1)
+
+
+# Order is the lax.switch branch index; keep stable.
+DEVICE_MUTATORS: tuple[DeviceMutator, ...] = (
+    DeviceMutator("uw", um.utf8_widen, 1, P_WIDENABLE),
+    DeviceMutator("ui", um.utf8_insert, 2, P_NONEMPTY),
+    DeviceMutator("num", nm.sed_num, 3, P_HAS_DIGIT),
+    DeviceMutator("bd", bm.byte_drop, 1, P_NONEMPTY),
+    DeviceMutator("bei", bm.byte_inc, 1, P_NONEMPTY),
+    DeviceMutator("bed", bm.byte_dec, 1, P_NONEMPTY),
+    DeviceMutator("bf", bm.byte_flip, 1, P_NONEMPTY),
+    DeviceMutator("bi", bm.byte_insert, 1, P_NONEMPTY),
+    DeviceMutator("ber", bm.byte_random, 1, P_NONEMPTY),
+    DeviceMutator("br", bm.byte_repeat, 1, P_NONEMPTY),
+    DeviceMutator("sp", sm.seq_perm, 1, P_PAIR),
+    DeviceMutator("sr", sm.seq_repeat, 1, P_NONEMPTY),
+    DeviceMutator("sd", sm.seq_drop, 1, P_NONEMPTY),
+    DeviceMutator("snand", sm.seq_randmask_bits, 1, P_NONEMPTY),
+    DeviceMutator("srnd", sm.seq_randmask_replace, 1, P_NONEMPTY),
+    DeviceMutator("ld", lm.line_del, 1, P_TEXT),
+    DeviceMutator("lds", lm.line_del_seq, 1, P_TEXT),
+    DeviceMutator("lr2", lm.line_dup, 1, P_TEXT),
+    DeviceMutator("lri", lm.line_clone, 1, P_TEXT),
+    DeviceMutator("lr", lm.line_repeat, 1, P_TEXT),
+    DeviceMutator("ls", lm.line_swap, 1, P_TEXT_2L),
+    DeviceMutator("lp", lm.line_perm, 1, P_TEXT_3L),
+    DeviceMutator("lis", lm.line_ins, 1, P_TEXT),
+    DeviceMutator("lrs", lm.line_replace, 1, P_TEXT),
+    DeviceMutator("nil", _nomutation, 0, P_NEVER),
+)
+
+DEVICE_CODES = tuple(m.code for m in DEVICE_MUTATORS)
+NUM_DEVICE_MUTATORS = len(DEVICE_MUTATORS)
+DEFAULT_DEVICE_PRI = tuple(m.default_pri for m in DEVICE_MUTATORS)
+
+# host-engine mutators with their reference default priorities
+# (src/erlamsa_mutations.erl:1291-1331)
+HOST_CODES: dict[str, int] = {
+    "sgm": 10, "js": 3, "ab": 1, "ad": 1, "tr2": 1, "td": 1, "ts1": 2,
+    "tr": 2, "ts2": 2, "ft": 2, "fn": 1, "fo": 2, "len": 2, "b64": 7,
+    "uri": 1, "zip": 1,
+}
+
+ALL_CODES = DEVICE_CODES + tuple(HOST_CODES)
+
+
+def code_index(code: str) -> int:
+    return DEVICE_CODES.index(code)
+
+
+def predicates(data, n):
+    """bool[NUM_PREDS] applicability table for one sample."""
+    L = data.shape[0]
+    i = jnp.arange(L, dtype=jnp.int32)
+    valid = i < n
+    nonempty = n > 0
+    has_digit = jnp.any((data >= 48) & (data <= 57) & valid)
+    widenable = jnp.any(((data & jnp.uint8(0x3F)) == data) & valid)
+    is_bin = nm._device_binarish(data, n)
+    text = nonempty & ~is_bin
+    nl_count = jnp.sum((data == 10) & valid).astype(jnp.int32)
+    # line count: newline-terminated segments plus an unterminated tail
+    last = data[jnp.clip(n - 1, 0, L - 1)]
+    nlines = nl_count + jnp.where(nonempty & (last != 10), 1, 0)
+    return jnp.stack(
+        [
+            nonempty,
+            n >= 2,
+            has_digit & nonempty,
+            text,
+            text & (nlines >= 2),
+            text & (nlines >= 3),
+            widenable & nonempty,
+            jnp.zeros((), bool),
+        ]
+    )
+
+
+# numpy on purpose: module import must not touch the JAX backend
+import numpy as np
+
+PRED_INDEX_NP = np.asarray([m.pred for m in DEVICE_MUTATORS], np.int32)

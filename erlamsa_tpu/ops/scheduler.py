@@ -1,0 +1,95 @@
+"""Mutator scheduler: the batch re-expression of mux_fuzzers.
+
+Reference semantics (src/erlamsa_mutations.erl:1244-1280): every mutator
+carries a self-adjusting score (2..10) times a user priority; per mutation
+event each mutator draws rand(score*pri), the draws are sorted descending,
+and mutators are tried in that order until one changes the data; every
+tried mutator's score is adjusted by the delta its attempt returned.
+
+Device re-expression, one fused pass per sample (vmapped over the batch):
+
+1. draw the weighted keys for all M mutators at once,
+2. argsort once for the try order,
+3. pick the first *applicable* mutator (predicate table, O(L) vector ops)
+   instead of physically running and re-comparing candidates,
+4. apply exactly one kernel via lax.switch,
+5. adjust scores: every earlier (tried-and-failed) mutator gets -1 — which
+   is precisely the delta our kernels return when inapplicable — and the
+   applied mutator gets its own delta; clamp into [MIN_SCORE, MAX_SCORE].
+
+Score state is per *sample* (int32[M]), initialized like mutators_mutator's
+randomized scores (src/erlamsa_mutations.erl:1385-1395), carried across
+cases by the caller. The reference shares one evolving score vector across
+the whole sequential run; per-sample state keeps batch samples independent
+(documented divergence — parity mode uses the oracle).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..constants import MAX_SCORE, MIN_SCORE
+from . import prng
+from .registry import DEVICE_MUTATORS, NUM_DEVICE_MUTATORS, PRED_INDEX_NP, predicates
+
+_KERNELS = tuple(m.kernel for m in DEVICE_MUTATORS)
+
+
+def init_scores(key: jax.Array, batch: int) -> jax.Array:
+    """Randomized initial scores max(2, rand(10)) per mutator per sample
+    (erlamsa_mutations.erl:1393-1395)."""
+    r = jax.random.randint(
+        key, (batch, NUM_DEVICE_MUTATORS), 0, int(MAX_SCORE), dtype=jnp.int32
+    )
+    return jnp.maximum(r, int(MIN_SCORE))
+
+
+def mutate_step(key, data, n, scores, pri):
+    """One mutation event on one sample.
+
+    Args:
+      key: per-event PRNG key.
+      data: uint8[L]; n: int32 length.
+      scores: int32[M] self-adjusting scores.
+      pri: int32[M] user priorities (0 disables a mutator).
+
+    Returns: (data', n', scores', applied int32) — applied is the registry
+    index, or -1 when nothing was applicable.
+    """
+    M = NUM_DEVICE_MUTATORS
+    preds = predicates(data, n)  # bool[NUM_PREDS]
+    applicable = preds[jnp.asarray(PRED_INDEX_NP)] & (pri > 0)
+
+    # weighted permutation: r_m = rand(score_m * pri_m), sorted desc
+    kweights = jax.random.split(prng.sub(key, prng.TAG_PERM), M)
+    bounds = jnp.maximum(scores * pri, 1)
+    draws = jax.vmap(lambda k, b: jax.random.randint(k, (), 0, b, dtype=jnp.int32))(
+        kweights, bounds
+    )
+    order = jnp.argsort(-draws, stable=True).astype(jnp.int32)
+
+    app_in_order = applicable[order]
+    any_app = jnp.any(app_in_order)
+    pos = jnp.argmax(app_in_order).astype(jnp.int32)  # first applicable
+    applied = order[pos]
+
+    new_data, new_n, delta = jax.lax.switch(
+        applied, _KERNELS, prng.sub(key, prng.TAG_SITE), data, n
+    )
+    new_data = jnp.where(any_app, new_data, data)
+    new_n = jnp.where(any_app, new_n, n)
+
+    # score adjustment for every tried mutator (erlamsa_mutations.erl:1238-1242)
+    pos_of = jnp.argsort(order).astype(jnp.int32)  # inverse permutation
+    tried_before = pos_of < pos
+    deltas = jnp.where(tried_before, -1, 0)
+    deltas = jnp.where(
+        (jnp.arange(M) == applied) & any_app, delta, deltas
+    )
+    new_scores = jnp.clip(
+        scores + deltas, int(MIN_SCORE), int(MAX_SCORE)
+    ).astype(jnp.int32)
+
+    applied_out = jnp.where(any_app, applied, -1).astype(jnp.int32)
+    return new_data, new_n, new_scores, applied_out

@@ -106,17 +106,26 @@ def splice(data, n, pos, repl, repl_len, drop_len):
 
 
 def utf8_widen(key, data, n):
-    """uw: overlong-encode a 6-bit byte at a random position
-    (erlamsa_mutations.erl:1080-1089). Bytes >= 0x40 are left unchanged
-    (the reference's edit fn falls through), still consuming the draw."""
-    p = prng.rand(prng.sub(key, prng.TAG_POS), n)
+    """uw: overlong-encode a 6-bit byte (erlamsa_mutations.erl:1080-1089).
+
+    Device redesign: the reference draws one position and silently no-ops if
+    that byte isn't widenable (falls through to a mux retry); here the
+    position is drawn uniformly among *widenable* bytes via a masked keyed
+    max, so an applicable draw always mutates — one pass, no retry loop.
+    """
+    L = data.shape[0]
+    i = _positions(L)
+    widenable = ((data & jnp.uint8(0x3F)) == data) & (i < n)
+    u = prng.uniform_f32(prng.sub(key, prng.TAG_POS), (L,))
+    p = jnp.argmax(jnp.where(widenable, u, -1.0)).astype(jnp.int32)
     b = data[p]
-    widenable = (b & jnp.uint8(0x3F)) == b
     repl = jnp.stack([jnp.uint8(0xC0), b | jnp.uint8(0x80)])
     out_w, n_w = splice(data, n, p, repl, 2, 1)
     delta = prng.rand_delta(key)
-    out = jnp.where(widenable, out_w, data)
-    n_out = jnp.where(widenable, n_w, n)
+    any_w = jnp.any(widenable)
+    out = jnp.where(any_w, out_w, data)
+    n_out = jnp.where(any_w, n_w, n)
+    delta = jnp.where(any_w, delta, -1)
     return _guard_empty(data, n, out, n_out, delta)
 
 

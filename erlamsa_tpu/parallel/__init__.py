@@ -1,0 +1,3 @@
+"""Device-mesh scaling: batch (data) and length (seq) sharding of the fuzz
+pipeline, replacing the reference's Erlang-distribution worker fan-out
+(SURVEY.md §2.5) with XLA collectives over ICI."""

@@ -1,0 +1,111 @@
+"""Mesh placement for fuzz_batch.
+
+The reference scales by spawning Erlang worker processes per case range
+(src/erlamsa_main.erl:89-108, 249-280) and distributing requests to nodes
+over Erlang distribution (src/erlamsa_app.erl:144-190). The TPU-native
+replacement:
+
+- **data axis (dp):** the corpus batch is embarrassingly parallel; shard B
+  across devices and every kernel runs purely locally — zero collectives in
+  steady state. This is the throughput path.
+- **seq axis (sp):** long-input support. Samples larger than a per-device
+  HBM budget shard their L dimension; XLA inserts the all-gathers the
+  gather/argsort kernels need. For the 4KB-seed regime B-sharding alone is
+  optimal (SURVEY.md §5.7), so seq stays 1 unless buffers are huge.
+
+Multi-host: the same mesh spec spans hosts via jax.distributed; the batch
+axis rides DCN between hosts and ICI within, which is the right layout
+because per-sample work never crosses samples.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..ops import prng
+from ..ops.patterns import DEFAULT_PATTERN_PRI_NP
+from ..ops.pipeline import FuzzMeta, fuzz_batch
+from ..ops.registry import DEFAULT_DEVICE_PRI
+
+
+def make_mesh(devices=None, data: int | None = None, seq: int = 1) -> Mesh:
+    """Build a (data, seq) mesh over the given (or all) devices."""
+    devices = devices if devices is not None else jax.devices()
+    n = len(devices)
+    if data is None:
+        data = n // seq
+    if data * seq != n:
+        raise ValueError(f"mesh {data}x{seq} != {n} devices")
+    arr = np.asarray(devices).reshape(data, seq)
+    return Mesh(arr, ("data", "seq"))
+
+
+def batch_sharding(mesh: Mesh) -> NamedSharding:
+    """[B, L] sharded batch-first; L across seq for long-input mode."""
+    return NamedSharding(mesh, P("data", "seq"))
+
+
+def lens_sharding(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P("data"))
+
+
+def scores_sharding(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P("data", None))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def make_sharded_fuzzer(mesh: Mesh, batch: int, mutator_pri=None, pattern_pri=None):
+    """Jitted multi-device fuzz step: keys/data/lens/scores sharded over the
+    data axis, priorities replicated. Returns step(base, case_idx, data,
+    lens, scores)."""
+    pri = jnp.asarray(
+        np.asarray(
+            mutator_pri if mutator_pri is not None else DEFAULT_DEVICE_PRI,
+            np.int32,
+        )
+    )
+    pat_pri = jnp.asarray(
+        np.asarray(
+            pattern_pri if pattern_pri is not None else DEFAULT_PATTERN_PRI_NP,
+            np.int32,
+        )
+    )
+
+    dsh = batch_sharding(mesh)
+    lsh = lens_sharding(mesh)
+    ssh = scores_sharding(mesh)
+    rep = replicated(mesh)
+
+    def step(base, case_idx, data, lens, scores):
+        ckey = prng.case_key(base, case_idx)
+        keys = prng.sample_keys(ckey, batch)
+        keys = jax.lax.with_sharding_constraint(keys, lsh)
+        data = jax.lax.with_sharding_constraint(data, dsh)
+        out, n_out, sc, meta = fuzz_batch(keys, data, lens, scores, pri, pat_pri)
+        return (
+            jax.lax.with_sharding_constraint(out, dsh),
+            n_out,
+            sc,
+            meta,
+        )
+
+    return jax.jit(
+        step,
+        in_shardings=(rep, None, dsh, lsh, ssh),
+        out_shardings=(dsh, lsh, ssh, FuzzMeta(lsh, ssh)),
+    )
+
+
+def place_batch(mesh: Mesh, data, lens, scores):
+    """Move host arrays onto the mesh with the canonical shardings."""
+    return (
+        jax.device_put(data, batch_sharding(mesh)),
+        jax.device_put(lens, lens_sharding(mesh)),
+        jax.device_put(scores, scores_sharding(mesh)),
+    )

@@ -1,0 +1,121 @@
+"""End-to-end fuzz_batch pipeline tests."""
+
+import jax
+import numpy as np
+import pytest
+
+from erlamsa_tpu.ops import prng
+from erlamsa_tpu.ops.buffers import Batch, pack, unpack
+from erlamsa_tpu.ops.patterns import PATTERNS
+from erlamsa_tpu.ops.pipeline import fuzz_batch, make_fuzzer
+from erlamsa_tpu.ops.registry import DEVICE_CODES
+from erlamsa_tpu.ops.scheduler import init_scores
+
+B, L = 128, 256
+SEEDS = [
+    b"Hello erlamsa! This is sample %d with number 123\n" % (i % 7)
+    for i in range(B)
+]
+
+
+@pytest.fixture(scope="module")
+def step():
+    f, _ = make_fuzzer(L, B)
+    return f
+
+
+@pytest.fixture(scope="module")
+def state():
+    base = prng.base_key((1, 2, 3))
+    scores = init_scores(jax.random.fold_in(base, 999), B)
+    return base, scores
+
+
+def test_fuzz_batch_runs_and_mutates(step, state):
+    base, scores = state
+    batch = pack(SEEDS, capacity=L)
+    data, lens, sc, meta = step(base, 0, batch.data, batch.lens, scores)
+    outs = unpack(Batch(data, lens))
+    changed = sum(1 for s, o in zip(SEEDS, outs) if s != o)
+    # nu/co patterns leave some samples untouched; most must change
+    assert changed > B * 0.5
+    assert meta.pattern.shape == (B,)
+    assert meta.applied.shape[0] == B
+
+
+def test_fuzz_batch_deterministic(step, state):
+    base, scores = state
+    batch = pack(SEEDS, capacity=L)
+    out1 = step(base, 7, batch.data, batch.lens, scores)
+    out2 = step(base, 7, batch.data, batch.lens, scores)
+    for a, b in zip(out1[:3], out2[:3]):
+        assert np.array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_fuzz_batch_cases_differ(step, state):
+    base, scores = state
+    batch = pack(SEEDS, capacity=L)
+    o1 = unpack(Batch(*step(base, 0, batch.data, batch.lens, scores)[:2]))
+    o2 = unpack(Batch(*step(base, 1, batch.data, batch.lens, scores)[:2]))
+    assert o1 != o2
+
+
+def test_scores_evolve_within_bounds(step, state):
+    base, scores = state
+    batch = pack(SEEDS, capacity=L)
+    sc = scores
+    for case in range(3):
+        _, _, sc, _ = step(base, case, batch.data, batch.lens, sc)
+    sc = np.asarray(sc)
+    assert sc.min() >= 2 and sc.max() <= 10
+    assert not np.array_equal(sc, np.asarray(scores))
+
+
+def test_meta_applied_valid_indices(step, state):
+    base, scores = state
+    batch = pack(SEEDS, capacity=L)
+    _, _, _, meta = step(base, 3, batch.data, batch.lens, scores)
+    applied = np.asarray(meta.applied)
+    assert applied.min() >= -1
+    assert applied.max() < len(DEVICE_CODES)
+    # every sample with pattern != nu/co-nomuta applied at least one mutator
+    pat = np.asarray(meta.pattern)
+    for i in range(B):
+        if PATTERNS[pat[i]] in ("od", "nd", "bu"):
+            assert (applied[i] >= 0).any()
+
+
+def test_priority_zero_disables(state):
+    base, scores = state
+    # only bf enabled: every applied mutator must be bf
+    pri = [0] * len(DEVICE_CODES)
+    pri[DEVICE_CODES.index("bf")] = 1
+    f, _ = make_fuzzer(L, B, mutator_pri=pri)
+    batch = pack(SEEDS, capacity=L)
+    _, _, _, meta = f(base, 0, batch.data, batch.lens, scores)
+    applied = np.asarray(meta.applied)
+    bf = DEVICE_CODES.index("bf")
+    assert set(np.unique(applied)) <= {-1, bf}
+
+
+def test_pattern_nu_only_is_identity(state):
+    base, scores = state
+    pat_pri = [0, 0, 0, 0, 1, 0]  # nu only
+    f, _ = make_fuzzer(L, B, pattern_pri=pat_pri)
+    batch = pack(SEEDS, capacity=L)
+    data, lens, _, meta = f(base, 0, batch.data, batch.lens, scores)
+    assert unpack(Batch(data, lens)) == SEEDS
+    assert set(np.unique(np.asarray(meta.applied))) == {-1}
+
+
+def test_skip_pattern_preserves_prefix(state):
+    base, scores = state
+    pat_pri = [0, 0, 0, 1, 0, 0]  # sk only
+    f, _ = make_fuzzer(L, 16, pattern_pri=pat_pri)
+    seeds = [b"A" * 100 for _ in range(16)]
+    batch = pack(seeds, capacity=L)
+    data, lens, _, _ = f(base, 0, batch.data, batch.lens, scores[:16])
+    outs = unpack(Batch(data, lens))
+    # the protected prefix is < n/2, so the first byte is always original
+    for o in outs:
+        assert o[:1] == b"A"
