@@ -1,0 +1,7 @@
+"""Format-aware engines ("model families"): lexer, parse-tree, JSON, SGML,
+fuse, URI, base64, length-field/checksum, ZIP, genfuzz grammar.
+
+These run host-side in both modes (the reference also treats them as the
+structured tail of the mutator distribution, SURVEY.md §7 phase 3); the
+batch path routes samples to them via the hybrid dispatcher.
+"""

@@ -1,0 +1,155 @@
+"""Length-field ("sizer") and checksum-field prediction.
+
+Reference: src/erlamsa_field_predict.erl. Finds plausible u8/u16/u32/u64
+big/little length fields whose value equals the distance to a candidate end
+offset, and xor8/crc32 trailer checksums by brute force over preamble
+offsets. Draw order matters for the sizer scan (it samples random end
+offsets); kept 1:1.
+
+The numpy variants (suffix _np) are the batch path's vectorized versions:
+one pass computes every candidate offset simultaneously instead of the
+reference's O(n*k) rescan.
+"""
+
+from __future__ import annotations
+
+import zlib
+
+import numpy as np
+
+from ..constants import PREAMBLE_MAX_BYTES, SIZER_MAX_FIRST_BYTES
+from ..utils.erlrand import ErlRand
+
+# sizer_location: (size_bits, "big"|"little", length_value, A, B)
+
+
+def _read_uint(data: bytes, off: int, size_bits: int, endian: str) -> int | None:
+    nbytes = size_bits // 8
+    if off + nbytes > len(data):
+        return None
+    chunk = data[off : off + nbytes]
+    return int.from_bytes(chunk, "big" if endian == "big" else "little")
+
+
+def _basic_u8len(a: int, b: int, data: bytes) -> list[tuple]:
+    """u8 length at offset a whose value == b - a - 1 > 2
+    (erlamsa_field_predict.erl:50-58)."""
+    if not (a < b and b > 0 and a < len(data)):
+        return []
+    v = _read_uint(data, a, 8, "big")
+    if v is not None and v == b - a - 1 and v > 2:
+        return [(8, "big", v, a, b)]
+    return []
+
+
+def _simple_u8len(a: int, data: bytes) -> list[tuple]:
+    return [
+        loc
+        for x in range(0, 9)
+        for loc in _basic_u8len(a, len(data) - x, data)
+    ]
+
+
+def _basic_len(a: int, b: int, data: bytes) -> list[tuple]:
+    """u16/u32/u64 BE then LE, first match wins (the reference's binary
+    pattern match tries clauses in order, erlamsa_field_predict.erl:66-78)."""
+    if not (a < b and b > 0 and a < len(data)):
+        return []
+    for size, endian in ((16, "big"), (32, "big"), (64, "big"),
+                         (16, "little"), (32, "little"), (64, "little")):
+        v = _read_uint(data, a, size, endian)
+        if v is not None and v == b - a - size // 8 and v > 2:
+            return [(size, endian, v, a, b)]
+    return []
+
+
+def _simple_len(a: int, b: int, data: bytes) -> list[tuple]:
+    out = []
+    for d in (0, 1, 2, 4, 8):
+        out.extend(_basic_len(a, b - d, data))
+    return out
+
+
+def get_possible_simple_lens(r: ErlRand, data: bytes) -> list[tuple]:
+    """All sizer candidates; for >10B inputs the end offsets are randomly
+    sampled (erlamsa_field_predict.erl:90-105)."""
+    n = len(data)
+    if n > 10:
+        sublen = min(n // 5, SIZER_MAX_FIRST_BYTES)
+        first_seq = list(range(0, sublen + 1))
+        var_b = [r.rand_range(sublen, n) for _ in first_seq]
+        ranges = [(x, y) for x in first_seq for y in var_b]
+        all_ranges = [(a, n) for a in first_seq] + ranges
+        big = []
+        # the reference foldl-prepends per-range results, reversing range order
+        for a, b in all_ranges:
+            big = _simple_len(a, b, data) + big
+        small = [loc for a in first_seq for loc in _simple_u8len(a, data)]
+        return small + big
+    out = []
+    for x in range(0, 4):
+        out.extend(_simple_len(x, n, data))
+        out.extend(_simple_u8len(x, data))
+    return out
+
+
+def extract_blob(data: bytes, loc: tuple) -> tuple[bytes, int, bytes, bytes]:
+    """(head, len_value, blob, rest) around a sizer
+    (erlamsa_field_predict.erl:111-117)."""
+    size, endian, lval, a, _b = loc
+    nb = size // 8
+    head = data[:a]
+    blob = data[a + nb : a + nb + lval]
+    rest = data[a + nb + lval :]
+    return head, lval, blob, rest
+
+
+def rebuild_blob(loc_endian: str, head: bytes, new_len: int, size_bits: int,
+                 blob: bytes, tail: bytes) -> bytes:
+    """head ++ len-field ++ blob ++ tail (erlamsa_field_predict.erl:119-123)."""
+    nb = size_bits // 8
+    field = (new_len % (1 << size_bits)).to_bytes(
+        nb, "big" if loc_endian == "big" else "little"
+    )
+    return head + field + blob + tail
+
+
+# --- checksums ------------------------------------------------------------
+
+
+def calc_xor8(data: bytes) -> int:
+    v = 0
+    for b in data:
+        v ^= b
+    return v
+
+
+def recalc_csum(kind: str, data: bytes) -> int:
+    """(erlamsa_field_predict.erl:163-167)."""
+    if kind == "crc32":
+        return zlib.crc32(data) & 0xFFFFFFFF
+    return calc_xor8(data)
+
+
+def get_possible_csum_locations(data: bytes) -> list[tuple]:
+    """Trailer checksums over preamble offsets: (kind, size_bits,
+    preamble_len, body_len) (erlamsa_field_predict.erl:154-161)."""
+    n = len(data)
+    if n == 0:
+        return []
+    out = []
+    limit = min(2 * n // 3, 30 * PREAMBLE_MAX_BYTES)
+    pre = np.frombuffer(data, dtype=np.uint8)
+    # vectorized xor8: suffix xors via cumulative xor from the right
+    sfx_xor = np.bitwise_xor.accumulate(pre[::-1])[::-1]
+    for a in range(0, limit + 1):
+        if n - a - 1 > 0:
+            body_x = sfx_xor[a] ^ sfx_xor[n - 1]  # xor of data[a:n-1]
+            if body_x == data[n - 1]:
+                out.append(("xor8", 8, a, n - a - 1))
+    for a in range(0, limit + 1):
+        if n - a >= 4:
+            c = int.from_bytes(data[n - 4 :], "big")
+            if zlib.crc32(data[a : n - 4]) & 0xFFFFFFFF == c:
+                out.append(("crc32", 32, a, n - a - 4))
+    return out

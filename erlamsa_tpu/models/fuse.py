@@ -1,0 +1,96 @@
+"""Fuse: jump between shared suffix positions of two byte sequences.
+
+Reference: src/erlamsa_fuse.erl (radamsa's "fuse"). The algorithm walks a
+lazily-built generalized suffix structure: nodes pair source-suffix sets
+with target-suffix sets sharing a prefix; each round either stops (prob
+1/8 or fuel exhausted) and picks a random (from, to) suffix pair, or
+refines every node one shared character deeper.
+
+The oracle keeps the reference's draw order (stop check, then element
+picks) so AS183 streams align. Suffixes are represented as integer offsets
+into the two buffers instead of linked lists — same walk, O(1) memory per
+suffix.
+"""
+
+from __future__ import annotations
+
+from ..utils.erlrand import ErlRand
+
+SEARCH_FUEL = 100_000
+SEARCH_STOP_IP = 8
+
+
+def _char_suffixes(buf: bytes, sufs: list[int]) -> dict[int, list[int]]:
+    """Group suffix offsets by first byte; each advances one position.
+    Empty suffixes (offset == len) are skipped; a bucket holding only one
+    exhausted suffix collapses to [] via the reference's fix_empty_list
+    (erlamsa_fuse.erl:57-70). Buckets build by prepending, so they end up
+    reversed relative to the input walk."""
+    n = len(buf)
+    subs: dict[int, list[int]] = {}
+    for off in sufs:
+        # empty suffixes: offset == n, or the [] marker from a degenerate
+        # node — both hit the reference's ([], Subs) -> Subs skip clause
+        if not isinstance(off, int) or off >= n:
+            continue
+        bucket = [off + 1] + subs.get(buf[off], [])
+        if bucket == [n]:
+            bucket = []  # fix_empty_list([[]]) -> []
+        subs[buf[off]] = bucket
+    return subs
+
+
+def _any_position_pair(r: ErlRand, buf_a: bytes, buf_b: bytes, nodes) -> tuple[int, int]:
+    """Pick a random node, then a random source and target suffix
+    (erlamsa_fuse.erl:72-77). rand_elem([]) yields the empty suffix without
+    a draw (erlamsa_rnd:rand_elem clause for [])."""
+    froms, tos = r.rand_elem(nodes)
+    frm = r.rand_elem(froms) if froms else []
+    to = r.rand_elem(tos) if tos else []
+    frm = frm if isinstance(frm, int) else len(buf_a)
+    to = to if isinstance(to, int) else len(buf_b)
+    return frm, to
+
+
+def find_jump_points(r: ErlRand, a: bytes, b: bytes) -> tuple[int, int]:
+    """Walk shared-prefix refinements until the stop draw fires
+    (erlamsa_fuse.erl:102-128). Returns byte offsets (from_a, to_b)."""
+    # suffixes(X) excludes the empty suffix (erlamsa_fuse.erl:52-55)
+    nodes: list[tuple[list, list]] = [
+        (list(range(len(a))), list(range(len(b))))
+    ]
+    fuel = SEARCH_FUEL
+    while True:
+        if fuel < 0:
+            return _any_position_pair(r, a, b, nodes)
+        if r.rand(SEARCH_STOP_IP) == 0:
+            return _any_position_pair(r, a, b, nodes)
+        refined: list[tuple[list, list]] = []
+        for froms, tos in nodes:
+            sas = _char_suffixes(a, froms)
+            sbs = _char_suffixes(b, tos)
+            # gb_trees:to_list iterates in ascending key order
+            for ch in sorted(sas):
+                asufs = sas[ch]
+                if asufs == []:
+                    # collapsed bucket: the reference pushes a degenerate
+                    # node #([[]], []) unconditionally (erlamsa_fuse.erl:90-92)
+                    refined.insert(0, ([[]], []))
+                    continue
+                bsufs = sbs.get(ch)
+                if bsufs is not None:
+                    refined.insert(0, (asufs, bsufs))
+        if not refined:
+            return _any_position_pair(r, a, b, nodes)
+        nodes = refined
+        fuel -= len(refined)
+
+
+def fuse(r: ErlRand, a: bytes, b: bytes) -> bytes:
+    """a[:from] ++ b[to:] via a shared-prefix jump (erlamsa_fuse.erl:130-135)."""
+    if not a:
+        return b
+    if not b:
+        return a
+    frm, to = find_jump_points(r, a, b)
+    return a[:frm] + b[to:]

@@ -1,0 +1,290 @@
+"""JSON format engine: tolerant parse, AST mutations, fold back to bytes.
+
+Reference: src/erlamsa_json.erl — a hand-written RFC7159-ish tokenizer with
+a context stack, AST walk/select helpers, and mutators: swap two nodes,
+duplicate, pump (nest a node inside itself), repeat an element (<= 100x),
+insert unserialization gadget payloads, and recurse a byte-level mutator
+into string/number leaves (json_mutation, :646-708).
+
+This implementation parses into span-preserving nodes so untouched regions
+fold back byte-identically, which matters because fuzzing targets parse the
+*raw* bytes.
+"""
+
+from __future__ import annotations
+
+from ..utils.erlrand import ErlRand
+
+WS = b" \t\r\n"
+
+
+class JNode:
+    """kind: obj | arr | str | num | lit; children only for obj/arr.
+    raw holds the exact source bytes for leaves (and separators are
+    reconstructed canonically on serialize)."""
+
+    __slots__ = ("kind", "children", "raw", "key")
+
+    def __init__(self, kind, children=None, raw=b"", key=None):
+        self.kind = kind
+        self.children = children if children is not None else []
+        self.raw = raw
+        self.key = key  # raw key bytes for object members
+
+    def clone(self) -> "JNode":
+        return JNode(
+            self.kind,
+            [c.clone() for c in self.children],
+            self.raw,
+            self.key,
+        )
+
+
+class _P:
+    def __init__(self, data: bytes):
+        self.d = data
+        self.i = 0
+
+    def ws(self):
+        while self.i < len(self.d) and self.d[self.i] in WS:
+            self.i += 1
+
+    def peek(self) -> int:
+        return self.d[self.i] if self.i < len(self.d) else -1
+
+
+def _parse_string(p: _P) -> bytes | None:
+    start = p.i
+    if p.peek() != 0x22:
+        return None
+    p.i += 1
+    while p.i < len(p.d):
+        c = p.d[p.i]
+        if c == 0x5C:
+            p.i += 2
+            continue
+        p.i += 1
+        if c == 0x22:
+            return p.d[start : p.i]
+    return None  # unterminated
+
+
+_NUM_CHARS = frozenset(b"-+.eE0123456789")
+
+
+def _parse_number(p: _P) -> bytes | None:
+    start = p.i
+    while p.i < len(p.d) and p.d[p.i] in _NUM_CHARS:
+        p.i += 1
+    return p.d[start : p.i] if p.i > start else None
+
+
+def _parse_value(p: _P, depth: int = 0) -> JNode | None:
+    if depth > 200:
+        return None
+    p.ws()
+    c = p.peek()
+    if c == 0x7B:  # {
+        p.i += 1
+        node = JNode("obj")
+        p.ws()
+        if p.peek() == 0x7D:
+            p.i += 1
+            return node
+        while True:
+            p.ws()
+            key = _parse_string(p)
+            if key is None:
+                return None
+            p.ws()
+            if p.peek() != 0x3A:
+                return None
+            p.i += 1
+            val = _parse_value(p, depth + 1)
+            if val is None:
+                return None
+            val.key = key
+            node.children.append(val)
+            p.ws()
+            if p.peek() == 0x2C:
+                p.i += 1
+                continue
+            if p.peek() == 0x7D:
+                p.i += 1
+                return node
+            return None
+    if c == 0x5B:  # [
+        p.i += 1
+        node = JNode("arr")
+        p.ws()
+        if p.peek() == 0x5D:
+            p.i += 1
+            return node
+        while True:
+            val = _parse_value(p, depth + 1)
+            if val is None:
+                return None
+            node.children.append(val)
+            p.ws()
+            if p.peek() == 0x2C:
+                p.i += 1
+                continue
+            if p.peek() == 0x5D:
+                p.i += 1
+                return node
+            return None
+    if c == 0x22:
+        raw = _parse_string(p)
+        return JNode("str", raw=raw) if raw is not None else None
+    for lit in (b"true", b"false", b"null"):
+        if p.d[p.i : p.i + len(lit)] == lit:
+            p.i += len(lit)
+            return JNode("lit", raw=lit)
+    raw = _parse_number(p)
+    if raw is not None:
+        return JNode("num", raw=raw)
+    return None
+
+
+def parse(data: bytes) -> JNode | None:
+    """Tolerant top-level parse; None when the data isn't JSON-ish."""
+    p = _P(data)
+    node = _parse_value(p)
+    if node is None:
+        return None
+    p.ws()
+    if p.i != len(p.d):
+        return None  # trailing garbage: not a clean JSON document
+    return node
+
+
+def serialize(node: JNode) -> bytes:
+    out = bytearray()
+    _ser(node, out, with_key=False)
+    return bytes(out)
+
+
+def _ser(node: JNode, out: bytearray, with_key: bool):
+    if with_key and node.key is not None:
+        out.extend(node.key)
+        out.append(0x3A)
+    if node.kind == "obj":
+        out.append(0x7B)
+        for i, c in enumerate(node.children):
+            if i:
+                out.append(0x2C)
+            _ser(c, out, with_key=True)
+        out.append(0x7D)
+    elif node.kind == "arr":
+        out.append(0x5B)
+        for i, c in enumerate(node.children):
+            if i:
+                out.append(0x2C)
+            _ser(c, out, with_key=False)
+        out.append(0x5D)
+    else:
+        out.extend(node.raw)
+
+
+def walk(node: JNode) -> list[JNode]:
+    """All nodes, depth-first (erlamsa_json.erl:286-319)."""
+    out = [node]
+    for c in node.children:
+        out.extend(walk(c))
+    return out
+
+
+# --- payloads (unserialize gadget probes, erlamsa_json.erl:617-625) -------
+
+UNSERIALIZE_PAYLOADS = (
+    # .NET ObjectDataProvider-style type-confusion probe
+    b'{"$type":"System.Windows.Data.ObjectDataProvider, PresentationFramework",'
+    b'"MethodName":"Start","ObjectInstance":{"$type":"System.Diagnostics.Process,'
+    b' System"},"MethodParameters":{"$type":"System.Collections.ArrayList",'
+    b'"$values":["calc.exe"]}}',
+    # fastjson-style autotype probe
+    b'{"@type":"com.sun.rowset.JdbcRowSetImpl","dataSourceName":'
+    b'"ldap://localhost:51234/Exploit","autoCommit":true}',
+    # generic prototype-pollution probe
+    b'{"__proto__":{"polluted":"1"}}',
+    b'{"$type":"System.IO.FileInfo, System.IO.FileSystem","fileName":"/etc/passwd"}',
+)
+
+
+# --- mutations ------------------------------------------------------------
+
+
+def _mutate_tree(r: ErlRand, root: JNode, inner_bytes_mutator) -> tuple[JNode, str]:
+    """One random tree mutation; returns (new_root, op_name).
+
+    Op mix follows erlamsa_json:json_mutation (:646-708): node swap, dup,
+    pump, repeat (<=100), payload insert, inner byte-level mutation of a
+    leaf.
+    """
+    nodes = walk(root)
+    op = r.rand(6)
+    if op == 0 and len(nodes) >= 2:  # swap two nodes' contents
+        a = r.rand_elem(nodes)
+        b = r.rand_elem(nodes)
+        a_copy = a.clone()
+        b_copy = b.clone()
+        _overwrite(a, b_copy)
+        _overwrite(b, a_copy)
+        return root, "json_swap"
+    if op == 1:  # dup: duplicate a child inside its parent
+        parents = [x for x in nodes if x.children]
+        if parents:
+            parent = r.rand_elem(parents)
+            idx = r.rand(len(parent.children))
+            parent.children.insert(idx, parent.children[idx].clone())
+            return root, "json_dup"
+    if op == 2:  # pump: nest a container inside itself (2x depth growth)
+        conts = [x for x in nodes if x.kind in ("obj", "arr") and x.children]
+        if conts:
+            target = r.rand_elem(conts)
+            clone = target.clone()
+            clone.key = None
+            target.children.append(clone)
+            return root, "json_pump"
+    if op == 3:  # repeat an array element up to 100x
+        arrs = [x for x in nodes if x.kind == "arr" and x.children]
+        if arrs:
+            arr = r.rand_elem(arrs)
+            idx = r.rand(len(arr.children))
+            reps = r.erand(100)
+            elem = arr.children[idx]
+            for _ in range(reps):
+                arr.children.insert(idx, elem.clone())
+            return root, "json_repeat"
+    if op == 4:  # insert an unserialization payload as a value
+        payload = parse(bytes(r.rand_elem(UNSERIALIZE_PAYLOADS)))
+        if payload is not None and nodes:
+            target = r.rand_elem(nodes)
+            key = target.key
+            _overwrite(target, payload)
+            target.key = key
+            return root, "json_unserialize"
+    # inner byte-level mutation of a string/number leaf
+    leaves = [x for x in nodes if x.kind in ("str", "num")]
+    if leaves:
+        leaf = r.rand_elem(leaves)
+        leaf.raw = bytes(inner_bytes_mutator(leaf.raw))
+        return root, "json_innertext"
+    return root, "json_noop"
+
+
+def _overwrite(dst: JNode, src: JNode):
+    dst.kind = src.kind
+    dst.children = src.children
+    dst.raw = src.raw
+    # key stays: object membership is positional
+
+
+def json_mutate(r: ErlRand, data: bytes, inner_bytes_mutator) -> tuple[bytes, str, int]:
+    """js: returns (mutated, op_name, delta). delta -1 when not JSON
+    (erlamsa_json.erl:710-730)."""
+    root = parse(data)
+    if root is None:
+        return data, "json_not_json", -1
+    root, op = _mutate_tree(r, root, inner_bytes_mutator)
+    return serialize(root), op, 1

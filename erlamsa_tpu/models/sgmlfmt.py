@@ -1,0 +1,300 @@
+"""SGML/XML/HTML format engine: fault-tolerant parse, tree mutations,
+XML-feature injections, fold back to bytes.
+
+Reference: src/erlamsa_sgml.erl — a binary-pattern tokenizer (tz/2), an AST
+builder that tolerates unclosed and mismatched tags (build_ast2), and
+mutators: swap/dup/pump/repeat/insert nodes, permute or mutate attributes,
+break a tag, inject XXE / billion-laughs / xmlns-SSRF features
+(sgml_xmlfeatures :627-665), and inner-text mutation of text nodes and
+attribute values (try_mutate_innertext :683-693).
+
+Tokens / nodes:
+    ("text", bytes)
+    ("decl", bytes)              <! ... > and <? ... ?> passthrough blobs
+    ("tag", name, attrs, children, closed)   attrs = list[(bytes, bytes|None)]
+unparsed close tags with no open partner become text, like the reference's
+fault tolerance.
+"""
+
+from __future__ import annotations
+
+from ..utils.erlrand import ErlRand
+
+_NAME_END = frozenset(b" \t\r\n/>")
+
+
+def _parse_attrs(chunk: bytes) -> list[tuple[bytes, bytes | None]]:
+    attrs = []
+    i, n = 0, len(chunk)
+    while i < n:
+        while i < n and chunk[i] in b" \t\r\n":
+            i += 1
+        if i >= n:
+            break
+        ks = i
+        while i < n and chunk[i] not in b" \t\r\n=":
+            i += 1
+        key = chunk[ks:i]
+        if not key:
+            break
+        while i < n and chunk[i] in b" \t\r\n":
+            i += 1
+        if i < n and chunk[i] == 0x3D:  # =
+            i += 1
+            while i < n and chunk[i] in b" \t\r\n":
+                i += 1
+            if i < n and chunk[i] in b"\"'":
+                q = chunk[i]
+                i += 1
+                vs = i
+                while i < n and chunk[i] != q:
+                    i += 1
+                attrs.append((key, chunk[vs:i]))
+                i += 1
+            else:
+                vs = i
+                while i < n and chunk[i] not in b" \t\r\n":
+                    i += 1
+                attrs.append((key, chunk[vs:i]))
+        else:
+            attrs.append((key, None))
+    return attrs
+
+
+def tokenize(data: bytes) -> list[tuple]:
+    """bytes -> flat token stream (erlamsa_sgml.erl:100-164 behavior)."""
+    toks: list[tuple] = []
+    i, n = 0, len(data)
+    text_start = 0
+    while i < n:
+        if data[i] != 0x3C:  # <
+            i += 1
+            continue
+        if i > text_start:
+            toks.append(("text", data[text_start:i]))
+        if data[i + 1 : i + 4] == b"!--":
+            end = data.find(b"-->", i + 4)
+            end = n if end < 0 else end + 3
+            toks.append(("decl", data[i:end]))
+            i = text_start = end
+            continue
+        if i + 1 < n and data[i + 1] in b"!?":
+            close = b"?>" if data[i + 1] == 0x3F else b">"
+            end = data.find(close, i + 2)
+            end = n if end < 0 else end + len(close)
+            toks.append(("decl", data[i:end]))
+            i = text_start = end
+            continue
+        end = data.find(b">", i + 1)
+        if end < 0:
+            # unterminated tag: trailing text, like the reference's tolerance
+            toks.append(("text", data[i:]))
+            i = text_start = n
+            break
+        inner = data[i + 1 : end]
+        if inner.startswith(b"/"):
+            toks.append(("close", inner[1:].strip()))
+        else:
+            selfclosed = inner.endswith(b"/")
+            if selfclosed:
+                inner = inner[:-1]
+            # name = up to first whitespace
+            j = 0
+            while j < len(inner) and inner[j] not in _NAME_END:
+                j += 1
+            name = inner[:j]
+            attrs = _parse_attrs(inner[j:])
+            toks.append(("open", name, attrs, selfclosed))
+        i = text_start = end + 1
+    if i > text_start:
+        toks.append(("text", data[text_start:i]))
+    return toks
+
+
+def build_ast(toks: list[tuple]) -> list:
+    """Token stream -> forest, tolerant of mismatches
+    (erlamsa_sgml.erl:204-279): a close tag pops up to its matching open if
+    one exists anywhere on the stack; otherwise it becomes text."""
+    root: list = []
+    stack: list[tuple] = []  # (name, attrs, children_list)
+    cur = root
+    for t in toks:
+        if t[0] in ("text", "decl"):
+            cur.append(t)
+        elif t[0] == "open":
+            _, name, attrs, selfclosed = t
+            node = ["tag", name, attrs, [], selfclosed]
+            cur.append(node)
+            if not selfclosed:
+                stack.append(node)
+                cur = node[3]
+        else:  # close
+            name = t[1]
+            match = None
+            for k in range(len(stack) - 1, -1, -1):
+                if stack[k][1] == name:
+                    match = k
+                    break
+            if match is None:
+                cur.append(("text", b"</" + name + b">"))
+                continue
+            # everything above the match stays as (implicitly closed) children
+            del stack[match:]
+            cur = stack[-1][3] if stack else root
+    return root
+
+
+def serialize(forest: list) -> bytes:
+    out = bytearray()
+    _ser_forest(forest, out)
+    return bytes(out)
+
+
+def _ser_forest(forest: list, out: bytearray):
+    for node in forest:
+        if isinstance(node, tuple):
+            out.extend(node[1])
+        else:
+            _, name, attrs, children, selfclosed = node
+            out.append(0x3C)
+            out.extend(name)
+            for k, v in attrs:
+                out.append(0x20)
+                out.extend(k)
+                if v is not None:
+                    out.extend(b'="')
+                    out.extend(v)
+                    out.append(0x22)
+            if selfclosed:
+                out.extend(b"/>")
+            else:
+                out.append(0x3E)
+                _ser_forest(children, out)
+                out.extend(b"</")
+                out.extend(name)
+                out.append(0x3E)
+
+
+def parse(data: bytes) -> list:
+    return build_ast(tokenize(data))
+
+
+def _tag_nodes(forest: list) -> list:
+    out = []
+    for node in forest:
+        if isinstance(node, list):
+            out.append(node)
+            out.extend(_tag_nodes(node[3]))
+    return out
+
+
+def _clone(node):
+    if isinstance(node, tuple):
+        return node
+    return [node[0], node[1], list(node[2]), [_clone(c) for c in node[3]], node[4]]
+
+
+# --- XML feature injections (erlamsa_sgml.erl:627-665) --------------------
+
+
+def _xxe_decl(ssrf_uri: bytes) -> bytes:
+    return (
+        b'<!DOCTYPE foo [ <!ENTITY xxe SYSTEM "file:///etc/passwd"> '
+        b'<!ENTITY ssrf SYSTEM "http' + ssrf_uri + b'"> ]>'
+    )
+
+
+def _billion_laughs() -> bytes:
+    ents = [b'<!ENTITY a0 "lol">']
+    for k in range(1, 6):
+        prev = b"&a%d;" % (k - 1)
+        ents.append(b'<!ENTITY a%d "%s">' % (k, prev * 8))
+    return b"<!DOCTYPE bomb [ " + b" ".join(ents) + b" ]>"
+
+
+def sgml_xmlfeatures(r: ErlRand, forest: list, ssrf_uri: bytes) -> list:
+    """Prepend a hostile prolog / inject xmlns SSRF."""
+    choice = r.rand(3)
+    if choice == 0:
+        return [("decl", _xxe_decl(ssrf_uri)), ("text", b"&xxe;&ssrf;")] + forest
+    if choice == 1:
+        return [("decl", _billion_laughs()), ("text", b"&a5;")] + forest
+    tags = _tag_nodes(forest)
+    if tags:
+        tag = r.rand_elem(tags)
+        tag[2] = list(tag[2]) + [(b"xmlns:ssrf", b"http" + ssrf_uri)]
+    return forest
+
+
+# --- mutations ------------------------------------------------------------
+
+
+def sgml_mutate(
+    r: ErlRand, data: bytes, inner_bytes_mutator, ssrf_uri: bytes = b"://localhost:51234/"
+) -> tuple[bytes, str, int]:
+    """sgm: one random tree mutation (erlamsa_sgml.erl:739-766 behavior).
+    Returns (mutated, op_name, delta); delta -1 when no tags parse."""
+    forest = parse(data)
+    tags = _tag_nodes(forest)
+    if not tags:
+        return data, "sgml_no_tags", -1
+
+    op = r.rand(9)
+    if op == 0 and len(tags) >= 2:  # swap two tags' payloads
+        a, b = r.rand_elem(tags), r.rand_elem(tags)
+        a[1], b[1] = b[1], a[1]
+        a[2], b[2] = b[2], a[2]
+        return serialize(forest), "sgml_swap", 1
+    if op == 1:  # dup a node in place
+        tag = r.rand_elem(tags)
+        tag[3] = tag[3] + [_clone(c) for c in tag[3]]
+        return serialize(forest), "sgml_dup", 1
+    if op == 2:  # pump: nest a clone of a tag inside itself
+        tag = r.rand_elem(tags)
+        tag[3] = tag[3] + [_clone(tag)]
+        return serialize(forest), "sgml_pump", 1
+    if op == 3:  # repeat a tag up to 100x at top level
+        tag = r.rand_elem(tags)
+        reps = r.erand(100)
+        forest = forest + [_clone(tag) for _ in range(reps)]
+        return serialize(forest), "sgml_repeat", 1
+    if op == 4:  # permute attributes
+        tag = r.rand_elem(tags)
+        if len(tag[2]) >= 2:
+            tag[2] = r.random_permutation(tag[2])
+        return serialize(forest), "sgml_permparams", 1
+    if op == 5:  # break a tag: drop its closing delimiter
+        tag = r.rand_elem(tags)
+        raw = serialize([tag])
+        broken = raw.replace(b">", b"", 1)
+        return serialize(forest).replace(raw, broken, 1), "sgml_breaktag", 1
+    if op == 6:  # XML features: XXE / billion laughs / xmlns SSRF
+        forest = sgml_xmlfeatures(r, forest, ssrf_uri)
+        return serialize(forest), "sgml_xmlfeatures", 1
+    if op == 7:  # mutate an attribute value byte-level
+        cands = [t for t in tags if any(v is not None for _, v in t[2])]
+        if cands:
+            tag = r.rand_elem(cands)
+            idxs = [i for i, (_, v) in enumerate(tag[2]) if v is not None]
+            i = idxs[r.rand(len(idxs))]
+            k, v = tag[2][i]
+            tag[2][i] = (k, bytes(inner_bytes_mutator(v)))
+            return serialize(forest), "sgml_attr_innertext", 1
+    # default: inner-text mutation of a random text node
+    texts = _text_refs(forest)
+    if texts:
+        holder, idx = texts[r.rand(len(texts))]
+        holder[idx] = ("text", bytes(inner_bytes_mutator(holder[idx][1])))
+        return serialize(forest), "sgml_innertext", 1
+    return serialize(forest), "sgml_noop", 1
+
+
+def _text_refs(forest: list) -> list[tuple[list, int]]:
+    """(container, index) for every text node so it can be replaced in place."""
+    out = []
+    for i, node in enumerate(forest):
+        if isinstance(node, tuple) and node[0] == "text":
+            out.append((forest, i))
+        elif isinstance(node, list):
+            out.extend(_text_refs(node[3]))
+    return out

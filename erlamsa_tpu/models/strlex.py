@@ -1,0 +1,141 @@
+"""String lexer: bytes -> chunks of text / byte / delimited runs.
+
+Reference: src/erlamsa_strlex.erl. A run of >= 6 "texty" bytes opens a text
+chunk; quote characters open delimited chunks with backslash-escape
+handling; everything else accumulates into byte chunks. unlex is the exact
+inverse used after chunk-level mutation.
+
+Chunks are tuples:
+    ("text", list[int]) | ("byte", list[int]) |
+    ("delimited", quote:int, list[int], quote:int)
+"""
+
+from __future__ import annotations
+
+MIN_TEXTY = 6
+
+
+def texty(b: int) -> bool:
+    """Printable ASCII or tab/newline/CR (erlamsa_strlex.erl:45-52)."""
+    if b < 9 or b > 126:
+        return False
+    if b > 31:
+        return True
+    return b in (9, 10, 13)
+
+
+def _texty_enough(data: bytes, pos: int) -> bool:
+    """At least MIN_TEXTY texty bytes ahead (or texty until end)
+    (erlamsa_strlex.erl:54-64)."""
+    for k in range(MIN_TEXTY):
+        if pos + k >= len(data):
+            return True  # short trailing runs count
+        if not texty(data[pos + k]):
+            return False
+    return True
+
+
+def lex(data: bytes) -> list[tuple]:
+    """bytes -> chunk list (erlamsa_strlex.erl:74-142)."""
+    chunks: list[tuple] = []
+    i = 0
+    n = len(data)
+    raw: list[int] = []
+
+    def flush_raw():
+        nonlocal raw
+        if raw:
+            chunks.append(("byte", raw))
+            raw = []
+
+    while i < n:
+        if not _texty_enough(data, i):
+            raw.append(data[i])
+            i += 1
+            continue
+        flush_raw()
+        # text mode
+        seen: list[int] = []
+        while i < n:
+            b = data[i]
+            if b in (0x22, 0x27):  # " or '
+                # delimited run; the opening quote is provisionally part of
+                # the text until the closing quote is found
+                quote = b
+                j = i + 1
+                after: list[int] = []
+                closed = False
+                while j < n:
+                    c = data[j]
+                    if c == quote:
+                        closed = True
+                        j += 1
+                        break
+                    if c == 0x5C:  # backslash escape
+                        if j + 1 >= n:
+                            after.append(0x5C)
+                            j += 1
+                            continue
+                        nxt = data[j + 1]
+                        if texty(nxt):
+                            after.extend((0x5C, nxt))
+                            j += 2
+                            continue
+                        after.append(0x5C)
+                        j += 1
+                        continue
+                    if texty(c):
+                        after.append(c)
+                        j += 1
+                        continue
+                    break  # non-texty inside quotes: abandon delimited run
+                if closed:
+                    if seen:
+                        chunks.append(("text", seen))
+                        seen = []
+                    chunks.append(("delimited", quote, after, quote))
+                    i = j
+                    continue
+                # unterminated: quote + contents become text, resume scan
+                seen = seen + [quote] + after
+                i = j
+                if i < n and not texty(data[i]):
+                    break
+                continue
+            if texty(b):
+                seen.append(b)
+                i += 1
+                continue
+            break
+        if seen:
+            chunks.append(("text", seen))
+    flush_raw()
+    return chunks
+
+
+def unlex(chunks: list[tuple]) -> bytes:
+    """Chunk list -> bytes (erlamsa_strlex.erl:145-156)."""
+    out = bytearray()
+    for c in chunks:
+        if c[0] == "delimited":
+            _, l, body, rr = c
+            out.append(l)
+            out.extend(_flatten(body))
+            out.append(rr)
+        else:
+            out.extend(_flatten(c[1]))
+    return bytes(out)
+
+
+def _flatten(x) -> bytes:
+    """Tolerate nested int/str/bytes lists produced by text mutators."""
+    if isinstance(x, (bytes, bytearray)):
+        return bytes(x)
+    if isinstance(x, int):
+        return bytes([x & 0xFF])
+    if isinstance(x, str):
+        return x.encode("latin-1", "replace")
+    out = bytearray()
+    for e in x:
+        out.extend(_flatten(e))
+    return bytes(out)

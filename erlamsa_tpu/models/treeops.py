@@ -1,0 +1,194 @@
+"""Guessed parse-tree mutations: partial-parse bytes by bracket/quote pairs
+and dup/del/swap/stutter subtrees.
+
+Reference: src/erlamsa_mutations.erl:786-1023. The tree is a Python list of
+ints (bytes) and nested lists (delimited nodes, first element = opening
+delimiter byte, last = closing when complete).
+"""
+
+from __future__ import annotations
+
+from ..utils.erlrand import ErlRand
+
+_DELIMS = {40: 41, 91: 93, 60: 62, 123: 125, 34: 34, 39: 39}
+
+
+def _grow(data: bytes, i: int, close: int) -> tuple[list, int | None]:
+    """Parse until `close`; returns (node_contents, next_index|None when out
+    of data) (erlamsa_mutations.erl:801-823)."""
+    out: list = []
+    n = len(data)
+    while i < n:
+        h = data[i]
+        if h == close:
+            out.append(close)
+            return out, i + 1
+        nxt = _DELIMS.get(h)
+        if nxt is None:
+            out.append(h)
+            i += 1
+            continue
+        sub, j = _grow(data, i + 1, nxt)
+        if j is None:
+            return out + [h] + sub, None  # partial parse flattens
+        out.append([h] + sub)
+        i = j
+    return out, None
+
+
+def partial_parse(data: bytes) -> list:
+    """bytes -> tree (erlamsa_mutations.erl:886-905)."""
+    out: list = []
+    i = 0
+    n = len(data)
+    while i < n:
+        h = data[i]
+        close = _DELIMS.get(h)
+        if close is None:
+            out.append(h)
+            i += 1
+            continue
+        sub, j = _grow(data, i + 1, close)
+        if j is None:
+            return out + [h] + sub
+        out.append([h] + sub)
+        i = j
+    return out
+
+
+def flatten_tree(node) -> bytes:
+    out = bytearray()
+    stack = [node]
+    while stack:
+        x = stack.pop()
+        if isinstance(x, int):
+            out.append(x & 0xFF)
+        else:
+            stack.extend(reversed(x))
+    return bytes(out)
+
+
+def sublists(lst: list) -> list[list]:
+    """All nested list nodes, reference walk order
+    (erlamsa_mutations.erl:836-845): prepend-on-descend."""
+    # the reference accumulates [H|Found] then recurses into H with that
+    # accumulator, scanning each list left to right
+    def walk(node: list, found: list) -> list:
+        for h in node:
+            if isinstance(h, list):
+                found = walk(h, [h] + found)
+        return found
+
+    return walk(lst, [])
+
+
+def edit_sublist(lst: list, sub, op) -> list:
+    """Replace nodes STRUCTURALLY equal to `sub` (the reference compares
+    with =:= on list values, erlamsa_mutations.erl:857-869): at each list
+    level, the first equal element swallows the rest of that list into
+    op([sub | rest]); subtrees walked before the match are edited too.
+    op returns the replacement slice."""
+    if not isinstance(lst, list):
+        return [lst]
+    out = []
+    i = 0
+    while i < len(lst):
+        h = lst[i]
+        if h == sub:
+            return out + op(lst[i:])
+        if isinstance(h, list):
+            out.append(edit_sublist(h, sub, op))
+        else:
+            out.append(h)
+        i += 1
+    return out
+
+
+def sed_tree_dup(r: ErlRand, tree: list) -> list:
+    """tr2: duplicate a node (erlamsa_mutations.erl:930-932)."""
+    subs = sublists(tree)
+    if not subs:
+        return tree
+    sub = r.rand_elem(subs)
+    return edit_sublist(tree, sub, lambda s: [s[0]] + s)
+
+
+def sed_tree_del(r: ErlRand, tree: list) -> list:
+    """td: delete a node (erlamsa_mutations.erl:934-936)."""
+    subs = sublists(tree)
+    if not subs:
+        return tree
+    sub = r.rand_elem(subs)
+    return edit_sublist(tree, sub, lambda s: s[1:])
+
+
+def sed_tree_swap_one(r: ErlRand, tree: list) -> list | None:
+    """ts1: overwrite one node with another (erlamsa_mutations.erl:938-943)."""
+    subs = sublists(tree)
+    if len(subs) < 2:
+        return None
+    to_swap = r.reservoir_sample(subs, 2)
+    perm = r.random_permutation(to_swap)
+    a, b = perm[0], perm[1]
+    return edit_sublist(tree, a, lambda s: [b] + s[1:])
+
+
+def sed_tree_swap_two(r: ErlRand, tree: list) -> list | None:
+    """ts2: pairwise swap (erlamsa_mutations.erl:945-952). Structural
+    matching like the reference's gb_trees mapping: ALL nodes equal to a
+    become b and vice versa; replaced nodes are not descended into
+    (edit_sublists, erlamsa_mutations.erl:872-884). Keeps the quirk that a
+    parent can swap with its own child."""
+    subs = sublists(tree)
+    if len(subs) < 2:
+        return None
+    a, b = r.reservoir_sample(subs, 2)[:2]
+
+    def walk(node):
+        if not isinstance(node, list):
+            return node
+        out = []
+        for h in node:
+            if isinstance(h, list) and h == a:
+                out.append(b)
+            elif isinstance(h, list) and h == b:
+                out.append(a)
+            elif isinstance(h, list):
+                out.append(walk(h))
+            else:
+                out.append(h)
+        return out
+
+    return walk(tree)
+
+
+def sed_tree_stutter(r: ErlRand, tree: list) -> list | None:
+    """tr: repeat a parent->child path 2^rand(10)-ish times
+    (erlamsa_mutations.erl:973-1022), memory-capped like the reference's
+    256MB guard."""
+    subs = sublists(tree)
+    rand_subs = r.random_permutation(subs)
+    parent = child = None
+    for h in rand_subs:
+        csubs = sublists(h)
+        if csubs:
+            parent, child = h, r.rand_elem(csubs)
+            break
+    n_reps = r.rand_log(10)
+    if parent is None:
+        return None
+
+    # repeat_path unrolled iteratively (the reference recurses and guards on
+    # process heap; Python's stack can't take n_reps levels). A flattened-
+    # bytes budget stands in for the reference's 256MB heap cap.
+    budget = 4 * 1024 * 1024
+    parent_size = len(flatten_tree(parent))
+    acc = parent
+    for _ in range(max(n_reps - 1, 0)):
+        if budget <= 0:
+            break
+        budget -= parent_size
+        prev = acc
+        acc = edit_sublist(parent, child, lambda s: [prev] + s[1:])
+
+    return edit_sublist(tree, child, lambda s: [acc] + s[1:])

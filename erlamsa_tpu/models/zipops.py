@@ -1,0 +1,44 @@
+"""ZIP archive helpers: path-traversal member renaming and member-wise
+mutation support.
+
+Reference: zip_path_traversal (src/erlamsa_mutations.erl:1146-1163) and the
+archiver pattern (src/erlamsa_patterns.erl:165-214), which use OTP's zip
+module; here Python's zipfile over in-memory buffers.
+"""
+
+from __future__ import annotations
+
+import io
+import zipfile
+
+from ..utils.erlrand import ErlRand
+
+
+def list_members(data: bytes) -> list[tuple[str, bytes]] | None:
+    """[(name, content)] or None when not a readable ZIP."""
+    try:
+        with zipfile.ZipFile(io.BytesIO(data)) as z:
+            return [(i.filename, z.read(i.filename)) for i in z.infolist()]
+    except Exception:
+        return None
+
+
+def rebuild(members: list[tuple[str, bytes]]) -> bytes:
+    buf = io.BytesIO()
+    with zipfile.ZipFile(buf, "w", zipfile.ZIP_DEFLATED) as z:
+        for name, content in members:
+            z.writestr(name, content)
+    return buf.getvalue()
+
+
+def path_traversal(r: ErlRand, data: bytes) -> bytes | None:
+    """Prefix every member with rand(20) '../' segments
+    (src/erlamsa_mutations.erl:1149-1163)."""
+    members = list_members(data)
+    if members is None:
+        return None
+    out = []
+    for name, content in members:
+        n = r.rand(20)
+        out.append(("../" * n + name, content))
+    return rebuild(out)

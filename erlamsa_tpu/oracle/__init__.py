@@ -1,0 +1,15 @@
+"""Sequential parity engine ("oracle").
+
+A pure-Python re-implementation of the reference's exact mutation pipeline,
+driven by the AS183 PRNG (erlamsa_tpu.utils.erlrand) in the reference's
+draw order, so a fixed seed reproduces the reference's decision stream.
+This is the `-m default`-equivalent path and the parity baseline the TPU
+throughput path is measured against; it also hosts the structured mutators
+(tree/JSON/SGML/fuse/zip) that the batch path routes to the host.
+
+Public surface:
+    fuzz(data, seed=..., **opts) -> bytes       one-shot library call
+    Engine(opts).run_case(idx) -> bytes         the CLI's per-case driver
+"""
+
+from .engine import fuzz  # noqa: F401

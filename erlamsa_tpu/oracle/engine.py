@@ -1,0 +1,118 @@
+"""The oracle fuzzing engine: seed -> generator -> pattern -> mutators ->
+output, case by case.
+
+Sequential re-implementation of erlamsa_main:fuzzer (src/erlamsa_main.erl:
+124-247) with the reference's seeding discipline: the parent stream draws a
+3-tuple ThreadSeed per case (gen_predictable_seed) and each case runs on a
+fresh AS183 stream seeded with it; resume therefore needs only
+(seed, case index) — the reference's last_seed.txt + --skip contract.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable
+
+from ..constants import TOO_MANY_FAILED_ATTEMPTS
+from ..utils.erlrand import ErlRand, gen_urandom_seed
+from . import gen as genmod
+from . import patterns as patmod
+from .mutations import Ctx, default_mutations, make_mutator
+
+
+class Engine:
+    def __init__(self, opts: dict):
+        self.opts = dict(opts)
+        self.seed = opts.get("seed") or gen_urandom_seed()
+        self.n_cases = opts.get("n", 1)
+        self.parent = ErlRand(self.seed)
+        self.ctx = Ctx(
+            self.parent,
+            ssrf_host=opts.get("ssrf_host", "localhost"),
+            ssrf_port=opts.get("ssrf_port", 51234),
+        )
+        # construction order matches fuzzer/1: mutator table first (its
+        # construction draws), then the generator choice draw
+        selected = opts.get("mutations") or default_mutations()
+        self.base_rows = make_mutator(self.ctx, selected, opts.get("custom_mutas", ()))
+        paths = opts.get("paths", ["-"])
+        self.gen_name, self.generator = genmod.make_generator(
+            self.ctx,
+            opts.get("generators") or genmod.default_generators(),
+            paths,
+            self.opts,
+            self.n_cases,
+        )
+        self.pattern = patmod.make_pattern(
+            opts.get("patterns") or patmod.default_patterns()
+        )
+        self.sequence_muta = opts.get("sequence_muta", False)
+        self.skip = opts.get("skip", 0)
+        self.sleep = opts.get("sleep", 0)
+        self.maxfails = opts.get("maxfails", TOO_MANY_FAILED_ATTEMPTS)
+        self.post = opts.get("post") or (lambda d: d)
+        self._rows = self.base_rows
+
+    def run_case(self, case_idx: int) -> tuple[bytes, list]:
+        """One fuzzing case: returns (mutated bytes, meta). The worker
+        stream is seeded from the parent stream (erlamsa_main.erl:179-184)."""
+        thread_seed = (
+            self.parent.erand(99999),
+            self.parent.erand(99999),
+            self.parent.erand(99999),
+        )
+        worker = ErlRand(thread_seed)
+        saved = self.ctx.r
+        self.ctx.r = worker
+        try:
+            blocks, gen_meta = self.generator()
+            rows = self._rows
+            out_blocks, new_rows, meta = self.pattern(
+                self.ctx, list(blocks), rows, [("nth", case_idx)]
+            )
+            if self.sequence_muta:
+                self._rows = new_rows
+            data = self.post(b"".join(out_blocks))
+            return data, meta
+        finally:
+            self.ctx.r = saved
+
+    def run(self, writer: Callable[[int, bytes, list], None] | None = None) -> list[bytes]:
+        """The fuzzing loop (erlamsa_main.erl:165-243). Returns collected
+        outputs when no writer is given (return/direct mode)."""
+        acc: list[bytes] = []
+        fails = 0
+        i = 1
+        while i <= self.n_cases:
+            if fails > self.maxfails:
+                break
+            data, meta = self.run_case(i)
+            if i > self.skip:
+                if writer is not None:
+                    try:
+                        writer(i, data, meta)
+                        fails = 0
+                    except ConnectionError:
+                        fails += 1
+                        time.sleep((10 * fails) / 1000.0)
+                        i += 1
+                        continue
+                else:
+                    if data != b"":
+                        acc.append(data)
+            if self.sleep:
+                time.sleep(self.sleep / 1000.0)
+            i += 1
+        return acc
+
+
+def fuzz(data: bytes, seed=None, **opts) -> bytes:
+    """Direct library call, like erlamsa_app:fuzz/2
+    (src/erlamsa_app.erl:255-263): paths=[direct], output=return."""
+    o = {"paths": ["direct"], "input": data, "n": 1}
+    if seed is not None:
+        o["seed"] = seed
+    o.update(opts)
+    eng = Engine(o)
+    results = eng.run()
+    return results[0] if results else b""

@@ -1,0 +1,131 @@
+"""Generic list mutations with the reference's exact draw order.
+
+Reference: src/erlamsa_generic.erl:52-162. Operates on Python lists (of
+lines, bytes, or arbitrary elements); every random draw maps 1:1 onto an
+erlamsa_rnd call so the AS183 stream stays aligned.
+"""
+
+from __future__ import annotations
+
+from ..utils.erlrand import ErlRand
+
+STORED_ELEMS = 10
+
+
+def list_del(r: ErlRand, l: list) -> list:
+    """Delete one random element (erlamsa_generic.erl:52-57)."""
+    if not l:
+        return l
+    p = r.erand(len(l))
+    return l[: p - 1] + l[p:]
+
+
+def list_del_seq(r: ErlRand, l: list) -> list:
+    """Delete a run: keep first start-1 elements, then resume from offset n
+    within the tail (erlamsa_generic.erl:59-66: applynth + lists:sublist)."""
+    if not l:
+        return l
+    ln = len(l)
+    start = r.erand(ln)
+    n = r.erand(ln - start + 1)
+    rest = l[start:]  # after dropping element at `start`
+    return l[: start - 1] + rest[n - 1 : n - 1 + ln]
+
+
+def list_dup(r: ErlRand, l: list) -> list:
+    """Duplicate one element (erlamsa_generic.erl:68-73)."""
+    if not l:
+        return l
+    p = r.erand(len(l))
+    return l[: p - 1] + [l[p - 1], l[p - 1]] + l[p:]
+
+
+def list_repeat(r: ErlRand, l: list) -> list:
+    """Replace one element with max(2, rand_log(10)) copies
+    (erlamsa_generic.erl:75-82)."""
+    if not l:
+        return l
+    p = r.erand(len(l))
+    n = max(2, r.rand_log(10))
+    return l[: p - 1] + [l[p - 1]] * n + l[p:]
+
+
+def list_clone(r: ErlRand, l: list) -> list:
+    """Overwrite element To with a copy of element From
+    (erlamsa_generic.erl:84-91)."""
+    if not l:
+        return l
+    frm = r.erand(len(l))
+    to = r.erand(len(l))
+    elem = l[frm - 1]
+    return l[: to - 1] + [elem] + l[to:]
+
+
+def list_swap(r: ErlRand, l: list) -> list:
+    """Swap two adjacent elements (erlamsa_generic.erl:93-99)."""
+    if len(l) < 2:
+        return l
+    p = r.erand(len(l) - 1)
+    out = list(l)
+    out[p - 1], out[p] = out[p], out[p - 1]
+    return out
+
+
+def list_perm(r: ErlRand, l: list) -> list:
+    """Permute a run of N = max(2, min(A, B)) elements from a random start
+    (erlamsa_generic.erl:101-116)."""
+    ln = len(l)
+    if ln < 3:
+        return l
+    frm = r.erand(ln - 1)
+    a = r.rand_range(2, ln - frm)
+    b = r.rand_log(10)
+    n = max(2, min(a, b))
+    head = l[: frm - 1]
+    seg = l[frm - 1 : frm - 1 + n]
+    tail = l[frm - 1 + n :]
+    return head + r.random_permutation(seg) + tail
+
+
+# --- stateful ops: 10-slot reservoir carried across calls ----------------
+# state = [count, elem1, elem2, ...] (erlamsa_generic.erl:118-143)
+
+
+def _step_state(r: ErlRand, st: list, l: list) -> list:
+    ln = len(l)
+    st = list(st)
+    while st[0] < STORED_ELEMS:
+        p = r.erand(ln)
+        st = [st[0] + 1, l[p - 1]] + st[1:]
+    up = r.erand(STORED_ELEMS << 1)  # [1, 20]; updates fire for up in [1, 9]
+    if up < STORED_ELEMS:
+        ep = r.erand(ln)
+        new = l[ep - 1]
+        old = st[up]
+        # the reference's applynth fun destructures the stored element and
+        # keeps its tail: slot becomes New ++ tl(Old) (erlamsa_generic.erl:135)
+        st[up] = new + old[1:] if isinstance(old, (bytes, bytearray)) else new
+    return st
+
+
+def _pick_state(r: ErlRand, st: list):
+    p = r.erand(st[0])
+    return st[p]
+
+
+def st_list_ins(r: ErlRand, st: list, l: list) -> tuple[list, list]:
+    """Insert a reservoir element at a random position
+    (erlamsa_generic.erl:155-157)."""
+    stp = _step_state(r, st, l)
+    x = _pick_state(r, stp)
+    p = r.erand(len(l))
+    return stp, l[: p - 1] + [x] + l[p - 1 :]
+
+
+def st_list_replace(r: ErlRand, st: list, l: list) -> tuple[list, list]:
+    """Overwrite a random position with a reservoir element
+    (erlamsa_generic.erl:160-162)."""
+    stp = _step_state(r, st, l)
+    x = _pick_state(r, stp)
+    p = r.erand(len(l))
+    return stp, l[: p - 1] + [x] + l[p:]

@@ -1,0 +1,805 @@
+"""The oracle's 38-mutator registry and mux scheduler.
+
+Sequential, AS183-driven re-implementation of src/erlamsa_mutations.erl.
+Each mutator is fn(ll, meta) -> (next_fn, ll', meta', delta) over a list
+whose head is the current bytes block (tail may hold further blocks or
+thunks). mux semantics (weighted permutation, retry-until-changed,
+self-adjusting scores, list reordering) follow mux_fuzzers
+(src/erlamsa_mutations.erl:1244-1280) draw-for-draw.
+
+Byte-exact parity notes: closed-form mutators (byte/seq/num/line/utf8/
+lines/fuse/len) follow the reference's draw order exactly; the JSON/SGML
+engines are behavioral re-implementations with their own draw sequences
+(documented in erlamsa_tpu/models/)."""
+
+from __future__ import annotations
+
+import base64 as b64mod
+import math
+from typing import Any, Callable
+
+from ..constants import ABSMAX_BINARY_BLOCK, MAX_SCORE, MIN_SCORE
+from ..models import fieldpred, fuse as fusemod, jsonfmt, sgmlfmt, strlex, treeops, zipops
+from ..utils import erlrand
+from ..utils.bytehelpers import binarish, flush_bvecs, halve
+from ..utils.erlrand import ErlRand
+from ..utils.tables import funny_unicode, interesting_numbers
+from . import generic, textmutas
+
+
+class Ctx:
+    """Shared oracle context: the PRNG and host-side config (the reference
+    keeps the latter in the global_config ets table,
+    src/erlamsa_app.erl:129)."""
+
+    def __init__(self, r: ErlRand, ssrf_host="localhost", ssrf_port=51234):
+        self.r = r
+        self.ssrf_host = ssrf_host
+        self.ssrf_port = ssrf_port
+
+    @property
+    def ssrf_ep(self):
+        return (self.ssrf_host, self.ssrf_port)
+
+    def ssrf_uri(self) -> str:
+        return f"://{self.ssrf_host}:{self.ssrf_port}/"
+
+
+# --- byte-level helpers ---------------------------------------------------
+
+
+def _edit_byte(data: bytes, pos: int, repl: bytes) -> bytes:
+    """Clone-and-edit at position (edit_byte_vector,
+    src/erlamsa_mutations.erl:54-61); empty input unchanged."""
+    if not data:
+        return data
+    return data[:pos] + repl + data[pos + 1 :]
+
+
+def _mk_byte_muta(ctx: Ctx, edit: Callable[[Ctx, int], bytes], name: str):
+    """construct_sed_byte_muta: draws P, then D, then the edit's own draws
+    (src/erlamsa_mutations.erl:175-181)."""
+
+    def fn(ll, meta):
+        h = ll[0]
+        p = ctx.r.rand(len(h))
+        d = ctx.r.rand_delta()
+        new = _edit_byte(h, p, edit(ctx, h[p]) if h else b"")
+        return fn, [new] + ll[1:], [(name, d)] + meta, d
+
+    return fn
+
+
+def _mk_bytes_muta(ctx: Ctx, op: Callable, name: str):
+    """construct_sed_bytes_muta: S, L, op draws, then D
+    (src/erlamsa_mutations.erl:230-249)."""
+
+    def fn(ll, meta):
+        h = ll[0]
+        if not h:
+            return fn, ll, [(name, -1)] + meta, -1
+        bsize = len(h)
+        s = ctx.r.rand(bsize)
+        l = ctx.r.rand_range(1, bsize - s + 1)
+        head, span, tail = h[:s], h[s : s + l], h[s + l :]
+        new_ll = op(ctx, head, span, tail, ll[1:])
+        d = ctx.r.rand_delta()
+        return fn, new_ll, [(name, bsize)] + meta, d
+
+    return fn
+
+
+# --- textual number (src/erlamsa_mutations.erl:63-169) --------------------
+
+
+def mutate_float(r: ErlRand, num: float) -> float:
+    t = r.rand(7)
+    if t == 0:
+        return -num
+    if t == 1:
+        return 0.0
+    if t == 2:
+        return 1.0
+    if t == 3:
+        return 1.0e-323
+    if t == 4:
+        return 1.0e308
+    return r.rand_float() * math.exp(100 * r.rand_float())
+
+
+def mutate_num(r: ErlRand, num: int) -> int:
+    """12 strategies; ids 6 and 11 hit the catch-all via clause order
+    (src/erlamsa_mutations.erl:92-112)."""
+    t = r.rand(12)
+    if t == 0:
+        return num + 1
+    if t == 1:
+        return num - 1
+    if t == 2:
+        return 0
+    if t == 3:
+        return 1
+    if t in (4, 5):
+        return r.rand_elem(interesting_numbers())
+    if t == 7:
+        return num + r.rand_elem(interesting_numbers())
+    if t == 8:
+        return num - r.rand_elem(interesting_numbers())
+    if t == 9:
+        sign = 1 if num >= 0 else -1
+        return num - r.rand(abs(num) * 2) * sign
+    if t == 10:
+        return -num
+    n = r.rand_range(1, 129)
+    l = r.rand_log(n)
+    s = r.rand(3)
+    return num - l if s == 0 else num + l
+
+
+def _find_numbers(data: bytes) -> list[tuple[int, int, int]]:
+    """Non-overlapping (start, end, value) runs, matching get_num's
+    left-to-right walk with leading-dash sign consumption
+    (src/erlamsa_mutations.erl:114-151)."""
+    out = []
+    i, n = 0, len(data)
+    while i < n:
+        b = data[i]
+        if 48 <= b <= 57 or b == 45:
+            j = i
+            sign = 1
+            digits = 0
+            val = 0
+            while j < n:
+                c = data[j]
+                if 48 <= c <= 57:
+                    val = val * 10 + (c - 48)
+                    digits += 1
+                    j += 1
+                elif c == 45 and digits == 0:
+                    sign = -1
+                    j += 1
+                else:
+                    break
+            if digits:
+                out.append((i, j, sign * val))
+                i = j
+                continue
+            i = j if j > i else i + 1
+        else:
+            i += 1
+    return out
+
+
+def sed_num(ctx: Ctx):
+    """num (src/erlamsa_mutations.erl:153-169)."""
+
+    def fn(ll, meta):
+        r = ctx.r
+        h = ll[0]
+        nums = _find_numbers(h)
+        which = r.rand(len(nums))
+        if not nums:
+            # no numbers: Which stays 0 at top; the data is still re-flushed
+            # (so a >2KB head re-splits and mux counts the try as "used",
+            # matching the reference's hd comparison)
+            d = -1 if r.rand(10) == 0 else 0
+            return fn, flush_bvecs(h, ll[1:]), [("muta_num", 0)] + meta, d
+        # the leftover-Which counts numbers from the END
+        a, b, val = nums[len(nums) - 1 - which]
+        new_val = mutate_num(r, val)
+        new = h[:a] + str(new_val).encode() + h[b:]
+        isbin = binarish(new)
+        new_ll = flush_bvecs(new, ll[1:])
+        d = -1 if isbin else 2
+        return fn, new_ll, [("muta_num", 1)] + meta, d
+
+    return fn
+
+
+# --- mutator constructors -------------------------------------------------
+
+
+def build_mutators(ctx: Ctx, custom=()) -> list[list]:
+    """The mutations() table: [score, pri, fn, name] rows in reference
+    order (src/erlamsa_mutations.erl:1283-1332). Construction-time draws
+    (the randmask mask picks) happen here, in row order, like the
+    reference's list evaluation."""
+    r = ctx.r
+
+    def sed_byte_drop(c, b):
+        return b""
+
+    def sed_byte_inc(c, b):
+        return bytes([(b + 1) & 255])
+
+    def sed_byte_dec(c, b):
+        return bytes([(b - 1) & 255])
+
+    def sed_byte_repeat(c, b):
+        return bytes([b, b])
+
+    def sed_byte_flip(c, b):
+        return bytes([b ^ (1 << c.r.rand(8))])
+
+    def sed_byte_insert(c, b):
+        return bytes([c.r.rand(256), b])
+
+    def sed_byte_random(c, b):
+        return bytes([c.r.rand(256)])
+
+    def op_perm(c, head, span, tail, rest):
+        permed = bytes(c.r.random_permutation(list(span)))
+        return [head + permed + tail] + rest
+
+    def op_repeat(c, head, span, tail, rest):
+        n = max(2, c.r.rand_log(10))
+        return [head + span * n + tail] + rest
+
+    def op_drop(c, head, span, tail, rest):
+        return [head + tail] + rest
+
+    def mask_nand(c, b):
+        return b & ~(1 << c.r.rand(8))
+
+    def mask_or(c, b):
+        return b | (1 << c.r.rand(8))
+
+    def mask_xor(c, b):
+        return b ^ (1 << c.r.rand(8))
+
+    def mask_replace(c, b):
+        return c.r.rand(256)
+
+    def mk_randmask(mask_funs):
+        # mask fun drawn once at construction (src/erlamsa_mutations.erl:309-312)
+        mask_fun = r.rand_elem(mask_funs)
+
+        def op(c, head, span, tail, rest):
+            # randmask: prob erand(100)/100 per byte with the nom==1 quirk
+            # (src/erlamsa_mutations.erl:279-291)
+            prob = c.r.erand(100)
+            out = bytearray()
+            for byte in span:
+                if c.r.rand_occurs_fixed(prob, 100):
+                    out.append(mask_fun(c, byte) & 0xFF)
+                else:
+                    out.append(byte)
+            return [head + bytes(out) + tail] + rest
+
+        return op
+
+    rows = [
+        [MAX_SCORE, 10, sgml_mutator(ctx), "sgm"],
+        [MAX_SCORE, 3, json_mutator(ctx), "js"],
+        [MAX_SCORE, 1, sed_utf8_widen(ctx), "uw"],
+        [MAX_SCORE, 2, sed_utf8_insert(ctx), "ui"],
+        [MAX_SCORE, 1, ascii_bad_mutator(ctx), "ab"],
+        [MAX_SCORE, 1, ascii_delimeter_mutator(ctx), "ad"],
+        [MAX_SCORE, 1, tree_op(ctx, treeops.sed_tree_dup, "tree_dup"), "tr2"],
+        [MAX_SCORE, 1, tree_op(ctx, treeops.sed_tree_del, "tree_del"), "td"],
+        [MAX_SCORE, 3, sed_num(ctx), "num"],
+        [MAX_SCORE, 2, tree_swap(ctx, treeops.sed_tree_swap_one, "tree_swap_one"), "ts1"],
+        [MAX_SCORE, 2, tree_stutter(ctx), "tr"],
+        [MAX_SCORE, 2, tree_swap(ctx, treeops.sed_tree_swap_two, "tree_swap_two"), "ts2"],
+        [MAX_SCORE, 1, _mk_byte_muta(ctx, sed_byte_drop, "byte_drop"), "bd"],
+        [MAX_SCORE, 1, _mk_byte_muta(ctx, sed_byte_inc, "byte_inc"), "bei"],
+        [MAX_SCORE, 1, _mk_byte_muta(ctx, sed_byte_dec, "byte_dec"), "bed"],
+        [MAX_SCORE, 1, _mk_byte_muta(ctx, sed_byte_flip, "byte_flip"), "bf"],
+        [MAX_SCORE, 1, _mk_byte_muta(ctx, sed_byte_insert, "byte_insert"), "bi"],
+        [MAX_SCORE, 1, _mk_byte_muta(ctx, sed_byte_random, "byte_swap_random"), "ber"],
+        [MAX_SCORE, 1, _mk_byte_muta(ctx, sed_byte_repeat, "byte_repeat"), "br"],
+        [MAX_SCORE, 1, _mk_bytes_muta(ctx, op_perm, "seq_perm"), "sp"],
+        [MAX_SCORE, 1, _mk_bytes_muta(ctx, op_repeat, "seq_repeat"), "sr"],
+        [MAX_SCORE, 1, _mk_bytes_muta(ctx, op_drop, "seq_drop"), "sd"],
+        [MAX_SCORE, 1, _mk_bytes_muta(
+            ctx, mk_randmask([mask_nand, mask_or, mask_xor]), "seq_randmask"), "snand"],
+        [MAX_SCORE, 1, _mk_bytes_muta(ctx, mk_randmask([mask_replace]), "seq_randmask"), "srnd"],
+        [MAX_SCORE, 1, line_muta(ctx, generic.list_del, "line_del"), "ld"],
+        [MAX_SCORE, 1, line_muta(ctx, generic.list_del_seq, "line_del_seq"), "lds"],
+        [MAX_SCORE, 1, line_muta(ctx, generic.list_dup, "line_dup"), "lr2"],
+        [MAX_SCORE, 1, line_muta(ctx, generic.list_clone, "line_clone"), "lri"],
+        [MAX_SCORE, 1, line_muta(ctx, generic.list_repeat, "line_repeat"), "lr"],
+        [MAX_SCORE, 1, line_muta(ctx, generic.list_swap, "line_swap"), "ls"],
+        [MAX_SCORE, 1, line_muta(ctx, generic.list_perm, "line_perm"), "lp"],
+        [MAX_SCORE, 1, st_line_muta(ctx, generic.st_list_ins, "list_ins"), "lis"],
+        [MAX_SCORE, 1, st_line_muta(ctx, generic.st_list_replace, "list_replace"), "lrs"],
+        [MAX_SCORE, 2, sed_fuse_this(ctx), "ft"],
+        [MAX_SCORE, 1, sed_fuse_next(ctx), "fn"],
+        [MAX_SCORE, 2, sed_fuse_old(ctx), "fo"],
+        [MAX_SCORE, 2, length_predict(ctx), "len"],
+        [MAX_SCORE, 7, base64_mutator(ctx), "b64"],
+        [MAX_SCORE, 1, uri_mutator(ctx), "uri"],
+        [MAX_SCORE, 1, zip_path_traversal(ctx), "zip"],
+        [MAX_SCORE, 0, nomutation(), "nil"],
+    ]
+    return rows + [list(row) for row in custom]
+
+
+# --- lines (src/erlamsa_mutations.erl:320-378) ----------------------------
+
+
+def _lines(data: bytes) -> list[bytes]:
+    out = []
+    cur = bytearray()
+    for b in data:
+        cur.append(b)
+        if b == 10:
+            out.append(bytes(cur))
+            cur = bytearray()
+    if cur:
+        out.append(bytes(cur))
+    return out
+
+
+def _try_lines(data: bytes):
+    ls = _lines(data)
+    if not ls or binarish(data):
+        return None
+    return ls
+
+
+def line_muta(ctx: Ctx, op, name: str):
+    def fn(ll, meta):
+        ls = _try_lines(ll[0])
+        if ls is None:
+            return fn, ll, meta, -1
+        mls = op(ctx.r, ls)
+        return fn, [b"".join(mls)] + ll[1:], [(name, 1)] + meta, 1
+
+    return fn
+
+
+def st_line_muta(ctx: Ctx, op, name: str, initial_state=None):
+    state = initial_state if initial_state is not None else [0]
+
+    def make(state):
+        def fn(ll, meta):
+            ls = _try_lines(ll[0])
+            if ls is None:
+                return make(state), ll, meta, -1
+            stp, new_ls = op(ctx.r, state, ls)
+            return make(stp), [b"".join(_as_bytes(x) for x in new_ls)] + ll[1:], [
+                (name, 1)
+            ] + meta, 1
+
+        return fn
+
+    return make(state)
+
+
+def _as_bytes(x) -> bytes:
+    if isinstance(x, (bytes, bytearray)):
+        return bytes(x)
+    if isinstance(x, int):
+        return bytes([x & 0xFF])
+    return b"".join(_as_bytes(e) for e in x)
+
+
+# --- utf8 (src/erlamsa_mutations.erl:1025-1099) ---------------------------
+
+
+def sed_utf8_widen(ctx: Ctx):
+    def fn(ll, meta):
+        h = ll[0]
+        p = ctx.r.rand(len(h))
+        d = ctx.r.rand_delta()
+        if h and (h[p] & 0x3F) == h[p]:
+            new = _edit_byte(h, p, bytes([0xC0, h[p] | 0x80]))
+        else:
+            new = h
+        return fn, [new] + ll[1:], [("sed_utf8_widen", d)] + meta, d
+
+    return fn
+
+
+def sed_utf8_insert(ctx: Ctx):
+    def fn(ll, meta):
+        h = ll[0]
+        p = ctx.r.rand(len(h))
+        d = ctx.r.rand_delta()
+        seq = bytes(ctx.r.rand_elem(funny_unicode()))
+        new = _edit_byte(h, p, bytes([h[p]]) + seq) if h else h
+        return fn, [new] + ll[1:], [("sed_utf8_insert", d)] + meta, d
+
+    return fn
+
+
+# --- ascii (src/erlamsa_mutations.erl:585-651) ----------------------------
+
+
+def _ascii_mutator(ctx: Ctx, mutate_chunks, name: str):
+    def fn(ll, meta):
+        h = ll[0]
+        cs = strlex.lex(h)
+        if not textmutas.stringy(cs):
+            return fn, ll, meta, -1
+        ms = mutate_chunks(ctx, cs)
+        d = ctx.r.rand_delta()
+        return fn, [strlex.unlex(ms)] + ll[1:], [(name, d)] + meta, d
+
+    return fn
+
+
+def ascii_bad_mutator(ctx: Ctx):
+    return _ascii_mutator(
+        ctx,
+        lambda c, cs: textmutas.string_generic_mutate(
+            c.r, cs,
+            ["insert_badness", "replace_badness", "insert_traversal",
+             "insert_aaas", "insert_null"],
+            c.ssrf_ep,
+        ),
+        "ascii_bad",
+    )
+
+
+def ascii_delimeter_mutator(ctx: Ctx):
+    return _ascii_mutator(
+        ctx,
+        lambda c, cs: textmutas.string_delimeter_mutate(c.r, cs, c.ssrf_ep),
+        "ascii_delimeter",
+    )
+
+
+# --- fuse (src/erlamsa_mutations.erl:384-427) -----------------------------
+
+
+def sed_fuse_this(ctx: Ctx):
+    def fn(ll, meta):
+        h = ll[0]
+        b = fusemod.fuse(ctx.r, h, h)
+        d = ctx.r.rand_delta()
+        return fn, [b] + ll[1:], [("fuse_this", d)] + meta, d
+
+    return fn
+
+
+def sed_fuse_next(ctx: Ctx):
+    def fn(ll, meta):
+        h = ll[0]
+        al1, al2 = halve(h)
+        tail = ll[1:]
+        if tail:
+            b, rest = tail[0], tail[1:]
+        else:
+            b, rest = h, []
+        abl = fusemod.fuse(ctx.r, al1, b)
+        abal = fusemod.fuse(ctx.r, abl, al2)
+        d = ctx.r.rand_delta()
+        return fn, flush_bvecs(abal, rest), [("fuse_next", d)] + meta, d
+
+    return fn
+
+
+def sed_fuse_old(ctx: Ctx, block: bytes | None = None):
+    def fn(ll, meta):
+        h = ll[0]
+        blk = h if block is None else block
+        al1, al2 = halve(h)
+        ol1, ol2 = halve(blk)
+        a = fusemod.fuse(ctx.r, al1, ol1)
+        b = fusemod.fuse(ctx.r, ol2, al2)
+        swap = ctx.r.rand(3)
+        d = ctx.r.rand_delta()
+        new_block = h if swap == 0 else blk
+        out = flush_bvecs(a, flush_bvecs(b, ll[1:]))
+        return sed_fuse_old(ctx, new_block), out, [("fuse_old", d)] + meta, d
+
+    return fn
+
+
+# --- tree (src/erlamsa_mutations.erl:786-1023) ----------------------------
+
+
+def tree_op(ctx: Ctx, op, name: str):
+    def fn(ll, meta):
+        h = ll[0]
+        if binarish(h):
+            return fn, ll, meta, -1
+        tree = treeops.partial_parse(h)
+        new = op(ctx.r, tree)
+        return fn, [treeops.flatten_tree(new)] + ll[1:], [(name, 1)] + meta, 1
+
+    return fn
+
+
+def tree_swap(ctx: Ctx, op, name: str):
+    def fn(ll, meta):
+        h = ll[0]
+        if binarish(h):
+            return fn, ll, meta, -1
+        tree = treeops.partial_parse(h)
+        new = op(ctx.r, tree)
+        if new is None:
+            return fn, ll, meta, -1
+        return fn, [treeops.flatten_tree(new)] + ll[1:], [(name, 1)] + meta, 1
+
+    return fn
+
+
+def tree_stutter(ctx: Ctx):
+    def fn(ll, meta):
+        h = ll[0]
+        if binarish(h):
+            return fn, ll, meta, -1
+        tree = treeops.partial_parse(h)
+        new = treeops.sed_tree_stutter(ctx.r, tree)
+        if new is None:
+            return fn, ll, meta, -1
+        return fn, [treeops.flatten_tree(new)] + ll[1:], [("tree_stutter", 1)] + meta, 1
+
+    return fn
+
+
+# --- length predict (src/erlamsa_mutations.erl:1107-1143) -----------------
+
+
+def length_predict(ctx: Ctx):
+    def fn(ll, meta):
+        r = ctx.r
+        h = ll[0]
+        lens = fieldpred.get_possible_simple_lens(r, h)
+        elem = r.rand_elem(lens)
+        if not elem:
+            return fn, ll, [("muta_len", -2)] + meta, -2
+        size, endian, lval, a, _bb = elem
+        head, _lv, blob, rest = fieldpred.extract_blob(h, elem)
+        tmp = int.from_bytes(r.random_block(size // 8), "big")
+        new_len = min(ABSMAX_BINARY_BLOCK, tmp * 2)
+        t = r.rand(7)
+        if t == 0:  # len = 0
+            new = fieldpred.rebuild_blob(endian, head, 0, size, blob, rest)
+        elif t == 1:  # len = -1 (all ones)
+            new = fieldpred.rebuild_blob(endian, head, (1 << size) - 1, size, blob, rest)
+        elif t == 2:  # expand blob with random data
+            rnd = r.fast_pseudorandom_block(new_len)
+            new = fieldpred.rebuild_blob(endian, head, lval, size, blob, rnd) + rest
+        elif t == 3:  # drop blob
+            new = fieldpred.rebuild_blob(endian, head, new_len, size, b"", rest)
+        else:  # random len field
+            new = fieldpred.rebuild_blob(endian, head, new_len, size, blob, rest)
+        return fn, [new] + ll[1:], [("muta_len", 1)] + meta, 1
+
+    return fn
+
+
+# --- base64 (src/erlamsa_mutations.erl:653-690) ---------------------------
+
+
+def base64_mutator(ctx: Ctx):
+    def fn(ll, meta):
+        r = ctx.r
+        h = ll[0]
+        cs = strlex.lex(h)
+        mutas = build_mutators(ctx)
+        new_cs = []
+        total_d = -1
+        new_meta = list(meta)
+        for chunk in cs:
+            if chunk[0] == "text" and len(chunk[1]) > 6:
+                try:
+                    raw = bytes(chunk[1]) if not isinstance(chunk[1], bytes) else chunk[1]
+                    decoded = b64mod.b64decode(raw, validate=True)
+                    d = r.rand_delta()
+                    muta = mutators_mutator(ctx, [row[:] for row in mutas])
+                    _m, new_ll, mm = apply_mux(ctx, muta, [decoded], [])
+                    new_bin = b"".join(x for x in new_ll if isinstance(x, bytes))
+                    enc = b64mod.b64encode(new_bin)
+                    new_cs.append(("text", list(enc)))
+                    total_d += d
+                    new_meta = [mm, ("base64_mutator", d)] + new_meta
+                    continue
+                except Exception:
+                    pass
+            new_cs.append(chunk)
+        return fn, [strlex.unlex(new_cs)] + ll[1:], new_meta, total_d
+
+    return fn
+
+
+# --- URI (src/erlamsa_mutations.erl:693-784) ------------------------------
+
+
+def _change_scheme(acc_rev: list[int]) -> list[int]:
+    """file -> http, else reverse back (src/erlamsa_mutations.erl:734-736)."""
+    if acc_rev[:4] == [ord("e"), ord("l"), ord("i"), ord("f")]:
+        return [ord("h"), ord("t"), ord("t"), ord("p")] + acc_rev[4:][::-1]
+    return acc_rev[::-1]
+
+
+def uri_mutator(ctx: Ctx):
+    def fn(ll, meta):
+        r = ctx.r
+        h = ll[0]
+        cs = strlex.lex(h)
+        new_cs = []
+        total_d = -1
+        new_meta = list(meta)
+        for chunk in cs:
+            if chunk[0] == "text" and len(chunk[1]) > 5:
+                s = "".join(chr(c) for c in chunk[1])
+                idx = s.find("://")
+                if idx >= 0:
+                    acc_rev = [ord(c) for c in s[:idx]][::-1]
+                    tail = s[idx + 3 :]
+                    mutated = _rand_uri_mutate(ctx, tail, acc_rev, r.erand(3))
+                    new_cs.append(("text", [ord(c) & 0xFF for c in mutated]))
+                    total_d += 1
+                    new_meta = [("uri", "success")] + new_meta
+                    continue
+            new_cs.append(chunk)
+        # the reference returns fun base64_mutator/2 as the continuation
+        # (erlamsa_mutations.erl:784) — after its first run the mux row
+        # labelled 'uri' executes the base64 mutator; quirk preserved
+        return base64_mutator(ctx), [strlex.unlex(new_cs)] + ll[1:], new_meta, total_d
+
+    return fn
+
+
+def _rand_uri_mutate(ctx: Ctx, tail: str, acc_rev: list[int], t: int) -> str:
+    """(src/erlamsa_mutations.erl:738-758)."""
+    r = ctx.r
+    host, port = ctx.ssrf_ep
+    scheme = "".join(chr(c) for c in _change_scheme(acc_rev))
+    if t == 1:
+        return scheme + ctx.ssrf_uri() + tail
+    parts = [p for p in tail.split("/") if p != ""]
+    domain = parts[0] if parts else ""
+    query = parts[1:]
+    if t == 2:
+        at = r.rand_elem([" @{}:{}", "@{}:{}"]).format(host, port)
+        return f"{scheme}://{domain}{at}/" + "/".join(query)
+    traversals = "/" + "".join("../" for _ in range(r.erand(10)))
+    which = r.erand(4)
+    target = ["/".join(query), "Windows/win.ini", "etc/shadow", "etc/passwd"][which - 1]
+    return "".join(chr(c) for c in acc_rev[::-1]) + "://" + domain + traversals + target
+
+
+# --- zip (src/erlamsa_mutations.erl:1146-1163) ----------------------------
+
+
+def zip_path_traversal(ctx: Ctx):
+    def fn(ll, meta):
+        h = ll[0]
+        new = zipops.path_traversal(ctx.r, h)
+        if new is None:
+            return fn, ll, [("muta_zippath", -1)] + meta, -1
+        return fn, [new] + ll[1:], [("muta_zippath", 1)] + meta, 1
+
+    return fn
+
+
+# --- JSON / SGML ----------------------------------------------------------
+
+
+def _inner_bytes_mutator(ctx: Ctx, kind: str):
+    """Mutate a leaf's raw bytes with the inner mutator subset
+    (inner_mutations, src/erlamsa_mutations.erl:1341-1356)."""
+
+    def run(raw: bytes) -> bytes:
+        rows = [
+            row for row in build_mutators(ctx)
+            if row[3] in _INNER_SETS.get(kind, _INNER_SETS["default"])
+        ]
+        muta = mutators_mutator(ctx, rows)
+        _m, new_ll, _meta = apply_mux(ctx, muta, [bytes(raw)], [])
+        return b"".join(x for x in new_ll if isinstance(x, bytes))
+
+    return run
+
+
+_INNER_SETS = {
+    # the reference's sgml list names the atom `json`, which matches no
+    # registry entry (registry name is `js`) — so the JSON mutator is
+    # effectively absent from the sgml inner set; quirk preserved
+    # (erlamsa_mutations.erl:1342)
+    "sgml": {"ab", "ad", "bd", "b64", "ld", "lp", "lri", "lr", "num", "sd", "uri"},
+    "json": {"ab", "ad", "b64", "num", "sd", "sp", "sr", "uri", "sgm"},
+    "default": {"ab", "ad", "ber", "b64", "ld", "lp", "lri", "lr", "num", "sd",
+                "srnd", "uri", "zip"},
+}
+
+
+def json_mutator(ctx: Ctx):
+    def fn(ll, meta):
+        new, op, d = jsonfmt.json_mutate(
+            ctx.r, ll[0], _inner_bytes_mutator(ctx, "json")
+        )
+        return fn, [new] + ll[1:], [(op, d)] + meta, d
+
+    return fn
+
+
+def sgml_mutator(ctx: Ctx):
+    def fn(ll, meta):
+        new, op, d = sgmlfmt.sgml_mutate(
+            ctx.r, ll[0], _inner_bytes_mutator(ctx, "sgml"),
+            ctx.ssrf_uri().encode(),
+        )
+        return fn, [new] + ll[1:], [(op, d)] + meta, d
+
+    return fn
+
+
+def nomutation():
+    def fn(ll, meta):
+        return fn, ll, [("nomutation", -1)] + meta, -1
+
+    return fn
+
+
+# --- mux (src/erlamsa_mutations.erl:1238-1280, 1370-1395) -----------------
+
+
+def adjust_priority(pri: float, delta: int) -> float:
+    if delta == 0:
+        return pri
+    return max(MIN_SCORE, min(MAX_SCORE, pri + delta))
+
+
+def weighted_permutations(r: ErlRand, rows: list[list]) -> list[list]:
+    """rand(score*pri) keys, sorted descending (stable)
+    (src/erlamsa_mutations.erl:1244-1250)."""
+    keyed = [(r.rand(int(row[0] * row[1])), row) for row in rows]
+    keyed.sort(key=lambda kv: -kv[0])
+    return [row for _k, row in keyed]
+
+
+def mutators_mutator(ctx: Ctx, rows: list[list]) -> list[list]:
+    """Randomize initial scores max(2, rand(10)); the reference folds the
+    input list prepending, so scores are drawn in reversed row order
+    (src/erlamsa_mutations.erl:1385-1395 over the make_mutator fold output)."""
+    out = []
+    for row in rows:
+        n = ctx.r.rand(int(MAX_SCORE))
+        out.insert(0, [max(2, n), row[1], row[2], row[3]])
+    return out
+
+
+def apply_mux(ctx: Ctx, rows: list[list], ll: list, meta: list):
+    """One mux_fuzzers event: returns (rows', ll', meta')
+    (src/erlamsa_mutations.erl:1256-1280)."""
+    if ll == [b""] or not ll:
+        return rows, ll, meta
+    perm = weighted_permutations(ctx.r, rows)
+    out: list[list] = []
+    idx = 0
+    while idx < len(perm):
+        row = perm[idx]
+        h = ll[0] if ll else b""
+        if isinstance(h, bytes) and len(h) > ABSMAX_BINARY_BLOCK:
+            return out + perm[idx + 1 :], ll, [("skipped_big", len(h))] + meta
+        score, pri, fn, name = row
+        nfn, nll, nmeta, delta = fn(ll, meta)
+        nrow = [adjust_priority(score, delta), pri, nfn, name]
+        out = [nrow] + out
+        changed = not (isinstance(nll, list) and nll and ll and nll[0] == ll[0])
+        if changed:
+            return out + perm[idx + 1 :], nll, [("used", name)] + nmeta
+        meta = [("failed", name)] + nmeta
+        idx += 1
+    return out, ll, meta
+
+
+def make_mutator(ctx: Ctx, selected: list[tuple[str, int]], custom=()) -> list[list]:
+    """CLI entry: filter the registry by selected (name, pri) pairs and
+    randomize scores (make_mutator, src/erlamsa_mutations.erl:1370-1383)."""
+    sel = dict(selected)
+    rows = []
+    for row in build_mutators(ctx, custom):
+        if row[3] in sel:
+            rows.insert(0, [row[0], sel[row[3]], row[2], row[3]])
+    return mutators_mutator(ctx, rows)
+
+
+def default_mutations() -> list[tuple[str, int]]:
+    """(name, pri) defaults (src/erlamsa_mutations.erl:1358-1359)."""
+    return [
+        ("sgm", 10), ("js", 3), ("uw", 1), ("ui", 2), ("ab", 1), ("ad", 1),
+        ("tr2", 1), ("td", 1), ("num", 3), ("ts1", 2), ("tr", 2), ("ts2", 2),
+        ("bd", 1), ("bei", 1), ("bed", 1), ("bf", 1), ("bi", 1), ("ber", 1),
+        ("br", 1), ("sp", 1), ("sr", 1), ("sd", 1), ("snand", 1), ("srnd", 1),
+        ("ld", 1), ("lds", 1), ("lr2", 1), ("lri", 1), ("lr", 1), ("ls", 1),
+        ("lp", 1), ("lis", 1), ("lrs", 1), ("ft", 2), ("fn", 1), ("fo", 2),
+        ("len", 2), ("b64", 7), ("uri", 1), ("zip", 1), ("nil", 0),
+    ]

@@ -1,0 +1,350 @@
+"""Oracle mutation patterns over block streams.
+
+Sequential re-implementation of src/erlamsa_patterns.erl with the
+reference's draw order: od (once), nd (geometric many), bu (burst), sk
+(skip prefix), sz (sizer-aware), cs (checksum-preserving), ar (ZIP
+archive), cp (gzip/deflate), nu (none), co (coin flip).
+
+A pattern call takes (ll, rows, meta) where ll is a list of byte blocks
+(possibly with thunks) and rows is the mux mutator table; it returns
+(blocks_out, rows', meta') with blocks fully forced — the forcing order
+matches the reference's lazy-stream consumption, so AS183 draws align.
+"""
+
+from __future__ import annotations
+
+import gzip as gzipmod
+import zlib
+
+from ..constants import ABSMAX_BINARY_BLOCK, ABSMAXHALF_BINARY_BLOCK, INITIAL_IP, REMUTATE_PROBABILITY
+from ..models import fieldpred, zipops
+from ..utils.erlrand import ErlRand
+from .mutations import Ctx, apply_mux
+
+
+def _force(x):
+    while callable(x):
+        x = x()
+    return x
+
+
+def _uncons(ll):
+    ll = _force(ll)
+    if isinstance(ll, (bytes, bytearray)):
+        return bytes(ll), []
+    if not ll:
+        return None, []
+    return _force(ll[0]), ll[1:]
+
+
+def _split_maxblocks(r: ErlRand, this: bytes, acc: list) -> list:
+    """Giant blocks split below the 1MB bitstring cap with a random cut
+    (src/erlamsa_patterns.erl:45-51)."""
+    while len(this) > ABSMAX_BINARY_BLOCK:
+        s = ABSMAXHALF_BINARY_BLOCK
+        cut = s + r.rand(s) - 1
+        acc = [this[:cut]] + acc
+        this = this[cut:]
+    return [this] + acc
+
+
+def _split(r: ErlRand, this, rest):
+    """(src/erlamsa_patterns.erl:53-60)."""
+    if this is None:
+        return None, rest
+    if isinstance(this, bytes) and len(this) > ABSMAX_BINARY_BLOCK:
+        lst = _split_maxblocks(r, this, [])
+        lst = lst[::-1] + list(rest)  # cons_revlst
+        return lst[0], lst[1:]
+    return this, rest
+
+
+def _mutate_once_loop(ctx: Ctx, rows, meta, cont, ip, this, ll):
+    """Walk blocks, 1/rand(Ip) trigger per block
+    (src/erlamsa_patterns.erl:281-296)."""
+    out_blocks: list[bytes] = []
+    while True:
+        ll = _force(ll)
+        n = ctx.r.rand(ip)
+        if n == 0 or ll == []:
+            nrows, nll, nmeta = apply_mux(ctx, rows, [this] + list(ll), meta)
+            blocks, frows, fmeta = cont(nll, nrows, nmeta)
+            return out_blocks + blocks, frows, fmeta
+        out_blocks.append(this)
+        this, ll = _force(ll[0]), ll[1:]
+
+
+def _mutate_once(ctx: Ctx, ll, rows, meta, cont):
+    """(src/erlamsa_patterns.erl:266-278)."""
+    if ll == [b""]:
+        return [], rows, [("mutate_once", "empty_stopped")] + meta
+    ip = ctx.r.rand(INITIAL_IP)
+    this, rest = _uncons(ll)
+    this, rest = _split(ctx.r, this, rest)
+    if this is not None:
+        return _mutate_once_loop(ctx, rows, meta, cont, ip, this, rest)
+    return cont([], rows, meta)
+
+
+def _final(ll, rows, meta):
+    return [b for b in map(_force, ll) if isinstance(b, (bytes, bytearray))], rows, meta
+
+
+def pat_once_dec(ctx: Ctx, ll, rows, meta):
+    """od (src/erlamsa_patterns.erl:307-309)."""
+    return _mutate_once(ctx, ll, rows, [("pattern", "once_dec")] + meta, _final)
+
+
+def pat_many_dec(ctx: Ctx, ll, rows, meta):
+    """nd: remutate with 4/5 probability (src/erlamsa_patterns.erl:314-326)."""
+
+    def cont(l, rw, mt):
+        if ctx.r.rand_occurs(REMUTATE_PROBABILITY):
+            return pat_many_dec(ctx, l, rw, mt)
+        return _final(l, rw, mt)
+
+    return _mutate_once(ctx, ll, rows, [("pattern", "many_dec")] + meta, cont)
+
+
+def pat_burst(ctx: Ctx, ll, rows, meta):
+    """bu: >= 2 consecutive mutations at the same stream point
+    (src/erlamsa_patterns.erl:330-349)."""
+
+    def cont(l, rw, mt, n=1):
+        while True:
+            p = ctx.r.rand_occurs(REMUTATE_PROBABILITY)
+            if p or n < 2:
+                rw, l, mt = apply_mux(ctx, rw, l, mt)
+                n += 1
+                continue
+            return _final(l, rw, mt)
+
+    return _mutate_once(ctx, ll, rows, [("pattern", "burst")] + meta, cont)
+
+
+def _rand_cont_pattern(ctx: Ctx):
+    """make_complex_pat picks a continuation pattern from the FULL table
+    each call (src/erlamsa_patterns.erl:352-357)."""
+    table = patterns_table()
+    _pri, fn, _name, _desc = ctx.r.rand_elem(table)
+    return fn
+
+
+def pat_skip(ctx: Ctx, ll, rows, meta):
+    """sk: protect a random prefix (src/erlamsa_patterns.erl:147-161)."""
+    next_pat = _rand_cont_pattern(ctx)
+    meta = [("pattern", "skipper")] + meta
+    ip = ctx.r.rand(INITIAL_IP)
+    bin_, rest = _uncons(ll)
+    if bin_ is None:
+        return [], rows, meta
+    ln = ctx.r.rand(len(bin_) // 2)
+    head, tail = bin_[:ln], bin_[ln:]
+    this, rest = _split(ctx.r, tail, rest)
+    meta2 = [("skipped", ln)] + meta
+    if this is not None:
+        blocks, frows, fmeta = _mutate_once_loop(
+            ctx, rows, meta2, lambda l, rw, mt: next_pat(ctx, l, rw, mt), ip, this, rest
+        )
+    else:
+        blocks, frows, fmeta = [], rows, meta2
+    return [head] + blocks, frows, fmeta
+
+
+def _prepare4sizer(blocks):
+    """Join leading binaries (src/erlamsa_patterns.erl:64-78)."""
+    return b"".join(blocks)
+
+
+def pat_sizer(ctx: Ctx, ll, rows, meta):
+    """sz: find a length field and mutate the enclosed blob
+    (src/erlamsa_patterns.erl:81-111)."""
+    next_pat = _rand_cont_pattern(ctx)
+    meta = [("pattern", "sizer")] + meta
+    ip = ctx.r.rand(INITIAL_IP)
+    bin_, rest = _uncons(ll)
+    if bin_ is None:
+        return [], rows, meta
+    elem = ctx.r.rand_elem(fieldpred.get_possible_simple_lens(ctx.r, bin_))
+    if not elem:
+        this, rest2 = _split(ctx.r, bin_, rest)
+        return _mutate_once_loop(
+            ctx, rows, [("sizer", "failed")] + meta,
+            lambda l, rw, mt: next_pat(ctx, l, rw, mt), ip, this, rest2,
+        )
+    size, endian, _lval, _a, _b = elem
+    head, _lv, blob, tailbin = fieldpred.extract_blob(bin_, elem)
+    this, rest2 = _split(ctx.r, blob, rest)
+    blocks, frows, fmeta = _mutate_once_loop(
+        ctx, rows, [("sizer", elem)] + meta,
+        lambda l, rw, mt: next_pat(ctx, l, rw, mt), ip, this, rest2,
+    )
+    new_blob = _prepare4sizer(blocks)
+    new_bin = fieldpred.rebuild_blob(endian, head, len(new_blob), size, b"", new_blob)
+    return [new_bin, tailbin], frows, fmeta
+
+
+def pat_csum(ctx: Ctx, ll, rows, meta):
+    """cs: mutate a checksummed body and fix the trailer
+    (src/erlamsa_patterns.erl:115-144)."""
+    next_pat = _rand_cont_pattern(ctx)
+    meta = [("pattern", "csum")] + meta
+    ip = ctx.r.rand(INITIAL_IP)
+    bin_, rest = _uncons(ll)
+    if bin_ is None:
+        return [], rows, meta
+    elem = ctx.r.rand_elem(fieldpred.get_possible_csum_locations(bin_))
+    if not elem:
+        this, rest2 = _split(ctx.r, bin_, rest)
+        return _mutate_once_loop(
+            ctx, rows, [("csum", "failed")] + meta,
+            lambda l, rw, mt: next_pat(ctx, l, rw, mt), ip, this, rest2,
+        )
+    kind, size, plen, blen = elem
+    pre, blob = bin_[:plen], bin_[plen : plen + blen]
+    this, rest2 = _split(ctx.r, blob, rest)
+    blocks, frows, fmeta = _mutate_once_loop(
+        ctx, rows, [("csum", elem)] + meta,
+        lambda l, rw, mt: next_pat(ctx, l, rw, mt), ip, this, rest2,
+    )
+    new_blob = _prepare4sizer(blocks)
+    c = fieldpred.recalc_csum(kind, new_blob)
+    return [pre + new_blob + c.to_bytes(size // 8, "big")], frows, fmeta
+
+
+def pat_archiver(ctx: Ctx, ll, rows, meta):
+    """ar: mutate ~25% of ZIP members (src/erlamsa_patterns.erl:165-214)."""
+    next_pat = _rand_cont_pattern(ctx)
+    meta = [("pattern", "archiver")] + meta
+    ip = ctx.r.rand(INITIAL_IP)
+    bin_, rest = _uncons(ll)
+    if bin_ is None:
+        return [], rows, meta
+    joined = bin_
+    if rest and all(isinstance(x, (bytes, bytearray)) for x in rest):
+        joined = bin_ + b"".join(rest)
+        rest = []
+    members = zipops.list_members(joined)
+    if members is None:
+        this, rest2 = _split(ctx.r, joined, rest)
+        return _mutate_once_loop(
+            ctx, rows, [("archiver", "failed")] + meta,
+            lambda l, rw, mt: next_pat(ctx, l, rw, mt), ip, this, rest2,
+        )
+    new_members = []
+    frows = rows
+    for name, content in members:
+        if ctx.r.rand(1000) > 750:  # 25%-ish per member
+            blocks, frows, _m = _mutate_once_loop(
+                ctx, frows, [], lambda l, rw, mt: next_pat(ctx, l, rw, mt),
+                ip, content, [],
+            )
+            new_members.append((name, _prepare4sizer(blocks)))
+        else:
+            new_members.append((name, content))
+    try:
+        return [zipops.rebuild(new_members)], frows, [("archiver", "ok")] + meta
+    except Exception:
+        return [joined], frows, [("archiver", "failed")] + meta
+
+
+def pat_compressed(ctx: Ctx, ll, rows, meta):
+    """cp: decompress (gzip, then raw zlib), mutate, recompress
+    (src/erlamsa_patterns.erl:216-260)."""
+    next_pat = _rand_cont_pattern(ctx)
+    meta = [("pattern", "compressed")] + meta
+    ip = ctx.r.rand(INITIAL_IP)
+    bin_, rest = _uncons(ll)
+    if bin_ is None:
+        return [], rows, meta
+    new_bin, frows, ok = None, rows, False
+    for kind in ("gzip", "deflate"):
+        try:
+            data = gzipmod.decompress(bin_) if kind == "gzip" else zlib.decompress(bin_)
+            blocks, frows, _m = _mutate_once_loop(
+                ctx, rows, [], lambda l, rw, mt: next_pat(ctx, l, rw, mt),
+                ip, data, [],
+            )
+            payload = _prepare4sizer(blocks)
+            new_bin = (
+                gzipmod.compress(payload) if kind == "gzip" else zlib.compress(payload)
+            )
+            meta = [("compressed", kind)] + meta
+            ok = True
+            break
+        except Exception:
+            continue
+    if not ok or new_bin == bin_:
+        this, rest2 = _split(ctx.r, bin_, rest)
+        return _mutate_once_loop(
+            ctx, frows, [("compressed", "failed")] + meta,
+            lambda l, rw, mt: next_pat(ctx, l, rw, mt), ip, this, rest2,
+        )
+    return [new_bin] + [b for b in rest if isinstance(b, bytes)], frows, meta
+
+
+def pat_nomuta(ctx: Ctx, ll, rows, meta):
+    """nu (src/erlamsa_patterns.erl:387-390)."""
+    this, rest = _uncons(ll)
+    this, rest = _split(ctx.r, this, rest)
+    blocks = [] if this is None else [this]
+    for b in rest:
+        b = _force(b)
+        if isinstance(b, (bytes, bytearray)):
+            blocks.append(bytes(b))
+    return blocks, rows, [("pattern", "no_muta")] + meta
+
+
+def pat_50_muta(ctx: Ctx, ll, rows, meta):
+    """co (src/erlamsa_patterns.erl:379-384)."""
+    if ctx.r.erand(2) == 1:
+        return pat_nomuta(ctx, ll, rows, meta)
+    return pat_once_dec(ctx, ll, rows, meta)
+
+
+_TABLE = None
+
+
+def patterns_table():
+    """(pri, fn, name, desc) rows (src/erlamsa_patterns.erl:394-405)."""
+    global _TABLE
+    if _TABLE is None:
+        _TABLE = [
+            (1, pat_once_dec, "od", "Mutate once pattern"),
+            (2, pat_many_dec, "nd", "Mutate possibly many times"),
+            (1, pat_burst, "bu", "Make several mutations closeby once"),
+            (2, pat_skip, "sk", "Skip random sized block and mutate rest"),
+            (2, pat_sizer, "sz", "Try to find sizer and mutate enclosed data"),
+            (1, pat_csum, "cs", "Try to find control sum field and mutate enclosed data"),
+            (1, pat_archiver, "ar", "Check whether data is an container (ZIP) and mutate enclosed files"),
+            (1, pat_compressed, "cp", "Check whether data compressed, decompress and mutate"),
+            (0, pat_50_muta, "co", "Coin-flip pattern"),
+            (0, pat_nomuta, "nu", "Pattern that calls no mutations"),
+        ]
+    return _TABLE
+
+
+def default_patterns() -> list[tuple[str, int]]:
+    return [(name, pri) for pri, _fn, name, _d in patterns_table()]
+
+
+def make_pattern(selected: list[tuple[str, int]]):
+    """Priority-muxed pattern chooser (src/erlamsa_patterns.erl:416-443)."""
+    sel = dict(selected)
+    pats = [
+        (sel[name], fn)
+        for pri, fn, name, _d in patterns_table()
+        if name in sel
+    ]
+    pats.sort(key=lambda x: -x[0])
+    total = sum(p for p, _ in pats)
+
+    def pattern(ctx: Ctx, ll, rows, meta):
+        n = ctx.r.rand(total)
+        for pri, fn in pats:
+            if n < pri or pri == 0 and n == 0:
+                return fn(ctx, ll, rows, meta)
+            n -= pri
+        return pats[-1][1](ctx, ll, rows, meta)
+
+    return pattern

