@@ -1,0 +1,8 @@
+"""python -m erlamsa_tpu — the CLI entry point (the reference's escript
+main, src/erlamsa.erl:5-17)."""
+
+import sys
+
+from .services.cli import main
+
+sys.exit(main())

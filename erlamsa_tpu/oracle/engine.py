@@ -67,8 +67,9 @@ class Engine:
         try:
             blocks, gen_meta = self.generator()
             rows = self._rows
+            ll = blocks if callable(blocks) else list(blocks)
             out_blocks, new_rows, meta = self.pattern(
-                self.ctx, list(blocks), rows, [("nth", case_idx)]
+                self.ctx, ll, rows, [("nth", case_idx)]
             )
             if self.sequence_muta:
                 self._rows = new_rows

@@ -32,43 +32,74 @@ def rand_block_size(r: ErlRand, block_scale: float) -> int:
                round(MIN_BLOCK_SIZE * block_scale))
 
 
-def _stream_bytes(r: ErlRand, data: bytes, block_scale: float) -> list[bytes]:
-    """Cut a byte source into random-sized blocks, mirroring stream_port
-    (erlamsa_gen.erl:63-88): the next block size is drawn BEFORE each read,
-    so data ending exactly on a block boundary still consumes one trailing
-    size draw before EOF is discovered."""
-    blocks = []
-    i = 0
-    while True:
-        want = rand_block_size(r, block_scale)
-        chunk = data[i : i + want]
-        i += len(chunk)
-        if len(chunk) == want:
-            blocks.append(chunk)
-            continue
-        # short read -> EOF on in-memory data
-        if chunk:
-            blocks.append(chunk)
-        return blocks + _finish(r, len(data))
+def _lazy_stream(ctx: Ctx, data: bytes, block_scale: float):
+    """One-shot lazy stream mirroring port_stream (erlamsa_gen.erl:59-88):
+    the returned thunk, when FORCED (by the pattern's uncons, i.e. after
+    the pattern-choice and Ip draws), materializes the whole block list —
+    the reference's stream_port recursion is eager after the first force.
+    The next block size is drawn before each read, so exact-boundary data
+    consumes one trailing size draw before EOF; draws land on whatever
+    stream ctx.r is bound to at forcing time (the per-case worker stream)."""
+
+    def force() -> list[bytes]:
+        r = ctx.r
+        blocks: list[bytes] = []
+        i = 0
+        while True:
+            want = rand_block_size(r, block_scale)
+            chunk = data[i : i + want]
+            i += len(chunk)
+            if len(chunk) == want:
+                blocks.append(chunk)
+                continue
+            if chunk:
+                blocks.append(chunk)
+            return blocks + _finish(r, len(data))
+
+    return force
 
 
-def stdin_generator(ctx: Ctx, block_scale: float):
+def _force_all(ll) -> list[bytes]:
+    """forcell (erlamsa_utils.erl:108-111): materialize a lazy chain."""
+    out = []
+    while callable(ll):
+        ll = ll()
+    for x in ll:
+        while callable(x):
+            x = x()
+        if isinstance(x, (bytes, bytearray)):
+            out.append(bytes(x))
+        else:
+            out.extend(_force_all(x))
+    return out
+
+
+def stdin_generator(ctx: Ctx, online: bool, block_scale: float):
+    """stdin source (erlamsa_gen.erl:91-102): single-case runs keep the
+    stream lazy (block draws land on the worker stream); multi-case runs
+    force it ONCE at construction on the parent stream and reuse it."""
     data = sys.stdin.buffer.read()
+    if online:
+        def gen():
+            return _lazy_stream(ctx, data, block_scale), ("generator", "stdin")
+        return gen
+    blocks = _force_all(_lazy_stream(ctx, data, block_scale))
 
     def gen():
-        return _stream_bytes(ctx.r, data, block_scale), ("generator", "stdin")
+        return list(blocks), ("generator", "stdin")
 
     return gen
 
 
 def file_generator(ctx: Ctx, paths: list[str], block_scale: float):
-    """Pick a random path per case (erlamsa_gen.erl:105-121)."""
+    """Pick a random path per case; blocks stay lazy
+    (erlamsa_gen.erl:105-121)."""
 
     def gen():
         p = ctx.r.erand(len(paths))
         with open(paths[p - 1], "rb") as f:
             data = f.read()
-        return _stream_bytes(ctx.r, data, block_scale), [
+        return _lazy_stream(ctx, data, block_scale), [
             ("generator", "file"), ("source", "path")
         ]
 
@@ -76,7 +107,8 @@ def file_generator(ctx: Ctx, paths: list[str], block_scale: float):
 
 
 def jump_generator(ctx: Ctx, paths: list[str], block_scale: float):
-    """Splice random spans of two random files (erlamsa_gen.erl:123-150)."""
+    """Splice random spans of two random files; the splice itself is a
+    thunk forced under the pattern walk (erlamsa_gen.erl:123-150)."""
 
     def gen():
         r = ctx.r
@@ -86,17 +118,23 @@ def jump_generator(ctx: Ctx, paths: list[str], block_scale: float):
             d1r = f.read()
         with open(p2, "rb") as f:
             d2r = f.read()
-        b1 = _stream_bytes(r, d1r, block_scale)
-        b2 = _stream_bytes(r, d2r, block_scale)
-        data1 = r.rand_elem(b1) if b1 else b""
-        data2 = r.rand_elem(b2) if b2 else b""
-        s1 = r.rand(len(data1))
-        s2 = r.rand(len(data2))
-        l1 = r.erand(len(data1) - s1)
-        l2 = r.erand(len(data2) - s2)
-        return [data1[s1 : s1 + l1] + data2[s2 : s2 + l2]], [
-            ("generator", "jump"), ("source", "path")
-        ]
+        ll1 = _lazy_stream(ctx, d1r, block_scale)
+        ll2 = _lazy_stream(ctx, d2r, block_scale)
+
+        def thunk():
+            # interleaved like jump_somewhere (erlamsa_gen.erl:123-132):
+            # force stream 1, pick from it, THEN force stream 2
+            b1 = _force_all(ll1)
+            data1 = r.rand_elem(b1) if b1 else b""
+            b2 = _force_all(ll2)
+            data2 = r.rand_elem(b2) if b2 else b""
+            s1 = r.rand(len(data1))
+            s2 = r.rand(len(data2))
+            l1 = r.erand(len(data1) - s1)
+            l2 = r.erand(len(data2) - s2)
+            return [data1[s1 : s1 + l1] + data2[s2 : s2 + l2]]
+
+        return thunk, [("generator", "jump"), ("source", "path")]
 
     return gen
 
@@ -153,7 +191,9 @@ def make_generator(ctx: Ctx, pris: list[tuple[str, int]], paths, opts, n_cases: 
     candidates = []
     for name, pri in pris:
         if name == "stdin" and paths and paths[0] == "-" and external is None:
-            candidates.append((pri, name, stdin_generator(ctx, block_scale)))
+            candidates.append(
+                (pri, name, stdin_generator(ctx, n_cases == 1, block_scale))
+            )
         elif name == "file" and paths and paths != ["-"] and paths != ["direct"]:
             fpaths = _expand_paths(paths) if opts.get("recursive") else list(paths)
             candidates.append((pri, name, file_generator(ctx, fpaths, block_scale)))

@@ -102,9 +102,16 @@ def _step_state(r: ErlRand, st: list, l: list) -> list:
         ep = r.erand(ln)
         new = l[ep - 1]
         old = st[up]
-        # the reference's applynth fun destructures the stored element and
-        # keeps its tail: slot becomes New ++ tl(Old) (erlamsa_generic.erl:135)
-        st[up] = new + old[1:] if isinstance(old, (bytes, bytearray)) else new
+        # the reference's applynth fun keeps the slot as the nested term
+        # [New | tl(Old)] (erlamsa_generic.erl:135): the first update's tail
+        # is the original line minus its head byte; a SECOND update drops
+        # the whole previous New and keeps that same tail. Model the slot
+        # as (new_line, tail_bytes).
+        if isinstance(old, tuple):
+            tail = old[1]
+        else:
+            tail = old[1:]
+        st[up] = (new, tail)
     return st
 
 

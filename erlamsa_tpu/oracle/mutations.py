@@ -255,12 +255,17 @@ def build_mutators(ctx: Ctx, custom=()) -> list[list]:
         mask_fun = r.rand_elem(mask_funs)
 
         def op(c, head, span, tail, rest):
-            # randmask: prob erand(100)/100 per byte with the nom==1 quirk
-            # (src/erlamsa_mutations.erl:279-291)
+            # randmask: prob erand(100)/100 per byte with the nom==1 quirk.
+            # The reference draws the NEXT byte's occurrence flag before the
+            # current byte's mask draw and discards the final one at [] —
+            # N+1 flag draws total (src/erlamsa_mutations.erl:279-291).
             prob = c.r.erand(100)
+            flag = c.r.rand_occurs_fixed(prob, 100)
             out = bytearray()
             for byte in span:
-                if c.r.rand_occurs_fixed(prob, 100):
+                cur = flag
+                flag = c.r.rand_occurs_fixed(prob, 100)
+                if cur:
                     out.append(mask_fun(c, byte) & 0xFF)
                 else:
                     out.append(byte)
@@ -372,6 +377,8 @@ def _as_bytes(x) -> bytes:
         return bytes(x)
     if isinstance(x, int):
         return bytes([x & 0xFF])
+    if isinstance(x, tuple):  # nested reservoir slot (new_line, tail_bytes)
+        return _as_bytes(x[0]) + _as_bytes(x[1])
     return b"".join(_as_bytes(e) for e in x)
 
 
@@ -601,9 +608,11 @@ def base64_mutator(ctx: Ctx):
 
 
 def _change_scheme(acc_rev: list[int]) -> list[int]:
-    """file -> http, else reverse back (src/erlamsa_mutations.erl:734-736)."""
+    """Trailing 'file' becomes 'http' IN PLACE: the reference reverses
+    [$p,$t,$t,$h | T], i.e. prefix-text ++ "http"
+    (src/erlamsa_mutations.erl:734-736)."""
     if acc_rev[:4] == [ord("e"), ord("l"), ord("i"), ord("f")]:
-        return [ord("h"), ord("t"), ord("t"), ord("p")] + acc_rev[4:][::-1]
+        return acc_rev[4:][::-1] + [ord("h"), ord("t"), ord("t"), ord("p")]
     return acc_rev[::-1]
 
 

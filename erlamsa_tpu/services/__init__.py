@@ -1,0 +1,3 @@
+"""Host shell: CLI, output writers, logging, monitors, proxy, FaaS,
+distributed nodes — the reference's L5/L4/L2/L1 layers (SURVEY.md §1)
+re-implemented around the TPU batch engine and the oracle."""

@@ -1,0 +1,124 @@
+"""Adaptive request batcher: funnel concurrent FaaS/proxy requests into
+device batches.
+
+The reference spawns one Erlang process per fuzz request
+(src/erlamsa_fsupervisor.erl:47-51); the TPU design instead queues
+requests and flushes them to one fuzz_batch call when the batch fills or a
+latency deadline passes — SURVEY.md §3.3's "batching opportunity". Oracle
+fallback handles requests whose options the device path can't serve
+(host-only mutators, patterns ar/cp/sz/cs).
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from dataclasses import dataclass, field
+
+from ..utils.erlrand import gen_urandom_seed
+
+
+@dataclass
+class _Req:
+    data: bytes
+    opts: dict
+    done: threading.Event = field(default_factory=threading.Event)
+    result: bytes = b""
+
+
+class OracleBatcher:
+    """Per-request oracle execution (the fallback backend): still bounded by
+    a worker pool rather than a process per request."""
+
+    def __init__(self, workers: int = 10):
+        self._q: queue.Queue[_Req] = queue.Queue()
+        for _ in range(workers):
+            threading.Thread(target=self._worker, daemon=True).start()
+
+    def _worker(self):
+        from ..oracle.engine import fuzz
+
+        while True:
+            req = self._q.get()
+            try:
+                req.result = fuzz(
+                    req.data,
+                    seed=req.opts.get("seed") or gen_urandom_seed(),
+                    **{k: v for k, v in req.opts.items() if k != "seed"},
+                )
+            except Exception:
+                req.result = b""
+            req.done.set()
+
+    def fuzz(self, data: bytes, opts: dict, timeout: float = 90.0) -> bytes:
+        req = _Req(data, opts)
+        self._q.put(req)
+        if not req.done.wait(timeout):
+            return b""  # erlamsa_fsupervisor.erl:83-86 empty answer
+        return req.result
+
+
+class TpuBatcher:
+    """Accumulate requests; flush as one padded device batch when the batch
+    fills or max_latency_ms passes."""
+
+    def __init__(self, batch: int = 256, capacity: int = 16384,
+                 max_latency_ms: float = 20.0, seed=None):
+        import jax
+
+        from ..ops import prng
+        from ..ops.pipeline import make_fuzzer
+        from ..ops.scheduler import init_scores
+
+        self.batch = batch
+        self.capacity = capacity
+        self.max_latency = max_latency_ms / 1000.0
+        self._q: queue.Queue[_Req] = queue.Queue()
+        self._step, _ = make_fuzzer(capacity, batch)
+        self._base = prng.base_key(seed or gen_urandom_seed())
+        self._scores = init_scores(jax.random.fold_in(self._base, 999), batch)
+        self._case = 0
+        threading.Thread(target=self._flusher, daemon=True).start()
+
+    def _flusher(self):
+        import numpy as np
+
+        from ..ops.buffers import Batch, pack, unpack
+
+        while True:
+            reqs: list[_Req] = [self._q.get()]
+            deadline = time.monotonic() + self.max_latency
+            while len(reqs) < self.batch:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    break
+                try:
+                    reqs.append(self._q.get(timeout=remaining))
+                except queue.Empty:
+                    break
+            seeds = [r.data[: self.capacity] for r in reqs]
+            pad = [b"\x00"] * (self.batch - len(seeds))
+            packed = pack(seeds + pad, capacity=self.capacity)
+            data, lens, self._scores, _meta = self._step(
+                self._base, self._case, packed.data, packed.lens, self._scores
+            )
+            self._case += 1
+            results = unpack(Batch(data, lens))
+            for r, res in zip(reqs, results):
+                r.result = res
+                r.done.set()
+
+    def fuzz(self, data: bytes, opts: dict, timeout: float = 90.0) -> bytes:
+        req = _Req(data, opts)
+        self._q.put(req)
+        if not req.done.wait(timeout):
+            return b""
+        return req.result
+
+
+def make_batcher(backend: str, **kw):
+    if backend == "tpu":
+        return TpuBatcher(**{k: v for k, v in kw.items()
+                             if k in ("batch", "capacity", "max_latency_ms", "seed")})
+    return OracleBatcher(workers=kw.get("workers", 10))

@@ -1,0 +1,243 @@
+"""Command-line interface, flag-compatible with the reference's erlamsa.
+
+Reference: src/erlamsa_cmdparse.erl (getopt spec at :77-137, --list
+introspection at :147-178, priority-list parsing at :232-257). Added here:
+``--backend tpu`` selects the batched device engine and ``--batch`` its
+batch size — the TPU analogue of ``-m xla`` in the north star.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+from ..utils.erlrand import gen_urandom_seed, parse_seed
+from . import logger, out
+
+
+def _parse_actions(s: str, defaults: list[tuple[str, int]]) -> list[tuple[str, int]]:
+    """'name=pri,name,...' -> [(name, pri)] on top of defaults
+    (string_to_actions, src/erlamsa_cmdparse.erl:232-257)."""
+    if s in ("default", "", None):
+        return list(defaults)
+    known = dict(defaults)
+    out_list: list[tuple[str, int]] = []
+    for item in s.split(","):
+        if not item:
+            continue
+        if "=" in item:
+            name, pri = item.split("=", 1)
+            out_list.append((name, int(pri)))
+        else:
+            out_list.append((item, known.get(item, 1)))
+    bad = [n for n, _ in out_list if n not in known]
+    if bad:
+        raise SystemExit(f"Unknown mutation/pattern/generator name(s): {bad}")
+    return out_list
+
+
+def _show_list() -> None:
+    """--list introspection (show_list, src/erlamsa_cmdparse.erl:147-178)."""
+    from ..oracle.gen import GENERATOR_INFO
+    from ..oracle.mutations import default_mutations
+    from ..oracle.patterns import patterns_table
+    from ..ops.registry import DEVICE_CODES
+
+    descs = {
+        "sgm": "SGML tree mutations", "js": "JSON tree mutations",
+        "uw": "try to make a code point too wide",
+        "ui": "insert funny unicode",
+        "ab": "enhance silly issues in ASCII string data handling",
+        "ad": "play with delimeters in ASCII string data",
+        "tr2": "duplicate a node", "td": "delete a node",
+        "num": "try to modify a textual number",
+        "ts1": "swap one node with another one",
+        "tr": "repeat a path of the parse tree",
+        "ts2": "swap two nodes pairwise",
+        "bd": "drop a byte", "bei": "increment a byte by one",
+        "bed": "decrement a byte by one", "bf": "flip one bit",
+        "bi": "insert a byte", "ber": "swap a byte with random one",
+        "br": "repeat a byte", "sp": "permute a sequence of bytes",
+        "sr": "repeat a sequence of bytes", "sd": "delete a sequence of bytes",
+        "snand": "NAND/OR/XOR random bytes from block",
+        "srnd": "replace random bytes from block with random values",
+        "ld": "delete a line", "lds": "delete many lines",
+        "lr2": "duplicate a line", "lri": "copy a line closeby",
+        "lr": "repeat a line", "ls": "swap two lines",
+        "lp": "swap order of lines", "lis": "insert a line from elsewhere",
+        "lrs": "replace a line with one from elsewhere",
+        "ft": "jump to a similar position in block",
+        "fn": "likely clone data between similar positions",
+        "fo": "fuse previously seen data elsewhere",
+        "len": "predicted length mutation",
+        "b64": "try mutate base64-encoded block",
+        "uri": "try mutate URI to cause SSRF", "zip": "ZIP path traversal",
+        "nil": "no mutation will occur (debugging purposes)",
+    }
+    print("Mutations (-m)   [* = also runs on TPU backend]")
+    for name, pri in default_mutations():
+        star = "*" if name in DEVICE_CODES else " "
+        print(f"  {star} {name:6s} pri={pri:<3d} {descs.get(name, '')}")
+    print("\nPatterns (-p)")
+    for pri, _fn, name, desc in patterns_table():
+        print(f"    {name:6s} pri={pri:<3d} {desc}")
+    print("\nGenerators (-g)")
+    for name, pri, desc in GENERATOR_INFO:
+        print(f"    {name:6s} pri={pri:<6d} {desc}")
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="erlamsa-tpu",
+        description="TPU-native general-purpose fuzzer "
+        "(erlamsa-compatible CLI).",
+    )
+    p.add_argument("paths", nargs="*", default=[], help="input files, or - for stdin")
+    p.add_argument("-n", "--count", default="1", help="number of cases, or 'inf'")
+    p.add_argument("-s", "--seed", default=None, help="random seed a,b,c")
+    p.add_argument("-m", "--mutations", default="default")
+    p.add_argument("-p", "--patterns", default="default")
+    p.add_argument("-g", "--generators", default="default")
+    p.add_argument("-o", "--output", default="-")
+    p.add_argument("-b", "--blockscale", type=float, default=1.0)
+    p.add_argument("-w", "--workers", type=int, default=1)
+    p.add_argument("--skip", type=int, default=0)
+    p.add_argument("--sleep", type=int, default=0, help="ms between cases")
+    p.add_argument("--maxfails", type=int, default=10)
+    p.add_argument("-S", "--sequence-muta", action="store_true")
+    p.add_argument("-l", "--list", action="store_true", help="list engines")
+    p.add_argument("-v", "--verbose", action="count", default=0)
+    p.add_argument("-L", "--logger", default=None,
+                   help="log spec: stdout|stderr|file=path[,level]")
+    p.add_argument("-M", "--meta", default=None, help="write metadata to path")
+    p.add_argument("-r", "--recursive", action="store_true")
+    p.add_argument("-H", "--httpsvc", default=None, help="run FaaS at host:port")
+    p.add_argument("-i", "--proxy", default=None,
+                   help="fuzzing proxy spec proto://lport:rhost:rport")
+    p.add_argument("-P", "--proxy-prob", default="0.1,0.1",
+                   help="proxy fuzzing probabilities c->s,s->c")
+    p.add_argument("--monitor", action="append", default=[],
+                   help="+name:params / !name:off")
+    p.add_argument("-e", "--external", default=None,
+                   help="python module with capabilities()")
+    p.add_argument("--backend", choices=["oracle", "tpu"], default="oracle",
+                   help="oracle = sequential parity engine; tpu = batched device engine")
+    p.add_argument("--batch", type=int, default=1024, help="TPU batch size")
+    p.add_argument("--node", default=None, help="join a parent node host:port")
+    p.add_argument("--svcport", type=int, default=17771,
+                   help="distribution/control port")
+    return p
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+
+    if args.list:
+        _show_list()
+        return 0
+
+    if args.logger:
+        spec = {}
+        for part in args.logger.split(","):
+            if part in ("stdout", "stderr"):
+                spec[part] = "debug" if args.verbose else "info"
+            elif part.startswith("file="):
+                spec["file"] = (part[5:], "debug")
+        logger.GLOBAL.configure(spec)
+
+    try:
+        seed = parse_seed(args.seed) if args.seed else gen_urandom_seed()
+    except ValueError as e:
+        raise SystemExit(f"erlamsa-tpu: {e}")
+    with open("./last_seed.txt", "w") as f:  # erlamsa_main.erl:135
+        f.write(repr(seed))
+
+    from ..oracle.gen import default_generators
+    from ..oracle.mutations import default_mutations
+    from ..oracle.patterns import default_patterns
+
+    n = 2**62 if args.count == "inf" else int(args.count)
+    opts = {
+        "paths": args.paths or ["-"],
+        "n": n,
+        "seed": seed,
+        "mutations": _parse_actions(args.mutations, default_mutations()),
+        "patterns": _parse_actions(args.patterns, default_patterns()),
+        "generators": _parse_actions(args.generators, default_generators()),
+        "blockscale": args.blockscale,
+        "skip": args.skip,
+        "sleep": args.sleep,
+        "maxfails": args.maxfails,
+        "sequence_muta": args.sequence_muta,
+        "recursive": args.recursive,
+        "workers": args.workers,
+        "output": args.output,
+        "verbose": args.verbose,
+        "meta_path": args.meta,
+    }
+
+    # service modes
+    if args.httpsvc:
+        from .faas import serve
+
+        host, _, port = args.httpsvc.rpartition(":")
+        return serve(host or "0.0.0.0", int(port), opts, backend=args.backend,
+                     batch=args.batch)
+    if args.proxy:
+        from .proxy import run_proxy
+
+        return run_proxy(args.proxy, args.proxy_prob, opts)
+    if args.node:
+        from .dist import run_node
+
+        host, _, port = args.node.rpartition(":")
+        return run_node(host or "127.0.0.1", int(port), opts)
+
+    if args.monitor:
+        from .monitors import start_monitors
+
+        start_monitors(args.monitor)
+
+    if args.backend == "tpu":
+        from .batchrunner import run_tpu_batch
+
+        return run_tpu_batch(opts, batch=args.batch)
+
+    return _run_oracle(opts)
+
+
+def _run_oracle(opts: dict) -> int:
+    from ..oracle.engine import Engine
+
+    writer, _maxtime = out.string_outputs(opts.get("output", "-"))
+    meta_fd = open(opts["meta_path"], "w") if opts.get("meta_path") else None
+
+    def writing(case_idx, data, meta):
+        if writer is not None:
+            writer(case_idx, data, meta)
+        if meta_fd:
+            meta_fd.write(f"{case_idx}\t{meta!r}\n")
+
+    workers = opts.get("workers", 1)
+    output = opts.get("output", "-")
+    if workers > 1 and output not in ("-", "return", "stdout", "stderr"):
+        from .workerpool import run_workers
+
+        return run_workers(opts, writing)
+
+    eng = Engine(opts)
+    if writer is None:
+        # return mode: Engine collects, CLI prints the collected results
+        results = eng.run()
+        for rdata in results:
+            sys.stdout.buffer.write(rdata)
+        sys.stdout.buffer.flush()
+    else:
+        eng.run(writing)
+    if meta_fd:
+        meta_fd.close()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,81 @@
+"""Cloud manager: API tokens and sessions for the FaaS service.
+
+Reference: src/erlamsa_cmanager.erl — 160-bit base64 tokens and sessions
+with 600s expiry kept in mnesia, token CRUD gated by an admin token. Here
+an in-memory store with a lock (the FaaS server is threaded).
+"""
+
+from __future__ import annotations
+
+import base64
+import os
+import threading
+import time
+
+from ..constants import NODE_ALIVE_DELTA  # noqa: F401  (re-exported constants live here)
+
+SESSION_EXPIRETIME = 600.0  # src/erlamsa.hrl:71
+TOKEN_BITS = 160  # src/erlamsa.hrl:69
+
+
+def _new_token() -> str:
+    return base64.b64encode(os.urandom(TOKEN_BITS // 8)).decode()
+
+
+class CloudManager:
+    def __init__(self, admin_token: str | None = None, auth_required: bool = False):
+        self.admin_token = admin_token or _new_token()
+        self.auth_required = auth_required
+        self._tokens: dict[str, dict] = {}
+        self._sessions: dict[str, dict] = {}
+        self._lock = threading.Lock()
+
+    # --- token CRUD (admin-gated, erlamsa_cmanager.erl:174-179) ----------
+
+    def add_token(self, admin: str, kind: str = "user") -> str | None:
+        if admin != self.admin_token:
+            return None
+        t = _new_token()
+        with self._lock:
+            self._tokens[t] = {"date": time.time(), "type": kind}
+        return t
+
+    def del_token(self, admin: str, token: str) -> bool:
+        if admin != self.admin_token:
+            return False
+        with self._lock:
+            return self._tokens.pop(token, None) is not None
+
+    def list_tokens(self, admin: str) -> list[str] | None:
+        if admin != self.admin_token:
+            return None
+        with self._lock:
+            return list(self._tokens)
+
+    # --- sessions (erlamsa_cmanager.erl:124-133, 225-242) ----------------
+
+    def get_client_context(self, token: str | None, session: str | None):
+        """Returns (status, session_id): 'ok' with a fresh/refreshed session,
+        or 'unauthorized'."""
+        self._cleanup()
+        if not self.auth_required:
+            return "ok", session or _new_token()[:27]
+        with self._lock:
+            if session and session in self._sessions:
+                self._sessions[session]["lastaccess"] = time.time()
+                return "ok", session
+            if token and (token in self._tokens or token == self.admin_token):
+                s = _new_token()[:27]
+                self._sessions[s] = {"token": token, "lastaccess": time.time()}
+                return "ok", s
+        return "unauthorized", ""
+
+    def _cleanup(self):
+        now = time.time()
+        with self._lock:
+            dead = [
+                s for s, v in self._sessions.items()
+                if now - v["lastaccess"] > SESSION_EXPIRETIME
+            ]
+            for s in dead:
+                del self._sessions[s]

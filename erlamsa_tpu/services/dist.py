@@ -1,0 +1,205 @@
+"""Distributed fuzzing nodes: join/keepalive control plane.
+
+Reference: src/erlamsa_app.erl:144-246 — worker nodes join a parent over
+Erlang distribution with {join, Pid} keepalives every 15s, the parent
+evicts nodes silent for >17s and routes each fuzz request to a random live
+node. Here the control plane is a JSON-lines TCP protocol:
+
+    {"op": "join", "port": N}            worker -> parent (keepalive)
+    {"op": "fuzz", "data": b64, ...}     parent -> worker / client -> parent
+    {"op": "result", "data": b64}        reply
+
+The data plane stays local to each node (its own oracle pool or TPU batch
+engine) — DCN-style corpus fan-out between hosts, device-local mutation,
+matching SURVEY.md §5.8's design obligation.
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import socket
+import threading
+import time
+
+from ..constants import NODE_ALIVE_DELTA, NODE_KEEPALIVE, NODES_CHECKTIMER
+from ..utils.erlrand import gen_urandom_seed
+from . import logger
+from .batcher import make_batcher
+
+
+def _send_json(sock: socket.socket, obj: dict):
+    sock.sendall(json.dumps(obj).encode() + b"\n")
+
+
+def _recv_json(f) -> dict | None:
+    line = f.readline()
+    if not line:
+        return None
+    return json.loads(line)
+
+
+class NodePool:
+    """Parent-side registry of live worker nodes
+    (erlamsa_app:loop/3, src/erlamsa_app.erl:210-246)."""
+
+    def __init__(self):
+        self._nodes: dict[tuple[str, int], float] = {}
+        self._lock = threading.Lock()
+        import random as _pyrandom
+
+        self._rng = _pyrandom.Random(str(gen_urandom_seed()))
+        threading.Thread(target=self._evict_loop, daemon=True).start()
+
+    def join(self, host: str, port: int):
+        with self._lock:
+            fresh = (host, port) not in self._nodes
+            self._nodes[(host, port)] = time.time()
+        if fresh:
+            logger.log("info", "node %s:%d joined", host, port)
+
+    def _evict_loop(self):
+        while True:
+            time.sleep(NODES_CHECKTIMER)
+            now = time.time()
+            with self._lock:
+                dead = [k for k, t in self._nodes.items()
+                        if now - t > NODE_ALIVE_DELTA]
+                for k in dead:
+                    del self._nodes[k]
+                    logger.log("info", "node %s:%d evicted", *k)
+
+    def pick(self) -> tuple[str, int] | None:
+        """Random live node (get_free_node, src/erlamsa_app.erl:185-190)."""
+        with self._lock:
+            if not self._nodes:
+                return None
+            return self._rng.choice(list(self._nodes))
+
+    def count(self) -> int:
+        with self._lock:
+            return len(self._nodes)
+
+
+class ParentServer:
+    """Accepts joins and fuzz requests; routes requests to a random worker
+    node, falling back to local fuzzing when no nodes joined."""
+
+    def __init__(self, port: int, opts: dict, backend: str = "oracle"):
+        self.port = port
+        self.pool = NodePool()
+        self.local = make_batcher(backend, workers=opts.get("workers", 10),
+                                  seed=opts.get("seed"))
+        self.opts = opts
+        self._stop = threading.Event()
+
+    def _handle(self, conn: socket.socket, addr):
+        f = conn.makefile("rb")
+        try:
+            while True:
+                msg = _recv_json(f)
+                if msg is None:
+                    return
+                if msg.get("op") == "join":
+                    self.pool.join(addr[0], int(msg.get("port", 0)))
+                    _send_json(conn, {"op": "joined"})
+                elif msg.get("op") == "fuzz":
+                    data = base64.b64decode(msg.get("data", ""))
+                    out = self.route_fuzz(data)
+                    _send_json(conn, {"op": "result",
+                                      "data": base64.b64encode(out).decode()})
+        except (OSError, ValueError):
+            pass
+        finally:
+            conn.close()
+
+    def route_fuzz(self, data: bytes) -> bytes:
+        node = self.pool.pick()
+        if node is not None:
+            try:
+                return remote_fuzz(node[0], node[1], data)
+            except OSError:
+                logger.log("warning", "node %s:%d failed, fuzzing locally", *node)
+        return self.local.fuzz(data, dict(self.opts))
+
+    def serve(self, block: bool = True):
+        srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        srv.bind(("0.0.0.0", self.port))
+        srv.listen(64)
+        self._srv = srv
+        logger.log("info", "distribution parent on :%d", self.port)
+
+        def loop():
+            while not self._stop.is_set():
+                try:
+                    conn, addr = srv.accept()
+                except OSError:
+                    break
+                threading.Thread(target=self._handle, args=(conn, addr),
+                                 daemon=True).start()
+
+        if block:
+            loop()
+            return 0
+        threading.Thread(target=loop, daemon=True).start()
+        return self
+
+    def stop(self):
+        self._stop.set()
+        try:
+            self._srv.close()
+        except Exception:
+            pass
+
+
+def remote_fuzz(host: str, port: int, data: bytes, timeout: float = 90.0) -> bytes:
+    """Client call into a node (erlamsa_app:call/2,
+    src/erlamsa_app.erl:248-253)."""
+    with socket.create_connection((host, port), timeout=timeout) as s:
+        _send_json(s, {"op": "fuzz", "data": base64.b64encode(data).decode()})
+        resp = _recv_json(s.makefile("rb"))
+        if resp and resp.get("op") == "result":
+            return base64.b64decode(resp.get("data", ""))
+    return b""
+
+
+class WorkerNode:
+    """Joins a parent with keepalives and serves fuzz requests
+    (erlamsa_app:loop_node, src/erlamsa_app.erl:165-182)."""
+
+    def __init__(self, parent_host: str, parent_port: int, opts: dict,
+                 backend: str = "oracle", listen_port: int = 0):
+        self.parent = (parent_host, parent_port)
+        self.server = ParentServer(listen_port or 0, opts, backend)
+        self.opts = opts
+        self._stop = threading.Event()
+
+    def start(self, block: bool = True):
+        self.server.serve(block=False)
+        my_port = self.server._srv.getsockname()[1]
+
+        def keepalive():
+            while not self._stop.is_set():
+                try:
+                    with socket.create_connection(self.parent, timeout=5) as s:
+                        _send_json(s, {"op": "join", "port": my_port})
+                        s.makefile("rb").readline()
+                except OSError as e:
+                    logger.log("warning", "keepalive to parent failed: %s", e)
+                self._stop.wait(NODE_KEEPALIVE)
+
+        t = threading.Thread(target=keepalive, daemon=True)
+        t.start()
+        if block:
+            t.join()
+            return 0
+        return self
+
+    def stop(self):
+        self._stop.set()
+        self.server.stop()
+
+
+def run_node(host: str, port: int, opts: dict) -> int:
+    return WorkerNode(host, port, opts).start(block=True)

@@ -1,0 +1,159 @@
+"""Fuzzing-as-a-service HTTP endpoint.
+
+Reference: src/erlamsa_httpsvc.erl + src/erlamsa_esi.erl — endpoints
+/erlamsa/erlamsa_esi:fuzz (octet-stream in/out), :json (base64 JSON), and
+:manage (token admin), with fuzzing options in erlamsa-* HTTP headers or
+JSON fields and session auth via the cloud manager. Requests are served
+from the adaptive batcher instead of one process per request.
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from ..utils.erlrand import parse_seed
+from . import logger
+from .batcher import make_batcher
+from .cmanager import CloudManager
+
+
+def _parse_header_opts(headers) -> dict:
+    """erlamsa-mutations/patterns/seed/blockscale headers
+    (erlamsa_esi:parse_headers, src/erlamsa_esi.erl:34-56)."""
+    opts: dict = {}
+    m = headers.get("erlamsa-mutations")
+    if m:
+        from .cli import _parse_actions
+        from ..oracle.mutations import default_mutations
+
+        opts["mutations"] = _parse_actions(m, default_mutations())
+    p = headers.get("erlamsa-patterns")
+    if p:
+        from .cli import _parse_actions
+        from ..oracle.patterns import default_patterns
+
+        opts["patterns"] = _parse_actions(p, default_patterns())
+    s = headers.get("erlamsa-seed")
+    if s:
+        opts["seed"] = parse_seed(s)
+    b = headers.get("erlamsa-blockscale")
+    if b:
+        opts["blockscale"] = float(b)
+    return opts
+
+
+class _Handler(BaseHTTPRequestHandler):
+    server_version = "erlamsa-tpu"
+    batcher = None
+    cmanager: CloudManager | None = None
+
+    def log_message(self, fmt, *args):
+        logger.log("debug", "faas: " + fmt, *args)
+
+    def _auth(self):
+        cm = self.cmanager
+        status, session = cm.get_client_context(
+            self.headers.get("erlamsa-token"), self.headers.get("erlamsa-session")
+        )
+        return status, session
+
+    def _reply(self, code: int, body: bytes, session: str = "",
+               ctype="application/octet-stream"):
+        self.send_response(code)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(body)))
+        self.send_header("erlamsa-status", "ok" if code == 200 else "error")
+        if session:
+            self.send_header("erlamsa-session", session)
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_POST(self):  # noqa: N802
+        length = int(self.headers.get("Content-Length", 0))
+        body = self.rfile.read(length)
+        path = self.path.rstrip("/")
+        status, session = self._auth()
+        if status != "ok":
+            self._reply(401, b"unauthorized")
+            return
+        if path.endswith(("erlamsa_esi:fuzz", "/fuzz")):
+            opts = _parse_header_opts(self.headers)
+            out = self.batcher.fuzz(body, opts)
+            self._reply(200, out, session)
+            return
+        if path.endswith(("erlamsa_esi:json", "/json")):
+            try:
+                req = json.loads(body)
+                data = base64.b64decode(req.get("data", ""))
+                opts: dict = {}
+                if "seed" in req:
+                    opts["seed"] = parse_seed(req["seed"])
+                if "mutations" in req:
+                    from .cli import _parse_actions
+                    from ..oracle.mutations import default_mutations
+
+                    opts["mutations"] = _parse_actions(
+                        req["mutations"], default_mutations()
+                    )
+                out = self.batcher.fuzz(data, opts)
+                self._reply(
+                    200,
+                    json.dumps({"data": base64.b64encode(out).decode()}).encode(),
+                    session,
+                    ctype="application/json",
+                )
+            except (ValueError, KeyError) as e:
+                self._reply(400, f"bad request: {e}".encode())
+            return
+        if path.endswith(("erlamsa_esi:manage", "/manage")):
+            try:
+                req = json.loads(body)
+                cm = self.cmanager
+                admin = req.get("admin", "")
+                op = req.get("op")
+                if op == "addtoken":
+                    t = cm.add_token(admin)
+                    ok = t is not None
+                    resp = {"status": "ok" if ok else "denied", "token": t or ""}
+                elif op == "deltoken":
+                    ok = cm.del_token(admin, req.get("token", ""))
+                    resp = {"status": "ok" if ok else "denied"}
+                elif op == "listtokens":
+                    ts = cm.list_tokens(admin)
+                    resp = {"status": "ok" if ts is not None else "denied",
+                            "tokens": ts or []}
+                else:
+                    resp = {"status": "badop"}
+                self._reply(200, json.dumps(resp).encode(), session,
+                            ctype="application/json")
+            except ValueError as e:
+                self._reply(400, f"bad request: {e}".encode())
+            return
+        self._reply(404, b"not found")
+
+
+def serve(host: str, port: int, opts: dict, backend: str = "oracle",
+          batch: int = 256, auth_required: bool = False,
+          block: bool = True):
+    """Start the FaaS server; returns the server object when block=False."""
+    _Handler.batcher = make_batcher(
+        backend, batch=batch, workers=opts.get("workers", 10),
+        seed=opts.get("seed"),
+    )
+    _Handler.cmanager = CloudManager(auth_required=auth_required)
+    srv = ThreadingHTTPServer((host, port), _Handler)
+    logger.log("info", "faas listening on %s:%d (backend=%s)", host, port, backend)
+    print(f"# faas listening on {host}:{port} backend={backend} "
+          f"admin-token={_Handler.cmanager.admin_token}", flush=True)
+    if not block:
+        import threading
+
+        threading.Thread(target=srv.serve_forever, daemon=True).start()
+        return srv
+    try:
+        srv.serve_forever()
+    except KeyboardInterrupt:
+        pass
+    return 0

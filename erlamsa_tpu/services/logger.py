@@ -1,0 +1,107 @@
+"""Logging service: fire-and-forget, multi-sink.
+
+Reference: src/erlamsa_logger.erl — a single logger process with
+stdout/stderr/file/CSV/syslog-UDP sinks, 8 levels, capped data payloads,
+hex/str render modes. Here a thread with a queue (so fuzzing never blocks
+on logging, like the reference's fire-and-forget global:send) feeding the
+configured sinks.
+"""
+
+from __future__ import annotations
+
+import queue
+import socket
+import sys
+import threading
+import time
+from typing import Callable
+
+from ..constants import MAX_LOG_DATA
+
+LEVELS = {
+    "critical": 0, "error": 1, "warning": 2, "finding": 3,
+    "info": 4, "meta": 5, "decision": 6, "debug": 7,
+}
+
+
+class Logger:
+    def __init__(self):
+        self._sinks: list[tuple[int, Callable[[str], None]]] = []
+        self._q: queue.Queue = queue.Queue()
+        self._thread: threading.Thread | None = None
+        self._log_data = True
+
+    def add_sink(self, level: str, write: Callable[[str], None]):
+        self._sinks.append((LEVELS.get(level, 4), write))
+        self._ensure_thread()
+
+    def configure(self, spec: dict):
+        """spec like the -L options: {"stdout": level, "file": (path, level),
+        "csv": (path, level), "syslog": (host, port, level)}
+        (erlamsa_logger:build_logger, src/erlamsa_logger.erl:194-228)."""
+        if "stdout" in spec:
+            self.add_sink(spec["stdout"], lambda s: print(s, flush=True))
+        if "stderr" in spec:
+            self.add_sink(
+                spec["stderr"], lambda s: print(s, file=sys.stderr, flush=True)
+            )
+        if "file" in spec:
+            path, level = spec["file"]
+            fd = open(path, "a")
+            self.add_sink(level, lambda s: (fd.write(s + "\n"), fd.flush()))
+        if "csv" in spec:
+            path, level = spec["csv"]
+            fd = open(path, "a")
+            self.add_sink(
+                level, lambda s: (fd.write(s.replace("\t", ",") + "\n"), fd.flush())
+            )
+        if "syslog" in spec:
+            host, port, level = spec["syslog"]
+            sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+            self.add_sink(
+                level, lambda s: sock.sendto(b"<134>" + s.encode(), (host, port))
+            )
+        if spec.get("no_io_logging"):
+            self._log_data = False
+
+    def _ensure_thread(self):
+        if self._thread is None:
+            self._thread = threading.Thread(target=self._drain, daemon=True)
+            self._thread.start()
+
+    def _drain(self):
+        while True:
+            lvl, line = self._q.get()
+            for sink_lvl, write in self._sinks:
+                if lvl <= sink_lvl:
+                    try:
+                        write(line)
+                    except Exception:
+                        pass
+
+    def log(self, level: str, fmt: str, *args):
+        """Fire-and-forget (erlamsa_logger:log/3)."""
+        if not self._sinks:
+            return
+        ts = time.strftime("%Y-%m-%d %H:%M:%S")
+        msg = fmt % args if args else fmt
+        self._q.put((LEVELS.get(level, 4), f"{ts}\t{level}\t{msg}"))
+
+    def log_data(self, level: str, fmt: str, args, data: bytes, render="str"):
+        """Log with a (capped) data payload (erlamsa_logger:log_data/4)."""
+        if not self._sinks or not self._log_data:
+            return
+        payload = data[:MAX_LOG_DATA]
+        shown = payload.hex() if render == "hex" else repr(payload)
+        self.log(level, (fmt % tuple(args) if args else fmt) + " " + shown)
+
+
+GLOBAL = Logger()
+
+
+def log(level: str, fmt: str, *args):
+    GLOBAL.log(level, fmt, *args)
+
+
+def log_data(level: str, fmt: str, args, data: bytes, render="str"):
+    GLOBAL.log_data(level, fmt, args, data, render)

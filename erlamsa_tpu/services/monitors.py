@@ -1,0 +1,288 @@
+"""Crash/event monitors.
+
+Reference: src/erlamsa_monitor.erl and mon_* modules — a registry of
+monitors started from ``--monitor +name:params`` / ``!name:off`` CLI specs,
+each reporting findings through the logger and optionally running an
+``after=exec`` recovery action:
+
+  cm      connect-back listener catching SSRF/XXE/reverse-shell payloads
+          (src/erlamsa_mon_connect.erl); its host:port is advertised to the
+          payload builders via the shared config
+  probe   periodic TCP/UDP liveness probe; refused/timeout -> finding
+          (src/erlamsa_mon_network.erl)
+  exec    spawn-and-watch a target process; nonzero/signal exit -> finding
+          (the cdb/r2 equivalent for environments without a debugger)
+  r2      radare2-driven crash triage (src/erlamsa_mon_r2.erl); gated on
+          an available `r2` binary
+  lc      adb logcat crash extraction (src/erlamsa_mon_logcat.erl); gated
+          on an available `adb` binary
+  lxi     SCPI measurement-range monitor over TCP
+          (src/erlamsa_mon_lxi.erl)
+"""
+
+from __future__ import annotations
+
+import shlex
+import shutil
+import socket
+import subprocess
+import threading
+import time
+
+from ..constants import DEFAULT_CM_PORT
+from . import logger
+
+# shared monitor config, the reference's global_config ets analogue
+CONFIG: dict = {"cm_port": DEFAULT_CM_PORT, "cm_host": None}
+
+
+def _run_after(params: dict):
+    """after=exec recovery hook (erlamsa_monitor:do_after,
+    src/erlamsa_monitor.erl:98-104)."""
+    cmd = params.get("after")
+    if cmd:
+        subprocess.Popen(shlex.split(cmd))
+
+
+class Monitor(threading.Thread):
+    name_code = "base"
+
+    def __init__(self, params: dict):
+        super().__init__(daemon=True)
+        self.params = params
+        self._stop = threading.Event()
+
+    def stop(self):
+        self._stop.set()
+
+
+class ConnectMonitor(Monitor):
+    """cm: TCP listener catching connect-backs; '{event}'-prefixed payloads
+    log as findings (src/erlamsa_mon_connect.erl:47-54)."""
+
+    name_code = "cm"
+
+    def run(self):
+        port = int(self.params.get("port", DEFAULT_CM_PORT))
+        CONFIG["cm_port"] = port
+        srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        try:
+            srv.bind(("0.0.0.0", port))
+        except OSError as e:
+            logger.log("error", "cm monitor cannot bind :%d: %s", port, e)
+            return
+        srv.listen(16)
+        srv.settimeout(1.0)
+        logger.log("info", "connect monitor listening on :%d", port)
+        while not self._stop.is_set():
+            try:
+                conn, addr = srv.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                break
+            try:
+                conn.settimeout(2.0)
+                data = conn.recv(4096)
+            except OSError:
+                data = b""
+            finally:
+                conn.close()
+            if data.startswith(b"{event}"):
+                logger.log("finding", "cm event from %s: %r", addr[0], data[7:200])
+            else:
+                logger.log("finding", "connect-back from %s:%d (%d bytes)",
+                           addr[0], addr[1], len(data))
+            _run_after(self.params)
+
+
+class NetworkProbeMonitor(Monitor):
+    """probe: periodic hello; timeout/refusal is a finding
+    (src/erlamsa_mon_network.erl:48-57)."""
+
+    name_code = "probe"
+
+    def run(self):
+        host = self.params.get("host", "127.0.0.1")
+        port = int(self.params.get("port", 80))
+        proto = self.params.get("proto", "tcp")
+        interval = float(self.params.get("interval", 5.0))
+        hello = self.params.get("hello", "hello").encode()
+        while not self._stop.is_set():
+            ok = False
+            try:
+                if proto == "udp":
+                    s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+                    s.settimeout(3.0)
+                    s.sendto(hello, (host, port))
+                    ok = True
+                else:
+                    with socket.create_connection((host, port), timeout=3.0) as s:
+                        s.sendall(hello)
+                        ok = True
+            except OSError as e:
+                logger.log("finding", "probe: %s:%d unreachable (%s)", host, port, e)
+                _run_after(self.params)
+            if ok:
+                logger.log("debug", "probe: %s:%d alive", host, port)
+            self._stop.wait(interval)
+
+
+class ExecMonitor(Monitor):
+    """exec: keep a target app running; abnormal exits are findings and the
+    app is restarted — the cross-platform stand-in for the cdb/r2 debugger
+    monitors (src/erlamsa_mon_cdb.erl behavior)."""
+
+    name_code = "exec"
+
+    def run(self):
+        cmd = self.params.get("app")
+        if not cmd:
+            logger.log("error", "exec monitor needs app=<cmdline>")
+            return
+        while not self._stop.is_set():
+            proc = subprocess.Popen(
+                shlex.split(cmd), stdout=subprocess.PIPE, stderr=subprocess.STDOUT
+            )
+            out, _ = proc.communicate()
+            rc = proc.returncode
+            if rc and not self._stop.is_set():
+                level = "finding" if rc < 0 else "warning"
+                logger.log(level, "exec target exited rc=%d; tail: %r",
+                           rc, out[-500:] if out else b"")
+                _run_after(self.params)
+            time.sleep(float(self.params.get("delay", 5.0)))
+
+
+class R2Monitor(Monitor):
+    """r2: drive radare2 over r2pipe for crash triage; registers/backtrace
+    dumps on crash (src/erlamsa_mon_r2.erl:43-58). Requires `r2`."""
+
+    name_code = "r2"
+
+    def run(self):
+        if shutil.which("r2") is None:
+            logger.log("error", "r2 monitor: radare2 not found in PATH")
+            return
+        app = self.params.get("app")
+        while not self._stop.is_set():
+            proc = subprocess.Popen(
+                ["r2", "-q0", "-d", *shlex.split(app)],
+                stdin=subprocess.PIPE, stdout=subprocess.PIPE,
+            )
+            try:
+                proc.stdin.write(b"dc\n")
+                proc.stdin.flush()
+                out = proc.stdout.read()
+                if b"SIGSEGV" in out or b"signal" in out:
+                    proc.stdin.write(b"drj\nij\ndbt\n")
+                    proc.stdin.flush()
+                    dump = proc.stdout.read()
+                    logger.log("finding", "r2 crash dump: %r", dump[:1000])
+                    _run_after(self.params)
+            except (OSError, ValueError):
+                pass
+            finally:
+                proc.kill()
+            time.sleep(float(self.params.get("delay", 2.0)))
+
+
+class LogcatMonitor(Monitor):
+    """lc: adb logcat crash extraction for Android targets
+    (src/erlamsa_mon_logcat.erl:31-51). Requires `adb`."""
+
+    name_code = "lc"
+
+    def run(self):
+        if shutil.which("adb") is None:
+            logger.log("error", "logcat monitor: adb not found in PATH")
+            return
+        app = self.params.get("app", "")
+        if app:
+            subprocess.run(["adb", "shell", "am", "start", "-n", app], check=False)
+        proc = subprocess.Popen(
+            ["adb", "logcat", "*:E"], stdout=subprocess.PIPE
+        )
+        crash_lines: list[bytes] = []
+        for line in proc.stdout:
+            if self._stop.is_set():
+                break
+            if b"FATAL EXCEPTION" in line or b"SIGSEGV" in line:
+                crash_lines = [line]
+            elif crash_lines:
+                crash_lines.append(line)
+                if len(crash_lines) > 20:
+                    logger.log("finding", "logcat crash: %r",
+                               b"".join(crash_lines)[:2000])
+                    _run_after(self.params)
+                    crash_lines = []
+        proc.kill()
+
+
+class LxiMonitor(Monitor):
+    """lxi: SCPI MEAS:CURR? over TCP; out-of-range measurement -> finding
+    (hardware fuzzing, src/erlamsa_mon_lxi.erl:75-93)."""
+
+    name_code = "lxi"
+
+    def run(self):
+        host = self.params.get("host", "127.0.0.1")
+        port = int(self.params.get("port", 5025))
+        lo = float(self.params.get("lvalue", 0.0))
+        hi = float(self.params.get("uvalue", 1.0))
+        interval = float(self.params.get("interval", 2.0))
+        while not self._stop.is_set():
+            try:
+                with socket.create_connection((host, port), timeout=3.0) as s:
+                    s.sendall(b"MEAS:CURR?\n")
+                    v = float(s.recv(256).strip())
+                    if not (lo <= v <= hi):
+                        logger.log("finding",
+                                   "lxi measurement %g outside [%g, %g]", v, lo, hi)
+                        _run_after(self.params)
+            except (OSError, ValueError) as e:
+                logger.log("warning", "lxi probe failed: %s", e)
+            self._stop.wait(interval)
+
+
+MONITORS = {
+    m.name_code: m
+    for m in (ConnectMonitor, NetworkProbeMonitor, ExecMonitor, R2Monitor,
+              LogcatMonitor, LxiMonitor)
+}
+
+
+def parse_monitor_spec(spec: str):
+    """'+name:k=v,k=v' enables, '!name:off' disables
+    (erlamsa_cmdparse monitor parsing, src/erlamsa_cmdparse.erl:436-451)."""
+    if spec.startswith("!"):
+        return None
+    spec = spec.lstrip("+")
+    name, _, rest = spec.partition(":")
+    params = {}
+    for kv in rest.split(","):
+        if "=" in kv:
+            k, v = kv.split("=", 1)
+            params[k] = v
+    return name, params
+
+
+def start_monitors(specs: list[str], default_cm: bool = False) -> list[Monitor]:
+    """Start requested monitors; with default_cm the connect monitor starts
+    unless disabled (erlamsa_monitor:default/0, src/erlamsa_monitor.erl:33)."""
+    started = []
+    disabled = {s.lstrip("!").partition(":")[0] for s in specs if s.startswith("!")}
+    wanted = [parse_monitor_spec(s) for s in specs if not s.startswith("!")]
+    wanted = [w for w in wanted if w]
+    if default_cm and "cm" not in disabled and not any(n == "cm" for n, _ in wanted):
+        wanted.append(("cm", {}))
+    for name, params in wanted:
+        cls = MONITORS.get(name)
+        if cls is None:
+            logger.log("error", "unknown monitor %s", name)
+            continue
+        mon = cls(params)
+        mon.start()
+        started.append(mon)
+    return started

@@ -1,0 +1,185 @@
+"""Output writers: route fuzzed cases to stdout / files / sockets / HTTP /
+spawned processes.
+
+Reference: src/erlamsa_out.erl — string_outputs maps the -o spec onto a
+writer; network failure raises so the main loop can back off
+({cantconnect,...}, src/erlamsa_main.erl:203-207). Spec forms:
+
+    "-"                      stdout
+    "template%n.ext"         per-case files (%n = case number)
+    "tcp://host:port"        TCP client     "tcp://:port" listen
+    "udp://host:port"        UDP client
+    "http://url"             HTTP POST
+    "exec://cmdline"         spawn target, feed stdin (erlexec analogue)
+    "serial://dev:baud"      serial device (termios)
+"""
+
+from __future__ import annotations
+
+import os
+import shlex
+import socket
+import subprocess
+import sys
+import urllib.request
+from typing import Callable
+
+from ..constants import DEFAULT_MAX_RUNNING_TIME
+from . import logger
+
+Writer = Callable[[int, bytes, list], None]
+
+
+class CantConnect(ConnectionError):
+    pass
+
+
+def _stdout_writer(case_idx: int, data: bytes, meta: list) -> None:
+    sys.stdout.buffer.write(data)
+    sys.stdout.buffer.flush()
+
+
+def _file_writer(template: str) -> Writer:
+    """%n in the template becomes the case number
+    (erlamsa_out.erl:109-123)."""
+
+    def write(case_idx: int, data: bytes, meta: list) -> None:
+        path = template.replace("%n", str(case_idx))
+        with open(path, "wb") as f:
+            f.write(data)
+        logger.log("info", "wrote %d bytes to %s", len(data), path)
+
+    return write
+
+
+def _tcp_writer(host: str, port: int) -> Writer:
+    def write(case_idx: int, data: bytes, meta: list) -> None:
+        try:
+            with socket.create_connection((host, port), timeout=5) as s:
+                s.sendall(data)
+        except OSError as e:
+            raise CantConnect(str(e)) from e
+
+    return write
+
+
+def _tcp_listen_writer(port: int) -> Writer:
+    """Listen mode: serve each accepted connection one fuzzed case
+    (erlamsa_out.erl tcp listen path)."""
+    srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    srv.bind(("0.0.0.0", port))
+    srv.listen(16)
+
+    def write(case_idx: int, data: bytes, meta: list) -> None:
+        conn, _addr = srv.accept()
+        try:
+            conn.sendall(data)
+        finally:
+            conn.close()
+
+    return write
+
+
+def _udp_writer(host: str, port: int) -> Writer:
+    sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+
+    def write(case_idx: int, data: bytes, meta: list) -> None:
+        try:
+            sock.sendto(data, (host, port))
+        except OSError as e:
+            raise CantConnect(str(e)) from e
+
+    return write
+
+
+def _http_writer(url: str) -> Writer:
+    def write(case_idx: int, data: bytes, meta: list) -> None:
+        try:
+            req = urllib.request.Request(
+                url, data=data, headers={"Content-Type": "application/octet-stream"}
+            )
+            urllib.request.urlopen(req, timeout=10).read()
+        except OSError as e:
+            raise CantConnect(str(e)) from e
+
+    return write
+
+
+def _exec_writer(cmdline: str, monitor_notify=None) -> Writer:
+    """Spawn the target per case and feed fuzzed data to its stdin; notify
+    monitors of the PID like the erlexec path (erlamsa_out.erl:143-179)."""
+
+    def write(case_idx: int, data: bytes, meta: list) -> None:
+        proc = subprocess.Popen(
+            shlex.split(cmdline),
+            stdin=subprocess.PIPE,
+            stdout=subprocess.DEVNULL,
+            stderr=subprocess.DEVNULL,
+        )
+        if monitor_notify:
+            monitor_notify(proc.pid)
+        try:
+            proc.communicate(data, timeout=DEFAULT_MAX_RUNNING_TIME)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+        rc = proc.returncode
+        if rc and rc < 0:
+            logger.log("finding", "exec target died with signal %d on case %d",
+                       -rc, case_idx)
+
+    return write
+
+
+def _serial_writer(dev: str, baud: int) -> Writer:
+    """termios-configured serial device (the reference uses the erlserial C
+    port, src/erlamsa_out.erl:129-137)."""
+    import termios
+
+    fd = os.open(dev, os.O_RDWR | os.O_NOCTTY)
+    attrs = termios.tcgetattr(fd)
+    speed = getattr(termios, f"B{baud}", termios.B115200)
+    attrs[4] = attrs[5] = speed
+    termios.tcsetattr(fd, termios.TCSANOW, attrs)
+
+    def write(case_idx: int, data: bytes, meta: list) -> None:
+        os.write(fd, data)
+
+    return write
+
+
+class ReturnCollector:
+    """output=return mode: collect results for the library caller."""
+
+    def __init__(self):
+        self.results: list[bytes] = []
+
+    def __call__(self, case_idx: int, data: bytes, meta: list) -> None:
+        self.results.append(data)
+
+
+def string_outputs(spec, monitor_notify=None) -> tuple[Writer | None, float]:
+    """-o spec -> (writer, max_running_time_s)
+    (erlamsa_out:string_outputs, src/erlamsa_out.erl:581-633).
+    None writer means return mode."""
+    if spec in (None, "return", "direct"):
+        return None, DEFAULT_MAX_RUNNING_TIME
+    if spec == "-":
+        return _stdout_writer, DEFAULT_MAX_RUNNING_TIME
+    if spec.startswith("tcp://"):
+        rest = spec[6:]
+        host, _, port = rest.rpartition(":")
+        if host == "":
+            return _tcp_listen_writer(int(port)), DEFAULT_MAX_RUNNING_TIME
+        return _tcp_writer(host, int(port)), DEFAULT_MAX_RUNNING_TIME
+    if spec.startswith("udp://"):
+        host, _, port = spec[6:].rpartition(":")
+        return _udp_writer(host or "127.0.0.1", int(port)), DEFAULT_MAX_RUNNING_TIME
+    if spec.startswith(("http://", "https://")):
+        return _http_writer(spec), DEFAULT_MAX_RUNNING_TIME
+    if spec.startswith("exec://"):
+        return _exec_writer(spec[7:], monitor_notify), DEFAULT_MAX_RUNNING_TIME
+    if spec.startswith("serial://"):
+        dev, _, baud = spec[9:].rpartition(":")
+        return _serial_writer(dev or spec[9:], int(baud or 115200)), DEFAULT_MAX_RUNNING_TIME
+    return _file_writer(spec), DEFAULT_MAX_RUNNING_TIME

@@ -1,0 +1,204 @@
+"""Fuzzing MitM proxy: TCP/UDP/HTTP pass-through with probabilistic
+mutation of either direction.
+
+Reference: src/erlamsa_fuzzproxy.erl — per-endpoint acceptor workers, c->s
+and s->c fuzzing probabilities with an ascent coefficient (raise_prob),
+first-K-packet bypass, HTTP header re-packing with Content-Length fixup,
+and CONNECT-based TLS MitM. Spec forms (erlamsa_cmdparse proxy parsing):
+
+    tcp://lport:rhost:rport
+    udp://lport:rhost:rport
+    http://lport:rhost:rport
+"""
+
+from __future__ import annotations
+
+import socket
+import threading
+
+from ..utils.erlrand import gen_urandom_seed
+from . import logger
+from .batcher import make_batcher
+
+
+def parse_proxy_spec(spec: str):
+    proto, _, rest = spec.partition("://")
+    parts = rest.split(":")
+    if len(parts) != 3:
+        raise SystemExit(f"bad proxy spec {spec!r}; want proto://lport:rhost:rport")
+    return proto, int(parts[0]), parts[1], int(parts[2])
+
+
+def parse_probs(s: str) -> tuple[float, float]:
+    a, _, b = s.partition(",")
+    return float(a), float(b or a)
+
+
+def raise_prob(prob: float, ascent: float) -> float:
+    """Probability ascent per packet (erlamsa_fuzzproxy.erl:61-65)."""
+    if ascent <= 0:
+        return prob
+    return min(1.0, prob + prob * ascent)
+
+
+def _split_http(data: bytes):
+    """(headers, body) or None when not HTTP-ish
+    (erlamsa_netutils:extract_http, src/erlamsa_netutils.erl:154-174)."""
+    sep = data.find(b"\r\n\r\n")
+    if sep < 0:
+        return None
+    head = data[:sep]
+    if b"HTTP/" not in head.split(b"\r\n", 1)[0]:
+        return None
+    return head, data[sep + 4 :]
+
+
+def _pack_http(head: bytes, body: bytes) -> bytes:
+    """Reassemble with Content-Length fixup
+    (erlamsa_netutils:pack_http, src/erlamsa_netutils.erl:176-207)."""
+    lines = head.split(b"\r\n")
+    out = []
+    had_cl = False
+    for ln in lines:
+        if ln.lower().startswith(b"content-length:"):
+            out.append(b"Content-Length: %d" % len(body))
+            had_cl = True
+        else:
+            out.append(ln)
+    if not had_cl and body:
+        out.append(b"Content-Length: %d" % len(body))
+    return b"\r\n".join(out) + b"\r\n\r\n" + body
+
+
+class FuzzProxy:
+    def __init__(self, spec: str, probs: str = "0.1,0.1", opts: dict | None = None,
+                 backend: str = "oracle", bypass: int = 0, ascent: float = 0.0):
+        self.proto, self.lport, self.rhost, self.rport = parse_proxy_spec(spec)
+        self.prob_cs, self.prob_sc = parse_probs(probs)
+        self.opts = opts or {}
+        self.bypass = bypass  # first K packets pass through (-k)
+        self.ascent = ascent
+        self.batcher = make_batcher(backend, workers=self.opts.get("workers", 10),
+                                    seed=self.opts.get("seed"))
+        import random as _pyrandom
+
+        self._coin = _pyrandom.Random(str(self.opts.get("seed") or gen_urandom_seed()))
+        self._stop = threading.Event()
+
+    def _fuzz_maybe(self, data: bytes, prob: float, npacket: int, direction: str) -> bytes:
+        """Probability gate + protocol-aware fuzz (fuzz_rnd,
+        src/erlamsa_fuzzproxy.erl:309-324)."""
+        if npacket <= self.bypass or self._coin.random() >= prob:
+            return data
+        if self.proto == "http":
+            parts = _split_http(data)
+            if parts is not None:
+                head, body = parts
+                fuzzed = self.batcher.fuzz(body, dict(self.opts)) if body else body
+                out = _pack_http(head, fuzzed)
+            else:
+                out = self.batcher.fuzz(data, dict(self.opts))
+        else:
+            out = self.batcher.fuzz(data, dict(self.opts))
+        logger.log_data("info", "proxy fuzzed packet %d (%s)",
+                        (npacket, direction), out)
+        return out
+
+    # --- TCP stream (loop_stream, erlamsa_fuzzproxy.erl:261-296) ----------
+
+    def _pump(self, src: socket.socket, dst: socket.socket, prob: float,
+              direction: str):
+        n = 0
+        pcs = prob
+        try:
+            while not self._stop.is_set():
+                data = src.recv(65536)
+                if not data:
+                    break
+                n += 1
+                out = self._fuzz_maybe(data, pcs, n, direction)
+                pcs = raise_prob(pcs, self.ascent)
+                dst.sendall(out)
+        except OSError:
+            pass
+        finally:
+            # propagate the half-close: stop writing to dst, but leave the
+            # opposite pump (dst -> src) alive to deliver the response
+            try:
+                dst.shutdown(socket.SHUT_WR)
+            except OSError:
+                pass
+
+    def _handle_tcp(self, client: socket.socket):
+        try:
+            server = socket.create_connection((self.rhost, self.rport), timeout=10)
+        except OSError as e:
+            logger.log("error", "proxy cannot reach %s:%d: %s",
+                       self.rhost, self.rport, e)
+            client.close()
+            return
+        t1 = threading.Thread(
+            target=self._pump, args=(client, server, self.prob_cs, "c->s"),
+            daemon=True)
+        t2 = threading.Thread(
+            target=self._pump, args=(server, client, self.prob_sc, "s->c"),
+            daemon=True)
+        t1.start()
+        t2.start()
+
+    def _serve_tcp(self):
+        srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        srv.bind(("0.0.0.0", self.lport))
+        srv.listen(64)
+        self._srv = srv
+        logger.log("info", "fuzzproxy %s://%d -> %s:%d",
+                   self.proto, self.lport, self.rhost, self.rport)
+        while not self._stop.is_set():
+            try:
+                client, _addr = srv.accept()
+            except OSError:
+                break
+            self._handle_tcp(client)
+
+    # --- UDP (loop_udp, erlamsa_fuzzproxy.erl:226-259) --------------------
+
+    def _serve_udp(self):
+        srv = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        srv.bind(("0.0.0.0", self.lport))
+        self._srv = srv
+        up = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        client_addr = None
+        n = 0
+        while not self._stop.is_set():
+            try:
+                data, addr = srv.recvfrom(65536)
+            except OSError:
+                break
+            if addr[0] != self.rhost or addr[1] != self.rport:
+                client_addr = addr
+                n += 1
+                out = self._fuzz_maybe(data, self.prob_cs, n, "c->s")
+                up.sendto(out, (self.rhost, self.rport))
+            elif client_addr:
+                out = self._fuzz_maybe(data, self.prob_sc, n, "s->c")
+                srv.sendto(out, client_addr)
+
+    def start(self, block: bool = True):
+        target = self._serve_udp if self.proto == "udp" else self._serve_tcp
+        if block:
+            target()
+            return 0
+        threading.Thread(target=target, daemon=True).start()
+        return self
+
+    def stop(self):
+        self._stop.set()
+        try:
+            self._srv.close()
+        except Exception:
+            pass
+
+
+def run_proxy(spec: str, probs: str, opts: dict) -> int:
+    return FuzzProxy(spec, probs, opts).start(block=True)

@@ -1,0 +1,80 @@
+"""Case-parallel workers (-w N).
+
+Reference: erlamsa_main:get_threading_mode + run_fuzzing_loop
+(src/erlamsa_main.erl:89-108, 249-280): N cases split into per-worker
+ranges plus a remainder; each worker runs the same loop with a seed drawn
+from the parent stream (or the same seed with --workers-same-seed).
+Processes (not threads) so oracle CPU work scales.
+"""
+
+from __future__ import annotations
+
+import multiprocessing as mp
+
+from ..utils.erlrand import ErlRand
+
+
+def _worker_main(opts: dict, lo: int, hi: int, extra: int, wseed):
+    from ..oracle.engine import Engine
+    from . import out as outmod
+
+    wopts = dict(opts)
+    wopts["seed"] = wseed
+    writer, _ = outmod.string_outputs(opts.get("output", "-"))
+    eng = Engine(wopts)
+    i = max(lo, 1)
+    while i <= hi:
+        data, meta = eng.run_case(i)
+        if writer is not None and data:
+            try:
+                writer(i, data, meta)
+            except ConnectionError:
+                pass
+        i += 1
+    if extra:
+        data, meta = eng.run_case(extra)
+        if writer is not None and data:
+            try:
+                writer(extra, data, meta)
+            except ConnectionError:
+                pass
+
+
+def split_ranges(n: int, workers: int) -> list[tuple[int, int, int]]:
+    """[(lo, hi, extra_case)] per worker covering cases 1..n exactly:
+    worker w owns [w*div, (w+1)*div - 1] and workers 0..rem additionally
+    own case div*workers + w (get_threading_mode,
+    src/erlamsa_main.erl:95-108)."""
+    div = n // workers
+    rem = n % workers
+    out = []
+    for w in range(workers):
+        lo = w * div
+        hi = (w + 1) * div - 1
+        extra = div * workers + w if w <= rem else 0
+        if w == 0:
+            lo = 1
+        if w == workers - 1:
+            hi = min(hi, n)
+        out.append((lo, hi, extra if extra and extra <= n else 0))
+    return out
+
+
+def run_workers(opts: dict, _writer) -> int:
+    n = opts.get("n", 1)
+    workers = opts.get("workers", 1)
+    parent = ErlRand(opts["seed"])
+    same_seed = opts.get("workers_same_seed", False)
+    procs = []
+    for lo, hi, extra in split_ranges(n, workers):
+        wseed = (
+            opts["seed"]
+            if same_seed
+            else (parent.erand(99999), parent.erand(99999), parent.erand(99999))
+        )
+        p = mp.Process(target=_worker_main, args=(opts, lo, hi, extra, wseed))
+        p.start()
+        procs.append(p)
+    for p in procs:
+        p.join()
+    return 0

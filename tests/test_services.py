@@ -1,0 +1,296 @@
+"""Service-level tests with live localhost sockets: FaaS, proxy, dist,
+monitors, output writers, CLI plumbing. The reference has NO automated
+tests for these layers (SURVEY.md §4) — these are new coverage."""
+
+import base64
+import json
+import socket
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from erlamsa_tpu.services.batcher import OracleBatcher
+from erlamsa_tpu.services.cli import _parse_actions, build_parser
+from erlamsa_tpu.services.cmanager import CloudManager
+from erlamsa_tpu.services.dist import ParentServer, WorkerNode, remote_fuzz
+from erlamsa_tpu.services.faas import serve
+from erlamsa_tpu.services.monitors import ConnectMonitor, parse_monitor_spec
+from erlamsa_tpu.services.out import string_outputs
+from erlamsa_tpu.services.proxy import FuzzProxy, _pack_http, _split_http, parse_proxy_spec
+from erlamsa_tpu.services.workerpool import split_ranges
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+# ---- cli plumbing -------------------------------------------------------
+
+
+def test_parse_actions():
+    defaults = [("bd", 1), ("bf", 1), ("num", 3)]
+    assert _parse_actions("default", defaults) == defaults
+    assert _parse_actions("bd,num=7", defaults) == [("bd", 1), ("num", 7)]
+    with pytest.raises(SystemExit):
+        _parse_actions("nope", defaults)
+
+
+def test_build_parser_roundtrip():
+    args = build_parser().parse_args(
+        ["-n", "5", "-s", "1,2,3", "-m", "bd", "--backend", "tpu", "f1", "f2"]
+    )
+    assert args.count == "5" and args.paths == ["f1", "f2"]
+    assert args.backend == "tpu"
+
+
+def test_split_ranges_cover_all_cases():
+    for n in (1, 2, 7, 10, 11, 100):
+        for w in (1, 2, 3, 7):
+            if w > n:
+                continue
+            covered = set()
+            for lo, hi, extra in split_ranges(n, w):
+                covered.update(range(max(lo, 1), hi + 1))
+                if extra:
+                    covered.add(extra)
+            assert covered == set(range(1, n + 1)), (n, w)
+
+
+# ---- output writers -----------------------------------------------------
+
+
+def test_file_writer(tmp_path):
+    w, _ = string_outputs(str(tmp_path / "out-%n.bin"))
+    w(7, b"data7", [])
+    assert (tmp_path / "out-7.bin").read_bytes() == b"data7"
+
+
+def test_tcp_writer_roundtrip():
+    port = _free_port()
+    received = []
+    srv = socket.socket()
+    srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    srv.bind(("127.0.0.1", port))
+    srv.listen(1)
+
+    def accept():
+        conn, _ = srv.accept()
+        received.append(conn.recv(4096))
+        conn.close()
+
+    t = threading.Thread(target=accept)
+    t.start()
+    w, _ = string_outputs(f"tcp://127.0.0.1:{port}")
+    w(1, b"fuzzed!", [])
+    t.join(5)
+    assert received == [b"fuzzed!"]
+
+
+def test_tcp_writer_cantconnect():
+    w, _ = string_outputs(f"tcp://127.0.0.1:{_free_port()}")
+    with pytest.raises(ConnectionError):
+        w(1, b"x", [])
+
+
+# ---- cmanager -----------------------------------------------------------
+
+
+def test_cmanager_tokens_and_sessions():
+    cm = CloudManager(auth_required=True)
+    assert cm.add_token("wrong-admin") is None
+    tok = cm.add_token(cm.admin_token)
+    assert tok
+    status, session = cm.get_client_context(tok, None)
+    assert status == "ok" and session
+    status2, session2 = cm.get_client_context(None, session)
+    assert status2 == "ok" and session2 == session
+    assert cm.get_client_context(None, None)[0] == "unauthorized"
+    assert cm.del_token(cm.admin_token, tok)
+
+
+# ---- faas ---------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def faas_server():
+    port = _free_port()
+    srv = serve("127.0.0.1", port, {"workers": 2, "seed": (1, 2, 3)},
+                backend="oracle", block=False)
+    yield port
+    srv.shutdown()
+
+
+def test_faas_fuzz_endpoint(faas_server):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{faas_server}/erlamsa/erlamsa_esi:fuzz",
+        data=b"faas test data 42\n",
+        headers={"erlamsa-seed": "5,6,7"},
+    )
+    resp = urllib.request.urlopen(req, timeout=30)
+    body = resp.read()
+    assert resp.headers["erlamsa-status"] == "ok"
+    assert body != b""
+
+
+def test_faas_fuzz_deterministic_seed(faas_server):
+    def post():
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{faas_server}/erlamsa/erlamsa_esi:fuzz",
+            data=b"same input\n",
+            headers={"erlamsa-seed": "9,9,9"},
+        )
+        return urllib.request.urlopen(req, timeout=30).read()
+
+    assert post() == post()
+
+
+def test_faas_json_endpoint(faas_server):
+    payload = json.dumps(
+        {"data": base64.b64encode(b"json api data 1\n").decode(), "seed": "3,4,5"}
+    ).encode()
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{faas_server}/erlamsa/erlamsa_esi:json", data=payload
+    )
+    resp = json.loads(urllib.request.urlopen(req, timeout=30).read())
+    assert base64.b64decode(resp["data"]) != b""
+
+
+def test_faas_concurrent_requests(faas_server):
+    results = []
+
+    def post(i):
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{faas_server}/erlamsa/erlamsa_esi:fuzz",
+            data=b"concurrent %d\n" % i,
+        )
+        results.append(urllib.request.urlopen(req, timeout=30).read())
+
+    threads = [threading.Thread(target=post, args=(i,)) for i in range(16)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(30)
+    assert len(results) == 16
+
+
+# ---- proxy --------------------------------------------------------------
+
+
+def test_parse_proxy_spec():
+    assert parse_proxy_spec("tcp://4000:target.host:80") == (
+        "tcp", 4000, "target.host", 80)
+    with pytest.raises(SystemExit):
+        parse_proxy_spec("tcp://nope")
+
+
+def test_http_split_pack():
+    raw = b"POST / HTTP/1.1\r\nHost: x\r\nContent-Length: 4\r\n\r\nabcd"
+    head, body = _split_http(raw)
+    assert body == b"abcd"
+    repacked = _pack_http(head, b"xyzzy!")
+    assert b"Content-Length: 6" in repacked
+    assert repacked.endswith(b"xyzzy!")
+    assert _split_http(b"random non-http bytes") is None
+
+
+def test_proxy_tcp_passthrough_and_fuzz():
+    # echo upstream
+    up_port = _free_port()
+    up = socket.socket()
+    up.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    up.bind(("127.0.0.1", up_port))
+    up.listen(4)
+
+    def echo():
+        while True:
+            try:
+                conn, _ = up.accept()
+            except OSError:
+                return
+            data = conn.recv(65536)
+            conn.sendall(data)
+            conn.close()
+
+    threading.Thread(target=echo, daemon=True).start()
+
+    lport = _free_port()
+    # prob 1.0 c->s: every client payload is fuzzed before reaching upstream
+    proxy = FuzzProxy(f"tcp://{lport}:127.0.0.1:{up_port}", "1.0,0.0",
+                      {"seed": (1, 2, 3), "workers": 2})
+    proxy.start(block=False)
+    time.sleep(0.3)
+
+    with socket.create_connection(("127.0.0.1", lport), timeout=10) as c:
+        c.sendall(b"proxy payload 123456\n")
+        c.shutdown(socket.SHUT_WR)
+        back = c.recv(65536)
+    proxy.stop()
+    up.close()
+    assert back != b""
+    # upstream echoed what the proxy forwarded; with prob 1.0 it's mutated
+    assert back != b"proxy payload 123456\n"
+
+
+# ---- monitors -----------------------------------------------------------
+
+
+def test_parse_monitor_spec():
+    assert parse_monitor_spec("+probe:host=1.2.3.4,port=80") == (
+        "probe", {"host": "1.2.3.4", "port": "80"})
+    assert parse_monitor_spec("!cm:off") is None
+
+
+def test_connect_monitor_catches_connection():
+    port = _free_port()
+    mon = ConnectMonitor({"port": str(port)})
+    mon.start()
+    time.sleep(0.3)
+    with socket.create_connection(("127.0.0.1", port), timeout=5) as s:
+        s.sendall(b"{event}ssrf-hit from target")
+    time.sleep(0.3)
+    mon.stop()
+
+
+# ---- dist ---------------------------------------------------------------
+
+
+def test_dist_parent_local_fallback():
+    port = _free_port()
+    parent = ParentServer(port, {"workers": 2, "seed": (1, 2, 3)})
+    parent.serve(block=False)
+    time.sleep(0.2)
+    out = remote_fuzz("127.0.0.1", port, b"dist test data\n")
+    parent.stop()
+    assert out != b""
+
+
+def test_dist_worker_join_and_route():
+    pport = _free_port()
+    parent = ParentServer(pport, {"workers": 2, "seed": (1, 2, 3)})
+    parent.serve(block=False)
+    worker = WorkerNode("127.0.0.1", pport, {"workers": 2, "seed": (4, 5, 6)})
+    worker.start(block=False)
+    deadline = time.time() + 10
+    while parent.pool.count() == 0 and time.time() < deadline:
+        time.sleep(0.1)
+    assert parent.pool.count() == 1
+    out = parent.route_fuzz(b"routed data 99\n")
+    worker.stop()
+    parent.stop()
+    assert out != b""
+
+
+# ---- batcher ------------------------------------------------------------
+
+
+def test_oracle_batcher():
+    b = OracleBatcher(workers=2)
+    out = b.fuzz(b"batch me 123\n", {"seed": (1, 2, 3)})
+    out2 = b.fuzz(b"batch me 123\n", {"seed": (1, 2, 3)})
+    assert out == out2
