@@ -1,0 +1,658 @@
+"""Fused mutation engine: one parametric apply instead of 25 kernels.
+
+Why: under vmap, ``lax.switch`` over per-sample mutator choices executes
+EVERY branch and selects — the naive pipeline pays for all 25 kernels on
+every sample every round (~1200 O(L) passes per sample per case). The TPU-
+first observation is that almost every mutator is a *decision* (a handful
+of scalars) followed by one of four *applications*:
+
+  SPLICE   out = data[:pos] ++ R ++ data[pos+drop:], where R is either a
+           repeated span of the input or a literal from a small scratch
+           row. Covers bd bei bed bf bi ber br sd sr uw ui num and the
+           line ops ld lds lr2 lri lr lis lrs (line spans are just spans).
+  SWAP     exchange two adjacent spans (ls at line granularity).
+  PERMUTE  keyed-argsort shuffle inside a window (sp, lp), capped at
+           PERM_WINDOW bytes / PERM_LINES lines (radamsa itself caps sp at
+           20 bytes; the reference's unbounded span is an acknowledged
+           deviation, src/erlamsa_mutations.erl:252).
+  MASK     per-byte NAND/OR/XOR/replace with probability (snand srnd).
+
+So each round computes cheap O(1)-per-mutator scalar params under a
+lax.switch (all branches are scalar work — executing them all is nearly
+free), then applies the four passes once each (identity when unused).
+Per-round cost drops from ~75 O(L) kernels to ~8 O(L) passes.
+
+Decision draws reuse the same distributions as the per-kernel path
+(positions, span lengths, repeat counts, deltas), so mutation-site
+statistics match the reference within the documented device divergences.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from . import prng
+from .line_mutators import _line_table
+from .num_mutators import (
+    _MAX_PARSE_DIGITS,
+    _SCRATCH,
+    _device_binarish,
+    _mutate_num,
+    _render_decimal,
+)
+from .registry import DEVICE_CODES
+from .scheduler import adjust_scores, weighted_pick
+from .seq_mutators import _span as _span_draw
+from .utf8_mutators import _FUNNY_LENS, _FUNNY_TABLE
+
+PERM_WINDOW = 256  # byte-permute window cap (radamsa uses 20)
+PERM_LINES = 64  # line-permute window cap
+
+_NUM_IDX = DEVICE_CODES.index("num")
+
+# application kinds
+K_NONE, K_SPLICE, K_SWAP, K_PERM_BYTES, K_PERM_LINES, K_MASK = range(6)
+
+# splice replacement sources
+SRC_NONE, SRC_SPAN, SRC_LIT = range(3)
+
+
+class Params:
+    """Per-sample edit program: a handful of int32 scalars + a scratch row.
+    Built as a dict of arrays so lax.switch branches can produce it."""
+
+    FIELDS = (
+        "kind", "pos", "drop",  # splice window
+        "src", "src_start", "src_len", "reps", "lit_len",  # replacement
+        "a1", "l1", "l2",  # swap (a2 = a1 + l1)
+        "ps", "pl",  # permute window (bytes or line index range)
+        "mask_op", "mask_prob",  # mask pass
+        "delta",
+    )
+
+
+def _zeros():
+    p = {f: jnp.int32(0) for f in Params.FIELDS}
+    p["kind"] = jnp.int32(K_NONE)
+    p["delta"] = jnp.int32(-1)
+    p["scratch"] = jnp.zeros(_SCRATCH, jnp.uint8)
+    return p
+
+
+class Tables:
+    """Shared per-round precomputation (a few O(L) passes)."""
+
+    def __init__(self, key, data, n):
+        L = data.shape[0]
+        i = jnp.arange(L, dtype=jnp.int32)
+        valid = i < n
+        self.data, self.n, self.i, self.valid = data, n, i, valid
+        self.line_starts, self.line_lens, self.nlines = _line_table(data, n)
+        # digit runs (for num)
+        is_digit = (data >= 48) & (data <= 57) & valid
+        prev = jnp.concatenate([jnp.zeros(1, bool), is_digit[:-1]])
+        self.digit_starts = is_digit & ~prev
+        self.is_digit = is_digit
+        self.run_count = jnp.sum(self.digit_starts).astype(jnp.int32)
+        # widenable bytes (for uw)
+        self.widenable = ((data & jnp.uint8(0x3F)) == data) & valid
+        self.key = key
+
+
+# --- per-mutator parameter generators ------------------------------------
+# Each takes (key, t: Tables) and returns a Params dict. All scalar work.
+
+
+def _pg_byte_drop(key, t):
+    p = _zeros()
+    p["kind"] = jnp.int32(K_SPLICE)
+    p["pos"] = prng.rand(prng.sub(key, prng.TAG_POS), t.n)
+    p["drop"] = jnp.int32(1)
+    p["delta"] = prng.rand_delta(key)
+    return p
+
+
+def _pg_byte_edit(edit):
+    """bei bed bf ber: replace one byte via a literal."""
+
+    def pg(key, t):
+        p = _zeros()
+        pos = prng.rand(prng.sub(key, prng.TAG_POS), t.n)
+        b = t.data[pos].astype(jnp.int32)
+        if edit == "inc":
+            nb = (b + 1) % 256
+        elif edit == "dec":
+            nb = (b - 1) % 256
+        elif edit == "flip":
+            nb = b ^ jnp.left_shift(1, prng.rand(prng.sub(key, prng.TAG_VAL), 8))
+        else:  # random — same draw as prng.rand_byte (int32 path)
+            nb = prng.rand_byte(prng.sub(key, prng.TAG_VAL)).astype(jnp.int32)
+        p["kind"] = jnp.int32(K_SPLICE)
+        p["pos"] = pos
+        p["drop"] = jnp.int32(1)
+        p["src"] = jnp.int32(SRC_LIT)
+        p["lit_len"] = jnp.int32(1)
+        p["scratch"] = p["scratch"].at[0].set(nb.astype(jnp.uint8))
+        p["delta"] = prng.rand_delta(key)
+        return p
+
+    return pg
+
+
+def _pg_byte_insert(key, t):
+    p = _zeros()
+    pos = prng.rand(prng.sub(key, prng.TAG_POS), t.n)
+    nb = prng.rand_byte(prng.sub(key, prng.TAG_VAL))
+    p["kind"] = jnp.int32(K_SPLICE)
+    p["pos"] = pos
+    p["src"] = jnp.int32(SRC_LIT)
+    p["lit_len"] = jnp.int32(2)
+    p["scratch"] = (
+        p["scratch"].at[0].set(nb.astype(jnp.uint8)).at[1].set(t.data[pos])
+    )
+    p["drop"] = jnp.int32(1)
+    p["delta"] = prng.rand_delta(key)
+    return p
+
+
+def _pg_byte_repeat(key, t):
+    p = _zeros()
+    pos = prng.rand(prng.sub(key, prng.TAG_POS), t.n)
+    p["kind"] = jnp.int32(K_SPLICE)
+    p["pos"] = pos
+    p["drop"] = jnp.int32(0)
+    p["src"] = jnp.int32(SRC_SPAN)
+    p["src_start"] = pos
+    p["src_len"] = jnp.int32(1)
+    p["reps"] = jnp.int32(1)
+    p["delta"] = prng.rand_delta(key)
+    return p
+
+
+_span = _span_draw  # same draws as the per-kernel engine (seq_mutators._span)
+
+
+def _pg_seq_drop(key, t):
+    p = _zeros()
+    s, l = _span(key, t.n)
+    p["kind"] = jnp.int32(K_SPLICE)
+    p["pos"] = s
+    p["drop"] = l
+    p["delta"] = prng.rand_delta(key)
+    return p
+
+
+def _pg_seq_repeat(key, t):
+    p = _zeros()
+    s, l = _span(key, t.n)
+    reps = jnp.maximum(2, prng.rand_log(prng.sub(key, prng.TAG_VAL), 10))
+    p["kind"] = jnp.int32(K_SPLICE)
+    p["pos"] = s
+    p["drop"] = l
+    p["src"] = jnp.int32(SRC_SPAN)
+    p["src_start"] = s
+    p["src_len"] = l
+    p["reps"] = reps
+    p["delta"] = prng.rand_delta(key)
+    return p
+
+
+def _pg_seq_perm(key, t):
+    p = _zeros()
+    W = min(PERM_WINDOW, t.data.shape[0])
+    s = prng.rand(prng.sub(key, prng.TAG_POS), t.n)
+    lmax = jnp.minimum(t.n - s, W)
+    l = prng.rand(prng.sub(key, prng.TAG_LEN), lmax) + 1
+    p["kind"] = jnp.int32(K_PERM_BYTES)
+    p["ps"] = s
+    p["pl"] = l
+    p["delta"] = prng.rand_delta(key)
+    return p
+
+
+def _pg_mask(ops):
+    def pg(key, t):
+        p = _zeros()
+        s, l = _span(key, t.n)
+        p["kind"] = jnp.int32(K_MASK)
+        p["ps"] = s
+        p["pl"] = l
+        p["mask_op"] = jnp.asarray(ops, jnp.int32)[
+            prng.rand(prng.sub(key, prng.TAG_MASK), len(ops))
+        ]
+        p["mask_prob"] = prng.erand(prng.sub(key, prng.TAG_PROB), 100)
+        p["delta"] = prng.rand_delta(key)
+        return p
+
+    return pg
+
+
+def _pg_utf8_widen(key, t):
+    p = _zeros()
+    u = prng.uniform_f32(prng.sub(key, prng.TAG_POS), (t.data.shape[0],))
+    pos = jnp.argmax(jnp.where(t.widenable, u, -1.0)).astype(jnp.int32)
+    b = t.data[pos]
+    p["kind"] = jnp.int32(K_SPLICE)
+    p["pos"] = pos
+    p["drop"] = jnp.int32(1)
+    p["src"] = jnp.int32(SRC_LIT)
+    p["lit_len"] = jnp.int32(2)
+    p["scratch"] = (
+        p["scratch"].at[0].set(jnp.uint8(0xC0)).at[1].set(b | jnp.uint8(0x80))
+    )
+    p["delta"] = prng.rand_delta(key)
+    return p
+
+
+def _pg_utf8_insert(key, t):
+    p = _zeros()
+    table = jnp.asarray(_FUNNY_TABLE)
+    lens = jnp.asarray(_FUNNY_LENS)
+    pos = prng.rand(prng.sub(key, prng.TAG_POS), t.n)
+    row = prng.rand(prng.sub(key, prng.TAG_VAL), table.shape[0])
+    seq = table[row]
+    m = lens[row]
+    p["kind"] = jnp.int32(K_SPLICE)
+    p["pos"] = pos + 1
+    p["src"] = jnp.int32(SRC_LIT)
+    p["lit_len"] = m
+    p["scratch"] = jax.lax.dynamic_update_slice(p["scratch"], seq, (0,))
+    p["delta"] = prng.rand_delta(key)
+    return p
+
+
+def _pg_num(key, t):
+    """Textual-number mutation as a splice with a rendered literal."""
+    p = _zeros()
+    L = t.data.shape[0]
+    which = prng.rand(prng.sub(key, prng.TAG_POS), t.run_count)
+    target = t.run_count - 1 - which
+    cs = jnp.cumsum(t.digit_starts).astype(jnp.int32)
+    a = jnp.argmax(t.digit_starts & (cs == target + 1)).astype(jnp.int32)
+    break_mask = (t.i >= a) & ~t.is_digit
+    b_end = jnp.where(jnp.any(break_mask), jnp.argmax(break_mask), t.n).astype(
+        jnp.int32
+    )
+    is_dash_before = jnp.where(
+        (t.i < a) & (a - 1 - t.i >= 0),
+        t.data[jnp.clip(a - 1 - t.i, 0, L - 1)] == 45,
+        False,
+    )
+    dash_count = jnp.argmin(
+        jnp.concatenate([is_dash_before, jnp.zeros(1, bool)])
+    ).astype(jnp.int32)
+    neg = dash_count > 0
+    a_ext = a - dash_count
+
+    def parse_body(k, v):
+        idx = jnp.clip(a + k, 0, L - 1)
+        take = a + k < b_end
+        d = (t.data[idx] - 48).astype(jnp.int64)
+        return jnp.where(take & (k < _MAX_PARSE_DIGITS), v * 10 + d, v)
+
+    mag = jax.lax.fori_loop(0, _MAX_PARSE_DIGITS, parse_body, jnp.int64(0))
+    value = jnp.where(neg, -mag, mag)
+    new_value = _mutate_num(key, value)
+    repl, repl_len = _render_decimal(new_value)
+
+    p["kind"] = jnp.int32(K_SPLICE)
+    p["pos"] = a_ext
+    p["drop"] = b_end - a_ext
+    p["src"] = jnp.int32(SRC_LIT)
+    p["lit_len"] = repl_len
+    p["scratch"] = repl[:_SCRATCH]
+    # delta placeholder: sed_num scores the MUTATED data's binarish-ness;
+    # fused_mutate_step recomputes it post-apply for the num mutator
+    p["delta"] = jnp.int32(2)
+    return p
+
+
+# --- line ops as line-span splices ---------------------------------------
+
+
+def _line_span(t, k):
+    k = jnp.clip(k, 0, t.data.shape[0] - 1)
+    return t.line_starts[k], t.line_lens[k]
+
+
+def _pg_line_del(key, t):
+    p = _zeros()
+    k = prng.erand(prng.sub(key, prng.TAG_POS), t.nlines) - 1
+    s, l = _line_span(t, k)
+    p["kind"] = jnp.int32(K_SPLICE)
+    p["pos"] = s
+    p["drop"] = l
+    p["delta"] = jnp.int32(1)
+    return p
+
+
+def _pg_line_del_seq(key, t):
+    p = _zeros()
+    start = prng.erand(prng.sub(key, prng.TAG_POS), t.nlines)
+    cnt = prng.erand(prng.sub(key, prng.TAG_LEN), t.nlines - start + 1)
+    s, _ = _line_span(t, start - 1)
+    last = start - 1 + cnt - 1
+    s2, l2 = _line_span(t, last)
+    p["kind"] = jnp.int32(K_SPLICE)
+    p["pos"] = s
+    p["drop"] = s2 + l2 - s
+    p["delta"] = jnp.int32(1)
+    return p
+
+
+def _pg_line_dup(key, t):
+    p = _zeros()
+    k = prng.erand(prng.sub(key, prng.TAG_POS), t.nlines) - 1
+    s, l = _line_span(t, k)
+    p["kind"] = jnp.int32(K_SPLICE)
+    p["pos"] = s
+    p["drop"] = jnp.int32(0)
+    p["src"] = jnp.int32(SRC_SPAN)
+    p["src_start"] = s
+    p["src_len"] = l
+    p["reps"] = jnp.int32(1)
+    p["delta"] = jnp.int32(1)
+    return p
+
+
+def _pg_line_clone(key, t):
+    """lri: overwrite line To with line From."""
+    p = _zeros()
+    frm = prng.erand(prng.sub(key, prng.TAG_POS), t.nlines) - 1
+    to = prng.erand(prng.sub(key, prng.TAG_VAL), t.nlines) - 1
+    fs, fl = _line_span(t, frm)
+    ts, tl = _line_span(t, to)
+    p["kind"] = jnp.int32(K_SPLICE)
+    p["pos"] = ts
+    p["drop"] = tl
+    p["src"] = jnp.int32(SRC_SPAN)
+    p["src_start"] = fs
+    p["src_len"] = fl
+    p["reps"] = jnp.int32(1)
+    p["delta"] = jnp.int32(1)
+    return p
+
+
+def _pg_line_repeat(key, t):
+    p = _zeros()
+    k = prng.erand(prng.sub(key, prng.TAG_POS), t.nlines) - 1
+    reps = jnp.maximum(2, prng.rand_log(prng.sub(key, prng.TAG_VAL), 10))
+    s, l = _line_span(t, k)
+    p["kind"] = jnp.int32(K_SPLICE)
+    p["pos"] = s
+    p["drop"] = l
+    p["src"] = jnp.int32(SRC_SPAN)
+    p["src_start"] = s
+    p["src_len"] = l
+    p["reps"] = reps
+    p["delta"] = jnp.int32(1)
+    return p
+
+
+def _pg_line_swap(key, t):
+    p = _zeros()
+    k = prng.erand(prng.sub(key, prng.TAG_POS), jnp.maximum(t.nlines - 1, 0)) - 1
+    s1, l1 = _line_span(t, k)
+    _s2, l2 = _line_span(t, k + 1)
+    p["kind"] = jnp.int32(K_SWAP)
+    p["a1"] = s1
+    p["l1"] = l1
+    p["l2"] = l2
+    p["delta"] = jnp.int32(1)
+    return p
+
+
+def _pg_line_perm(key, t):
+    p = _zeros()
+    frm = prng.erand(prng.sub(key, prng.TAG_POS), jnp.maximum(t.nlines - 1, 0)) - 1
+    a = prng.rand_range(
+        prng.sub(key, prng.TAG_LEN), 2, jnp.maximum(t.nlines - frm - 1, 2)
+    )
+    b = prng.rand_log(prng.sub(key, prng.TAG_VAL), 10)
+    cnt = jnp.clip(jnp.maximum(2, jnp.minimum(a, b)), 0, PERM_LINES)
+    p["kind"] = jnp.int32(K_PERM_LINES)
+    p["ps"] = frm  # first line index
+    p["pl"] = cnt  # number of lines
+    p["delta"] = jnp.int32(1)
+    return p
+
+
+def _pg_line_ins(key, t):
+    p = _zeros()
+    donor = prng.erand(prng.sub(key, prng.TAG_AUX), t.nlines) - 1
+    to = prng.erand(prng.sub(key, prng.TAG_POS), t.nlines) - 1
+    ds, dl = _line_span(t, donor)
+    ts, _tl = _line_span(t, to)
+    p["kind"] = jnp.int32(K_SPLICE)
+    p["pos"] = ts
+    p["drop"] = jnp.int32(0)
+    p["src"] = jnp.int32(SRC_SPAN)
+    p["src_start"] = ds
+    p["src_len"] = dl
+    p["reps"] = jnp.int32(1)
+    p["delta"] = jnp.int32(1)
+    return p
+
+
+def _pg_line_replace(key, t):
+    """lrs: like lri but with the per-kernel engine's key tags (donor from
+    TAG_AUX, target from TAG_POS — line_mutators._src_line_replace)."""
+    p = _zeros()
+    donor = prng.erand(prng.sub(key, prng.TAG_AUX), t.nlines) - 1
+    to = prng.erand(prng.sub(key, prng.TAG_POS), t.nlines) - 1
+    ds, dl = _line_span(t, donor)
+    ts, tl = _line_span(t, to)
+    p["kind"] = jnp.int32(K_SPLICE)
+    p["pos"] = ts
+    p["drop"] = tl
+    p["src"] = jnp.int32(SRC_SPAN)
+    p["src_start"] = ds
+    p["src_len"] = dl
+    p["reps"] = jnp.int32(1)
+    p["delta"] = jnp.int32(1)
+    return p
+
+
+def _pg_none(key, t):
+    return _zeros()
+
+
+# order MUST match registry.DEVICE_CODES
+_PARAM_GENS = {
+    "uw": _pg_utf8_widen,
+    "ui": _pg_utf8_insert,
+    "num": _pg_num,
+    "bd": _pg_byte_drop,
+    "bei": _pg_byte_edit("inc"),
+    "bed": _pg_byte_edit("dec"),
+    "bf": _pg_byte_edit("flip"),
+    "bi": _pg_byte_insert,
+    "ber": _pg_byte_edit("random"),
+    "br": _pg_byte_repeat,
+    "sp": _pg_seq_perm,
+    "sr": _pg_seq_repeat,
+    "sd": _pg_seq_drop,
+    "snand": _pg_mask((0, 1, 2)),
+    "srnd": _pg_mask((3,)),
+    "ld": _pg_line_del,
+    "lds": _pg_line_del_seq,
+    "lr2": _pg_line_dup,
+    "lri": _pg_line_clone,
+    "lr": _pg_line_repeat,
+    "ls": _pg_line_swap,
+    "lp": _pg_line_perm,
+    "lis": _pg_line_ins,
+    "lrs": _pg_line_replace,
+    "nil": _pg_none,
+}
+
+_PARAM_BRANCHES = tuple(_PARAM_GENS[c] for c in DEVICE_CODES)
+
+
+# --- the four applications ------------------------------------------------
+
+
+def _apply_splice(p, data, n):
+    """out = data[:pos] ++ R ++ data[pos+drop:] in one gather."""
+    L = data.shape[0]
+    i = jnp.arange(L, dtype=jnp.int32)
+    active = p["kind"] == K_SPLICE
+    pos = jnp.clip(p["pos"], 0, n)
+    drop = jnp.clip(p["drop"], 0, n - pos)
+    span_total = p["src_len"] * p["reps"]
+    rlen = jnp.select(
+        [p["src"] == SRC_SPAN, p["src"] == SRC_LIT],
+        [span_total, p["lit_len"]],
+        0,
+    )
+    end_ins = pos + rlen
+    src_span = p["src_start"] + jnp.mod(
+        i - pos, jnp.maximum(p["src_len"], 1)
+    )
+    lit_idx = jnp.clip(i - pos, 0, _SCRATCH - 1)
+    repl_byte = jnp.where(
+        p["src"] == SRC_LIT,
+        p["scratch"][lit_idx],
+        data[jnp.clip(src_span, 0, L - 1)],
+    )
+    tail_src = jnp.clip(i - rlen + drop, 0, L - 1)
+    out = jnp.where(
+        i < pos,
+        data,
+        jnp.where(i < end_ins, repl_byte, data[tail_src]),
+    )
+    n_out = jnp.clip(n - drop + rlen, 0, L)
+    out = jnp.where(i < n_out, out, jnp.uint8(0))
+    return (
+        jnp.where(active, out, data),
+        jnp.where(active, n_out, n),
+    )
+
+
+def _apply_swap(p, data, n):
+    """Exchange adjacent spans [a1, a1+l1) and [a1+l1, a1+l1+l2)."""
+    L = data.shape[0]
+    i = jnp.arange(L, dtype=jnp.int32)
+    active = p["kind"] == K_SWAP
+    a1, l1, l2 = p["a1"], p["l1"], p["l2"]
+    a2 = a1 + l1
+    in_first = (i >= a1) & (i < a1 + l2)
+    in_second = (i >= a1 + l2) & (i < a1 + l2 + l1)
+    src = jnp.where(
+        in_first, a2 + (i - a1), jnp.where(in_second, a1 + (i - a1 - l2), i)
+    )
+    out = data[jnp.clip(src, 0, L - 1)]
+    return jnp.where(active, out, data), n
+
+
+def _apply_perm_bytes(key, p, data, n):
+    """Window permute: argsort over a fixed PERM_WINDOW slice. The slice
+    start clamps near the buffer end, so the permuted span is addressed by
+    its offset within the slice."""
+    L = data.shape[0]
+    W = min(PERM_WINDOW, L)  # static clamp: capacity may be < PERM_WINDOW
+    active = p["kind"] == K_PERM_BYTES
+    ss = jnp.clip(p["ps"], 0, jnp.maximum(L - W, 0))
+    offset = p["ps"] - ss  # >0 only when the slice start was clamped
+    window = jax.lax.dynamic_slice(data, (ss,), (W,))
+    w = jnp.arange(W, dtype=jnp.int32)
+    in_span = (w >= offset) & (w < offset + p["pl"])
+    u = prng.uniform_f32(prng.sub(key, prng.TAG_PERM), (W,))
+    sortkey = jnp.where(in_span, u, 2.0 + w.astype(jnp.float32))
+    order = jnp.argsort(sortkey).astype(jnp.int32)
+    j = jnp.clip(w - offset, 0, W - 1)
+    permed = jnp.where(in_span, window[order[j]], window)
+    out = jax.lax.dynamic_update_slice(data, permed, (ss,))
+    return jnp.where(active, out, data), n
+
+
+def _apply_perm_lines(key, p, data, n, starts, lens, nlines):
+    """Permute up to PERM_LINES whole lines within a window: output bytes in
+    the window gather via a small per-line cum-length table."""
+    L = data.shape[0]
+    i = jnp.arange(L, dtype=jnp.int32)
+    active = p["kind"] == K_PERM_LINES
+    f = jnp.clip(p["ps"], 0, jnp.maximum(nlines - 1, 0))
+    cnt = jnp.clip(p["pl"], 0, jnp.maximum(nlines - f, 0))
+    k = jnp.arange(PERM_LINES, dtype=jnp.int32)
+    line_idx = jnp.clip(f + k, 0, L - 1)
+    wlens = jnp.where(k < cnt, lens[line_idx], 0)
+    # random order of the cnt window lines
+    u = prng.uniform_f32(prng.sub(key, prng.TAG_PERM), (PERM_LINES,))
+    sortkey = jnp.where(k < cnt, u, 2.0 + k.astype(jnp.float32))
+    order = jnp.argsort(sortkey).astype(jnp.int32)  # window-line perm
+    out_lens = wlens[order]
+    cum = jnp.cumsum(out_lens).astype(jnp.int32)
+    win_start = starts[jnp.clip(f, 0, L - 1)]
+    total = cum[jnp.clip(cnt - 1, 0, PERM_LINES - 1)]
+    rel = i - win_start
+    in_win = (rel >= 0) & (rel < total)
+    j = jnp.searchsorted(cum, rel, side="right").astype(jnp.int32)
+    j = jnp.clip(j, 0, PERM_LINES - 1)
+    prev_cum = jnp.where(j > 0, cum[jnp.clip(j - 1, 0, PERM_LINES - 1)], 0)
+    src_line = jnp.clip(f + order[j], 0, L - 1)
+    src_byte = starts[src_line] + (rel - prev_cum)
+    out = jnp.where(in_win, data[jnp.clip(src_byte, 0, L - 1)], data)
+    return jnp.where(active, out, data), n
+
+
+def _apply_mask(key, p, data, n):
+    L = data.shape[0]
+    i = jnp.arange(L, dtype=jnp.int32)
+    active = p["kind"] == K_MASK
+    in_span = (i >= p["ps"]) & (i < p["ps"] + p["pl"])
+    kb = jax.random.split(prng.sub(key, prng.TAG_VAL), 3)
+    occurs_n = jax.random.randint(kb[0], (L,), 0, 100, dtype=jnp.int32)
+    occurs = jnp.where(p["mask_prob"] == 1, occurs_n != 0, occurs_n < p["mask_prob"])
+    bit = jax.random.randint(kb[1], (L,), 0, 8, dtype=jnp.int32)
+    rnd = jax.random.randint(kb[2], (L,), 0, 256, dtype=jnp.int32).astype(jnp.uint8)
+    one = jnp.left_shift(jnp.uint8(1), bit.astype(jnp.uint8))
+    masked = jnp.select(
+        [p["mask_op"] == 0, p["mask_op"] == 1, p["mask_op"] == 2],
+        [data & ~one, data | one, data ^ one],
+        rnd,
+    )
+    out = jnp.where(in_span & occurs, masked, data)
+    return jnp.where(active, out, data), n
+
+
+# --- fused scheduler step -------------------------------------------------
+
+
+def fused_mutate_step(key, data, n, scores, pri):
+    """Drop-in replacement for scheduler.mutate_step with ~8 O(L) passes.
+    Selection and score accounting are shared with the switch engine
+    (scheduler.weighted_pick / adjust_scores)."""
+    applied, any_app, pos, pos_of = weighted_pick(key, data, n, scores, pri)
+
+    t = Tables(key, data, n)
+    site_key = prng.sub(key, prng.TAG_SITE)
+    # Tables is a host object, not a pytree: close each branch over it
+    branches = tuple(
+        (lambda g: (lambda k: g(k, t)))(g) for g in _PARAM_BRANCHES
+    )
+    params = jax.lax.switch(applied, branches, site_key)
+
+    out, n1 = _apply_splice(params, data, n)
+    out, n1 = _apply_swap(params, out, n1)
+    out, n1 = _apply_perm_bytes(site_key, params, out, n1)
+    out, n1 = _apply_perm_lines(
+        site_key, params, out, n1, t.line_starts, t.line_lens, t.nlines
+    )
+    out, n1 = _apply_mask(site_key, params, out, n1)
+
+    out = jnp.where(any_app, out, data)
+    n1 = jnp.where(any_app, n1, n)
+
+    # sed_num scores the mutated data's binarish-ness (num_mutators.py);
+    # recompute it here where the post-splice bytes exist
+    delta = jnp.where(
+        applied == _NUM_IDX,
+        jnp.where(_device_binarish(out, n1), -1, 2),
+        params["delta"],
+    ).astype(jnp.int32)
+
+    new_scores = adjust_scores(scores, applied, any_app, pos, pos_of, delta)
+    applied_out = jnp.where(any_app, applied, -1).astype(jnp.int32)
+    return out, n1, new_scores, applied_out

@@ -56,8 +56,22 @@ def _splice_prefix(orig, mutated, s, n_mut):
     return jnp.where(i < n_out, out, jnp.uint8(0)), n_out
 
 
-def fuzz_sample(key, data, n, scores, pri, pat_pri):
-    """Mutate one sample end-to-end. vmapped by fuzz_batch."""
+ENGINES = ("fused", "switch")
+
+
+def fuzz_sample(key, data, n, scores, pri, pat_pri, engine: str = "fused"):
+    """Mutate one sample end-to-end. vmapped by fuzz_batch.
+
+    NOTE: the two engines draw sp/lp permutations differently (fused caps
+    the window), so (seed, case) reproducibility holds only within one
+    engine; record the engine alongside the seed when archiving cases.
+    """
+    if engine not in ENGINES:
+        raise ValueError(f"engine must be one of {ENGINES}, got {engine!r}")
+    if engine == "fused":
+        from .fused import fused_mutate_step as step_fn
+    else:
+        step_fn = mutate_step
     pat, rounds, skip = pattern_plan(prng.sub(key, prng.TAG_PROB), n, pat_pri)
 
     work, wn = _shift_left(data, n, skip)
@@ -66,7 +80,7 @@ def fuzz_sample(key, data, n, scores, pri, pat_pri):
         wdata, wlen, sc, log = carry
         active = r < rounds
         kr = prng.sub(prng.sub(key, prng.TAG_SITE), r)
-        nd, nn, nsc, applied = mutate_step(kr, wdata, wlen, sc, pri)
+        nd, nn, nsc, applied = step_fn(kr, wdata, wlen, sc, pri)
         wdata = jnp.where(active, nd, wdata)
         wlen = jnp.where(active, nn, wlen)
         sc = jnp.where(active, nsc, sc)
@@ -82,7 +96,7 @@ def fuzz_sample(key, data, n, scores, pri, pat_pri):
     return out, n_out, scores, pat, log
 
 
-def fuzz_batch(keys, data, lens, scores, pri, pat_pri):
+def fuzz_batch(keys, data, lens, scores, pri, pat_pri, engine: str = "fused"):
     """One device call: mutate a [B, L] batch.
 
     Args:
@@ -90,16 +104,19 @@ def fuzz_batch(keys, data, lens, scores, pri, pat_pri):
       data: uint8[B, L]; lens: int32[B].
       scores: int32[B, M] scheduler state (scheduler.init_scores).
       pri: int32[M] mutator priorities; pat_pri: int32[P] pattern priorities.
+      engine: "fused" (default, ~8 O(L) passes/round) or "switch" (one
+        kernel per mutator — the reference-shaped baseline).
 
     Returns (data', lens', scores', FuzzMeta).
     """
     out, n_out, sc, pat, log = jax.vmap(
-        fuzz_sample, in_axes=(0, 0, 0, 0, None, None)
-    )(keys, data, lens, scores, pri, pat_pri)
+        lambda k, d, n, s: fuzz_sample(k, d, n, s, pri, pat_pri, engine)
+    )(keys, data, lens, scores)
     return out, n_out, sc, FuzzMeta(pat, log)
 
 
-def make_fuzzer(capacity: int, batch: int, mutator_pri=None, pattern_pri=None):
+def make_fuzzer(capacity: int, batch: int, mutator_pri=None, pattern_pri=None,
+                engine: str = "fused"):
     """Host convenience: returns (jitted_step, initial_state_fn).
 
     jitted_step(case_idx, data, lens, scores) -> (data', lens', scores', meta)
@@ -121,6 +138,8 @@ def make_fuzzer(capacity: int, batch: int, mutator_pri=None, pattern_pri=None):
         raise ValueError(f"mutator_pri must have {NUM_DEVICE_MUTATORS} entries")
     if pat_pri.shape != (NUM_PATTERNS,):
         raise ValueError(f"pattern_pri must have {NUM_PATTERNS} entries")
+    if engine not in ENGINES:
+        raise ValueError(f"engine must be one of {ENGINES}, got {engine!r}")
 
     def step(base, case_idx, data, lens, scores):
         if data.shape != (batch, capacity):
@@ -130,7 +149,8 @@ def make_fuzzer(capacity: int, batch: int, mutator_pri=None, pattern_pri=None):
         ckey = prng.case_key(base, case_idx)
         keys = prng.sample_keys(ckey, batch)
         return fuzz_batch(
-            keys, data, lens, scores, jnp.asarray(pri), jnp.asarray(pat_pri)
+            keys, data, lens, scores, jnp.asarray(pri), jnp.asarray(pat_pri),
+            engine=engine,
         )
 
     return jax.jit(step), init_scores

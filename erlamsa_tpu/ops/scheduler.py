@@ -45,18 +45,13 @@ def init_scores(key: jax.Array, batch: int) -> jax.Array:
     return jnp.maximum(r, int(MIN_SCORE))
 
 
-def mutate_step(key, data, n, scores, pri):
-    """One mutation event on one sample.
+def weighted_pick(key, data, n, scores, pri):
+    """The mux selection: applicability table, weighted-permutation draw,
+    first applicable in descending order. Shared by both engines.
 
-    Args:
-      key: per-event PRNG key.
-      data: uint8[L]; n: int32 length.
-      scores: int32[M] self-adjusting scores.
-      pri: int32[M] user priorities (0 disables a mutator).
-
-    Returns: (data', n', scores', applied int32) — applied is the registry
-    index, or -1 when nothing was applicable.
-    """
+    Returns (applied, any_app, pos, pos_of): chosen registry index, whether
+    anything was applicable, its position in the try order, and the inverse
+    permutation (for tried-before score accounting)."""
     M = NUM_DEVICE_MUTATORS
     preds = predicates(data, n)  # bool[NUM_PREDS]
     applicable = preds[jnp.asarray(PRED_INDEX_NP)] & (pri > 0)
@@ -73,6 +68,35 @@ def mutate_step(key, data, n, scores, pri):
     any_app = jnp.any(app_in_order)
     pos = jnp.argmax(app_in_order).astype(jnp.int32)  # first applicable
     applied = order[pos]
+    pos_of = jnp.argsort(order).astype(jnp.int32)  # inverse permutation
+    return applied, any_app, pos, pos_of
+
+
+def adjust_scores(scores, applied, any_app, pos, pos_of, delta):
+    """Score update for every tried mutator: -1 for tried-and-failed, the
+    applied mutator's own delta, clamped (erlamsa_mutations.erl:1238-1242)."""
+    M = NUM_DEVICE_MUTATORS
+    tried_before = pos_of < pos
+    deltas = jnp.where(tried_before, -1, 0)
+    deltas = jnp.where((jnp.arange(M) == applied) & any_app, delta, deltas)
+    return jnp.clip(scores + deltas, int(MIN_SCORE), int(MAX_SCORE)).astype(
+        jnp.int32
+    )
+
+
+def mutate_step(key, data, n, scores, pri):
+    """One mutation event on one sample (the per-kernel "switch" engine).
+
+    Args:
+      key: per-event PRNG key.
+      data: uint8[L]; n: int32 length.
+      scores: int32[M] self-adjusting scores.
+      pri: int32[M] user priorities (0 disables a mutator).
+
+    Returns: (data', n', scores', applied int32) — applied is the registry
+    index, or -1 when nothing was applicable.
+    """
+    applied, any_app, pos, pos_of = weighted_pick(key, data, n, scores, pri)
 
     new_data, new_n, delta = jax.lax.switch(
         applied, _KERNELS, prng.sub(key, prng.TAG_SITE), data, n
@@ -80,16 +104,6 @@ def mutate_step(key, data, n, scores, pri):
     new_data = jnp.where(any_app, new_data, data)
     new_n = jnp.where(any_app, new_n, n)
 
-    # score adjustment for every tried mutator (erlamsa_mutations.erl:1238-1242)
-    pos_of = jnp.argsort(order).astype(jnp.int32)  # inverse permutation
-    tried_before = pos_of < pos
-    deltas = jnp.where(tried_before, -1, 0)
-    deltas = jnp.where(
-        (jnp.arange(M) == applied) & any_app, delta, deltas
-    )
-    new_scores = jnp.clip(
-        scores + deltas, int(MIN_SCORE), int(MAX_SCORE)
-    ).astype(jnp.int32)
-
+    new_scores = adjust_scores(scores, applied, any_app, pos, pos_of, delta)
     applied_out = jnp.where(any_app, applied, -1).astype(jnp.int32)
     return new_data, new_n, new_scores, applied_out

@@ -209,7 +209,16 @@ def main(argv=None) -> int:
 def _run_oracle(opts: dict) -> int:
     from ..oracle.engine import Engine
 
-    writer, _maxtime = out.string_outputs(opts.get("output", "-"))
+    workers = opts.get("workers", 1)
+    output = opts.get("output", "-")
+    if workers > 1 and output not in ("-", "return", "stdout", "stderr"):
+        # workers create their own writers — binding sockets here too would
+        # clash with theirs (e.g. tcp:// listen mode)
+        from .workerpool import run_workers
+
+        return run_workers(opts, None)
+
+    writer, _maxtime = out.string_outputs(output)
     meta_fd = open(opts["meta_path"], "w") if opts.get("meta_path") else None
 
     def writing(case_idx, data, meta):
@@ -217,13 +226,6 @@ def _run_oracle(opts: dict) -> int:
             writer(case_idx, data, meta)
         if meta_fd:
             meta_fd.write(f"{case_idx}\t{meta!r}\n")
-
-    workers = opts.get("workers", 1)
-    output = opts.get("output", "-")
-    if workers > 1 and output not in ("-", "return", "stdout", "stderr"):
-        from .workerpool import run_workers
-
-        return run_workers(opts, writing)
 
     eng = Engine(opts)
     if writer is None:

@@ -1,0 +1,260 @@
+"""Fused-engine correctness: per-mutator invariants (same as the kernel
+tests) driven through fused_mutate_step with a single mutator enabled, plus
+a pipeline-level comparison of both engines."""
+
+from functools import cache
+
+import jax
+import numpy as np
+import pytest
+
+from erlamsa_tpu.ops import prng
+from erlamsa_tpu.ops.buffers import Batch, pack, unpack
+from erlamsa_tpu.ops.fused import fused_mutate_step
+from erlamsa_tpu.ops.pipeline import make_fuzzer
+from erlamsa_tpu.ops.registry import DEVICE_CODES, NUM_DEVICE_MUTATORS
+from erlamsa_tpu.ops.scheduler import init_scores
+
+L = 512
+DOC = b"alpha 123\nbravo 4567\ncharlie\ndelta\necho\n"
+
+
+@cache
+def _stepper():
+    def one(keys, data, lens, scores, pri):
+        return jax.vmap(fused_mutate_step, in_axes=(0, 0, 0, 0, None))(
+            keys, data, lens, scores, pri
+        )
+
+    return jax.jit(one)
+
+
+def run_one(code, seeds, seed=7, case=0):
+    batch = pack(seeds, capacity=L)
+    keys = prng.sample_keys(prng.case_key(prng.base_key(seed), case), len(seeds))
+    scores = init_scores(jax.random.fold_in(prng.base_key(seed), 1), len(seeds))
+    pri = np.zeros(NUM_DEVICE_MUTATORS, np.int32)
+    pri[DEVICE_CODES.index(code)] = 1
+    data, lens, _sc, applied = _stepper()(
+        keys, batch.data, batch.lens, scores, jax.numpy.asarray(pri)
+    )
+    return unpack(Batch(data, lens)), np.asarray(applied)
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(99)
+
+
+def rand_seeds(rng, count=32, lo=4, hi=200):
+    return [rng.integers(0, 256, rng.integers(lo, hi), dtype=np.uint8).tobytes()
+            for _ in range(count)]
+
+
+def test_fused_byte_drop(rng):
+    seeds = rand_seeds(rng)
+    outs, applied = run_one("bd", seeds)
+    for s, o in zip(seeds, outs):
+        assert len(o) == len(s) - 1
+        assert any(s[:i] + s[i + 1 :] == o for i in range(len(s)))
+    assert (applied == DEVICE_CODES.index("bd")).all()
+
+
+def test_fused_byte_inc_dec(rng):
+    seeds = rand_seeds(rng)
+    outs, _ = run_one("bei", seeds)
+    for s, o in zip(seeds, outs):
+        assert len(o) == len(s) and (sum(o) - sum(s)) % 256 == 1
+    outs, _ = run_one("bed", seeds)
+    for s, o in zip(seeds, outs):
+        assert (sum(s) - sum(o)) % 256 == 1
+
+
+def test_fused_byte_flip(rng):
+    seeds = rand_seeds(rng)
+    outs, _ = run_one("bf", seeds)
+    for s, o in zip(seeds, outs):
+        diff = [a ^ b for a, b in zip(s, o)]
+        nz = [d for d in diff if d]
+        assert len(nz) == 1 and bin(nz[0]).count("1") == 1
+
+
+def test_fused_byte_insert_repeat(rng):
+    seeds = rand_seeds(rng)
+    outs, _ = run_one("bi", seeds)
+    for s, o in zip(seeds, outs):
+        assert len(o) == len(s) + 1
+        assert any(o[:i] + o[i + 1 :] == s for i in range(len(o)))
+    outs, _ = run_one("br", seeds)
+    for s, o in zip(seeds, outs):
+        assert len(o) == len(s) + 1
+        assert any(s[:i] + s[i : i + 1] + s[i:] == o for i in range(len(s)))
+
+
+def test_fused_seq_drop(rng):
+    seeds = rand_seeds(rng)
+    outs, _ = run_one("sd", seeds)
+    for s, o in zip(seeds, outs):
+        assert 0 <= len(o) < len(s)
+
+
+def test_fused_seq_repeat_grows(rng):
+    seeds = rand_seeds(rng, lo=4, hi=40)
+    outs, _ = run_one("sr", seeds)
+    for s, o in zip(seeds, outs):
+        assert len(o) > len(s) or len(o) == L
+
+
+def test_fused_seq_perm_multiset(rng):
+    seeds = rand_seeds(rng, lo=4, hi=100)
+    outs, _ = run_one("sp", seeds)
+    for s, o in zip(seeds, outs):
+        assert sorted(s) == sorted(o)
+
+
+def test_fused_mask_size(rng):
+    seeds = rand_seeds(rng)
+    for code in ("snand", "srnd"):
+        outs, _ = run_one(code, seeds)
+        for s, o in zip(seeds, outs):
+            assert len(o) == len(s)
+
+
+def test_fused_num():
+    outs, applied = run_one("num", [b"100 + 100 + 100"] * 64, seed=3)
+    changed = [o for o in outs if o != b"100 + 100 + 100"]
+    assert len(changed) > 40
+    assert all(b" + " in o for o in changed)
+
+
+def test_fused_utf8():
+    seeds = [bytes([1, 2, 3, 60, 61, 62]) * 8] * 32
+    outs, _ = run_one("uw", seeds)
+    grown = [o for o in outs if len(o) == len(seeds[0]) + 1]
+    assert grown and all(0xC0 in o for o in grown)
+    outs, _ = run_one("ui", [b"plain ascii text"] * 16)
+    assert all(len(o) > 16 for o in outs)
+
+
+def _as_lines(b):
+    out, cur = [], bytearray()
+    for x in b:
+        cur.append(x)
+        if x == 10:
+            out.append(bytes(cur))
+            cur = bytearray()
+    if cur:
+        out.append(bytes(cur))
+    return out
+
+
+LINES = _as_lines(DOC)
+
+
+def test_fused_line_del():
+    outs, _ = run_one("ld", [DOC] * 32)
+    for o in outs:
+        ls = _as_lines(o)
+        assert len(ls) == 4 and all(l in LINES for l in ls)
+
+
+def test_fused_line_dup():
+    outs, _ = run_one("lr2", [DOC] * 32)
+    for o in outs:
+        ls = _as_lines(o)
+        assert len(ls) == 6
+        assert any(ls[i] == ls[i + 1] for i in range(5))
+
+
+def test_fused_line_swap():
+    outs, _ = run_one("ls", [DOC] * 32)
+    assert any(o != DOC for o in outs)
+    for o in outs:
+        assert sorted(_as_lines(o)) == sorted(LINES)
+
+
+def test_fused_line_perm():
+    outs, _ = run_one("lp", [DOC] * 32)
+    for o in outs:
+        assert sorted(_as_lines(o)) == sorted(LINES)
+    assert any(o != DOC for o in outs)
+
+
+def test_fused_line_clone_replace():
+    for code in ("lri", "lrs"):
+        outs, _ = run_one(code, [DOC] * 16)
+        for o in outs:
+            ls = _as_lines(o)
+            assert len(ls) == 5 and all(l in LINES for l in ls)
+
+
+def test_fused_line_ins():
+    outs, _ = run_one("lis", [DOC] * 16)
+    for o in outs:
+        ls = _as_lines(o)
+        assert len(ls) == 6 and all(l in LINES for l in ls)
+
+
+def test_fused_line_repeat():
+    outs, _ = run_one("lr", [DOC] * 16)
+    for o in outs:
+        assert len(_as_lines(o)) >= 6 or len(o) == L
+
+
+def test_fused_empty_input():
+    outs, applied = run_one("bd", [b"", b"xy"])
+    assert outs[0] == b"" and applied[0] == -1
+
+
+SHARED_EXACT = ("bd", "bei", "bed", "bf", "bi", "ber", "br", "sd", "sr",
+                "ld", "lds", "lr2", "lri", "lr", "ls", "lis", "lrs")
+
+
+@cache
+def _both_steppers():
+    from erlamsa_tpu.ops.scheduler import mutate_step
+
+    def run(step):
+        def one(keys, data, lens, scores, pri):
+            return jax.vmap(step, in_axes=(0, 0, 0, 0, None))(
+                keys, data, lens, scores, pri
+            )
+
+        return jax.jit(one)
+
+    return run(fused_mutate_step), run(mutate_step)
+
+
+@pytest.mark.parametrize("code", SHARED_EXACT)
+def test_fused_matches_switch_engine(code, rng=None):
+    """The splice-family mutators use identical key tags and distributions
+    in both engines — outputs must be bit-identical for the same keys."""
+    rng = np.random.default_rng(7)
+    seeds = [DOC] * 8 + rand_seeds(rng, count=8, lo=8, hi=120)
+    batch = pack(seeds, capacity=L)
+    keys = prng.sample_keys(prng.case_key(prng.base_key(13), 0), len(seeds))
+    scores = init_scores(jax.random.fold_in(prng.base_key(13), 1), len(seeds))
+    pri = np.zeros(NUM_DEVICE_MUTATORS, np.int32)
+    pri[DEVICE_CODES.index(code)] = 1
+    fstep, sstep = _both_steppers()
+    fd, fl, _fs, fa = fstep(keys, batch.data, batch.lens, scores,
+                            jax.numpy.asarray(pri))
+    sd, sl, _ss, sa = sstep(keys, batch.data, batch.lens, scores,
+                            jax.numpy.asarray(pri))
+    f_out = unpack(Batch(fd, fl))
+    s_out = unpack(Batch(sd, sl))
+    assert f_out == s_out, code
+    assert np.array_equal(np.asarray(fa), np.asarray(sa))
+
+
+def test_fused_pipeline_runs():
+    B = 64
+    step, _ = make_fuzzer(L, B, engine="fused")
+    seeds = [DOC] * B
+    batch = pack(seeds, capacity=L)
+    base = prng.base_key((1, 2, 3))
+    scores = init_scores(jax.random.fold_in(base, 999), B)
+    data, lens, sc, meta = step(base, 0, batch.data, batch.lens, scores)
+    outs = unpack(Batch(data, lens))
+    assert sum(1 for o in outs if o != DOC) > B * 0.5
+    assert np.asarray(sc).min() >= 2 and np.asarray(sc).max() <= 10
