@@ -1,0 +1,150 @@
+"""Generation-based fuzzing combinators (genfuzz).
+
+Reference: src/erlamsa_gf.erl — a small grammar DSL (static / range /
+rbyte..rddword / rbinary / pick / pick_pref / loop / sizer / block /
+session_get) whose tree is flattened once to estimate depth and then
+generated with a fuzzing probability scaled by that depth
+(erlamsa_gf:fuzz/3, :173-181).
+
+A grammar is a list of nodes; each node is a tuple ("kind", ...):
+
+    ("static", bytes)            literal bytes
+    ("range", lo, hi)            one byte in [lo, hi]
+    ("rbyte",) ("rword",) ("rdword",) ("rddword",)   random 1/2/4/8 bytes
+    ("rbinary", n)               n random bytes
+    ("pick", [grammar, ...])     uniform choice of a sub-grammar
+    ("pick_pref", [(w, grammar), ...])   weighted choice
+    ("loop", grammar, max_n)     1..max_n repetitions
+    ("sizer", fmt, grammar)      length field over the generated block;
+                                 fmt in {u8, u16be, u16le, u32be, u32le}
+    ("block", [grammar...])      grouping (sizer target)
+    ("session_get", key, default)   replay session state (gfcomms)
+
+generate() is the pure expansion; fuzz_grammar() expands while mutating
+leaves with probability ~ 1/depth, like the reference's scaled fuzzing.
+"""
+
+from __future__ import annotations
+
+import struct
+
+from ..utils.erlrand import ErlRand
+
+_SIZER_FMT = {
+    "u8": ("B", 1, "big"),
+    "u16be": (">H", 2, "big"),
+    "u16le": ("<H", 2, "little"),
+    "u32be": (">I", 4, "big"),
+    "u32le": ("<I", 4, "little"),
+}
+
+
+def _flatten_depth(node, depth=1) -> int:
+    """Estimate grammar depth (the reference flattens twice,
+    erlamsa_gf:173-181)."""
+    if isinstance(node, list):
+        return max((_flatten_depth(x, depth + 1) for x in node), default=depth)
+    if not isinstance(node, tuple):
+        return depth
+    kind = node[0]
+    if kind in ("pick",):
+        return max(
+            (_flatten_depth(g, depth + 1) for g in node[1]), default=depth
+        )
+    if kind == "pick_pref":
+        return max(
+            (_flatten_depth(g, depth + 1) for _w, g in node[1]), default=depth
+        )
+    if kind in ("loop", "sizer"):
+        return _flatten_depth(node[-1] if kind == "loop" else node[2], depth + 1)
+    if kind == "block":
+        return max(
+            (_flatten_depth(g, depth + 1) for g in node[1]), default=depth
+        )
+    return depth
+
+
+def generate(r: ErlRand, grammar, session: dict | None = None,
+             fuzz_prob: float = 0.0) -> bytes:
+    """Expand a grammar to bytes; leaves mutate with fuzz_prob."""
+    session = session if session is not None else {}
+
+    def emit(node) -> bytes:
+        if isinstance(node, list):
+            return b"".join(emit(x) for x in node)
+        if isinstance(node, (bytes, bytearray)):
+            node = ("static", bytes(node))
+        kind = node[0]
+        if kind == "static":
+            out = node[1]
+            if fuzz_prob and r.rand_float() < fuzz_prob and out:
+                # flip one byte of the literal
+                p = r.rand(len(out))
+                out = out[:p] + bytes([r.rand(256)]) + out[p + 1 :]
+            return out
+        if kind == "range":
+            lo, hi = node[1], node[2]
+            if fuzz_prob and r.rand_float() < fuzz_prob:
+                return bytes([r.rand(256)])  # out-of-range byte
+            return bytes([r.rand_span(lo, hi)])
+        if kind == "rbyte":
+            return r.rbyte()
+        if kind == "rword":
+            return r.rword()
+        if kind == "rdword":
+            return r.rdword()
+        if kind == "rddword":
+            return r.rddword()
+        if kind == "rbinary":
+            return r.random_block(node[1])
+        if kind == "pick":
+            return emit(r.rand_elem(node[1]))
+        if kind == "pick_pref":
+            total = sum(w for w, _g in node[1])
+            n = r.rand(total)
+            for w, g in node[1]:
+                if n < w:
+                    return emit(g)
+                n -= w
+            return emit(node[1][-1][1])
+        if kind == "loop":
+            times = r.erand(node[2])
+            if fuzz_prob and r.rand_float() < fuzz_prob:
+                times = times * (1 + r.rand_log(6))  # loop blowup
+            return b"".join(emit(node[1]) for _ in range(times))
+        if kind == "sizer":
+            fmt, _width, _endian = _SIZER_FMT[node[1]]
+            body = emit(node[2])
+            size = len(body)
+            if fuzz_prob and r.rand_float() < fuzz_prob:
+                size = r.rand(1 << (8 * _width))  # lie about the length
+            mask = (1 << (8 * _width)) - 1
+            return struct.pack(fmt, size & mask) + body
+        if kind == "block":
+            return b"".join(emit(g) for g in node[1])
+        if kind == "session_get":
+            return bytes(session.get(node[1], node[2]))
+        raise ValueError(f"unknown grammar node {node!r}")
+
+    return emit(grammar)
+
+
+def fuzz_grammar(r: ErlRand, grammar, session: dict | None = None) -> bytes:
+    """Generate with depth-scaled fuzzing probability
+    (erlamsa_gf:fuzz/3)."""
+    depth = _flatten_depth(grammar)
+    prob = 1.0 / max(depth * 2, 2)
+    return generate(r, grammar, session, fuzz_prob=prob)
+
+
+def make_external_generator(grammar, seed=None):
+    """Adapter: a grammar becomes a generator for the engine's genfuz slot
+    (the reference's external module `generator` capability)."""
+    from ..utils.erlrand import gen_urandom_seed
+
+    r = ErlRand(seed or gen_urandom_seed())
+
+    def gen():
+        return [fuzz_grammar(r, grammar)], ("generator", "genfuz")
+
+    return gen

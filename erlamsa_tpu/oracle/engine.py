@@ -34,7 +34,12 @@ class Engine:
         # construction order matches fuzzer/1: mutator table first (its
         # construction draws), then the generator choice draw
         selected = opts.get("mutations") or default_mutations()
-        self.base_rows = make_mutator(self.ctx, selected, opts.get("custom_mutas", ()))
+        custom = list(opts.get("custom_mutas", ()))
+        ext = opts.get("external_module")
+        if ext is not None:
+            custom += ext.custom_mutations(self.ctx)
+            selected = list(selected) + [(row[3], row[1]) for row in custom]
+        self.base_rows = make_mutator(self.ctx, selected, custom)
         paths = opts.get("paths", ["-"])
         self.gen_name, self.generator = genmod.make_generator(
             self.ctx,

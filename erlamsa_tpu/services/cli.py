@@ -120,6 +120,8 @@ def build_parser() -> argparse.ArgumentParser:
                    help="+name:params / !name:off")
     p.add_argument("-e", "--external", default=None,
                    help="python module with capabilities()")
+    p.add_argument("-d", "--debug", action="store_true",
+                   help="start the periodic profiler")
     p.add_argument("--backend", choices=["oracle", "tpu"], default="oracle",
                    help="oracle = sequential parity engine; tpu = batched device engine")
     p.add_argument("--batch", type=int, default=1024, help="TPU batch size")
@@ -175,6 +177,26 @@ def main(argv=None) -> int:
         "verbose": args.verbose,
         "meta_path": args.meta,
     }
+
+    # externals and the profiler load before service modes so -e/-d apply
+    # to the proxy/FaaS/node paths too
+    if args.external:
+        from .external import load_external
+
+        ext = load_external(args.external)
+        if ext:
+            opts["external_module"] = ext
+            gen = ext.generator()
+            if gen is not None:
+                opts["external_generator"] = gen
+            post = ext.post()
+            if post is not None:
+                opts["post"] = post
+
+    if args.debug:
+        from .metrics import Profiler
+
+        Profiler().start()
 
     # service modes
     if args.httpsvc:

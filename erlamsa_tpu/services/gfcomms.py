@@ -1,0 +1,75 @@
+"""Genfuzz TCP service: serve grammar-generated fuzzing data per connection.
+
+Reference: src/erlamsa_gfcomms.erl — accept TCP, call the external module's
+fuzzer per packet with a session dict. Here the handler generates from a
+genfuzz grammar (models/genfuzz.py) or delegates to an external module's
+``fuzzer(proto, data, session)``.
+"""
+
+from __future__ import annotations
+
+import socket
+import threading
+
+from ..models.genfuzz import fuzz_grammar
+from ..utils.erlrand import ErlRand, gen_urandom_seed
+from . import logger
+
+
+class GfComms:
+    def __init__(self, port: int, grammar=None, external_fuzzer=None, seed=None):
+        self.port = port
+        self.grammar = grammar
+        self.external = external_fuzzer
+        self.r = ErlRand(seed or gen_urandom_seed())
+        self._stop = threading.Event()
+
+    def _handle(self, conn: socket.socket, addr):
+        session: dict = {}
+        try:
+            while not self._stop.is_set():
+                data = conn.recv(65536)
+                if not data:
+                    break
+                if self.external is not None:
+                    out = self.external("tcp", data, session)
+                elif self.grammar is not None:
+                    out = fuzz_grammar(self.r, self.grammar, session)
+                else:
+                    out = data
+                conn.sendall(out)
+        except OSError:
+            pass
+        finally:
+            conn.close()
+
+    def serve(self, block: bool = True):
+        srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        srv.bind(("0.0.0.0", self.port))
+        srv.listen(16)
+        self._srv = srv
+        logger.log("info", "gfcomms listening on :%d", self.port)
+
+        def loop():
+            while not self._stop.is_set():
+                try:
+                    conn, addr = srv.accept()
+                except OSError:
+                    break
+                threading.Thread(
+                    target=self._handle, args=(conn, addr), daemon=True
+                ).start()
+
+        if block:
+            loop()
+            return 0
+        threading.Thread(target=loop, daemon=True).start()
+        return self
+
+    def stop(self):
+        self._stop.set()
+        try:
+            self._srv.close()
+        except Exception:
+            pass

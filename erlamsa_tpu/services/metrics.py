@@ -1,0 +1,87 @@
+"""Metrics and the debug profiler.
+
+Reference: src/erlamsa_profiler.erl (-d mode: 5s loop logging process
+count/memory) and the per-case metadata recorder (maybe_meta_logger,
+src/erlamsa_main.erl:58-70). The TPU design makes per-batch device timing
+and samples/sec first-class (the BASELINE metric, SURVEY.md §5.1).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+from . import logger
+
+
+class Counters:
+    """Throughput counters shared by batch runners and services."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.samples = 0
+        self.bytes_out = 0
+        self.batches = 0
+        self.device_time = 0.0
+        self.t0 = time.perf_counter()
+
+    def record_batch(self, n_samples: int, n_bytes: int, device_seconds: float):
+        with self._lock:
+            self.samples += n_samples
+            self.bytes_out += n_bytes
+            self.batches += 1
+            self.device_time += device_seconds
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            wall = time.perf_counter() - self.t0
+            return {
+                "samples": self.samples,
+                "batches": self.batches,
+                "bytes_out": self.bytes_out,
+                "wall_s": round(wall, 3),
+                "device_s": round(self.device_time, 3),
+                "samples_per_sec": round(self.samples / wall, 1) if wall else 0.0,
+                "device_samples_per_sec": round(
+                    self.samples / self.device_time, 1
+                ) if self.device_time else 0.0,
+            }
+
+
+GLOBAL = Counters()
+
+
+class Profiler(threading.Thread):
+    """-d mode: periodic process stats to the logger
+    (erlamsa_profiler:profiler/1, 5s loop)."""
+
+    def __init__(self, interval: float = 5.0):
+        super().__init__(daemon=True)
+        self.interval = interval
+        self._stop = threading.Event()
+
+    def run(self):
+        while not self._stop.is_set():
+            try:
+                with open("/proc/self/status") as f:
+                    status = f.read()
+                rss = next(
+                    (l.split()[1] for l in status.splitlines()
+                     if l.startswith("VmRSS")), "?"
+                )
+                threads = next(
+                    (l.split()[1] for l in status.splitlines()
+                     if l.startswith("Threads")), "?"
+                )
+            except OSError:
+                rss = threads = "?"
+            snap = GLOBAL.snapshot()
+            logger.log(
+                "debug",
+                "profiler: rss=%skB threads=%s samples=%d (%.1f/s)",
+                rss, threads, snap["samples"], snap["samples_per_sec"],
+            )
+            self._stop.wait(self.interval)
+
+    def stop(self):
+        self._stop.set()

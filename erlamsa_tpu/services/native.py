@@ -1,0 +1,132 @@
+"""ctypes bindings for the C++ runtime ports (native/erlamsa_port.cpp).
+
+Builds the shared library on first use when a compiler is available (the
+reference ships its native deps pre-built; here g++ is part of the image).
+Every caller has a pure-Python fallback, so a missing toolchain degrades
+gracefully rather than breaking the CLI.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import shutil
+import subprocess
+import threading
+from pathlib import Path
+
+from . import logger
+
+_SRC = Path(__file__).resolve().parent.parent.parent / "native" / "erlamsa_port.cpp"
+_LIB = _SRC.parent / "liberlamsa_port.so"
+_lock = threading.Lock()
+_lib: ctypes.CDLL | None = None
+_tried = False
+
+
+class ExecResult(ctypes.Structure):
+    _fields_ = [
+        ("exit_code", ctypes.c_int32),
+        ("term_signal", ctypes.c_int32),
+        ("timed_out", ctypes.c_int32),
+        ("user_usec", ctypes.c_int64),
+        ("sys_usec", ctypes.c_int64),
+        ("max_rss_kb", ctypes.c_int64),
+        ("pid", ctypes.c_int32),
+    ]
+
+
+def build() -> bool:
+    """Compile the library if needed; returns availability."""
+    if _LIB.exists() and _LIB.stat().st_mtime >= _SRC.stat().st_mtime:
+        return True
+    gxx = shutil.which("g++")
+    if gxx is None:
+        return False
+    try:
+        subprocess.run(
+            [gxx, "-O2", "-shared", "-fPIC", "-o", str(_LIB), str(_SRC)],
+            check=True, capture_output=True, timeout=120,
+        )
+        return True
+    except (subprocess.CalledProcessError, subprocess.TimeoutExpired) as e:
+        logger.log("warning", "native port build failed: %s", e)
+        return False
+
+
+def get() -> ctypes.CDLL | None:
+    """The loaded library, building it on demand; None when unavailable."""
+    global _lib, _tried
+    with _lock:
+        if _lib is not None or _tried:
+            return _lib
+        _tried = True
+        if not build():
+            return None
+        lib = ctypes.CDLL(str(_LIB))
+        lib.erlamsa_exec_feed.argtypes = [
+            ctypes.POINTER(ctypes.c_char_p), ctypes.c_char_p, ctypes.c_int64,
+            ctypes.c_int64, ctypes.POINTER(ExecResult),
+        ]
+        lib.erlamsa_exec_feed.restype = ctypes.c_int
+        lib.erlamsa_rawsock_open.restype = ctypes.c_int
+        lib.erlamsa_rawsock_send.argtypes = [
+            ctypes.c_int, ctypes.c_char_p, ctypes.c_int64, ctypes.c_uint32,
+        ]
+        lib.erlamsa_serial_open.argtypes = [ctypes.c_char_p, ctypes.c_int]
+        lib.erlamsa_serial_open.restype = ctypes.c_int
+        lib.erlamsa_fd_write.argtypes = [
+            ctypes.c_int, ctypes.c_char_p, ctypes.c_int64,
+        ]
+        _lib = lib
+        return _lib
+
+
+def exec_feed(argv: list[str], data: bytes, timeout_ms: int = 30000):
+    """Spawn a target, feed stdin, return an ExecResult — the erlexec-port
+    path. Returns None when the native lib is unavailable (callers fall
+    back to subprocess)."""
+    lib = get()
+    if lib is None:
+        return None
+    c_argv = (ctypes.c_char_p * (len(argv) + 1))(
+        *[a.encode() for a in argv], None
+    )
+    res = ExecResult()
+    rc = lib.erlamsa_exec_feed(c_argv, data, len(data), timeout_ms, res)
+    if rc != 0:
+        logger.log("warning", "native exec failed: errno %d", -rc)
+        return None
+    return res
+
+
+def rawsock_send(packet: bytes, dst_ip: str) -> int | None:
+    """Send a raw IPv4 packet (caller-built header); needs CAP_NET_RAW."""
+    import socket as pysock
+    import struct
+
+    lib = get()
+    if lib is None:
+        return None
+    fd = lib.erlamsa_rawsock_open()
+    if fd < 0:
+        return fd
+    try:
+        dst_be = struct.unpack("=I", pysock.inet_aton(dst_ip))[0]
+        return lib.erlamsa_rawsock_send(fd, packet, len(packet), dst_be)
+    finally:
+        lib.erlamsa_fd_close(fd)
+
+
+def serial_open(dev: str, baud: int) -> int | None:
+    lib = get()
+    if lib is None:
+        return None
+    fd = lib.erlamsa_serial_open(dev.encode(), baud)
+    return fd if fd >= 0 else None
+
+
+def fd_write(fd: int, data: bytes) -> int:
+    lib = get()
+    assert lib is not None
+    return lib.erlamsa_fd_write(fd, data, len(data))

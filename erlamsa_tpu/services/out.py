@@ -108,11 +108,35 @@ def _http_writer(url: str) -> Writer:
 
 def _exec_writer(cmdline: str, monitor_notify=None) -> Writer:
     """Spawn the target per case and feed fuzzed data to its stdin; notify
-    monitors of the PID like the erlexec path (erlamsa_out.erl:143-179)."""
+    monitors of the PID like the erlexec path (erlamsa_out.erl:143-179).
+    Prefers the C++ exec port (native/erlamsa_port.cpp) which reports
+    terminating signals and rusage; falls back to subprocess."""
+    argv = shlex.split(cmdline)
 
     def write(case_idx: int, data: bytes, meta: list) -> None:
+        from . import native
+
+        res = native.exec_feed(argv, data, int(DEFAULT_MAX_RUNNING_TIME * 1000))
+        if res is not None:
+            if monitor_notify:
+                monitor_notify(res.pid)
+            if res.exit_code == 127:
+                # execvp failed: the target doesn't exist — surface it so
+                # the run loop backs off and stops after maxfails
+                raise CantConnect(f"exec target failed to start: {argv[0]}")
+            if res.term_signal:
+                logger.log(
+                    "finding",
+                    "exec target died with signal %d on case %d "
+                    "(user %.1fms rss %dkB)",
+                    res.term_signal, case_idx, res.user_usec / 1000.0,
+                    res.max_rss_kb,
+                )
+            elif res.timed_out:
+                logger.log("warning", "exec target timed out on case %d", case_idx)
+            return
         proc = subprocess.Popen(
-            shlex.split(cmdline),
+            argv,
             stdin=subprocess.PIPE,
             stdout=subprocess.DEVNULL,
             stderr=subprocess.DEVNULL,
@@ -127,6 +151,25 @@ def _exec_writer(cmdline: str, monitor_notify=None) -> Writer:
         if rc and rc < 0:
             logger.log("finding", "exec target died with signal %d on case %d",
                        -rc, case_idx)
+
+    return write
+
+
+def _rawip_writer(dst_ip: str) -> Writer:
+    """Raw IPv4 output (the procket path, erlamsa_out.erl:185-203): the
+    fuzzed case IS the packet, IP header included. Needs CAP_NET_RAW."""
+
+    def write(case_idx: int, data: bytes, meta: list) -> None:
+        from . import native
+
+        try:
+            rc = native.rawsock_send(data, dst_ip)
+        except OSError as e:  # e.g. non-dotted-quad destination
+            raise CantConnect(f"bad raw destination {dst_ip!r}: {e}") from e
+        if rc is None:
+            raise CantConnect("native raw-socket port unavailable")
+        if rc < 0:
+            raise CantConnect(f"raw send failed: errno {-rc}")
 
     return write
 
@@ -179,6 +222,8 @@ def string_outputs(spec, monitor_notify=None) -> tuple[Writer | None, float]:
         return _http_writer(spec), DEFAULT_MAX_RUNNING_TIME
     if spec.startswith("exec://"):
         return _exec_writer(spec[7:], monitor_notify), DEFAULT_MAX_RUNNING_TIME
+    if spec.startswith("ip://"):
+        return _rawip_writer(spec[5:]), DEFAULT_MAX_RUNNING_TIME
     if spec.startswith("serial://"):
         dev, _, baud = spec[9:].rpartition(":")
         return _serial_writer(dev or spec[9:], int(baud or 115200)), DEFAULT_MAX_RUNNING_TIME

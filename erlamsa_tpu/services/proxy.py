@@ -85,10 +85,29 @@ class FuzzProxy:
         self._coin = _pyrandom.Random(str(self.opts.get("seed") or gen_urandom_seed()))
         self._stop = threading.Event()
 
-    def _fuzz_maybe(self, data: bytes, prob: float, npacket: int, direction: str) -> bytes:
+    def _fuzz_maybe(self, data: bytes, prob: float, npacket: int,
+                    direction: str, conn_state: dict) -> bytes:
         """Probability gate + protocol-aware fuzz (fuzz_rnd,
-        src/erlamsa_fuzzproxy.erl:309-324)."""
-        if npacket <= self.bypass or self._coin.random() >= prob:
+        src/erlamsa_fuzzproxy.erl:309-324). HTTP/2 is special: EVERY packet
+        must flow through the framer (its reassembly buffer owns partial
+        frames), with the coin gating only whether DATA payloads mutate."""
+        gate = npacket > self.bypass and self._coin.random() < prob
+        if self.proto == "http2":
+            from ..models.http2 import Http2FuzzState, fuzz_http2
+
+            st = conn_state.setdefault(direction, Http2FuzzState())
+            fuzzer = (
+                (lambda b: self.batcher.fuzz(b, dict(self.opts)))
+                if gate
+                else (lambda b: b)
+            )
+            out = fuzz_http2(fuzzer, data, st)
+            del st.seen_headers[:-32]  # bounded observability buffer
+            if gate:
+                logger.log_data("info", "proxy fuzzed packet %d (%s)",
+                                (npacket, direction), out)
+            return out
+        if not gate:
             return data
         if self.proto == "http":
             parts = _split_http(data)
@@ -107,7 +126,7 @@ class FuzzProxy:
     # --- TCP stream (loop_stream, erlamsa_fuzzproxy.erl:261-296) ----------
 
     def _pump(self, src: socket.socket, dst: socket.socket, prob: float,
-              direction: str):
+              direction: str, conn_state: dict):
         n = 0
         pcs = prob
         try:
@@ -116,7 +135,7 @@ class FuzzProxy:
                 if not data:
                     break
                 n += 1
-                out = self._fuzz_maybe(data, pcs, n, direction)
+                out = self._fuzz_maybe(data, pcs, n, direction, conn_state)
                 pcs = raise_prob(pcs, self.ascent)
                 dst.sendall(out)
         except OSError:
@@ -137,11 +156,14 @@ class FuzzProxy:
                        self.rhost, self.rport, e)
             client.close()
             return
+        conn_state: dict = {}  # per-connection HTTP/2 framing + HPACK state
         t1 = threading.Thread(
-            target=self._pump, args=(client, server, self.prob_cs, "c->s"),
+            target=self._pump,
+            args=(client, server, self.prob_cs, "c->s", conn_state),
             daemon=True)
         t2 = threading.Thread(
-            target=self._pump, args=(server, client, self.prob_sc, "s->c"),
+            target=self._pump,
+            args=(server, client, self.prob_sc, "s->c", conn_state),
             daemon=True)
         t1.start()
         t2.start()
@@ -170,6 +192,7 @@ class FuzzProxy:
         up = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
         client_addr = None
         n = 0
+        conn_state: dict = {}
         while not self._stop.is_set():
             try:
                 data, addr = srv.recvfrom(65536)
@@ -178,10 +201,10 @@ class FuzzProxy:
             if addr[0] != self.rhost or addr[1] != self.rport:
                 client_addr = addr
                 n += 1
-                out = self._fuzz_maybe(data, self.prob_cs, n, "c->s")
+                out = self._fuzz_maybe(data, self.prob_cs, n, "c->s", conn_state)
                 up.sendto(out, (self.rhost, self.rport))
             elif client_addr:
-                out = self._fuzz_maybe(data, self.prob_sc, n, "s->c")
+                out = self._fuzz_maybe(data, self.prob_sc, n, "s->c", conn_state)
                 srv.sendto(out, client_addr)
 
     def start(self, block: bool = True):

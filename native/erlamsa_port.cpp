@@ -1,0 +1,232 @@
+// Native runtime ports for erlamsa_tpu.
+//
+// The reference ships three native deps (SURVEY.md §2.4): erlexec (spawn a
+// target app, feed stdin, watch its exit), procket (raw IP / AF_PACKET
+// sockets), and erlserial (termios serial IO). This library provides the
+// same capabilities behind a plain C ABI consumed via ctypes
+// (erlamsa_tpu/services/native.py) — no pybind11 needed.
+//
+// Build: g++ -O2 -shared -fPIC -o liberlamsa_port.so erlamsa_port.cpp
+//
+// All functions return 0 on success or a negative errno.
+
+#include <cerrno>
+#include <csignal>
+#include <cstdint>
+#include <cstring>
+#include <fcntl.h>
+#include <net/if.h>
+#include <netinet/in.h>
+#include <sys/ioctl.h>
+#include <sys/resource.h>
+#include <sys/socket.h>
+#include <sys/time.h>
+#include <sys/types.h>
+#include <sys/wait.h>
+#include <termios.h>
+#include <unistd.h>
+
+extern "C" {
+
+// ---- exec port (erlexec equivalent) -------------------------------------
+
+struct exec_result {
+    int32_t exit_code;   // exit status, or -1 when signalled/timeout
+    int32_t term_signal; // terminating signal, 0 if none
+    int32_t timed_out;   // 1 when the deadline killed it
+    int64_t user_usec;   // rusage user time
+    int64_t sys_usec;    // rusage system time
+    int64_t max_rss_kb;  // peak resident set
+    int32_t pid;         // child pid (for monitors)
+};
+
+// Spawn argv[0..argc), write `data` to its stdin, wait up to timeout_ms.
+// Crash detection (signal exits) is the fuzzing "finding" signal — the
+// same contract as the reference's exec writer + monitor notification
+// (src/erlamsa_out.erl:143-179).
+int erlamsa_exec_feed(char **argv, const uint8_t *data, int64_t len,
+                      int64_t timeout_ms, struct exec_result *res) {
+    memset(res, 0, sizeof(*res));
+    int in_pipe[2];
+    if (pipe(in_pipe) < 0) return -errno;
+
+    pid_t pid = fork();
+    if (pid < 0) {
+        close(in_pipe[0]);
+        close(in_pipe[1]);
+        return -errno;
+    }
+    if (pid == 0) {
+        // child: stdin from pipe, stdout/stderr silenced
+        dup2(in_pipe[0], 0);
+        close(in_pipe[0]);
+        close(in_pipe[1]);
+        int devnull = open("/dev/null", O_WRONLY);
+        if (devnull >= 0) {
+            dup2(devnull, 1);
+            dup2(devnull, 2);
+        }
+        execvp(argv[0], argv);
+        _exit(127);
+    }
+    close(in_pipe[0]);
+    res->pid = pid;
+
+    // non-blocking stdin feed interleaved with the deadline wait: a target
+    // that never drains its pipe must not hang the fuzzing loop
+    signal(SIGPIPE, SIG_IGN);
+    fcntl(in_pipe[1], F_SETFL, O_NONBLOCK);
+    int64_t off = 0;
+    bool stdin_open = true;
+
+    int64_t waited = 0;
+    int status = 0;
+    struct rusage ru;
+    memset(&ru, 0, sizeof(ru));
+    for (;;) {
+        if (stdin_open) {
+            while (off < len) {
+                ssize_t w = write(in_pipe[1], data + off, (size_t)(len - off));
+                if (w < 0) {
+                    if (errno == EINTR) continue;
+                    if (errno == EAGAIN || errno == EWOULDBLOCK) break;
+                    off = len;  // EPIPE etc.: give up feeding
+                    break;
+                }
+                off += w;
+            }
+            if (off >= len) {
+                close(in_pipe[1]);
+                stdin_open = false;
+            }
+        }
+        pid_t r = wait4(pid, &status, WNOHANG, &ru);
+        if (r == pid) break;
+        if (r < 0 && errno != EINTR) {
+            if (stdin_open) close(in_pipe[1]);
+            return -errno;
+        }
+        if (timeout_ms >= 0 && waited >= timeout_ms * 1000) {
+            kill(pid, SIGKILL);
+            wait4(pid, &status, 0, &ru);
+            res->timed_out = 1;
+            break;
+        }
+        usleep(2000);
+        waited += 2000;
+    }
+    if (stdin_open) close(in_pipe[1]);
+
+    // wait4 fills THIS child's rusage (not the cumulative children total)
+    res->user_usec = (int64_t)ru.ru_utime.tv_sec * 1000000 + ru.ru_utime.tv_usec;
+    res->sys_usec = (int64_t)ru.ru_stime.tv_sec * 1000000 + ru.ru_stime.tv_usec;
+    res->max_rss_kb = ru.ru_maxrss;
+    if (WIFEXITED(status)) {
+        res->exit_code = WEXITSTATUS(status);
+    } else if (WIFSIGNALED(status)) {
+        res->exit_code = -1;
+        res->term_signal = WTERMSIG(status);
+    }
+    return 0;
+}
+
+// ---- raw sockets (procket equivalent) -----------------------------------
+
+// Open a raw IPv4 socket (IPPROTO_RAW: caller builds the IP header).
+// Needs CAP_NET_RAW/root, exactly like procket.
+int erlamsa_rawsock_open() {
+    int fd = socket(AF_INET, SOCK_RAW, IPPROTO_RAW);
+    if (fd < 0) return -errno;
+    int one = 1;
+    if (setsockopt(fd, IPPROTO_IP, IP_HDRINCL, &one, sizeof(one)) < 0) {
+        int e = -errno;
+        close(fd);
+        return e;
+    }
+    return fd;
+}
+
+int erlamsa_rawsock_send(int fd, const uint8_t *pkt, int64_t len,
+                         uint32_t dst_be) {
+    struct sockaddr_in dst;
+    memset(&dst, 0, sizeof(dst));
+    dst.sin_family = AF_INET;
+    dst.sin_addr.s_addr = dst_be;
+    ssize_t w = sendto(fd, pkt, (size_t)len, 0, (struct sockaddr *)&dst,
+                       sizeof(dst));
+    return w < 0 ? -errno : (int)w;
+}
+
+// Open an AF_PACKET socket bound to an interface (raw-iface writer).
+int erlamsa_packet_open(const char *ifname) {
+#ifdef AF_PACKET
+    int fd = socket(AF_PACKET, SOCK_RAW, 0);
+    if (fd < 0) return -errno;
+    struct ifreq ifr;
+    memset(&ifr, 0, sizeof(ifr));
+    strncpy(ifr.ifr_name, ifname, IFNAMSIZ - 1);
+    if (ioctl(fd, SIOCGIFINDEX, &ifr) < 0) {
+        int e = -errno;
+        close(fd);
+        return e;
+    }
+    return fd;
+#else
+    (void)ifname;
+    return -ENOSYS;
+#endif
+}
+
+// ---- serial (erlserial equivalent) --------------------------------------
+
+static speed_t to_speed(int baud) {
+    switch (baud) {
+        case 9600: return B9600;
+        case 19200: return B19200;
+        case 38400: return B38400;
+        case 57600: return B57600;
+        case 115200: return B115200;
+        default: return B115200;
+    }
+}
+
+int erlamsa_serial_open(const char *dev, int baud) {
+    int fd = open(dev, O_RDWR | O_NOCTTY | O_NONBLOCK);
+    if (fd < 0) return -errno;
+    struct termios tio;
+    if (tcgetattr(fd, &tio) < 0) {
+        int e = -errno;
+        close(fd);
+        return e;
+    }
+    cfmakeraw(&tio);
+    cfsetispeed(&tio, to_speed(baud));
+    cfsetospeed(&tio, to_speed(baud));
+    tio.c_cflag |= CLOCAL | CREAD;
+    if (tcsetattr(fd, TCSANOW, &tio) < 0) {
+        int e = -errno;
+        close(fd);
+        return e;
+    }
+    return fd;
+}
+
+int erlamsa_fd_write(int fd, const uint8_t *data, int64_t len) {
+    int64_t off = 0;
+    while (off < len) {
+        ssize_t w = write(fd, data + off, (size_t)(len - off));
+        if (w < 0) {
+            if (errno == EINTR || errno == EAGAIN) {
+                usleep(1000);
+                continue;
+            }
+            return -errno;
+        }
+        off += w;
+    }
+    return (int)off;
+}
+
+int erlamsa_fd_close(int fd) { return close(fd) < 0 ? -errno : 0; }
+
+}  // extern "C"
