@@ -87,8 +87,20 @@ class HpackContext:
         self.max_size = max_size
         self.dynamic: list[tuple[bytes, bytes]] = []
 
+    @staticmethod
+    def _entry_len(s: bytes) -> int:
+        """RFC table size uses the DECODED octet length; huffman-opaque
+        entries carry a '?huff:' marker that must not count, and huffman
+        decoding shrinks ~4:3, so approximate with the coded length."""
+        if s.startswith(b"?huff:"):
+            return len(s) - 6
+        return len(s)
+
     def _size(self) -> int:
-        return sum(len(n) + len(v) + 32 for n, v in self.dynamic)
+        return sum(
+            self._entry_len(n) + self._entry_len(v) + 32
+            for n, v in self.dynamic
+        )
 
     def _evict(self):
         while self.dynamic and self._size() > self.max_size:

@@ -29,6 +29,7 @@ T_CONTINUATION = 0x9
 
 F_PADDED = 0x8
 F_END_HEADERS = 0x4
+F_PRIORITY = 0x20
 
 
 def parse_frames(data: bytes) -> tuple[list[tuple[int, int, int, bytes]], bytes]:
@@ -89,6 +90,8 @@ def fuzz_http2(
                 if flags & F_PADDED and block:
                     pad = block[0]
                     block = block[1 : len(block) - pad]
+                if flags & F_PRIORITY and len(block) >= 5:
+                    block = block[5:]  # stream dep (4) + weight (1)
                 state.seen_headers.append(state.hpack.decode(block))
             except (IndexError, ValueError):
                 pass  # desync-tolerant, like the reference's kill-on-desync

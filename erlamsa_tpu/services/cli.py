@@ -116,6 +116,16 @@ def build_parser() -> argparse.ArgumentParser:
                    help="fuzzing proxy spec proto://lport:rhost:rport")
     p.add_argument("-P", "--proxy-prob", default="0.1,0.1",
                    help="proxy fuzzing probabilities c->s,s->c")
+    p.add_argument("-k", "--bypass", type=int, default=0,
+                   help="pass through the first K proxy packets unfuzzed")
+    p.add_argument("--ascent", type=float, default=0.0,
+                   help="proxy probability ascent coefficient")
+    p.add_argument("--certfile", default=None, help="TLS cert for tls:// proxy")
+    p.add_argument("--keyfile", default=None, help="TLS key for tls:// proxy")
+    p.add_argument("--workers-same-seed", action="store_true",
+                   help="all workers use the run seed instead of derived seeds")
+    p.add_argument("-D", "--detach", action="store_true",
+                   help="daemonize (fork to background)")
     p.add_argument("--monitor", action="append", default=[],
                    help="+name:params / !name:off")
     p.add_argument("-e", "--external", default=None,
@@ -173,10 +183,24 @@ def main(argv=None) -> int:
         "sequence_muta": args.sequence_muta,
         "recursive": args.recursive,
         "workers": args.workers,
+        "workers_same_seed": args.workers_same_seed,
         "output": args.output,
         "verbose": args.verbose,
         "meta_path": args.meta,
+        "certfile": args.certfile,
+        "keyfile": args.keyfile,
     }
+
+    if args.detach:
+        import os as _os
+
+        # classic double-fork detach (the reference re-execs a -detached
+        # escript, src/erlamsa.erl:9-13 + erlamsa_daemon)
+        if _os.fork() > 0:
+            return 0
+        _os.setsid()
+        if _os.fork() > 0:
+            _os._exit(0)
 
     # externals and the profiler load before service modes so -e/-d apply
     # to the proxy/FaaS/node paths too
@@ -206,9 +230,11 @@ def main(argv=None) -> int:
         return serve(host or "0.0.0.0", int(port), opts, backend=args.backend,
                      batch=args.batch)
     if args.proxy:
-        from .proxy import run_proxy
+        from .proxy import FuzzProxy
 
-        return run_proxy(args.proxy, args.proxy_prob, opts)
+        return FuzzProxy(args.proxy, args.proxy_prob, opts,
+                         backend=args.backend, bypass=args.bypass,
+                         ascent=args.ascent).start(block=True)
     if args.node:
         from .dist import run_node
 

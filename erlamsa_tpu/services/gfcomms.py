@@ -22,6 +22,9 @@ class GfComms:
         self.grammar = grammar
         self.external = external_fuzzer
         self.r = ErlRand(seed or gen_urandom_seed())
+        # one AS183 stream shared by handler threads: serialize draws so a
+        # fixed seed stays reproducible (single-connection replay contract)
+        self._rlock = threading.Lock()
         self._stop = threading.Event()
 
     def _handle(self, conn: socket.socket, addr):
@@ -34,7 +37,8 @@ class GfComms:
                 if self.external is not None:
                     out = self.external("tcp", data, session)
                 elif self.grammar is not None:
-                    out = fuzz_grammar(self.r, self.grammar, session)
+                    with self._rlock:
+                        out = fuzz_grammar(self.r, self.grammar, session)
                 else:
                     out = data
                 conn.sendall(out)

@@ -63,6 +63,26 @@ def _tcp_writer(host: str, port: int) -> Writer:
     return write
 
 
+def _tls_writer(host: str, port: int) -> Writer:
+    """TLS client output (erlamsa_out.erl tls path); certificate checks are
+    off — fuzzing targets rarely have valid chains."""
+    import ssl
+
+    ctx = ssl.create_default_context()
+    ctx.check_hostname = False
+    ctx.verify_mode = ssl.CERT_NONE
+
+    def write(case_idx: int, data: bytes, meta: list) -> None:
+        try:
+            with socket.create_connection((host, port), timeout=5) as raw:
+                with ctx.wrap_socket(raw, server_hostname=host) as s:
+                    s.sendall(data)
+        except (OSError, ssl.SSLError) as e:
+            raise CantConnect(str(e)) from e
+
+    return write
+
+
 def _tcp_listen_writer(port: int) -> Writer:
     """Listen mode: serve each accepted connection one fuzzed case
     (erlamsa_out.erl tcp listen path)."""
@@ -159,15 +179,27 @@ def _rawip_writer(dst_ip: str) -> Writer:
     """Raw IPv4 output (the procket path, erlamsa_out.erl:185-203): the
     fuzzed case IS the packet, IP header included. Needs CAP_NET_RAW."""
 
+    state = {"fd": None}  # raw fd opened once, reused across cases
+
     def write(case_idx: int, data: bytes, meta: list) -> None:
+        import socket as pysock
+        import struct
+
         from . import native
 
-        try:
-            rc = native.rawsock_send(data, dst_ip)
-        except OSError as e:  # e.g. non-dotted-quad destination
-            raise CantConnect(f"bad raw destination {dst_ip!r}: {e}") from e
-        if rc is None:
+        lib = native.get()
+        if lib is None:
             raise CantConnect("native raw-socket port unavailable")
+        if state["fd"] is None:
+            fd = lib.erlamsa_rawsock_open()
+            if fd < 0:
+                raise CantConnect(f"raw socket open failed: errno {-fd}")
+            state["fd"] = fd
+        try:
+            dst_be = struct.unpack("=I", pysock.inet_aton(dst_ip))[0]
+        except OSError as e:  # non-dotted-quad destination
+            raise CantConnect(f"bad raw destination {dst_ip!r}: {e}") from e
+        rc = lib.erlamsa_rawsock_send(state["fd"], data, len(data), dst_be)
         if rc < 0:
             raise CantConnect(f"raw send failed: errno {-rc}")
 
@@ -215,6 +247,9 @@ def string_outputs(spec, monitor_notify=None) -> tuple[Writer | None, float]:
         if host == "":
             return _tcp_listen_writer(int(port)), DEFAULT_MAX_RUNNING_TIME
         return _tcp_writer(host, int(port)), DEFAULT_MAX_RUNNING_TIME
+    if spec.startswith("tls://"):
+        host, _, port = spec[6:].rpartition(":")
+        return _tls_writer(host or "127.0.0.1", int(port)), DEFAULT_MAX_RUNNING_TIME
     if spec.startswith("udp://"):
         host, _, port = spec[6:].rpartition(":")
         return _udp_writer(host or "127.0.0.1", int(port)), DEFAULT_MAX_RUNNING_TIME

@@ -9,6 +9,9 @@ and CONNECT-based TLS MitM. Spec forms (erlamsa_cmdparse proxy parsing):
     tcp://lport:rhost:rport
     udp://lport:rhost:rport
     http://lport:rhost:rport
+    http2://lport:rhost:rport
+    tls://lport:rhost:rport    (MitM: self-signed listener, TLS upstream;
+                                cert/key via opts certfile/keyfile)
 """
 
 from __future__ import annotations
@@ -78,6 +81,11 @@ class FuzzProxy:
         self.opts = opts or {}
         self.bypass = bypass  # first K packets pass through (-k)
         self.ascent = ascent
+        if self.proto == "tls" and not self.opts.get("certfile"):
+            raise SystemExit(
+                "tls:// proxy needs --certfile/--keyfile (generate with: "
+                "openssl req -x509 -newkey rsa:2048 -nodes -keyout k.pem "
+                "-out c.pem -days 30 -subj /CN=localhost)")
         self.batcher = make_batcher(backend, workers=self.opts.get("workers", 10),
                                     seed=self.opts.get("seed"))
         import random as _pyrandom
@@ -148,13 +156,41 @@ class FuzzProxy:
             except OSError:
                 pass
 
+    def _tls_wrap_client(self, client: socket.socket):
+        import ssl
+
+        certfile = self.opts.get("certfile")
+        keyfile = self.opts.get("keyfile")
+        ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+        if certfile:
+            ctx.load_cert_chain(certfile, keyfile)
+        else:
+            raise RuntimeError(
+                "tls:// proxy needs certfile=/keyfile= in opts "
+                "(generate: openssl req -x509 -newkey rsa:2048 -nodes ...)")
+        return ctx.wrap_socket(client, server_side=True)
+
+    def _tls_wrap_server(self, server: socket.socket):
+        import ssl
+
+        ctx = ssl.create_default_context()
+        ctx.check_hostname = False
+        ctx.verify_mode = ssl.CERT_NONE
+        return ctx.wrap_socket(server, server_hostname=self.rhost)
+
     def _handle_tcp(self, client: socket.socket):
+        server = None
         try:
             server = socket.create_connection((self.rhost, self.rport), timeout=10)
-        except OSError as e:
-            logger.log("error", "proxy cannot reach %s:%d: %s",
+            if self.proto == "tls":
+                client = self._tls_wrap_client(client)
+                server = self._tls_wrap_server(server)
+        except (OSError, RuntimeError) as e:
+            logger.log("error", "proxy connection setup failed (%s:%d): %s",
                        self.rhost, self.rport, e)
             client.close()
+            if server is not None:
+                server.close()
             return
         conn_state: dict = {}  # per-connection HTTP/2 framing + HPACK state
         t1 = threading.Thread(

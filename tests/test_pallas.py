@@ -1,0 +1,70 @@
+"""Pallas randmask kernel tests (interpret mode on CPU)."""
+
+import numpy as np
+import pytest
+
+jaxmod = pytest.importorskip("jax")
+
+from erlamsa_tpu.ops.pallas_kernels import pallas_randmask  # noqa: E402
+
+import jax.numpy as jnp  # noqa: E402
+
+B, L = 8, 256
+
+
+def _run(params_rows, data_rows, seeds=None):
+    seeds = seeds if seeds is not None else np.arange(B, dtype=np.int32)
+    params = np.asarray(params_rows, np.int32)
+    data = np.asarray(data_rows, np.uint8)
+    out = pallas_randmask(
+        jnp.asarray(seeds), jnp.asarray(params), jnp.asarray(data)
+    )
+    return np.asarray(out)
+
+
+def test_inactive_is_identity():
+    rng = np.random.default_rng(0)
+    data = rng.integers(0, 256, (B, L), dtype=np.uint8)
+    params = [[0, L, 3, 100, 0]] * B  # active=0
+    out = _run(params, data)
+    assert np.array_equal(out, data)
+
+
+def test_replace_full_span_changes_bytes():
+    data = np.zeros((B, L), np.uint8)
+    params = [[0, L, 3, 100, 1]] * B  # replace, prob 100 -> everything
+    out = _run(params, data)
+    # with prob=100 every byte in span is replaced by random bytes
+    assert out.any()
+    assert len(np.unique(out)) > 10
+
+
+def test_span_respected():
+    data = np.zeros((B, L), np.uint8)
+    params = [[64, 32, 1, 100, 1]] * B  # OR a random bit, span [64, 96)
+    out = _run(params, data)
+    assert not out[:, :64].any()
+    assert not out[:, 96:].any()
+    assert out[:, 64:96].any()
+
+
+def test_or_only_sets_bits():
+    rng = np.random.default_rng(1)
+    data = rng.integers(0, 256, (B, L), dtype=np.uint8)
+    params = [[0, L, 1, 100, 1]] * B
+    out = _run(params, data)
+    # OR can only set bits: out | data == out
+    assert np.array_equal(out | data, out)
+
+
+def test_deterministic_per_seed():
+    data = np.zeros((B, L), np.uint8)
+    params = [[0, L, 3, 100, 1]] * B
+    seeds = np.full(B, 42, np.int32)
+    a = _run(params, data, seeds)
+    b = _run(params, data, seeds)
+    assert np.array_equal(a, b)
+    # same seed -> same stream for every row
+    assert np.array_equal(a[0], a[1])
+    c = _run(params, data, np.full(B, 43, np.int32))
+    assert not np.array_equal(a, c)
