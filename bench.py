@@ -25,7 +25,40 @@ WARMUP = 2
 ITERS = int(os.environ.get("ERLAMSA_BENCH_ITERS", 10))
 
 
+def _watchdog_reexec(seconds: float) -> None:
+    """The axon relay in this image can wedge so hard that ANY jax backend
+    init blocks (see .claude/skills/verify/SKILL.md). If init doesn't
+    complete in time, re-exec on CPU with small shapes so the driver still
+    gets a JSON line instead of a hang."""
+    import os
+    import threading
+
+    if os.environ.get("ERLAMSA_BENCH_FALLBACK"):
+        return  # already the fallback process
+
+    def fire():
+        env = dict(os.environ)
+        env.pop("PALLAS_AXON_POOL_IPS", None)
+        env["JAX_PLATFORMS"] = "cpu"
+        env["ERLAMSA_BENCH_FALLBACK"] = "1"
+        env.setdefault("ERLAMSA_BENCH_BATCH", "128")
+        env.setdefault("ERLAMSA_BENCH_SEED_LEN", "1024")
+        env.setdefault("ERLAMSA_BENCH_CAPACITY", "4096")
+        env.setdefault("ERLAMSA_BENCH_ITERS", "3")
+        os.execve(sys.executable, [sys.executable, __file__], env)
+
+    t = threading.Timer(seconds, fire)
+    t.daemon = True
+    t.start()
+    global _watchdog
+    _watchdog = t
+
+
+_watchdog = None
+
+
 def main() -> None:
+    _watchdog_reexec(float(os.environ.get("ERLAMSA_BENCH_TIMEOUT", 240)))
     import jax
 
     from erlamsa_tpu.ops import prng
@@ -61,17 +94,23 @@ def main() -> None:
     jax.block_until_ready(out)
     dt = time.perf_counter() - t0
 
+    if _watchdog is not None:
+        _watchdog.cancel()
     samples_per_sec = BATCH * ITERS / dt
-    print(
-        json.dumps(
-            {
-                "metric": "mutated samples/sec/chip (4KB seeds)",
-                "value": round(samples_per_sec, 1),
-                "unit": "samples/sec",
-                "vs_baseline": round(samples_per_sec / 100_000.0, 4),
-            }
-        )
-    )
+    record = {
+        "metric": f"mutated samples/sec/chip ({SEED_LEN}B seeds)",
+        "value": round(samples_per_sec, 1),
+        "unit": "samples/sec",
+        "vs_baseline": round(samples_per_sec / 100_000.0, 4),
+    }
+    if os.environ.get("ERLAMSA_BENCH_FALLBACK"):
+        # the watchdog re-exec'd us on CPU with reduced shapes: mark the
+        # datapoint so it is never read as a real TPU/4KB number
+        record["fallback"] = True
+        record["platform"] = jax.default_backend()
+        record["seed_len"] = SEED_LEN
+        record["batch"] = BATCH
+    print(json.dumps(record))
 
 
 if __name__ == "__main__":

@@ -1,22 +1,25 @@
-"""Device mutation patterns: od nd bu sk nu co.
+"""Device mutation patterns: od nd bu sk nu co sz.
 
 Reference semantics (src/erlamsa_patterns.erl:299-405): a pattern decides
 how many mutation events hit a sample and where — once (od), a geometric
 chain with 4/5 continue probability (nd), a burst of >=2 (bu), skip a
-random prefix then continue with another pattern (sk), none (nu), or a
-coin flip between nu and od (co).
+random prefix then continue with another pattern (sk), none (nu), a coin
+flip between nu and od (co), or mutate inside a detected length field's
+blob and rewrite the field (sz — the vectorized scan lives in
+ops/sizer.py, wired in by the pipeline).
 
 Device re-expression: a pattern evaluates, per sample, to
   (rounds, skip): number of scheduler events (<= MAX_BURST_MUTATIONS, the
   geometric tail truncated — P(chain > 16) ~ 2.8% folds into round 16) and
-  a protected prefix length.
+  a protected prefix length (sz extends skip past the detected field).
 The pipeline then runs a fori_loop of masked scheduler steps on the
-suffix. The archiver/compressed/sizer/checksum patterns (ar cp sz cs) are
-host-side (erlamsa_tpu/models/, like the reference's zip/zlib paths).
+suffix. The archiver/compressed/checksum patterns (ar cp cs) remain
+host-side (erlamsa_tpu/oracle/patterns.py, like the reference's zip/zlib
+paths).
 
 The reference picks the pattern by priority out of {od:1, nd:2, bu:1,
 sk:2, sz:2, cs:1, ar:1, cp:1, co:0, nu:0} (src/erlamsa_patterns.erl:394-405);
-the device table carries od nd bu sk nu co with those weights.
+the device table carries od nd bu sk nu co sz with those weights.
 """
 
 from __future__ import annotations
@@ -28,11 +31,12 @@ import numpy as np
 from ..constants import MAX_BURST_MUTATIONS, REMUTATE_PROBABILITY
 from . import prng
 
-PATTERNS = ("od", "nd", "bu", "sk", "nu", "co")
-DEFAULT_PATTERN_PRI_NP = np.asarray([1, 2, 1, 2, 0, 0], np.int32)
+PATTERNS = ("od", "nd", "bu", "sk", "nu", "co", "sz")
+DEFAULT_PATTERN_PRI_NP = np.asarray([1, 2, 1, 2, 0, 0, 2], np.int32)
 NUM_PATTERNS = len(PATTERNS)
 
-_OD, _ND, _BU, _SK, _NU, _CO = range(NUM_PATTERNS)
+_OD, _ND, _BU, _SK, _NU, _CO, _SZ = range(NUM_PATTERNS)
+SZ = _SZ  # pipeline needs the id to run sizer detection/rebuild
 
 
 def _geometric_rounds(key, base):
@@ -69,21 +73,25 @@ def pattern_plan(key, n, pat_pri):
 
     # sk: random prefix protected, then an od/nd/bu continuation
     # (make_pat_skip draws a random continuation pattern,
-    # src/erlamsa_patterns.erl:352-361; device set restricts to od/nd/bu)
+    # src/erlamsa_patterns.erl:352-361; device set restricts to od/nd/bu).
+    # sz uses the same continuation draw (make_pat_sizer is built from the
+    # same make_complex_pat machinery).
     skip = prng.rand(prng.sub(kg, _SK), jnp.maximum(n // 2, 1))
     cont = prng.rand(prng.sub(kg, _SK + 16), 3)  # 0 od, 1 nd, 2 bu
-    sk_rounds = jnp.select(
+    cont_rounds = jnp.select(
         [cont == 0, cont == 1], [jnp.int32(1), nd_rounds], bu_rounds
     )
 
     rounds = jnp.select(
-        [pat == _OD, pat == _ND, pat == _BU, pat == _SK, pat == _NU],
+        [pat == _OD, pat == _ND, pat == _BU, pat == _SK, pat == _NU,
+         pat == _SZ],
         [
             jnp.int32(1),
             nd_rounds,
             bu_rounds,
-            sk_rounds,
+            cont_rounds,
             jnp.int32(0),
+            cont_rounds,
         ],
         jnp.where(co_is_muta, 1, 0),
     )

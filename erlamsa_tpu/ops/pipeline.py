@@ -72,7 +72,19 @@ def fuzz_sample(key, data, n, scores, pri, pat_pri, engine: str = "fused"):
         from .fused import fused_mutate_step as step_fn
     else:
         step_fn = mutate_step
+    from .patterns import SZ
+    from .sizer import detect_sizer, rebuild_sizer
+
     pat, rounds, skip = pattern_plan(prng.sub(key, prng.TAG_PROB), n, pat_pri)
+
+    # sz: mutate only the blob behind a detected tail length field, then
+    # rewrite the field with the blob's new length (vectorized sizer scan,
+    # ops/sizer.py). Not found -> degenerates to an od-ish whole-buffer pass.
+    found, field_a, field_w, field_kind = detect_sizer(
+        prng.sub(key, prng.TAG_LEN), data, n
+    )
+    use_sz = (pat == SZ) & found
+    skip = jnp.where(use_sz, field_a + field_w, skip)
 
     work, wn = _shift_left(data, n, skip)
 
@@ -93,6 +105,14 @@ def fuzz_sample(key, data, n, scores, pri, pat_pri, engine: str = "fused"):
     )
 
     out, n_out = _splice_prefix(data, work, skip, wn)
+    # field value = the blob length that actually fit (splice may have
+    # truncated growth at capacity), not the pre-truncation wn
+    out = jnp.where(
+        use_sz,
+        rebuild_sizer(out, n_out, field_a, field_w, field_kind,
+                      jnp.maximum(n_out - skip, 0)),
+        out,
+    )
     return out, n_out, scores, pat, log
 
 

@@ -1,0 +1,85 @@
+"""Device sizer detection + rebuild: the sz pattern's vectorized core.
+
+Reference: the sizer pattern finds a plausible length field, mutates the
+enclosed blob, and rewrites the field with the blob's new length
+(src/erlamsa_patterns.erl:81-111 over erlamsa_field_predict's randomized
+O(n*k) rescan). On device the scan is a handful of shifted compares: every
+offset is tested simultaneously for u8/u16/u32 big/little fields whose
+value equals the distance to the end of the buffer — one vector pass
+instead of hundreds of per-offset re-reads.
+
+Scope vs the oracle: device sizers are *tail* sizers (blob runs to the end
+of the sample, the overwhelmingly common layout); the oracle also samples
+random interior end offsets. Checksum-preserving (cs) stays host-side this
+round (crc32 isn't suffix-decomposable; xor8 is a candidate for later).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from . import prng
+
+# field kinds: (width_bytes, endianness) — index into these tables
+KIND_U8, KIND_U16BE, KIND_U16LE, KIND_U32BE, KIND_U32LE = range(5)
+_WIDTHS = (1, 2, 4)
+
+
+def detect_sizer(key, data, n):
+    """Find a random plausible tail length field.
+
+    Returns (found, a, width_bytes, kind): field at [a, a+width), value ==
+    n - a - width (> 2). One uniform pick among all candidates via keyed
+    argmax.
+    """
+    L = data.shape[0]
+    i = jnp.arange(L, dtype=jnp.int32)
+    d = data.astype(jnp.int32)
+
+    def at(off):
+        return d[jnp.clip(i + off, 0, L - 1)]
+
+    b0, b1, b2, b3 = at(0), at(1), at(2), at(3)
+    v_u8 = b0
+    v_u16be = b0 * 256 + b1
+    v_u16le = b1 * 256 + b0
+    v_u32be = ((b0 * 256 + b1) * 256 + b2) * 256 + b3
+    v_u32le = ((b3 * 256 + b2) * 256 + b1) * 256 + b0
+
+    cands = []
+    for kind, (v, w) in enumerate(
+        ((v_u8, 1), (v_u16be, 2), (v_u16le, 2), (v_u32be, 4), (v_u32le, 4))
+    ):
+        want = n - i - w
+        ok = (v == want) & (v > 2) & (i + w < n)
+        cands.append(ok)
+    cand = jnp.stack(cands)  # [5, L]
+
+    # uniform pick with ONE scalar draw: r-th candidate in flat cumsum order
+    flat_mask = cand.reshape(-1)
+    total = jnp.sum(flat_mask).astype(jnp.int32)
+    any_found = total > 0
+    r = prng.rand(prng.sub(key, prng.TAG_AUX), total)
+    cum = jnp.cumsum(flat_mask).astype(jnp.int32)
+    flat = jnp.argmax(flat_mask & (cum == r + 1))
+    kind = (flat // L).astype(jnp.int32)
+    a = (flat % L).astype(jnp.int32)
+    width = jnp.asarray((1, 2, 2, 4, 4), jnp.int32)[kind]
+    return any_found, a, width, kind
+
+
+def rebuild_sizer(data, n, a, width, kind, blob_len):
+    """Rewrite the length field at [a, a+width) with blob_len."""
+    L = data.shape[0]
+    i = jnp.arange(L, dtype=jnp.int32)
+    v = blob_len.astype(jnp.int32)
+    # byte k of the field (k = i - a in [0, width))
+    k = i - a
+    be_shift = (width - 1 - k) * 8
+    le_shift = k * 8
+    is_le = (kind == KIND_U16LE) | (kind == KIND_U32LE)
+    shift = jnp.where(is_le, le_shift, be_shift)
+    field_byte = jnp.right_shift(v, jnp.clip(shift, 0, 31)) & 0xFF
+    in_field = (k >= 0) & (k < width)
+    return jnp.where(in_field, field_byte.astype(jnp.uint8), data)
