@@ -86,6 +86,10 @@ def main() -> None:
         out = step(base, case, data, lens, scores)
         jax.block_until_ready(out)
         scores = out[2]
+        if case == 0 and _watchdog is not None:
+            # init + compile survived: the guard's job (wedged-relay hangs)
+            # is done — don't let it kill a legitimately slow timed run
+            _watchdog.cancel()
 
     t0 = time.perf_counter()
     for case in range(WARMUP, WARMUP + ITERS):

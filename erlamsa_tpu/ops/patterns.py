@@ -1,4 +1,4 @@
-"""Device mutation patterns: od nd bu sk nu co sz.
+"""Device mutation patterns: od nd bu sk nu co sz cs.
 
 Reference semantics (src/erlamsa_patterns.erl:299-405): a pattern decides
 how many mutation events hit a sample and where — once (od), a geometric
@@ -13,13 +13,14 @@ Device re-expression: a pattern evaluates, per sample, to
   geometric tail truncated — P(chain > 16) ~ 2.8% folds into round 16) and
   a protected prefix length (sz extends skip past the detected field).
 The pipeline then runs a fori_loop of masked scheduler steps on the
-suffix. The archiver/compressed/checksum patterns (ar cp cs) remain
-host-side (erlamsa_tpu/oracle/patterns.py, like the reference's zip/zlib
-paths).
+suffix. cs runs on device for xor8 trailers (suffix-xor scan + trailer
+recompute); crc32 checksums and the archiver/compressed patterns (ar cp)
+remain host-side (erlamsa_tpu/oracle/patterns.py, like the reference's
+zip/zlib paths).
 
 The reference picks the pattern by priority out of {od:1, nd:2, bu:1,
 sk:2, sz:2, cs:1, ar:1, cp:1, co:0, nu:0} (src/erlamsa_patterns.erl:394-405);
-the device table carries od nd bu sk nu co sz with those weights.
+the device table carries od nd bu sk nu co sz cs with those weights.
 """
 
 from __future__ import annotations
@@ -31,12 +32,13 @@ import numpy as np
 from ..constants import MAX_BURST_MUTATIONS, REMUTATE_PROBABILITY
 from . import prng
 
-PATTERNS = ("od", "nd", "bu", "sk", "nu", "co", "sz")
-DEFAULT_PATTERN_PRI_NP = np.asarray([1, 2, 1, 2, 0, 0, 2], np.int32)
+PATTERNS = ("od", "nd", "bu", "sk", "nu", "co", "sz", "cs")
+DEFAULT_PATTERN_PRI_NP = np.asarray([1, 2, 1, 2, 0, 0, 2, 1], np.int32)
 NUM_PATTERNS = len(PATTERNS)
 
-_OD, _ND, _BU, _SK, _NU, _CO, _SZ = range(NUM_PATTERNS)
-SZ = _SZ  # pipeline needs the id to run sizer detection/rebuild
+_OD, _ND, _BU, _SK, _NU, _CO, _SZ, _CS = range(NUM_PATTERNS)
+SZ = _SZ  # pipeline needs these ids to run detection/rebuild
+CS = _CS
 
 
 def _geometric_rounds(key, base):
@@ -84,13 +86,14 @@ def pattern_plan(key, n, pat_pri):
 
     rounds = jnp.select(
         [pat == _OD, pat == _ND, pat == _BU, pat == _SK, pat == _NU,
-         pat == _SZ],
+         pat == _SZ, pat == _CS],
         [
             jnp.int32(1),
             nd_rounds,
             bu_rounds,
             cont_rounds,
             jnp.int32(0),
+            cont_rounds,
             cont_rounds,
         ],
         jnp.where(co_is_muta, 1, 0),

@@ -59,8 +59,13 @@ def _splice_prefix(orig, mutated, s, n_mut):
 ENGINES = ("fused", "switch")
 
 
-def fuzz_sample(key, data, n, scores, pri, pat_pri, engine: str = "fused"):
+def fuzz_sample(key, data, n, scores, pri, pat_pri, engine: str = "fused",
+                enable_sizer: bool = True, enable_csum: bool = True):
     """Mutate one sample end-to-end. vmapped by fuzz_batch.
+
+    enable_sizer/enable_csum are TRACE-TIME switches: when the caller knows
+    the sz/cs pattern priorities are zero (make_fuzzer does), the detection
+    scans never enter the compiled program.
 
     NOTE: the two engines draw sp/lp permutations differently (fused caps
     the window), so (seed, case) reproducibility holds only within one
@@ -72,21 +77,38 @@ def fuzz_sample(key, data, n, scores, pri, pat_pri, engine: str = "fused"):
         from .fused import fused_mutate_step as step_fn
     else:
         step_fn = mutate_step
-    from .patterns import SZ
-    from .sizer import detect_sizer, rebuild_sizer
+    from .patterns import CS, SZ
+    from .sizer import detect_sizer, detect_xor8, rebuild_sizer, xor8_of_range
 
     pat, rounds, skip = pattern_plan(prng.sub(key, prng.TAG_PROB), n, pat_pri)
 
     # sz: mutate only the blob behind a detected tail length field, then
     # rewrite the field with the blob's new length (vectorized sizer scan,
     # ops/sizer.py). Not found -> degenerates to an od-ish whole-buffer pass.
-    found, field_a, field_w, field_kind = detect_sizer(
-        prng.sub(key, prng.TAG_LEN), data, n
-    )
-    use_sz = (pat == SZ) & found
-    skip = jnp.where(use_sz, field_a + field_w, skip)
+    if enable_sizer:
+        found, field_a, field_w, field_kind = detect_sizer(
+            prng.sub(key, prng.TAG_LEN), data, n
+        )
+        use_sz = (pat == SZ) & found
+        skip = jnp.where(use_sz, field_a + field_w, skip)
+    else:
+        use_sz = jnp.bool_(False)
+        field_a = field_w = field_kind = jnp.int32(0)
+
+    # cs: mutate the body behind a detected xor8 trailer checksum, keep the
+    # preamble, recompute the trailer afterwards (device path covers xor8;
+    # crc32 stays on the oracle)
+    if enable_csum:
+        cs_found, cs_a = detect_xor8(prng.sub(key, prng.TAG_VAL), data, n)
+        use_cs = (pat == CS) & cs_found & ~use_sz
+        skip = jnp.where(use_cs, cs_a, skip)
+    else:
+        use_cs = jnp.bool_(False)
 
     work, wn = _shift_left(data, n, skip)
+    # the checksum byte itself is held out of the mutable region
+    if enable_csum:
+        wn = jnp.where(use_cs, jnp.maximum(wn - 1, 0), wn)
 
     def body(r, carry):
         wdata, wlen, sc, log = carry
@@ -105,18 +127,29 @@ def fuzz_sample(key, data, n, scores, pri, pat_pri, engine: str = "fused"):
     )
 
     out, n_out = _splice_prefix(data, work, skip, wn)
-    # field value = the blob length that actually fit (splice may have
-    # truncated growth at capacity), not the pre-truncation wn
-    out = jnp.where(
-        use_sz,
-        rebuild_sizer(out, n_out, field_a, field_w, field_kind,
-                      jnp.maximum(n_out - skip, 0)),
-        out,
-    )
+    if enable_sizer:
+        # field value = the blob length that actually fit (splice may have
+        # truncated growth at capacity), not the pre-truncation wn
+        out = jnp.where(
+            use_sz,
+            rebuild_sizer(out, n_out, field_a, field_w, field_kind,
+                          jnp.maximum(n_out - skip, 0)),
+            out,
+        )
+    if enable_csum:
+        # cs: append the recomputed xor8 trailer over the mutated body
+        L = data.shape[0]
+        cs_pos = jnp.minimum(n_out, L - 1)
+        csum = xor8_of_range(out, skip, cs_pos)
+        out_cs = out.at[cs_pos].set(csum)
+        n_out_cs = jnp.minimum(n_out + 1, L)
+        out = jnp.where(use_cs, out_cs, out)
+        n_out = jnp.where(use_cs, n_out_cs, n_out)
     return out, n_out, scores, pat, log
 
 
-def fuzz_batch(keys, data, lens, scores, pri, pat_pri, engine: str = "fused"):
+def fuzz_batch(keys, data, lens, scores, pri, pat_pri, engine: str = "fused",
+               enable_sizer: bool = True, enable_csum: bool = True):
     """One device call: mutate a [B, L] batch.
 
     Args:
@@ -126,11 +159,15 @@ def fuzz_batch(keys, data, lens, scores, pri, pat_pri, engine: str = "fused"):
       pri: int32[M] mutator priorities; pat_pri: int32[P] pattern priorities.
       engine: "fused" (default, ~8 O(L) passes/round) or "switch" (one
         kernel per mutator — the reference-shaped baseline).
+      enable_sizer/enable_csum: trace-time switches for the sz/cs scans
+        (set False when those patterns carry zero priority).
 
     Returns (data', lens', scores', FuzzMeta).
     """
     out, n_out, sc, pat, log = jax.vmap(
-        lambda k, d, n, s: fuzz_sample(k, d, n, s, pri, pat_pri, engine)
+        lambda k, d, n, s: fuzz_sample(
+            k, d, n, s, pri, pat_pri, engine, enable_sizer, enable_csum
+        )
     )(keys, data, lens, scores)
     return out, n_out, sc, FuzzMeta(pat, log)
 
@@ -161,6 +198,11 @@ def make_fuzzer(capacity: int, batch: int, mutator_pri=None, pattern_pri=None,
     if engine not in ENGINES:
         raise ValueError(f"engine must be one of {ENGINES}, got {engine!r}")
 
+    from .patterns import CS, SZ
+
+    enable_sizer = bool(pat_pri[SZ] > 0)
+    enable_csum = bool(pat_pri[CS] > 0)
+
     def step(base, case_idx, data, lens, scores):
         if data.shape != (batch, capacity):
             raise ValueError(
@@ -170,7 +212,7 @@ def make_fuzzer(capacity: int, batch: int, mutator_pri=None, pattern_pri=None,
         keys = prng.sample_keys(ckey, batch)
         return fuzz_batch(
             keys, data, lens, scores, jnp.asarray(pri), jnp.asarray(pat_pri),
-            engine=engine,
+            engine=engine, enable_sizer=enable_sizer, enable_csum=enable_csum,
         )
 
     return jax.jit(step), init_scores

@@ -19,6 +19,7 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
+from ..constants import PREAMBLE_MAX_BYTES
 from . import prng
 
 # field kinds: (width_bytes, endianness) — index into these tables
@@ -67,6 +68,40 @@ def detect_sizer(key, data, n):
     a = (flat % L).astype(jnp.int32)
     width = jnp.asarray((1, 2, 2, 4, 4), jnp.int32)[kind]
     return any_found, a, width, kind
+
+
+def detect_xor8(key, data, n):
+    """Find a random xor8 trailer checksum: offsets a where
+    xor(data[a:n-1]) == data[n-1], i.e. the suffix-xor at a is zero —
+    one reversed cumulative-xor pass instead of the reference's
+    O(n*k) per-preamble rescan (erlamsa_field_predict.erl:129-161).
+
+    Returns (found, a): preamble length of a plausible checksummed body.
+    """
+    L = data.shape[0]
+    i = jnp.arange(L, dtype=jnp.int32)
+    x = jnp.where(i < n, data, jnp.uint8(0))
+    sfx = jnp.flip(
+        jax.lax.associative_scan(jnp.bitwise_xor, jnp.flip(x))
+    )  # sfx[i] = xor of data[i:n]
+    # inclusive preamble envelope, same as the oracle's range(0, limit + 1)
+    # (models/fieldpred.py get_possible_csum_locations)
+    limit = jnp.minimum(2 * n // 3, 30 * PREAMBLE_MAX_BYTES)
+    cand = (sfx == 0) & (i <= limit) & (i < n - 1) & (n > 2)
+    total = jnp.sum(cand).astype(jnp.int32)
+    found = total > 0
+    r = prng.rand(prng.sub(key, prng.TAG_MASK), total)
+    cum = jnp.cumsum(cand).astype(jnp.int32)
+    a = jnp.argmax(cand & (cum == r + 1)).astype(jnp.int32)
+    return found, a
+
+
+def xor8_of_range(data, start, end):
+    """xor of data[start:end] via prefix-xor difference."""
+    L = data.shape[0]
+    i = jnp.arange(L, dtype=jnp.int32)
+    x = jnp.where((i >= start) & (i < end), data, jnp.uint8(0))
+    return jax.lax.associative_scan(jnp.bitwise_xor, x)[L - 1]
 
 
 def rebuild_sizer(data, n, a, width, kind, blob_len):

@@ -100,7 +100,7 @@ def test_priority_zero_disables(state):
 
 def test_pattern_nu_only_is_identity(state):
     base, scores = state
-    pat_pri = [0, 0, 0, 0, 1, 0, 0]  # nu only
+    pat_pri = [0, 0, 0, 0, 1, 0, 0, 0]  # nu only
     f, _ = make_fuzzer(L, B, pattern_pri=pat_pri)
     batch = pack(SEEDS, capacity=L)
     data, lens, _, meta = f(base, 0, batch.data, batch.lens, scores)
@@ -110,7 +110,7 @@ def test_pattern_nu_only_is_identity(state):
 
 def test_skip_pattern_preserves_prefix(state):
     base, scores = state
-    pat_pri = [0, 0, 0, 1, 0, 0, 0]  # sk only
+    pat_pri = [0, 0, 0, 1, 0, 0, 0, 0]  # sk only
     f, _ = make_fuzzer(L, 16, pattern_pri=pat_pri)
     seeds = [b"A" * 100 for _ in range(16)]
     batch = pack(seeds, capacity=L)
@@ -125,7 +125,7 @@ def test_sizer_pattern_rewrites_field(state):
     import struct
 
     base, scores = state
-    pat_pri = [0, 0, 0, 0, 0, 0, 1]  # sz only
+    pat_pri = [0, 0, 0, 0, 0, 0, 1, 0]  # sz only
     f, _ = make_fuzzer(L, 32, pattern_pri=pat_pri)
     payload = b"SIZED_PAYLOAD_CONTENT_HERE_123456"
     seeds = [b"HD" + struct.pack(">H", len(payload)) + payload] * 32
@@ -142,3 +142,27 @@ def test_sizer_pattern_rewrites_field(state):
             rewritten += 1
     # most mutated samples must carry a corrected length field
     assert rewritten > 10
+
+
+def test_checksum_pattern_recomputes_xor8(state):
+    base, scores = state
+    pat_pri = [0, 0, 0, 0, 0, 0, 0, 1]  # cs only
+    f, _ = make_fuzzer(L, 32, pattern_pri=pat_pri)
+    body = b"CHECKSUMMED_BODY_0123456789abcdef"
+    csum = 0
+    for x in body:
+        csum ^= x
+    seeds = [body + bytes([csum])] * 32
+    batch = pack(seeds, capacity=L)
+    data, lens, _, _ = f(base, 0, batch.data, batch.lens, scores[:32])
+    outs = unpack(Batch(data, lens))
+    fixed = 0
+    for o in outs:
+        if o == seeds[0] or len(o) < 2:
+            continue
+        x = 0
+        for b_ in o[:-1]:
+            x ^= b_
+        if x == o[-1]:
+            fixed += 1
+    assert fixed > 10
