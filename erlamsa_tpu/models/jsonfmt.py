@@ -238,10 +238,13 @@ def _mutate_tree(r: ErlRand, root: JNode, inner_bytes_mutator) -> tuple[JNode, s
             idx = r.rand(len(parent.children))
             parent.children.insert(idx, parent.children[idx].clone())
             return root, "json_dup"
-    if op == 2:  # pump: nest a container inside itself (2x depth growth)
+    if op == 2:  # pump: nest a container inside itself (2x depth growth,
+        # size-capped like the sgml pump so repeated rounds can't explode)
         conts = [x for x in nodes if x.kind in ("obj", "arr") and x.children]
         if conts:
             target = r.rand_elem(conts)
+            if len(serialize(target)) >= 1 << 20:
+                return root, "json_pump_capped"
             clone = target.clone()
             clone.key = None
             target.children.append(clone)
@@ -287,4 +290,9 @@ def json_mutate(r: ErlRand, data: bytes, inner_bytes_mutator) -> tuple[bytes, st
     if root is None:
         return data, "json_not_json", -1
     root, op = _mutate_tree(r, root, inner_bytes_mutator)
+    if op.endswith("_capped"):
+        # suppressed mutation: return the ORIGINAL bytes with a failure
+        # delta so the mux retries instead of rewarding a no-op (serialize
+        # could still normalize whitespace and read as a change)
+        return data, op, -1
     return serialize(root), op, 1

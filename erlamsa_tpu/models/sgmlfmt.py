@@ -249,8 +249,14 @@ def sgml_mutate(
         tag = r.rand_elem(tags)
         tag[3] = tag[3] + [_clone(c) for c in tag[3]]
         return serialize(forest), "sgml_dup", 1
-    if op == 2:  # pump: nest a clone of a tag inside itself
+    if op == 2:  # pump: nest a clone of a tag inside itself (size-capped —
+        # repeated pumps across nd/bu rounds otherwise explode the tree,
+        # cf. the reference's 256MB heap guard on tree stutter)
         tag = r.rand_elem(tags)
+        if len(serialize([tag])) >= 1 << 20:
+            # capped: report a failed try (unchanged data, noop delta) so
+            # the mux doesn't reward a no-op
+            return data, "sgml_pump_capped", -1
         tag[3] = tag[3] + [_clone(tag)]
         return serialize(forest), "sgml_pump", 1
     if op == 3:  # repeat a tag up to 100x at top level

@@ -10,8 +10,22 @@ fresh AS183 stream seeded with it; resume therefore needs only
 
 from __future__ import annotations
 
+import sys
 import time
 from typing import Callable
+
+
+def _ensure_deep_stack():
+    """Deep parse trees (pump mutations nest nodes across nd/bu rounds)
+    exceed CPython's default 1000-frame limit in the recursive serializers;
+    the reference runs on BEAM with no such ceiling. Applied at Engine
+    construction (not import) so merely importing the package doesn't
+    mutate global interpreter state. CPython 3.12's C-stack guard turns
+    overshoot into a catchable RecursionError rather than a crash, and the
+    pump size caps (models/jsonfmt.py, models/sgmlfmt.py) bound realistic
+    depth well below this."""
+    if sys.getrecursionlimit() < 20000:
+        sys.setrecursionlimit(20000)
 
 from ..constants import TOO_MANY_FAILED_ATTEMPTS
 from ..utils.erlrand import ErlRand, gen_urandom_seed
@@ -22,6 +36,7 @@ from .mutations import Ctx, default_mutations, make_mutator
 
 class Engine:
     def __init__(self, opts: dict):
+        _ensure_deep_stack()
         self.opts = dict(opts)
         self.seed = opts.get("seed") or gen_urandom_seed()
         self.n_cases = opts.get("n", 1)

@@ -12,6 +12,8 @@ from __future__ import annotations
 import sys
 import time
 
+import numpy as np
+
 from . import logger, out
 
 
@@ -47,8 +49,12 @@ def run_tpu_batch(opts: dict, batch: int = 1024) -> int:
     cap = capacity_for(max(len(s) for s in corpus))
     packed = pack(corpus, capacity=cap)
 
-    # device-capable subset of the selected mutators
-    selected = dict(opts.get("mutations") or [])
+    # device-capable subset of the selected mutators; host-capable rows go
+    # to the hybrid dispatcher's oracle pool
+    from ..oracle.mutations import default_mutations
+    from .hybrid import HybridDispatcher
+
+    selected = dict(opts.get("mutations") or default_mutations())
     pri = [selected.get(code, 0) for code in DEVICE_CODES]
     if not any(pri):
         print(
@@ -57,6 +63,7 @@ def run_tpu_batch(opts: dict, batch: int = 1024) -> int:
             file=sys.stderr,
         )
         return 1
+    hybrid = HybridDispatcher(list(selected.items()), opts["seed"])
 
     step, _ = make_fuzzer(cap, batch, mutator_pri=pri)
     base = prng.base_key(opts["seed"])
@@ -65,22 +72,34 @@ def run_tpu_batch(opts: dict, batch: int = 1024) -> int:
     writer, _mt = out.string_outputs(opts.get("output", "-"))
     n_cases = opts.get("n", 1)
     total = 0
+    host_total = 0
     t0 = time.perf_counter()
     data, lens = packed.data, packed.lens
     for case in range(n_cases):
+        host_mask = hybrid.split(case, corpus)
+        # device mutates the WHOLE batch (async); the host pool handles its
+        # share in parallel, and host results override at merge time
         new_data, new_lens, scores, meta = step(base, case, data, lens, scores)
+        host_results = {}
+        host_idx = [(i, corpus[i]) for i in np.nonzero(host_mask)[0]]
+        if host_idx:
+            host_results = hybrid.fuzz_host(case, host_idx)
         results = unpack(Batch(new_data, new_lens))
         for i, rdata in enumerate(results):
+            payload = host_results.get(i, rdata)
             if writer is not None:
-                writer(case * batch + i, rdata, [])
+                writer(case * batch + i, payload, [])
             else:
-                sys.stdout.buffer.write(rdata)
+                sys.stdout.buffer.write(payload)
         total += len(results)
+        host_total += len(host_idx)
+    hybrid.close()
     dt = time.perf_counter() - t0
     logger.log("info", "tpu backend: %d samples in %.2fs (%.0f samples/s)",
                total, dt, total / max(dt, 1e-9))
     print(
-        f"# {total} samples, {dt:.2f}s, {total / max(dt, 1e-9):.0f} samples/s",
+        f"# {total} samples ({host_total} host-routed), {dt:.2f}s, "
+        f"{total / max(dt, 1e-9):.0f} samples/s",
         file=sys.stderr,
     )
     return 0

@@ -1,0 +1,144 @@
+"""Hybrid dispatcher: full-mutator-set fuzzing with device batches.
+
+The device engine covers 24 closed-form mutators; the structured tail
+(sgm js ab ad tree* ft fn fo len b64 uri zip) runs in the oracle. The
+reference's mux draws one mutator per event from the whole weighted set —
+the hybrid dispatcher reproduces that split at the *sample* level:
+
+  1. per sample, estimate which registry rows are applicable (cheap host
+     heuristics mirroring the mutators' own guards),
+  2. draw host-vs-device from the applicable priority mass,
+  3. device samples ride one fuzz_batch call; host samples fan out to an
+     oracle worker pool restricted to the host subset.
+
+This keeps the TPU busy with the bulk of the corpus while the host handles
+the structured minority (SURVEY.md §7 phase 3's host/device split). The
+split probabilities follow priorities, not evolving scores (documented
+approximation — scores evolve within each engine).
+"""
+
+from __future__ import annotations
+
+import base64 as b64mod
+import binascii
+import concurrent.futures as cf
+import os
+
+import numpy as np
+
+from ..ops.registry import DEVICE_CODES, HOST_CODES
+from ..utils.bytehelpers import binarish
+
+
+def host_applicable_mass(data: bytes, selected: dict[str, int]) -> int:
+    """Priority mass of host mutators whose guards plausibly pass for this
+    sample (mirrors each mutator's own cheap precondition)."""
+    import re
+
+    mass = 0
+    is_bin = binarish(data)
+    # a '<' immediately followed by a name/bang/slash — the shape the SGML
+    # tokenizer actually turns into a tag, unlike a bare 0x3C byte
+    has_tag = re.search(rb"<[A-Za-z!/?]", data[:4096]) is not None
+    stripped = data[:64].lstrip()
+    looks_json = stripped[:1] in (b"{", b"[", b'"') or (
+        stripped[:1].isdigit()
+    )
+    is_zip = data[:4] in (b"PK\x03\x04", b"PK\x05\x06")
+    has_uri = b"://" in data
+    maybe_b64 = False
+    chunk = data.strip()
+    if len(chunk) > 6 and len(chunk) % 4 == 0:
+        try:
+            b64mod.b64decode(chunk, validate=True)
+            maybe_b64 = True
+        except (binascii.Error, ValueError):
+            pass
+
+    for code, pri in selected.items():
+        if code not in HOST_CODES or pri <= 0:
+            continue
+        if code == "sgm" and not has_tag:
+            continue
+        if code == "js" and not looks_json:
+            continue
+        if code == "zip" and not is_zip:
+            continue
+        if code == "uri" and not has_uri:
+            continue
+        if code == "b64" and not maybe_b64:
+            continue
+        if code in ("tr2", "td", "ts1", "ts2", "tr", "ab", "ad") and is_bin:
+            continue
+        if code == "len" and len(data) <= 10:
+            continue
+        mass += pri
+    return mass
+
+
+class HybridDispatcher:
+    """Splits a corpus batch into device and host work per case."""
+
+    def __init__(self, selected: list[tuple[str, int]], seed,
+                 host_workers: int | None = None):
+        self.selected = dict(selected)
+        self.device_mass = sum(
+            p for c, p in self.selected.items() if c in DEVICE_CODES and p > 0
+        )
+        self.host_rows = [
+            (c, p) for c, p in self.selected.items() if c in HOST_CODES and p > 0
+        ]
+        self.seed = seed
+        self._mass_cache: np.ndarray | None = None
+        self._mass_corpus: list | None = None
+        self._pool = cf.ThreadPoolExecutor(
+            max_workers=host_workers or min(8, (os.cpu_count() or 2))
+        )
+
+    def _masses(self, seeds: list[bytes]) -> np.ndarray:
+        """Per-sample host priority mass, computed once per corpus (the
+        batch runner reuses one immutable corpus across cases)."""
+        if self._mass_cache is None or self._mass_corpus is not seeds:
+            self._mass_cache = np.asarray(
+                [host_applicable_mass(s, self.selected) for s in seeds],
+                np.int64,
+            )
+            self._mass_corpus = seeds
+        return self._mass_cache
+
+    def split(self, case_idx: int, seeds: list[bytes]) -> np.ndarray:
+        """bool[B]: True = host-routed. Deterministic in (seed, case) —
+        the RNG is keyed on the integer seed values, NOT Python's salted
+        hash, so routing reproduces across processes."""
+        out = np.zeros(len(seeds), bool)
+        if not self.host_rows:
+            return out
+        seed_ints = (
+            list(self.seed) if isinstance(self.seed, tuple) else [int(self.seed)]
+        )
+        rng = np.random.default_rng([*seed_ints, case_idx, 0x48594252])
+        hm = self._masses(seeds)
+        total = hm + self.device_mass
+        draws = rng.random(len(seeds))
+        probs = np.where(total > 0, hm / np.maximum(total, 1), 0.0)
+        return draws < probs
+
+    def fuzz_host(self, case_idx: int, idx_seeds: list[tuple[int, bytes]]):
+        """Oracle fuzz for host-routed samples; returns {index: bytes}."""
+        from ..oracle.engine import fuzz
+
+        def one(item):
+            i, data = item
+            return i, fuzz(
+                data,
+                seed=(self.seed[0], self.seed[1] ^ case_idx,
+                      self.seed[2] ^ (i + 1))
+                if isinstance(self.seed, tuple)
+                else (1, case_idx, i + 1),
+                mutations=self.host_rows,
+            )
+
+        return dict(self._pool.map(one, idx_seeds))
+
+    def close(self):
+        self._pool.shutdown(wait=False)

@@ -1,0 +1,56 @@
+"""Hybrid dispatcher tests: host/device split heuristics and oracle pool."""
+
+import numpy as np
+
+from erlamsa_tpu.oracle.mutations import default_mutations
+from erlamsa_tpu.services.hybrid import HybridDispatcher, host_applicable_mass
+
+
+SELECTED = dict(default_mutations())
+
+
+def test_host_mass_heuristics():
+    # plain binary: tree/ascii/sgml/js guards all fail
+    assert host_applicable_mass(bytes(range(256)), SELECTED) <= \
+        SELECTED["len"] + SELECTED["ft"] + SELECTED["fn"] + SELECTED["fo"]
+    # XML-ish data unlocks sgm (pri 10)
+    xml_mass = host_applicable_mass(b"<a><b>text</b></a>", SELECTED)
+    assert xml_mass >= SELECTED["sgm"]
+    # JSON-ish unlocks js
+    js_mass = host_applicable_mass(b'{"k": 1}', SELECTED)
+    assert js_mass >= SELECTED["js"]
+    # URI unlocks uri
+    assert host_applicable_mass(b"see http://x.com/ ok", SELECTED) >= \
+        host_applicable_mass(b"see nothing here ok", SELECTED)
+
+
+def test_split_deterministic_and_reasonable():
+    d = HybridDispatcher(list(SELECTED.items()), (1, 2, 3))
+    seeds = [b"<xml><doc>content</doc></xml>"] * 64 + [bytes(range(200))] * 64
+    m1 = d.split(0, seeds)
+    m2 = d.split(0, seeds)
+    assert np.array_equal(m1, m2)
+    m3 = d.split(1, seeds)
+    assert not np.array_equal(m1, m3)
+    # XML samples route to host far more often than raw binary
+    assert m1[:64].sum() > m1[64:].sum()
+    d.close()
+
+
+def test_fuzz_host_runs_host_mutators():
+    d = HybridDispatcher(list(SELECTED.items()), (1, 2, 3))
+    items = [(0, b"<a><b>text node</b></a>"), (3, b'{"x": [1,2,3]}')]
+    res = d.fuzz_host(0, items)
+    assert set(res) == {0, 3}
+    assert all(isinstance(v, bytes) for v in res.values())
+    # deterministic for the same (seed, case, index)
+    res2 = d.fuzz_host(0, items)
+    assert res == res2
+    d.close()
+
+
+def test_device_only_selection_never_routes_host():
+    d = HybridDispatcher([("bd", 1), ("bf", 1)], (1, 2, 3))
+    m = d.split(0, [b"<xml/>"] * 32)
+    assert not m.any()
+    d.close()
