@@ -206,19 +206,68 @@ def _rawip_writer(dst_ip: str) -> Writer:
     return write
 
 
-def _serial_writer(dev: str, baud: int) -> Writer:
-    """termios-configured serial device (the reference uses the erlserial C
-    port, src/erlamsa_out.erl:129-137)."""
+def open_serial_raw(dev: str, baud: int) -> int:
+    """Open a serial device in RAW mode at the given speed — shared by the
+    serial writer and the serial proxy (the reference's erlserial C port
+    configures raw mode the same way). Canonical-mode line discipline would
+    otherwise mangle binary fuzz traffic (CR/NL translation, ECHO,
+    withheld partial lines)."""
     import termios
 
     fd = os.open(dev, os.O_RDWR | os.O_NOCTTY)
     attrs = termios.tcgetattr(fd)
     speed = getattr(termios, f"B{baud}", termios.B115200)
+    # cfmakeraw equivalent (the termios module here lacks it)
+    attrs[0] &= ~(termios.IGNBRK | termios.BRKINT | termios.PARMRK
+                  | termios.ISTRIP | termios.INLCR | termios.IGNCR
+                  | termios.ICRNL | termios.IXON)
+    attrs[1] &= ~termios.OPOST
+    attrs[3] &= ~(termios.ECHO | termios.ECHONL | termios.ICANON
+                  | termios.ISIG | termios.IEXTEN)
+    attrs[2] &= ~(termios.CSIZE | termios.PARENB)
+    attrs[2] |= termios.CS8 | termios.CLOCAL | termios.CREAD
     attrs[4] = attrs[5] = speed
     termios.tcsetattr(fd, termios.TCSANOW, attrs)
+    return fd
+
+
+def _serial_writer(dev: str, baud: int) -> Writer:
+    """termios-configured serial device (the reference uses the erlserial C
+    port, src/erlamsa_out.erl:129-137)."""
+    fd = open_serial_raw(dev, baud)
 
     def write(case_idx: int, data: bytes, meta: list) -> None:
         os.write(fd, data)
+
+    return write
+
+
+def _can_writer(iface: str, can_id: int) -> Writer:
+    """SocketCAN output (the cansockd path, erlamsa_out.erl cansockd
+    writers): each fuzzed case streams as 8-byte CAN frames. Gated on
+    AF_CAN support and the interface existing."""
+    import struct
+
+    if not hasattr(socket, "AF_CAN"):
+        raise SystemExit("can:// needs SocketCAN (AF_CAN) support")
+    sock = socket.socket(socket.AF_CAN, socket.SOCK_RAW, socket.CAN_RAW)
+    try:
+        sock.bind((iface,))
+    except OSError as e:
+        raise SystemExit(f"can:// cannot bind {iface!r}: {e}")
+    if can_id > 0x7FF:  # 29-bit extended arbitration id
+        can_id |= socket.CAN_EFF_FLAG
+
+    def write(case_idx: int, data: bytes, meta: list) -> None:
+        try:
+            for off in range(0, len(data), 8):
+                chunk = data[off : off + 8]
+                # '=' = native byte order, matching the kernel's can_frame
+                frame = struct.pack("=IB3x8s", can_id, len(chunk),
+                                    chunk.ljust(8, b"\x00"))
+                sock.send(frame)
+        except OSError as e:
+            raise CantConnect(str(e)) from e
 
     return write
 
@@ -259,6 +308,9 @@ def string_outputs(spec, monitor_notify=None) -> tuple[Writer | None, float]:
         return _exec_writer(spec[7:], monitor_notify), DEFAULT_MAX_RUNNING_TIME
     if spec.startswith("ip://"):
         return _rawip_writer(spec[5:]), DEFAULT_MAX_RUNNING_TIME
+    if spec.startswith("can://"):
+        iface, _, can_id = spec[6:].partition(":")
+        return _can_writer(iface, int(can_id or "0", 0)), DEFAULT_MAX_RUNNING_TIME
     if spec.startswith("serial://"):
         dev, _, baud = spec[9:].rpartition(":")
         return _serial_writer(dev or spec[9:], int(baud or 115200)), DEFAULT_MAX_RUNNING_TIME

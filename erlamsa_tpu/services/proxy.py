@@ -12,6 +12,13 @@ and CONNECT-based TLS MitM. Spec forms (erlamsa_cmdparse proxy parsing):
     http2://lport:rhost:rport
     tls://lport:rhost:rport    (MitM: self-signed listener, TLS upstream;
                                 cert/key via opts certfile/keyfile)
+    connect://lport::          (standalone HTTP proxy: clients send
+                                CONNECT host:port / absolute-URI requests;
+                                the upstream target comes from the request,
+                                like the reference's CONNECT MitM path,
+                                src/erlamsa_fuzzproxy.erl:138-164)
+    serial://dev1@baud:dev2@baud  (dual serial pass-through,
+                                src/erlamsa_fuzzproxy.erl:202-224)
 """
 
 from __future__ import annotations
@@ -26,9 +33,22 @@ from .batcher import make_batcher
 
 def parse_proxy_spec(spec: str):
     proto, _, rest = spec.partition("://")
+    if proto == "serial":
+        parts = rest.split(":")
+        if len(parts) != 2 or "@" not in parts[0] or "@" not in parts[1]:
+            raise SystemExit(
+                f"bad serial proxy spec {spec!r}; want serial://dev1@baud:dev2@baud"
+            )
+        return proto, parts[0], parts[1], 0
     parts = rest.split(":")
     if len(parts) != 3:
         raise SystemExit(f"bad proxy spec {spec!r}; want proto://lport:rhost:rport")
+    if proto == "connect":
+        # the upstream comes from each CONNECT/Host request; rhost:rport in
+        # the spec are meaningless and stay empty
+        return proto, int(parts[0]), "", 0
+    if not parts[2]:
+        raise SystemExit(f"bad proxy spec {spec!r}; missing rport")
     return proto, int(parts[0]), parts[1], int(parts[2])
 
 
@@ -204,6 +224,108 @@ class FuzzProxy:
         t1.start()
         t2.start()
 
+    # --- CONNECT / absolute-URI HTTP proxy (erlamsa_fuzzproxy.erl:138-164) -
+
+    def _handle_connect(self, client: socket.socket):
+        """Standalone HTTP proxy: read the request head, derive the real
+        upstream from CONNECT host:port or the request's Host header."""
+        server = None
+        try:
+            client.settimeout(10)
+            head = b""
+            while b"\r\n\r\n" not in head and len(head) < 65536:
+                chunk = client.recv(8192)
+                if not chunk:
+                    client.close()
+                    return
+                head += chunk
+            first = head.split(b"\r\n", 1)[0]
+            if first.startswith(b"CONNECT "):
+                target = first.split()[1].decode()
+                host, _, port = target.rpartition(":")
+                server = socket.create_connection(
+                    (host or target, int(port or 443)), timeout=10
+                )
+                client.sendall(b"HTTP/1.1 200 Connection Established\r\n\r\n")
+                leftover = head.split(b"\r\n\r\n", 1)[1]
+            else:
+                # absolute-URI / Host-header plain proxying
+                host_line = next(
+                    (l for l in head.split(b"\r\n") if l.lower().startswith(b"host:")),
+                    None,
+                )
+                if host_line is None:
+                    client.close()
+                    return
+                hostport = host_line.split(b":", 1)[1].strip().decode()
+                host, _, port = hostport.partition(":")
+                server = socket.create_connection((host, int(port or 80)), timeout=10)
+                leftover = head  # forward the full request
+            client.settimeout(None)
+            conn_state: dict = {}
+            if leftover:
+                out = self._fuzz_maybe(leftover, self.prob_cs, 1, "c->s", conn_state)
+                server.sendall(out)
+            t1 = threading.Thread(
+                target=self._pump,
+                args=(client, server, self.prob_cs, "c->s", conn_state),
+                daemon=True)
+            t2 = threading.Thread(
+                target=self._pump,
+                args=(server, client, self.prob_sc, "s->c", conn_state),
+                daemon=True)
+            t1.start()
+            t2.start()
+        except (OSError, ValueError, IndexError) as e:
+            logger.log("error", "connect-proxy setup failed: %s", e)
+            client.close()
+            if server is not None:
+                server.close()
+
+    # --- dual serial (erlamsa_fuzzproxy.erl:202-224) -----------------------
+
+    def _serve_serial(self):
+        import os as _os
+        import select
+
+        from .out import open_serial_raw
+
+        def open_dev(spec):
+            dev, _, baud = spec.partition("@")
+            return open_serial_raw(dev, int(baud or 115200))
+
+        fd1 = open_dev(self.lport)  # lport/rhost carry the dev specs here
+        fd2 = open_dev(self.rhost)
+        conn_state: dict = {}
+        counts = {"c->s": 0, "s->c": 0}  # per-direction like _pump's n
+        try:
+            while not self._stop.is_set():
+                r, _w, _x = select.select([fd1, fd2], [], [], 1.0)
+                for fd in r:
+                    try:
+                        data = _os.read(fd, 4096)
+                    except OSError as e:
+                        logger.log("error", "serial proxy read failed: %s", e)
+                        return
+                    if not data:
+                        # EOF (pty peer closed): selecting again would spin
+                        logger.log("info", "serial endpoint closed")
+                        return
+                    direction = "c->s" if fd == fd1 else "s->c"
+                    counts[direction] += 1
+                    prob = self.prob_cs if fd == fd1 else self.prob_sc
+                    out = self._fuzz_maybe(
+                        data, prob, counts[direction], direction, conn_state
+                    )
+                    try:
+                        _os.write(fd2 if fd == fd1 else fd1, out)
+                    except OSError as e:
+                        logger.log("error", "serial proxy write failed: %s", e)
+                        return
+        finally:
+            _os.close(fd1)
+            _os.close(fd2)
+
     def _serve_tcp(self):
         srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
@@ -217,7 +339,12 @@ class FuzzProxy:
                 client, _addr = srv.accept()
             except OSError:
                 break
-            self._handle_tcp(client)
+            if self.proto == "connect":
+                threading.Thread(
+                    target=self._handle_connect, args=(client,), daemon=True
+                ).start()
+            else:
+                self._handle_tcp(client)
 
     # --- UDP (loop_udp, erlamsa_fuzzproxy.erl:226-259) --------------------
 
@@ -244,7 +371,12 @@ class FuzzProxy:
                 srv.sendto(out, client_addr)
 
     def start(self, block: bool = True):
-        target = self._serve_udp if self.proto == "udp" else self._serve_tcp
+        if self.proto == "serial":
+            target = self._serve_serial
+        elif self.proto == "udp":
+            target = self._serve_udp
+        else:
+            target = self._serve_tcp
         if block:
             target()
             return 0
