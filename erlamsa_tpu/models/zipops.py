@@ -27,7 +27,15 @@ def rebuild(members: list[tuple[str, bytes]]) -> bytes:
     buf = io.BytesIO()
     with zipfile.ZipFile(buf, "w", zipfile.ZIP_DEFLATED) as z:
         for name, content in members:
-            z.writestr(name, content)
+            # fixed timestamp: writestr(name, ...) would embed the current
+            # wall clock and break fixed-seed reproducibility
+            info = zipfile.ZipInfo(name, date_time=(1980, 1, 1, 0, 0, 0))
+            info.compress_type = zipfile.ZIP_DEFLATED
+            # writestr(str, ...) would set this itself; a bare ZipInfo
+            # leaves mode 000 and attrs-honoring extractors create
+            # unreadable files
+            info.external_attr = 0o600 << 16
+            z.writestr(info, content)
     return buf.getvalue()
 
 

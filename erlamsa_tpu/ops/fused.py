@@ -598,6 +598,18 @@ def _apply_perm_lines(key, p, data, n, starts, lens, nlines):
 
 
 def _apply_mask(key, p, data, n):
+    from .pallas_kernels import pallas_enabled, randmask_single
+
+    if pallas_enabled():
+        # Pallas path: random bits come from the TPU hardware PRNG inside
+        # the kernel (threefry bits in interpret mode off-TPU)
+        params_row = jnp.stack(
+            [p["ps"], p["pl"], p["mask_op"], p["mask_prob"],
+             (p["kind"] == K_MASK).astype(jnp.int32)]
+        ).astype(jnp.int32)
+        out = randmask_single(prng.sub(key, prng.TAG_VAL), params_row, data)
+        return out, n
+
     L = data.shape[0]
     i = jnp.arange(L, dtype=jnp.int32)
     active = p["kind"] == K_MASK

@@ -130,3 +130,29 @@ def pallas_enabled() -> bool:
     """Opt-in until validated on real chips (the relay in this image blocks
     live TPU testing): ERLAMSA_PALLAS=1."""
     return os.environ.get("ERLAMSA_PALLAS") == "1"
+
+
+def randmask_single(key, params_row, data_row):
+    """Single-sample mask pass for use INSIDE the vmapped fused engine
+    (vmap lifts the pallas_call by prepending a grid dimension).
+
+    Args: key (threefry key), params_row int32[5] = (s, l, op, prob,
+    active), data_row uint8[L]. Returns uint8[L].
+    """
+    L = data_row.shape[0]
+    params2 = params_row.reshape(1, 5)
+    data2 = data_row.reshape(1, L)
+    if not _interpret() and pltpu is not None:
+        seed = jax.random.randint(key, (1,), 0, 2**31 - 1, dtype=jnp.int32)
+        out = pl.pallas_call(
+            _randmask_kernel_hw,
+            out_shape=jax.ShapeDtypeStruct((1, L), jnp.uint8),
+        )(seed, params2, data2)
+        return out[0]
+    bits = jax.random.bits(key, (1, 3, L), jnp.uint32)
+    out = pl.pallas_call(
+        _randmask_kernel_bits,
+        out_shape=jax.ShapeDtypeStruct((1, L), jnp.uint8),
+        interpret=True,
+    )(bits, params2, data2)
+    return out[0]

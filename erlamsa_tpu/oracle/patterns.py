@@ -266,8 +266,13 @@ def pat_compressed(ctx: Ctx, ll, rows, meta):
                 ip, data, [],
             )
             payload = _prepare4sizer(blocks)
+            # mtime=0 keeps recompression deterministic: gzip's header
+            # otherwise embeds wall-clock seconds and identical seeds
+            # produce different bytes across calls
             new_bin = (
-                gzipmod.compress(payload) if kind == "gzip" else zlib.compress(payload)
+                gzipmod.compress(payload, mtime=0)
+                if kind == "gzip"
+                else zlib.compress(payload)
             )
             meta = [("compressed", kind)] + meta
             ok = True

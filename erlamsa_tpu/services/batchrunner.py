@@ -69,13 +69,47 @@ def run_tpu_batch(opts: dict, batch: int = 1024) -> int:
     base = prng.base_key(opts["seed"])
     scores = init_scores(jax.random.fold_in(base, 999), batch)
 
-    writer, _mt = out.string_outputs(opts.get("output", "-"))
+    # resume: restore the scheduler scores + case counter (the rest of the
+    # stream is a pure function of (seed, case, sample))
+    from ..ops.registry import NUM_DEVICE_MUTATORS
+
+    start_case = 0
     n_cases = opts.get("n", 1)
+    state_path = opts.get("state_path")
+    if state_path:
+        import os as _os
+
+        from .checkpoint import load_state, save_state
+
+        if _os.path.exists(state_path):
+            st = load_state(state_path)
+            if st is None:
+                print("# checkpoint unreadable, starting fresh", file=sys.stderr)
+            else:
+                ck_seed, start_case, ck_scores = st
+                if (ck_seed != tuple(opts["seed"])
+                        or ck_scores.shape != (batch, NUM_DEVICE_MUTATORS)):
+                    print("# checkpoint mismatch (seed/shape), starting fresh",
+                          file=sys.stderr)
+                    start_case = 0
+                else:
+                    import jax.numpy as jnp
+
+                    scores = jnp.asarray(ck_scores)
+                    print(f"# resumed at case {start_case}", file=sys.stderr)
+        if start_case >= n_cases:
+            print(f"# run already complete ({start_case}/{n_cases} cases)",
+                  file=sys.stderr)
+            return 0
+
+    writer, _mt = out.string_outputs(opts.get("output", "-"))
     total = 0
     host_total = 0
     t0 = time.perf_counter()
     data, lens = packed.data, packed.lens
-    for case in range(n_cases):
+    # -n is the TOTAL case target, like the reference: resume completes the
+    # original run rather than adding n more cases
+    for case in range(start_case, n_cases):
         host_mask = hybrid.split(case, corpus)
         # device mutates the WHOLE batch (async); the host pool handles its
         # share in parallel, and host results override at merge time
@@ -93,6 +127,8 @@ def run_tpu_batch(opts: dict, batch: int = 1024) -> int:
                 sys.stdout.buffer.write(payload)
         total += len(results)
         host_total += len(host_idx)
+        if state_path:
+            save_state(state_path, opts["seed"], case + 1, scores)
     hybrid.close()
     dt = time.perf_counter() - t0
     logger.log("info", "tpu backend: %d samples in %.2fs (%.0f samples/s)",

@@ -1,0 +1,44 @@
+"""Checkpoint/resume for batch runs.
+
+The reference's checkpoint is (seed, case index): last_seed.txt plus
+--skip reproduces any point of the stream because everything is a pure
+function of the PRNG (SURVEY.md §5.4). The TPU path keeps that contract —
+counter keys derive from (seed, case, sample) — plus one piece of real
+state: the per-sample scheduler scores (and, in sequence mode, the case
+counter). This module persists both as a .npz so a long corpus run can
+stop and resume exactly.
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+
+def save_state(path: str, seed, case_idx: int, scores) -> None:
+    """Atomic write (tmp + rename): a kill mid-save — the very interruption
+    checkpoints exist for — must never corrupt the previous checkpoint."""
+    tmp = path + ".tmp"
+    np.savez(
+        tmp,
+        seed=np.asarray(seed, np.int64),
+        case_idx=np.asarray(case_idx, np.int64),
+        scores=np.asarray(scores, np.int32),
+    )
+    # np.savez appends .npz when missing; normalize
+    written = tmp if os.path.exists(tmp) else tmp + ".npz"
+    os.replace(written, path)
+
+
+def load_state(path: str):
+    """-> (seed tuple, case_idx, scores ndarray), or None when the file is
+    unreadable/corrupt (callers start fresh)."""
+    try:
+        with np.load(path) as z:
+            seed = tuple(int(x) for x in z["seed"])
+            case_idx = int(z["case_idx"])
+            scores = z["scores"].copy()
+        return seed, case_idx, scores
+    except Exception:
+        return None

@@ -135,6 +135,8 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--backend", choices=["oracle", "tpu"], default="oracle",
                    help="oracle = sequential parity engine; tpu = batched device engine")
     p.add_argument("--batch", type=int, default=1024, help="TPU batch size")
+    p.add_argument("--state", default=None,
+                   help="checkpoint file (.npz) for stop/resume of batch runs")
     p.add_argument("--node", default=None, help="join a parent node host:port")
     p.add_argument("--svcport", type=int, default=17771,
                    help="distribution/control port")
@@ -189,6 +191,7 @@ def main(argv=None) -> int:
         "meta_path": args.meta,
         "certfile": args.certfile,
         "keyfile": args.keyfile,
+        "state_path": args.state,
     }
 
     if args.detach:

@@ -54,3 +54,41 @@ def test_device_only_selection_never_routes_host():
     m = d.split(0, [b"<xml/>"] * 32)
     assert not m.any()
     d.close()
+
+
+def test_checkpoint_roundtrip(tmp_path):
+    from erlamsa_tpu.services.checkpoint import load_state, save_state
+
+    p = str(tmp_path / "st.npz")
+    scores = np.random.default_rng(0).integers(2, 11, (16, 25), dtype=np.int32)
+    save_state(p, (1, 2, 3), 42, scores)
+    seed, case, sc = load_state(p)
+    assert seed == (1, 2, 3) and case == 42
+    assert np.array_equal(sc, scores)
+
+
+def test_batchrunner_resume(tmp_path, monkeypatch, capsys):
+    from erlamsa_tpu.services.batchrunner import run_tpu_batch
+
+    seedfile = tmp_path / "seed.bin"
+    seedfile.write_bytes(b"resumable corpus data 123\n" * 4)
+    state = str(tmp_path / "ck.npz")
+    opts = {
+        "paths": [str(seedfile)], "n": 2, "seed": (7, 7, 7),
+        "output": str(tmp_path / "o-%n.bin"), "state_path": state,
+        "mutations": [("bd", 1), ("bf", 1)],
+    }
+    assert run_tpu_batch(dict(opts), batch=8) == 0
+    from erlamsa_tpu.services.checkpoint import load_state
+
+    _s, case, _sc = load_state(state)
+    assert case == 2
+    # -n is the TOTAL target: rerunning the completed command is a no-op
+    assert run_tpu_batch(dict(opts), batch=8) == 0
+    _s, case2, _sc2 = load_state(state)
+    assert case2 == 2
+    # raising -n completes the remainder only
+    opts["n"] = 3
+    assert run_tpu_batch(dict(opts), batch=8) == 0
+    _s, case3, _sc3 = load_state(state)
+    assert case3 == 3

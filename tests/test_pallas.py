@@ -68,3 +68,31 @@ def test_deterministic_per_seed():
     assert np.array_equal(a[0], a[1])
     c = _run(params, data, np.full(B, 43, np.int32))
     assert not np.array_equal(a, c)
+
+
+def test_fused_pipeline_with_pallas_mask(monkeypatch):
+    """The full fused pipeline with the Pallas mask pass (interpret mode):
+    snand/srnd invariants hold end-to-end."""
+    monkeypatch.setenv("ERLAMSA_PALLAS", "1")
+    import jax as _jax
+
+    from erlamsa_tpu.ops import prng
+    from erlamsa_tpu.ops.buffers import Batch, pack, unpack
+    from erlamsa_tpu.ops.fused import fused_mutate_step
+    from erlamsa_tpu.ops.registry import DEVICE_CODES, NUM_DEVICE_MUTATORS
+    from erlamsa_tpu.ops.scheduler import init_scores
+
+    seeds = [bytes(range(64)) * 2] * 8
+    batch = pack(seeds, capacity=256)
+    keys = prng.sample_keys(prng.case_key(prng.base_key(3), 0), 8)
+    scores = init_scores(_jax.random.fold_in(prng.base_key(3), 1), 8)
+    pri = np.zeros(NUM_DEVICE_MUTATORS, np.int32)
+    pri[DEVICE_CODES.index("srnd")] = 1
+
+    step = _jax.jit(_jax.vmap(fused_mutate_step, in_axes=(0, 0, 0, 0, None)))
+    data, lens, _sc, applied = step(keys, batch.data, batch.lens, scores,
+                                    jnp.asarray(pri))
+    outs = unpack(Batch(data, lens))
+    assert all(len(o) == len(s) for o, s in zip(outs, seeds))
+    assert any(o != s for o, s in zip(outs, seeds))
+    assert (np.asarray(applied) == DEVICE_CODES.index("srnd")).all()
