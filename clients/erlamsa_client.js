@@ -1,0 +1,54 @@
+#!/usr/bin/env node
+// Node.js client for the erlamsa_tpu fuzzing-as-a-service endpoint
+// (mirrors the reference's clients/ JS example).
+//
+//   const { fuzz } = require("./erlamsa_client");
+//   const out = await fuzz("http://127.0.0.1:17771", Buffer.from("data"),
+//                          { seed: "1,2,3" });
+
+"use strict";
+
+const http = require("http");
+const { URL } = require("url");
+
+function fuzz(baseUrl, data, opts = {}) {
+  const url = new URL("/erlamsa/erlamsa_esi:fuzz", baseUrl);
+  const headers = { "Content-Type": "application/octet-stream" };
+  for (const k of ["seed", "mutations", "patterns", "blockscale"]) {
+    if (opts[k] !== undefined) headers[`erlamsa-${k}`] = String(opts[k]);
+  }
+  if (opts.token) headers["erlamsa-token"] = opts.token;
+  if (opts.session) headers["erlamsa-session"] = opts.session;
+
+  return new Promise((resolve, reject) => {
+    const req = http.request(
+      url,
+      { method: "POST", headers, timeout: 95000 },
+      (res) => {
+        const chunks = [];
+        res.on("data", (c) => chunks.push(c));
+        res.on("end", () =>
+          resolve({
+            data: Buffer.concat(chunks),
+            session: res.headers["erlamsa-session"],
+            status: res.headers["erlamsa-status"],
+          })
+        );
+      }
+    );
+    req.on("error", reject);
+    req.end(data);
+  });
+}
+
+module.exports = { fuzz };
+
+if (require.main === module) {
+  const chunks = [];
+  process.stdin.on("data", (c) => chunks.push(c));
+  process.stdin.on("end", async () => {
+    const base = process.argv[2] || "http://127.0.0.1:17771";
+    const out = await fuzz(base, Buffer.concat(chunks));
+    process.stdout.write(out.data);
+  });
+}

@@ -1,0 +1,76 @@
+#!/usr/bin/env python3
+"""Python client for the erlamsa_tpu fuzzing-as-a-service endpoint.
+
+Mirrors the reference's clients/ examples: octet-stream and JSON APIs,
+erlamsa-* option headers, session reuse.
+
+    from erlamsa_client import ErlamsaClient
+    c = ErlamsaClient("http://127.0.0.1:17771")
+    fuzzed = c.fuzz(b"some data", seed="1,2,3", mutations="bd,bf")
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import urllib.request
+
+
+class ErlamsaClient:
+    def __init__(self, base_url: str, token: str | None = None):
+        self.base_url = base_url.rstrip("/")
+        self.token = token
+        self.session: str | None = None
+
+    def _headers(self, opts: dict) -> dict:
+        h = {"Content-Type": "application/octet-stream"}
+        if self.token:
+            h["erlamsa-token"] = self.token
+        if self.session:
+            h["erlamsa-session"] = self.session
+        for k in ("seed", "mutations", "patterns", "blockscale"):
+            if k in opts and opts[k] is not None:
+                h[f"erlamsa-{k}"] = str(opts[k])
+        return h
+
+    def fuzz(self, data: bytes, **opts) -> bytes:
+        """POST /erlamsa/erlamsa_esi:fuzz — bytes in, fuzzed bytes out."""
+        req = urllib.request.Request(
+            f"{self.base_url}/erlamsa/erlamsa_esi:fuzz",
+            data=data,
+            headers=self._headers(opts),
+        )
+        resp = urllib.request.urlopen(req, timeout=95)
+        self.session = resp.headers.get("erlamsa-session") or self.session
+        return resp.read()
+
+    def fuzz_json(self, data: bytes, **opts) -> bytes:
+        """POST /erlamsa/erlamsa_esi:json — base64 JSON API."""
+        payload: dict = {"data": base64.b64encode(data).decode()}
+        payload.update({k: v for k, v in opts.items() if v is not None})
+        req = urllib.request.Request(
+            f"{self.base_url}/erlamsa/erlamsa_esi:json",
+            data=json.dumps(payload).encode(),
+            headers={"Content-Type": "application/json",
+                     **({"erlamsa-token": self.token} if self.token else {})},
+        )
+        resp = json.loads(urllib.request.urlopen(req, timeout=95).read())
+        return base64.b64decode(resp["data"])
+
+    def manage(self, admin_token: str, op: str, **kw) -> dict:
+        """Token administration (addtoken/deltoken/listtokens)."""
+        payload = {"admin": admin_token, "op": op, **kw}
+        req = urllib.request.Request(
+            f"{self.base_url}/erlamsa/erlamsa_esi:manage",
+            data=json.dumps(payload).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        return json.loads(urllib.request.urlopen(req, timeout=30).read())
+
+
+if __name__ == "__main__":
+    import sys
+
+    url = sys.argv[1] if len(sys.argv) > 1 else "http://127.0.0.1:17771"
+    data = sys.stdin.buffer.read()
+    sys.stdout.buffer.write(ErlamsaClient(url).fuzz(data))

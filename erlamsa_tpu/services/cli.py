@@ -160,7 +160,11 @@ def main(argv=None) -> int:
         logger.GLOBAL.configure(spec)
 
     try:
-        seed = parse_seed(args.seed) if args.seed else gen_urandom_seed()
+        seed = (
+            parse_seed(args.seed, allow_source=True)
+            if args.seed
+            else gen_urandom_seed()
+        )
     except ValueError as e:
         raise SystemExit(f"erlamsa-tpu: {e}")
     with open("./last_seed.txt", "w") as f:  # erlamsa_main.erl:135

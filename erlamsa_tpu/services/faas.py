@@ -79,7 +79,11 @@ class _Handler(BaseHTTPRequestHandler):
             self._reply(401, b"unauthorized")
             return
         if path.endswith(("erlamsa_esi:fuzz", "/fuzz")):
-            opts = _parse_header_opts(self.headers)
+            try:
+                opts = _parse_header_opts(self.headers)
+            except (ValueError, SystemExit) as e:
+                self._reply(400, f"bad erlamsa-* header: {e}".encode())
+                return
             out = self.batcher.fuzz(body, opts)
             self._reply(200, out, session)
             return

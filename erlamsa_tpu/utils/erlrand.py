@@ -235,8 +235,34 @@ def gen_urandom_seed() -> tuple[int, int, int]:
     return (word(), word(), word())
 
 
-def parse_seed(s: str) -> tuple[int, int, int]:
-    """Parse the CLI 'a,b,c' seed form."""
+def seed_from_source(path: str) -> tuple[int, int, int]:
+    """Seed triple from an external entropy source (file/device), the
+    erlamsa_rnd_ext analogue (reference: src/erlamsa_rnd_ext.erl:84 decodes
+    big-endian 16-bit words): 6 bytes -> three big-endian components."""
+    try:
+        with open(path, "rb") as f:
+            b = f.read(6)
+    except OSError as e:
+        raise ValueError(f"cannot read entropy source {path!r}: {e}") from e
+    if len(b) < 6:
+        raise ValueError(f"entropy source {path!r} yielded fewer than 6 bytes")
+    return (
+        (b[0] << 8) | b[1],
+        (b[2] << 8) | b[3],
+        (b[4] << 8) | b[5],
+    )
+
+
+def parse_seed(s: str, allow_source: bool = False) -> tuple[int, int, int]:
+    """Parse a seed: 'a,b,c', or 'source:PATH' (external entropy) when
+    allow_source is set. Source seeds are CLI-ONLY — service endpoints must
+    never accept them, or any HTTP client could make the server open
+    arbitrary local files (the reference likewise only takes source: from
+    the command line, src/erlamsa_cmdparse.erl)."""
+    if s.startswith("source:"):
+        if not allow_source:
+            raise ValueError("source: seeds are not allowed here")
+        return seed_from_source(s[7:])
     parts = [int(x) for x in s.split(",")]
     if len(parts) != 3:
         raise ValueError(f"seed must be three comma-separated integers, got {s!r}")

@@ -140,3 +140,24 @@ def test_determinism():
     for _ in range(50):
         assert a.uniform() == b.uniform()
     assert a.random_block(100) == b.random_block(100)
+
+
+def test_seed_from_source(tmp_path):
+    from erlamsa_tpu.utils.erlrand import seed_from_source
+
+    p = tmp_path / "entropy.bin"
+    p.write_bytes(bytes([0x01, 0x02, 0x03, 0x04, 0x05, 0x06]))
+    # big-endian words, matching erlamsa_rnd_ext.erl:84 and gen_urandom_seed
+    assert seed_from_source(str(p)) == (0x0102, 0x0304, 0x0506)
+    assert parse_seed(f"source:{p}", allow_source=True) == (0x0102, 0x0304, 0x0506)
+    import pytest as _pytest
+
+    # source: seeds are CLI-only: service contexts must reject them
+    with _pytest.raises(ValueError):
+        parse_seed(f"source:{p}")
+    short = tmp_path / "short.bin"
+    short.write_bytes(b"xy")
+    with _pytest.raises(ValueError):
+        seed_from_source(str(short))
+    with _pytest.raises(ValueError):
+        seed_from_source(str(tmp_path / "missing.bin"))
