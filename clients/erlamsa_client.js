@@ -27,15 +27,26 @@ function fuzz(baseUrl, data, opts = {}) {
       (res) => {
         const chunks = [];
         res.on("data", (c) => chunks.push(c));
-        res.on("end", () =>
+        res.on("end", () => {
+          if (res.statusCode !== 200) {
+            reject(
+              new Error(
+                `erlamsa service returned HTTP ${res.statusCode}: ` +
+                  Buffer.concat(chunks).toString().slice(0, 200)
+              )
+            );
+            return;
+          }
           resolve({
             data: Buffer.concat(chunks),
             session: res.headers["erlamsa-session"],
             status: res.headers["erlamsa-status"],
-          })
-        );
+          });
+        });
       }
     );
+    // without this handler the timeout option is a no-op
+    req.on("timeout", () => req.destroy(new Error("erlamsa request timed out")));
     req.on("error", reject);
     req.end(data);
   });

@@ -8,61 +8,79 @@ delimiter byte, last = closing when complete).
 
 from __future__ import annotations
 
+import sys
+
 from ..utils.erlrand import ErlRand
 
 _DELIMS = {40: 41, 91: 93, 60: 62, 123: 125, 34: 34, 39: 39}
 
 
-def _grow(data: bytes, i: int, close: int) -> tuple[list, int | None]:
-    """Parse until `close`; returns (node_contents, next_index|None when out
-    of data) (erlamsa_mutations.erl:801-823)."""
-    out: list = []
-    n = len(data)
-    while i < n:
-        h = data[i]
-        if h == close:
-            out.append(close)
-            return out, i + 1
-        nxt = _DELIMS.get(h)
-        if nxt is None:
-            out.append(h)
-            i += 1
+def _ensure_stack():
+    """The recursive walkers below (sublists/edit_sublist) descend to the
+    parse depth, which MAX_PARSE_DEPTH allows up to 2000 — beyond CPython's
+    default 1000-frame limit. Ensure headroom here so library callers are
+    covered too, not only Engine-constructed flows."""
+    if sys.getrecursionlimit() < 20000:
+        sys.setrecursionlimit(20000)
+
+
+MAX_PARSE_DEPTH = 2000
+
+
+def partial_parse(data: bytes, max_depth: int = MAX_PARSE_DEPTH) -> list:
+    """bytes -> tree (erlamsa_mutations.erl:886-905), iteratively.
+
+    The reference's recursive grow() runs on BEAM with no stack ceiling;
+    mutated data routinely contains thousands of consecutive openers (a
+    seq-repeat of '<' alone does it), so this walker keeps an explicit
+    stack. Nesting beyond max_depth treats further openers as literal
+    bytes — a documented pragmatic cap that also bounds every downstream
+    recursive tree walker.
+    """
+    _ensure_stack()
+    root: list = []
+    # frames: (close_byte, node_list); node[0] is the opener byte
+    stack: list[tuple[int, list]] = []
+    cur = root
+    for h in data:
+        if stack and h == stack[-1][0]:
+            close, node = stack.pop()
+            node.append(close)
+            parent = stack[-1][1] if stack else root
+            parent.append(node)
+            cur = parent
             continue
-        sub, j = _grow(data, i + 1, nxt)
-        if j is None:
-            return out + [h] + sub, None  # partial parse flattens
-        out.append([h] + sub)
-        i = j
-    return out, None
-
-
-def partial_parse(data: bytes) -> list:
-    """bytes -> tree (erlamsa_mutations.erl:886-905)."""
-    out: list = []
-    i = 0
-    n = len(data)
-    while i < n:
-        h = data[i]
         close = _DELIMS.get(h)
-        if close is None:
-            out.append(h)
-            i += 1
+        if close is not None and len(stack) < max_depth:
+            node = [h]
+            stack.append((close, node))
+            cur = node
             continue
-        sub, j = _grow(data, i + 1, close)
-        if j is None:
-            return out + [h] + sub
-        out.append([h] + sub)
-        i = j
-    return out
+        cur.append(h)
+    # EOF with unclosed frames: flatten each partial node into its parent
+    # (the reference's failed grow() splices [H|This] into the enclosing
+    # level, keeping completed sublists intact)
+    while stack:
+        _close, node = stack.pop()
+        parent = stack[-1][1] if stack else root
+        parent.extend(node)
+    return root
 
 
-def flatten_tree(node) -> bytes:
+def flatten_tree(node, limit: int | None = None) -> bytes | None:
+    """Tree -> bytes. With `limit`, returns None as soon as the output
+    would exceed it — stutter/dup results can reference large shared
+    substructure at many positions, and materializing them unbounded is a
+    multi-GB trap (the reference leans on BEAM heap guards; we cap at the
+    caller's block limit instead)."""
     out = bytearray()
     stack = [node]
     while stack:
         x = stack.pop()
         if isinstance(x, int):
             out.append(x & 0xFF)
+            if limit is not None and len(out) > limit:
+                return None
         else:
             stack.extend(reversed(x))
     return bytes(out)

@@ -505,7 +505,10 @@ def tree_op(ctx: Ctx, op, name: str):
             return fn, ll, meta, -1
         tree = treeops.partial_parse(h)
         new = op(ctx.r, tree)
-        return fn, [treeops.flatten_tree(new)] + ll[1:], [(name, 1)] + meta, 1
+        flat = treeops.flatten_tree(new, limit=ABSMAX_BINARY_BLOCK)
+        if flat is None:  # oversized result: failed try
+            return fn, ll, meta, -1
+        return fn, [flat] + ll[1:], [(name, 1)] + meta, 1
 
     return fn
 
@@ -519,7 +522,10 @@ def tree_swap(ctx: Ctx, op, name: str):
         new = op(ctx.r, tree)
         if new is None:
             return fn, ll, meta, -1
-        return fn, [treeops.flatten_tree(new)] + ll[1:], [(name, 1)] + meta, 1
+        flat = treeops.flatten_tree(new, limit=ABSMAX_BINARY_BLOCK)
+        if flat is None:
+            return fn, ll, meta, -1
+        return fn, [flat] + ll[1:], [(name, 1)] + meta, 1
 
     return fn
 
@@ -533,7 +539,10 @@ def tree_stutter(ctx: Ctx):
         new = treeops.sed_tree_stutter(ctx.r, tree)
         if new is None:
             return fn, ll, meta, -1
-        return fn, [treeops.flatten_tree(new)] + ll[1:], [("tree_stutter", 1)] + meta, 1
+        flat = treeops.flatten_tree(new, limit=ABSMAX_BINARY_BLOCK)
+        if flat is None:
+            return fn, ll, meta, -1
+        return fn, [flat] + ll[1:], [("tree_stutter", 1)] + meta, 1
 
     return fn
 

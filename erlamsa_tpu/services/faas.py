@@ -108,7 +108,9 @@ class _Handler(BaseHTTPRequestHandler):
                     session,
                     ctype="application/json",
                 )
-            except (ValueError, KeyError) as e:
+            except (ValueError, KeyError, SystemExit) as e:
+                # _parse_actions raises SystemExit for unknown names —
+                # a bad request here, not a server exit
                 self._reply(400, f"bad request: {e}".encode())
             return
         if path.endswith(("erlamsa_esi:manage", "/manage")):

@@ -167,3 +167,15 @@ def test_fixed_seed_deterministic_across_wall_clock():
     time.sleep(1.1)
     b = fuzz(b"batch me 123\n", seed=(1, 2, 3))
     assert a == b
+
+
+def test_pathological_nesting_soak():
+    """Regression: seq-repeat can emit thousands of consecutive delimiter
+    openers; the tree parser must stay iterative/bounded (a 200-case CLI
+    soak used to die with RecursionError here)."""
+    from erlamsa_tpu.models.treeops import flatten_tree, partial_parse
+
+    data = b"<" * 5000 + b"x" + b")" * 3000
+    assert flatten_tree(partial_parse(data)) == data
+    out = fuzz(b"(" * 2500 + b"payload", seed=(13, 13, 13))
+    assert isinstance(out, bytes)
