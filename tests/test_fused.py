@@ -258,3 +258,39 @@ def test_fused_pipeline_runs():
     outs = unpack(Batch(data, lens))
     assert sum(1 for o in outs if o != DOC) > B * 0.5
     assert np.asarray(sc).min() >= 2 and np.asarray(sc).max() <= 10
+
+
+def test_device_sizer_detection_is_valid():
+    """Device sizer finds are independently valid: the field value equals
+    the distance to the buffer end. (The device scan covers ALL offsets
+    at u8/u16/u32 widths — broader than the oracle's offset<=n/5 sampling,
+    narrower in width (no u64); neither is a subset of the other.)"""
+    import struct
+
+    from erlamsa_tpu.ops.sizer import detect_sizer
+
+    payload = b"P" * 23
+    cases = [
+        b"HDR" + struct.pack(fmt, len(payload)) + payload
+        for fmt in ("B", ">H", "<H", ">I", "<I")
+    ]
+    # the low half of a u64be tail sizer is itself a valid u32be tail sizer
+    cases.append(b"HDR" + struct.pack(">Q", len(payload)) + payload)
+    cases.append(b"no sizer here at all......")
+
+    for data in cases:
+        batch = pack([data], capacity=L)
+        keys = prng.sample_keys(prng.case_key(prng.base_key(1), 0), 1)
+        found, a, w, kind = jax.jit(jax.vmap(detect_sizer))(
+            keys, batch.data, batch.lens
+        )
+        has_field = data[:3] == b"HDR"
+        assert bool(found[0]) == has_field, data
+        if not has_field:
+            continue
+        dev_a, dev_w, dev_kind = int(a[0]), int(w[0]), int(kind[0])
+        fieldbytes = data[dev_a : dev_a + dev_w]
+        endian = "little" if dev_kind in (2, 4) else "big"
+        value = int.from_bytes(fieldbytes, endian)
+        assert value == len(data) - dev_a - dev_w, (data, dev_a, dev_w, value)
+        assert value > 2
