@@ -20,15 +20,27 @@ def save_state(path: str, seed, case_idx: int, scores) -> None:
     """Atomic write (tmp + rename): a kill mid-save — the very interruption
     checkpoints exist for — must never corrupt the previous checkpoint."""
     tmp = path + ".tmp"
-    np.savez(
-        tmp,
-        seed=np.asarray(seed, np.int64),
-        case_idx=np.asarray(case_idx, np.int64),
-        scores=np.asarray(scores, np.int32),
-    )
-    # np.savez appends .npz when missing; normalize
-    written = tmp if os.path.exists(tmp) else tmp + ".npz"
-    os.replace(written, path)
+    with open(tmp, "wb") as f:
+        np.savez(
+            f,
+            seed=np.asarray(seed, np.int64),
+            case_idx=np.asarray(case_idx, np.int64),
+            scores=np.asarray(scores, np.int32),
+        )
+        # data must be durable BEFORE the rename publishes it, or a crash
+        # right after os.replace leaves a truncated checkpoint and the run
+        # silently restarts from case 0
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+    try:
+        dfd = os.open(os.path.dirname(os.path.abspath(path)), os.O_RDONLY)
+        try:
+            os.fsync(dfd)
+        finally:
+            os.close(dfd)
+    except OSError:
+        pass
 
 
 def load_state(path: str):

@@ -32,10 +32,17 @@ def _send_json(sock: socket.socket, obj: dict):
     sock.sendall(json.dumps(obj).encode() + b"\n")
 
 
+# a peer streaming one endless line must not exhaust memory; 64 MiB covers
+# any legitimate base64 fuzz payload (10 MB log cap * 4/3 with headroom)
+MAX_LINE = 64 * 1024 * 1024
+
+
 def _recv_json(f) -> dict | None:
-    line = f.readline()
+    line = f.readline(MAX_LINE + 1)
     if not line:
         return None
+    if len(line) > MAX_LINE:
+        raise ValueError("oversized protocol line")
     return json.loads(line)
 
 
@@ -118,7 +125,7 @@ class ParentServer:
         if node is not None:
             try:
                 return remote_fuzz(node[0], node[1], data)
-            except OSError:
+            except (OSError, ValueError):
                 logger.log("warning", "node %s:%d failed, fuzzing locally", *node)
         return self.local.fuzz(data, dict(self.opts))
 
@@ -184,8 +191,8 @@ class WorkerNode:
                 try:
                     with socket.create_connection(self.parent, timeout=5) as s:
                         _send_json(s, {"op": "join", "port": my_port})
-                        s.makefile("rb").readline()
-                except OSError as e:
+                        _recv_json(s.makefile("rb"))
+                except (OSError, ValueError) as e:
                     logger.log("warning", "keepalive to parent failed: %s", e)
                 self._stop.wait(NODE_KEEPALIVE)
 

@@ -349,26 +349,59 @@ class FuzzProxy:
     # --- UDP (loop_udp, erlamsa_fuzzproxy.erl:226-259) --------------------
 
     def _serve_udp(self):
+        import select
+
         srv = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
         srv.bind(("0.0.0.0", self.lport))
         self._srv = srv
+        # upstream-facing socket: client packets go out of it, so server
+        # replies come back to ITS ephemeral port — select over both, like
+        # the reference receiving on SrvSocket and ClSocket
+        # (erlamsa_fuzzproxy.erl:226-259)
         up = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        up.bind(("0.0.0.0", 0))
+        try:
+            r_ip = socket.gethostbyname(self.rhost)
+        except OSError:
+            r_ip = self.rhost
         client_addr = None
-        n = 0
+        counts = {"c->s": 0, "s->c": 0}
         conn_state: dict = {}
-        while not self._stop.is_set():
-            try:
-                data, addr = srv.recvfrom(65536)
-            except OSError:
-                break
-            if addr[0] != self.rhost or addr[1] != self.rport:
-                client_addr = addr
-                n += 1
-                out = self._fuzz_maybe(data, self.prob_cs, n, "c->s", conn_state)
-                up.sendto(out, (self.rhost, self.rport))
-            elif client_addr:
-                out = self._fuzz_maybe(data, self.prob_sc, n, "s->c", conn_state)
-                srv.sendto(out, client_addr)
+        try:
+            while not self._stop.is_set():
+                try:
+                    rd, _w, _x = select.select([srv, up], [], [], 1.0)
+                except (OSError, ValueError):
+                    # stop() closes srv from another thread; a closed
+                    # socket's fileno() is -1 which select rejects
+                    break
+                for sock in rd:
+                    try:
+                        data, addr = sock.recvfrom(65536)
+                    except OSError:
+                        return
+                    is_server = sock is up or (
+                        addr[0] == r_ip and addr[1] == self.rport
+                    )
+                    if is_server:
+                        if client_addr is None:
+                            continue
+                        counts["s->c"] += 1
+                        out = self._fuzz_maybe(
+                            data, self.prob_sc, counts["s->c"], "s->c",
+                            conn_state,
+                        )
+                        srv.sendto(out, client_addr)
+                    else:
+                        client_addr = addr
+                        counts["c->s"] += 1
+                        out = self._fuzz_maybe(
+                            data, self.prob_cs, counts["c->s"], "c->s",
+                            conn_state,
+                        )
+                        up.sendto(out, (r_ip, self.rport))
+        finally:
+            up.close()
 
     def start(self, block: bool = True):
         if self.proto == "serial":

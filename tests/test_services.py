@@ -298,6 +298,42 @@ def test_oracle_batcher():
     assert out == out2
 
 
+def test_proxy_udp_both_directions():
+    # UDP echo upstream; replies arrive on the proxy's upstream-facing
+    # socket's ephemeral port, which the fixed loop must read and relay
+    # back (the s->c direction the reference covers in loop_udp,
+    # erlamsa_fuzzproxy.erl:226-259)
+    up = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    up.bind(("127.0.0.1", 0))
+    up_port = up.getsockname()[1]
+
+    def echo():
+        while True:
+            try:
+                data, addr = up.recvfrom(65536)
+            except OSError:
+                return
+            up.sendto(b"reply:" + data, addr)
+
+    threading.Thread(target=echo, daemon=True).start()
+
+    lport = _free_port()
+    # passthrough both ways (prob 0): datagrams must arrive unmodified
+    proxy = FuzzProxy(f"udp://{lport}:localhost:{up_port}", "0.0,0.0",
+                      {"seed": (1, 2, 3), "workers": 2})
+    proxy.start(block=False)
+    time.sleep(0.3)
+
+    c = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    c.settimeout(10)
+    c.sendto(b"udp payload", ("127.0.0.1", lport))
+    back, _ = c.recvfrom(65536)
+    proxy.stop()
+    up.close()
+    c.close()
+    assert back == b"reply:udp payload"
+
+
 def test_parse_proxy_spec_variants():
     assert parse_proxy_spec("connect://8080::") == ("connect", 8080, "", 0)
     assert parse_proxy_spec("serial:///dev/ttyS0@9600:/dev/ttyS1@115200") == (
