@@ -1,0 +1,243 @@
+%% erlamsa external module: the `xla` mutation backend (the north star's
+%% `-m xla`). Load into erlamsa with:
+%%
+%%     erlc erlamsa_mutations_xla.erl     % beam next to erlamsa's ebin
+%%     ./erlamsa -e erlamsa_mutations_xla -m xla ...
+%%
+%% Module shape follows external_muta.erl:1-21 (capabilities/0 +
+%% mutations/0), loaded via erlamsa_cmdparse:parse_external
+%% (src/erlamsa_cmdparse.erl:456-470). The actual mutation work happens in
+%% the Python/JAX server (`python3 -m erlamsa_tpu.services.xla_bridge`)
+%% over an Erlang port speaking the {packet,4} frame protocol documented
+%% in bridge/PROTOCOL.md.
+%%
+%% Determinism: each mutation event ships this process's live AS183 state
+%% (the process-dictionary `random_seed` that erlamsa_rnd's legacy
+%% `random` module keeps, src/erlamsa_rnd.erl:72-73); every draw happens
+%% server-side against that exact state and the advanced state is written
+%% back — so at fixed seed the combined stream is deterministic, and the
+%% server's draws are draw-for-draw the ones `-m default` would make.
+
+-module(erlamsa_mutations_xla).
+
+-export([capabilities/0, mutations/0]).
+-export([fuzz_case/2, fuzz_case/4, fuzz_batch/3, ping/0]).
+%% internal (spawned)
+-export([bridge_loop_init/1]).
+
+-define(OP_HELLO, 16#01).
+-define(OP_FUZZ_CASE, 16#02).
+-define(OP_MUX_EVENT, 16#03).
+-define(OP_FUZZ_BATCH, 16#05).
+-define(OP_PING, 16#7E).
+-define(OP_ERROR, 16#FF).
+-define(RESP, 16#80).
+-define(CALL_TIMEOUT, 90000).   %% src/erlamsa_fsupervisor.erl:83-86 budget
+
+%%% ------------------------------------------------------------------
+%%% external-module contract
+%%% ------------------------------------------------------------------
+
+capabilities() -> {mutations, external}.
+
+mutations() ->
+    MaxScore = erlamsa_mutations:get_max_score(),
+    [{MaxScore, 2, fun xla_mutate/2, xla,
+      "mutation via the erlamsa_tpu XLA/TPU bridge"}].
+
+%% One mux event delegated to the server (MUX_EVENT op): mutate the head
+%% block, keep the tail, thread the AS183 state through the wire.
+xla_mutate(Ll = [H | T], Meta) when is_binary(H) ->
+    {S1, S2, S3} = current_rand_state(),
+    Header = ["{\"state\": [", integer_to_list(S1), ",",
+              integer_to_list(S2), ",", integer_to_list(S3), "]}"],
+    case call_bridge(?OP_MUX_EVENT, Header, H) of
+        {ok, RespHeader, Data} ->
+            case parse_int_array(RespHeader, <<"state">>) of
+                [N1, N2, N3] -> put(random_seed, {N1, N2, N3});
+                _ -> ok
+            end,
+            Result = erlamsa_utils:flush_bvecs(Data, T),
+            {fun xla_mutate/2, Result, [{muta_xla, 1} | Meta], 1};
+        {error, Reason} ->
+            %% negative delta: the self-adjusting scheduler lowers our
+            %% score when the bridge fails (src/erlamsa_mutations.erl:1238)
+            {fun xla_mutate/2, Ll, [{muta_xla_failed, Reason} | Meta], -1}
+    end;
+xla_mutate(Ll, Meta) ->
+    {fun xla_mutate/2, Ll, Meta, -1}.
+
+%%% ------------------------------------------------------------------
+%%% direct helpers (parity + throughput paths)
+%%% ------------------------------------------------------------------
+
+%% Whole-case parity run: byte-identical to the erlamsa_tpu default
+%% stream for the same per-case ThreadSeed (PROTOCOL.md FUZZ_CASE).
+fuzz_case(Seed, Data) -> fuzz_case(Seed, Data, "default", "default").
+
+fuzz_case({S1, S2, S3}, Data, Mutations, Patterns) when is_binary(Data) ->
+    Header = ["{\"seed\": [", integer_to_list(S1), ",",
+              integer_to_list(S2), ",", integer_to_list(S3),
+              "], \"mutations\": \"", Mutations,
+              "\", \"patterns\": \"", Patterns, "\"}"],
+    case call_bridge(?OP_FUZZ_CASE, Header, Data) of
+        {ok, _RespHeader, Out} -> {ok, Out};
+        Err -> Err
+    end.
+
+%% Batched throughput call: one frame mutates a whole corpus batch on the
+%% device (PROTOCOL.md FUZZ_BATCH).
+fuzz_batch({S1, S2, S3}, CaseIdx, Samples) when is_list(Samples) ->
+    Lens = [byte_size(B) || B <- Samples],
+    Header = ["{\"seed\": [", integer_to_list(S1), ",",
+              integer_to_list(S2), ",", integer_to_list(S3),
+              "], \"case\": ", integer_to_list(CaseIdx),
+              ", \"lens\": ", int_array(Lens),
+              ", \"backend\": \"tpu\"}"],
+    case call_bridge(?OP_FUZZ_BATCH, Header, list_to_binary(Samples)) of
+        {ok, RespHeader, Out} ->
+            {ok, split_blob(Out, parse_int_array(RespHeader, <<"lens">>))};
+        Err -> Err
+    end.
+
+ping() ->
+    case call_bridge(?OP_PING, "{}", <<>>) of
+        {ok, _, _} -> pong;
+        Err -> Err
+    end.
+
+%%% ------------------------------------------------------------------
+%%% bridge owner process + port plumbing
+%%% ------------------------------------------------------------------
+
+current_rand_state() ->
+    case get(random_seed) of
+        {A, B, C} -> {A, B, C};
+        _ -> {3172, 9814, 20125}   %% random module's default seed
+    end.
+
+server_command() ->
+    case os:getenv("ERLAMSA_XLA_BRIDGE_CMD") of
+        false ->
+            {os:find_executable("python3"),
+             ["-m", "erlamsa_tpu.services.xla_bridge"]};
+        Cmd ->
+            [Exe | Args] = string:tokens(Cmd, " "),
+            {os:find_executable(Exe), Args}
+    end.
+
+ensure_bridge() ->
+    case whereis(erlamsa_xla_bridge) of
+        undefined ->
+            Caller = self(),
+            Pid = spawn(?MODULE, bridge_loop_init, [Caller]),
+            receive
+                {bridge_up, Pid} -> Pid;
+                {bridge_failed, Pid, Reason} -> {error, Reason}
+            after ?CALL_TIMEOUT -> {error, bridge_start_timeout}
+            end;
+        Pid -> Pid
+    end.
+
+bridge_loop_init(Caller) ->
+    try register(erlamsa_xla_bridge, self()) of
+        true ->
+            {Exe, Args} = server_command(),
+            Port = open_port({spawn_executable, Exe},
+                             [{args, Args}, {packet, 4}, binary,
+                              use_stdio, exit_status, hide]),
+            port_command(Port, frame(?OP_HELLO, "{\"version\": 1}", <<>>)),
+            receive
+                {Port, {data, _HelloResp}} ->
+                    Caller ! {bridge_up, self()},
+                    bridge_loop(Port);
+                {Port, {exit_status, St}} ->
+                    Caller ! {bridge_failed, self(), {exit_status, St}}
+            after ?CALL_TIMEOUT ->
+                Caller ! {bridge_failed, self(), hello_timeout}
+            end
+    catch
+        error:badarg ->
+            %% lost the registration race; the winner serves everyone
+            Caller ! {bridge_up, whereis(erlamsa_xla_bridge)}
+    end.
+
+bridge_loop(Port) ->
+    receive
+        {req, From, Ref, Op, Header, Payload} ->
+            port_command(Port, frame(Op, Header, Payload)),
+            receive
+                {Port, {data, Resp}} -> From ! {Ref, decode(Resp)};
+                {Port, {exit_status, St}} ->
+                    From ! {Ref, {error, {exit_status, St}}},
+                    exit(normal)
+            after ?CALL_TIMEOUT ->
+                From ! {Ref, {error, timeout}}
+            end,
+            bridge_loop(Port);
+        {Port, {exit_status, _}} -> exit(normal);
+        stop -> port_close(Port)
+    end.
+
+call_bridge(Op, Header, Payload) ->
+    case ensure_bridge() of
+        {error, _} = E -> E;
+        Pid ->
+            Ref = make_ref(),
+            Pid ! {req, self(), Ref, Op, iolist_to_binary(Header), Payload},
+            receive {Ref, Reply} -> Reply
+            after ?CALL_TIMEOUT -> {error, timeout}
+            end
+    end.
+
+%% frame payload: opcode byte + JSON header + 0x00 + raw bytes
+%% ({packet,4} adds the 4-byte big-endian length)
+frame(Op, Header, Payload) ->
+    [<<Op:8>>, Header, <<0:8>>, Payload].
+
+decode(<<?OP_ERROR:8, Rest/binary>>) ->
+    {Header, _} = split_header(Rest),
+    {error, Header};
+decode(<<Op:8, Rest/binary>>) when Op band ?RESP =/= 0 ->
+    {Header, Data} = split_header(Rest),
+    {ok, Header, Data};
+decode(Other) ->
+    {error, {bad_frame, Other}}.
+
+split_header(Bin) ->
+    case binary:split(Bin, <<0>>) of
+        [H, D] -> {H, D};
+        [H] -> {H, <<>>}
+    end.
+
+%%% ------------------------------------------------------------------
+%%% minimal JSON helpers (only what the protocol headers need; no deps —
+%%% the reference's OTP floor, 18.0 per .travis.yml, has no stdlib json)
+%%% ------------------------------------------------------------------
+
+int_array(Ints) ->
+    ["[", string:join([integer_to_list(I) || I <- Ints], ","), "]"].
+
+%% Extract `"key": [int, int, ...]` from a flat JSON object binary.
+parse_int_array(Bin, Key) ->
+    Pat = <<$", Key/binary, $">>,
+    case binary:split(Bin, Pat) of
+        [_, Rest] ->
+            case binary:split(Rest, <<"[">>) of
+                [_, Rest2] ->
+                    case binary:split(Rest2, <<"]">>) of
+                        [Inner, _] ->
+                            [list_to_integer(string:strip(S))
+                             || S <- string:tokens(binary_to_list(Inner), ","),
+                                S =/= ""];
+                        _ -> []
+                    end;
+                _ -> []
+            end;
+        _ -> []
+    end.
+
+split_blob(_Bin, []) -> [];
+split_blob(Bin, [N | T]) ->
+    <<H:N/binary, Rest/binary>> = Bin,
+    [H | split_blob(Rest, T)].

@@ -1,0 +1,244 @@
+"""Bridge protocol tests: a mock Erlang-side client speaking
+bridge/PROTOCOL.md frames against the real server, over both transports
+(stdio port mode, as erlamsa's open_port({packet,4}) would; and TCP).
+
+No Erlang/OTP exists in this image, so bridge/erlamsa_mutations_xla.erl
+can't be compiled here — these tests stand in for its half of the
+conversation byte-for-byte (same frames, same state-threading contract).
+"""
+
+import os
+import socket
+import struct
+import subprocess
+import sys
+import time
+
+import pytest
+
+from erlamsa_tpu.services.xla_bridge import (
+    OP_ERROR,
+    OP_FUZZ_BATCH,
+    OP_FUZZ_CASE,
+    OP_HELLO,
+    OP_MUX_EVENT,
+    OP_PING,
+    RESP,
+    decode_body,
+    encode_frame,
+    serve_tcp,
+)
+
+# ---- pure framing ---------------------------------------------------------
+
+
+def test_frame_roundtrip():
+    f = encode_frame(OP_FUZZ_CASE, {"seed": [1, 2, 3]}, b"\x00payload\xff")
+    (ln,) = struct.unpack(">I", f[:4])
+    assert ln == len(f) - 4
+    op, header, payload = decode_body(f[4:])
+    assert op == OP_FUZZ_CASE
+    assert header == {"seed": [1, 2, 3]}
+    assert payload == b"\x00payload\xff"
+
+
+def test_frame_empty_payload_keeps_separator():
+    f = encode_frame(OP_PING, {})
+    op, header, payload = decode_body(f[4:])
+    assert (op, header, payload) == (OP_PING, {}, b"")
+
+
+# ---- stdio port mode (what erlamsa's open_port speaks) --------------------
+
+
+class PortClient:
+    """Mock of the Erlang side: {packet,4} frames over a child's stdio."""
+
+    def __init__(self):
+        env = dict(os.environ)
+        env.pop("PALLAS_AXON_POOL_IPS", None)
+        env["JAX_PLATFORMS"] = "cpu"
+        self.proc = subprocess.Popen(
+            [sys.executable, "-m", "erlamsa_tpu.services.xla_bridge"],
+            stdin=subprocess.PIPE,
+            stdout=subprocess.PIPE,
+            env=env,
+        )
+
+    def call(self, opcode, header, payload=b""):
+        self.proc.stdin.write(encode_frame(opcode, header, payload))
+        self.proc.stdin.flush()
+        hdr = self.proc.stdout.read(4)
+        assert len(hdr) == 4, "server closed the port"
+        (ln,) = struct.unpack(">I", hdr)
+        body = self.proc.stdout.read(ln)
+        return decode_body(body)
+
+    def close(self):
+        self.proc.stdin.close()
+        self.proc.wait(timeout=30)
+
+
+@pytest.fixture(scope="module")
+def port_client():
+    c = PortClient()
+    op, header, _ = c.call(OP_HELLO, {"version": 1})
+    assert op == OP_HELLO | RESP
+    assert header["ok"] is True
+    assert set(header["backends"]) == {"oracle", "tpu"}
+    yield c
+    c.close()
+
+
+def test_port_ping(port_client):
+    op, _, _ = port_client.call(OP_PING, {})
+    assert op == OP_PING | RESP
+
+
+def test_port_fuzz_case_matches_direct_oracle(port_client):
+    from erlamsa_tpu.oracle.engine import fuzz
+
+    data = b"Hello erlamsa bridge! value=123 name=test\n" * 4
+    op, header, out = port_client.call(
+        OP_FUZZ_CASE, {"seed": [11, 22, 33]}, data
+    )
+    assert op == OP_FUZZ_CASE | RESP
+    assert header["len"] == len(out)
+    # parity: whole-case delegation is byte-identical to the direct
+    # library call at the same ThreadSeed (PROTOCOL.md FUZZ_CASE contract)
+    assert out == fuzz(data, seed=(11, 22, 33))
+    # and deterministic across calls
+    _, _, out2 = port_client.call(OP_FUZZ_CASE, {"seed": [11, 22, 33]}, data)
+    assert out2 == out
+
+
+def test_port_fuzz_case_mutation_subset(port_client):
+    data = b"abcdefgh" * 8
+    _, _, out = port_client.call(
+        OP_FUZZ_CASE,
+        {"seed": [1, 2, 3], "mutations": "bf=1", "patterns": "od"},
+        data,
+    )
+    # bf flips exactly one bit: same length, exactly one byte differs
+    assert len(out) == len(data)
+    diff = [i for i in range(len(data)) if out[i] != data[i]]
+    assert len(diff) == 1
+
+
+def test_port_mux_event_threads_state(port_client):
+    from erlamsa_tpu.oracle.mutations import Ctx, apply_mux, make_mutator
+    from erlamsa_tpu.oracle.mutations import default_mutations
+    from erlamsa_tpu.utils.erlrand import ErlRand
+
+    data = b"mux event payload: 12345 67890 abcdef\n" * 3
+    state = [1001, 2002, 3003]
+    op, header, out = port_client.call(
+        OP_MUX_EVENT, {"state": state}, data
+    )
+    assert op == OP_MUX_EVENT | RESP
+    new_state = header["state"]
+    assert len(new_state) == 3 and new_state != state
+
+    # the server must be doing exactly make_mutator + one apply_mux on
+    # that AS183 state (the -m default draws, PROTOCOL.md MUX_EVENT)
+    r = ErlRand()
+    r.setstate(tuple(state))
+    ctx = Ctx(r)
+    rows = make_mutator(ctx, default_mutations())
+    _rows, ll, _meta = apply_mux(ctx, rows, [data], [])
+    expect = b"".join(b for b in ll if isinstance(b, bytes))
+    assert out == expect
+    assert tuple(new_state) == r.getstate()
+
+
+def test_port_error_paths():
+    c = PortClient()
+    # op before HELLO is rejected
+    op, header, _ = c.call(OP_FUZZ_CASE, {"seed": [1, 2, 3]}, b"x")
+    assert op == OP_ERROR
+    assert "HELLO" in header["error"]
+    c.call(OP_HELLO, {"version": 1})
+    # unknown opcode
+    op, header, _ = c.call(0x42, {})
+    assert op == OP_ERROR
+    # bad request inside a handler must not kill the port
+    op, header, _ = c.call(OP_FUZZ_BATCH, {"seed": [1, 2, 3], "lens": [999]}, b"xy")
+    assert op == OP_ERROR
+    op, _, _ = c.call(OP_PING, {})
+    assert op == OP_PING | RESP
+    c.close()
+
+
+# ---- TCP transport + batch ops -------------------------------------------
+
+
+class TcpClient:
+    def __init__(self, port):
+        # generous: the tpu-backend op compiles a fresh XLA shape on first
+        # use, which can take minutes on a loaded CI host
+        self.sock = socket.create_connection(("127.0.0.1", port), timeout=300)
+
+    def call(self, opcode, header, payload=b""):
+        self.sock.sendall(encode_frame(opcode, header, payload))
+        hdr = b""
+        while len(hdr) < 4:
+            hdr += self.sock.recv(4 - len(hdr))
+        (ln,) = struct.unpack(">I", hdr)
+        body = b""
+        while len(body) < ln:
+            body += self.sock.recv(ln - len(body))
+        return decode_body(body)
+
+    def close(self):
+        self.sock.close()
+
+
+@pytest.fixture(scope="module")
+def tcp_client():
+    srv = serve_tcp(0, block=False)
+    port = srv.getsockname()[1]
+    time.sleep(0.1)
+    c = TcpClient(port)
+    op, header, _ = c.call(OP_HELLO, {"version": 1})
+    assert header["ok"] is True
+    yield c
+    c.close()
+    srv.close()
+
+
+def test_tcp_fuzz_batch_oracle_backend(tcp_client):
+    from erlamsa_tpu.oracle.engine import fuzz
+    from erlamsa_tpu.utils.erlrand import ErlRand
+
+    samples = [b"sample one 111\n", b"sample two 22222\n" * 2, b"x" * 64]
+    blob = b"".join(samples)
+    op, header, out = tcp_client.call(
+        OP_FUZZ_BATCH,
+        {"seed": [5, 6, 7], "case": 0, "lens": [len(s) for s in samples],
+         "backend": "oracle"},
+        blob,
+    )
+    assert op == OP_FUZZ_BATCH | RESP
+    lens = header["lens"]
+    assert len(lens) == len(samples) and sum(lens) == len(out)
+
+    # per-sample ThreadSeed derivation matches the engine discipline
+    parent = ErlRand((5, 6, 7))
+    pos = 0
+    for s, n in zip(samples, lens):
+        ts = (parent.erand(99999), parent.erand(99999), parent.erand(99999))
+        assert out[pos : pos + n] == fuzz(s, seed=ts)
+        pos += n
+
+
+def test_tcp_fuzz_batch_tpu_backend_deterministic(tcp_client):
+    samples = [bytes([i % 256]) * 96 for i in range(8)]
+    req = {"seed": [9, 9, 9], "case": 3, "lens": [len(s) for s in samples],
+           "backend": "tpu"}
+    blob = b"".join(samples)
+    op, h1, out1 = tcp_client.call(OP_FUZZ_BATCH, req, blob)
+    assert op == OP_FUZZ_BATCH | RESP
+    _, h2, out2 = tcp_client.call(OP_FUZZ_BATCH, req, blob)
+    assert (h1["lens"], out1) == (h2["lens"], out2)
+    # something mutated
+    assert out1 != blob
