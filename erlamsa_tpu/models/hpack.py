@@ -1,14 +1,18 @@
 """HPACK (RFC 7541) header compression — decoder/encoder.
 
-Reference vendors cowlib's cow_hpack (src/cow_hpack.erl) for its HTTP/2
-proxy path. This implementation covers integer/string primitives, the full
-static table, and a size-managed dynamic table. Huffman-coded strings are
-recognized but returned opaque (name/value marked raw) — the proxy only
-needs HPACK to track state while passing HEADERS through unmodified, and
-re-encoding always uses non-huffman literals (always legal per the RFC).
+Reference vendors cowlib's cow_hpack (src/cow_hpack.erl +
+src/cow_hpack_dec_huffman_lookup.hrl) for its HTTP/2 proxy path. This
+implementation covers integer/string primitives, Huffman string coding
+(models/huffman.py), the full static table, and a size-managed dynamic
+table — so http2 header fuzzing sees real decoded strings. Invalid
+Huffman payloads fall back to an opaque ``?huff:`` marker rather than
+failing the whole block; re-encoding uses non-huffman literals (always
+legal per the RFC).
 """
 
 from __future__ import annotations
+
+from .huffman import huffman_decode
 
 STATIC_TABLE = [
     (b":authority", b""), (b":method", b"GET"), (b":method", b"POST"),
@@ -68,11 +72,18 @@ def decode_integer(data: bytes, pos: int, prefix_bits: int) -> tuple[int, int]:
 
 
 def decode_string(data: bytes, pos: int) -> tuple[bytes, bool, int]:
-    """Returns (raw, is_huffman, next_pos); huffman payloads stay opaque."""
+    """Returns (string, is_opaque, next_pos). Huffman payloads are decoded
+    to their real octets; is_opaque is True only when a huffman payload is
+    invalid and must be carried raw (caller marks it)."""
     huff = bool(data[pos] & 0x80)
     length, pos = decode_integer(data, pos, 7)
     raw = data[pos : pos + length]
-    return raw, huff, pos + length
+    if huff:
+        try:
+            return huffman_decode(raw), False, pos + length
+        except ValueError:
+            return raw, True, pos + length
+    return raw, False, pos + length
 
 
 def encode_string(s: bytes) -> bytes:
@@ -85,29 +96,25 @@ class HpackContext:
 
     def __init__(self, max_size: int = 4096):
         self.max_size = max_size
-        self.dynamic: list[tuple[bytes, bytes]] = []
-
-    @staticmethod
-    def _entry_len(s: bytes) -> int:
-        """RFC table size uses the DECODED octet length; huffman-opaque
-        entries carry a '?huff:' marker that must not count, and huffman
-        decoding shrinks ~4:3, so approximate with the coded length."""
-        if s.startswith(b"?huff:"):
-            return len(s) - 6
-        return len(s)
+        # (name, value, rfc_size): size is tracked OUT OF BAND, computed
+        # from the wire-decoded octet lengths at insert time, so a decoded
+        # value that happens to start with the '?huff:' fallback marker
+        # can't skew the accounting
+        self.dynamic: list[tuple[bytes, bytes, int]] = []
 
     def _size(self) -> int:
-        return sum(
-            self._entry_len(n) + self._entry_len(v) + 32
-            for n, v in self.dynamic
-        )
+        return sum(sz for _n, _v, sz in self.dynamic)
 
     def _evict(self):
         while self.dynamic and self._size() > self.max_size:
             self.dynamic.pop()
 
-    def add(self, name: bytes, value: bytes):
-        self.dynamic.insert(0, (name, value))
+    def add(self, name: bytes, value: bytes, entry_size: int | None = None):
+        """entry_size: RFC 7541 §4.1 decoded-octets size (len(name) +
+        len(value) + 32); derived from the stored strings when omitted."""
+        if entry_size is None:
+            entry_size = len(name) + len(value) + 32
+        self.dynamic.insert(0, (name, value, entry_size))
         self._evict()
 
     def lookup(self, index: int) -> tuple[bytes, bytes]:
@@ -115,12 +122,12 @@ class HpackContext:
             return STATIC_TABLE[index - 1]
         dyn = index - len(STATIC_TABLE) - 1
         if 0 <= dyn < len(self.dynamic):
-            return self.dynamic[dyn]
+            return self.dynamic[dyn][:2]
         raise IndexError(f"hpack index {index} out of range")
 
     def decode(self, block: bytes) -> list[tuple[bytes, bytes]]:
-        """Header block -> [(name, value)]; huffman strings come back as
-        (b'?huff', raw) markers."""
+        """Header block -> [(name, value)] with huffman strings decoded;
+        invalid huffman payloads come back marked b'?huff:'+raw."""
         headers = []
         pos = 0
         while pos < len(block):
@@ -130,13 +137,16 @@ class HpackContext:
                 headers.append(self.lookup(idx))
             elif b & 0x40:  # literal with incremental indexing
                 idx, pos = decode_integer(block, pos, 6)
-                name = self.lookup(idx)[0] if idx else None
-                if name is None:
+                if idx:
+                    name = self.lookup(idx)[0]
+                    name_sz = len(name)
+                else:
                     raw, hf, pos = decode_string(block, pos)
                     name = b"?huff:" + raw if hf else raw
+                    name_sz = len(raw)
                 raw, hf, pos = decode_string(block, pos)
                 value = b"?huff:" + raw if hf else raw
-                self.add(name, value)
+                self.add(name, value, name_sz + len(raw) + 32)
                 headers.append((name, value))
             elif b & 0x20:  # dynamic table size update
                 size, pos = decode_integer(block, pos, 5)

@@ -109,6 +109,72 @@ def test_hpack_incremental_indexing_updates_table():
     assert ctx.decode(encode_integer(62, 7, 0x80)) == [(b"foo", b"bar")]
 
 
+def test_huffman_rfc_vectors():
+    """RFC 7541 Appendix C encoded strings."""
+    from erlamsa_tpu.models.huffman import huffman_decode, huffman_encode
+
+    vectors = [
+        (b"www.example.com", "f1e3c2e5f23a6ba0ab90f4ff"),       # C.4.1
+        (b"no-cache", "a8eb10649cbf"),                           # C.4.2
+        (b"custom-key", "25a849e95ba97d7f"),                     # C.4.3
+        (b"custom-value", "25a849e95bb8e8b4bf"),                 # C.4.3
+        (b"302", "6402"),                                        # C.6.1
+        (b"private", "aec3771a4b"),                              # C.6.1
+    ]
+    for plain, hexcoded in vectors:
+        assert huffman_encode(plain) == bytes.fromhex(hexcoded)
+        assert huffman_decode(bytes.fromhex(hexcoded)) == plain
+
+
+def test_huffman_roundtrip_and_errors():
+    import pytest as _pytest
+
+    from erlamsa_tpu.models.huffman import huffman_decode, huffman_encode
+
+    rng = __import__("random").Random(7)
+    for n in (0, 1, 7, 64, 300):
+        s = bytes(rng.randrange(256) for _ in range(n))
+        assert huffman_decode(huffman_encode(s)) == s
+    # padding of zeros is invalid (must be EOS prefix = all ones)
+    with _pytest.raises(ValueError):
+        huffman_decode(bytes.fromhex("f1e3c2e5f23a6ba0ab90f400"))
+    # 8+ bits of padding is invalid
+    with _pytest.raises(ValueError):
+        huffman_decode(huffman_encode(b"www") + b"\xff")
+
+
+def test_hpack_decodes_huffman_strings():
+    from erlamsa_tpu.models.huffman import huffman_encode
+
+    ctx = HpackContext()
+    # literal with incremental indexing, huffman name + value (0x80 flag)
+    coded_name = huffman_encode(b"custom-key")
+    coded_value = huffman_encode(b"custom-value")
+    block = (
+        bytes([0x40])
+        + encode_integer(len(coded_name), 7, 0x80) + coded_name
+        + encode_integer(len(coded_value), 7, 0x80) + coded_value
+    )
+    assert ctx.decode(block) == [(b"custom-key", b"custom-value")]
+    # the dynamic table stores the DECODED form
+    assert ctx.decode(encode_integer(62, 7, 0x80)) == [
+        (b"custom-key", b"custom-value")
+    ]
+
+
+def test_hpack_invalid_huffman_falls_back_opaque():
+    ctx = HpackContext()
+    bad = b"\x00\x00"  # zero padding bits: invalid huffman
+    block = (
+        bytes([0x00])  # literal without indexing, new name
+        + encode_string(b"x-bad")
+        + encode_integer(len(bad), 7, 0x80) + bad
+    )
+    (name, value), = ctx.decode(block)
+    assert name == b"x-bad"
+    assert value.startswith(b"?huff:")
+
+
 # ---- http2 --------------------------------------------------------------
 
 
