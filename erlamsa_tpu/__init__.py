@@ -23,4 +23,21 @@ Layout:
     services/  host shell: cli, out, proxy, faas, monitors, logger, dist
 """
 
-__version__ = "0.1.0"
+__version__ = "0.2.0"
+
+
+def fuzz(data: bytes, seed=None, **opts) -> bytes:
+    """One-call library API, the erlamsa_app:fuzz/1,2 seam
+    (src/erlamsa_app.erl:255-263):
+
+        import erlamsa_tpu
+        mutated = erlamsa_tpu.fuzz(b"some data")
+        mutated = erlamsa_tpu.fuzz(b"some data", seed=(1, 2, 3),
+                                   mutations=[("bf", 1)])
+
+    Runs one oracle case (random seed when none given). This is the A/B
+    parity surface (SURVEY.md §3.2); the batched device path is
+    erlamsa_tpu.ops.pipeline.fuzz_batch / services.batchrunner."""
+    from .oracle.engine import fuzz as _fuzz
+
+    return _fuzz(data, seed=seed, **opts)

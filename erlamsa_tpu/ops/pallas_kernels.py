@@ -11,12 +11,10 @@ are reproducible for a fixed (seed, case, sample) like the rest of the
 throughput path — but the bitstream differs from the jnp engine's threefry
 draws.
 
-STATUS: standalone + unit-tested; not yet wired into the fused engine
-(integration needs a batched apply stage outside the vmap, and the
-hardware-PRNG build needs validation on a real chip, which this image's
-relay currently blocks). pallas_enabled()/ERLAMSA_PALLAS is the reserved
-opt-in for that wiring. Runs in interpret mode off-TPU so the same tests
-cover CPU CI.
+STATUS: wired into the fused engine behind ERLAMSA_PALLAS=1 (the randmask
+apply, ops/fused.py) and tested end-to-end in interpret mode off-TPU, so
+the same tests cover CPU CI. The hardware-PRNG build still needs
+validation on a real chip (this image's relay has blocked chip access).
 """
 
 from __future__ import annotations

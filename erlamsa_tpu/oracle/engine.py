@@ -70,8 +70,12 @@ class Engine:
         self.skip = opts.get("skip", 0)
         self.sleep = opts.get("sleep", 0)
         self.maxfails = opts.get("maxfails", TOO_MANY_FAILED_ATTEMPTS)
+        # per-case wall-clock budget in seconds (reference MaxRunningTime,
+        # src/erlamsa_main.erl:211-220); 0/None = unlimited
+        self.max_running_time = opts.get("maxrunningtime") or 0
         self.post = opts.get("post") or (lambda d: d)
         self._rows = self.base_rows
+        self._case_gen = 0
 
     def run_case(self, case_idx: int) -> tuple[bytes, list]:
         """One fuzzing case: returns (mutated bytes, meta). The worker
@@ -83,6 +87,8 @@ class Engine:
         )
         worker = ErlRand(thread_seed)
         saved = self.ctx.r
+        self._case_gen += 1
+        gen = self._case_gen
         self.ctx.r = worker
         try:
             blocks, gen_meta = self.generator()
@@ -91,29 +97,48 @@ class Engine:
             out_blocks, new_rows, meta = self.pattern(
                 self.ctx, ll, rows, [("nth", case_idx)]
             )
-            if self.sequence_muta:
+            if self.sequence_muta and self._case_gen == gen:
+                # a case the watchdog abandoned must not clobber the live
+                # case's sequence state when its thread wakes up late
                 self._rows = new_rows
             data = self.post(b"".join(out_blocks))
             return data, meta
         finally:
+            # ctx.r is thread-local (see Ctx), so an abandoned case thread
+            # only ever touches its own slot here
             self.ctx.r = saved
 
     def run(self, writer: Callable[[int, bytes, list], None] | None = None) -> list[bytes]:
         """The fuzzing loop (erlamsa_main.erl:165-243). Returns collected
         outputs when no writer is given (return/direct mode)."""
+        from ..utils.watchdog import CaseTimeout, run_with_timeout
+
         acc: list[bytes] = []
         fails = 0
         i = 1
         while i <= self.n_cases:
             if fails > self.maxfails:
                 break
-            data, meta = self.run_case(i)
+            try:
+                data, meta = run_with_timeout(
+                    self.run_case, self.max_running_time, i
+                )
+            except CaseTimeout:
+                # reference kills the case worker and moves on
+                # (src/erlamsa_main.erl:211-220)
+                i += 1
+                continue
             if i > self.skip:
                 if writer is not None:
                     try:
-                        writer(i, data, meta)
+                        run_with_timeout(
+                            writer, self.max_running_time, i, data, meta
+                        )
                         fails = 0
-                    except ConnectionError:
+                    except (ConnectionError, CaseTimeout):
+                        # a hung writer is an output failure: back off and
+                        # let maxfails break the loop
+                        # (src/erlamsa_main.erl:170-175,203-207)
                         fails += 1
                         time.sleep((10 * fails) / 1000.0)
                         i += 1

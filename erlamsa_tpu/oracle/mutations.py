@@ -30,12 +30,30 @@ from . import generic, textmutas
 class Ctx:
     """Shared oracle context: the PRNG and host-side config (the reference
     keeps the latter in the global_config ets table,
-    src/erlamsa_app.erl:129)."""
+    src/erlamsa_app.erl:129).
+
+    The PRNG slot is THREAD-LOCAL (with the constructor's rand as the
+    shared default): a case abandoned by the per-case watchdog
+    (utils/watchdog.py) keeps running in its own thread, and it must keep
+    drawing from its own worker stream rather than racing the live case's
+    — the reference gets this isolation from per-case Erlang processes
+    (src/erlamsa_main.erl:180-221)."""
 
     def __init__(self, r: ErlRand, ssrf_host="localhost", ssrf_port=51234):
-        self.r = r
+        import threading
+
+        self._r_default = r
+        self._r_local = threading.local()
         self.ssrf_host = ssrf_host
         self.ssrf_port = ssrf_port
+
+    @property
+    def r(self) -> ErlRand:
+        return getattr(self._r_local, "value", None) or self._r_default
+
+    @r.setter
+    def r(self, rand: ErlRand) -> None:
+        self._r_local.value = rand
 
     @property
     def ssrf_ep(self):

@@ -29,26 +29,36 @@ class _Req:
 
 class OracleBatcher:
     """Per-request oracle execution (the fallback backend): still bounded by
-    a worker pool rather than a process per request."""
+    a worker pool rather than a process per request. Each case runs under
+    the per-case watchdog (default 30s, the reference's service-mode
+    MaxRunningTime, src/erlamsa_cmdparse.erl:109-111) so one hung case is
+    abandoned instead of permanently draining a pool worker — the
+    fsupervisor reaper's job (src/erlamsa_fsupervisor.erl:96-105)."""
 
-    def __init__(self, workers: int = 10):
+    def __init__(self, workers: int = 10, max_running_time: float = 30.0):
         self._q: queue.Queue[_Req] = queue.Queue()
+        self.max_running_time = max_running_time
         for _ in range(workers):
             threading.Thread(target=self._worker, daemon=True).start()
 
     def _worker(self):
         from ..oracle.engine import fuzz
+        from ..utils.watchdog import run_with_timeout
 
         while True:
             req = self._q.get()
             try:
-                req.result = fuzz(
+                req.result = run_with_timeout(
+                    fuzz,
+                    req.opts.get("maxrunningtime", self.max_running_time),
                     req.data,
                     seed=req.opts.get("seed") or gen_urandom_seed(),
-                    **{k: v for k, v in req.opts.items() if k != "seed"},
+                    **{k: v for k, v in req.opts.items()
+                       if k not in ("seed", "maxrunningtime")},
                 )
             except Exception:
-                req.result = b""
+                req.result = b""  # incl. CaseTimeout: empty answer,
+                # like the reference's 90s give-up (fsupervisor.erl:83-86)
             req.done.set()
 
     def fuzz(self, data: bytes, opts: dict, timeout: float = 90.0) -> bytes:
@@ -117,8 +127,16 @@ class TpuBatcher:
         return req.result
 
 
+def service_budget(opts: dict) -> float:
+    """Per-case budget for service modes: -T when given, else the
+    reference's 30s service default (src/erlamsa_cmdparse.erl:109-111)."""
+    mrt = opts.get("maxrunningtime")
+    return 30.0 if mrt is None else float(mrt)
+
+
 def make_batcher(backend: str, **kw):
     if backend == "tpu":
         return TpuBatcher(**{k: v for k, v in kw.items()
                              if k in ("batch", "capacity", "max_latency_ms", "seed")})
-    return OracleBatcher(workers=kw.get("workers", 10))
+    return OracleBatcher(workers=kw.get("workers", 10),
+                         max_running_time=kw.get("max_running_time", 30.0))

@@ -63,7 +63,10 @@ def run_tpu_batch(opts: dict, batch: int = 1024) -> int:
             file=sys.stderr,
         )
         return 1
-    hybrid = HybridDispatcher(list(selected.items()), opts["seed"])
+    from .batcher import service_budget
+
+    hybrid = HybridDispatcher(list(selected.items()), opts["seed"],
+                              max_running_time=service_budget(opts))
 
     step, _ = make_fuzzer(cap, batch, mutator_pri=pri)
     base = prng.base_key(opts["seed"])
@@ -86,7 +89,7 @@ def run_tpu_batch(opts: dict, batch: int = 1024) -> int:
             if st is None:
                 print("# checkpoint unreadable, starting fresh", file=sys.stderr)
             else:
-                ck_seed, start_case, ck_scores = st
+                ck_seed, start_case, ck_scores, ck_host = st
                 if (ck_seed != tuple(opts["seed"])
                         or ck_scores.shape != (batch, NUM_DEVICE_MUTATORS)):
                     print("# checkpoint mismatch (seed/shape), starting fresh",
@@ -96,6 +99,12 @@ def run_tpu_batch(opts: dict, batch: int = 1024) -> int:
                     import jax.numpy as jnp
 
                     scores = jnp.asarray(ck_scores)
+                    # restore the hybrid routing state too, so the resumed
+                    # run splits host/device exactly like an uninterrupted
+                    # one
+                    for code, val in ck_host.items():
+                        if code in hybrid.host_scores:
+                            hybrid.host_scores[code] = val
                     print(f"# resumed at case {start_case}", file=sys.stderr)
         if start_case >= n_cases:
             print(f"# run already complete ({start_case}/{n_cases} cases)",
@@ -110,7 +119,10 @@ def run_tpu_batch(opts: dict, batch: int = 1024) -> int:
     # -n is the TOTAL case target, like the reference: resume completes the
     # original run rather than adding n more cases
     for case in range(start_case, n_cases):
-        host_mask = hybrid.split(case, corpus)
+        # live scheduler scores weight the host/device split like the
+        # reference's score*pri mux mass (erlamsa_mutations.erl:1244-1250)
+        host_mask = hybrid.split(case, corpus,
+                                 device_scores=np.asarray(scores))
         # device mutates the WHOLE batch (async); the host pool handles its
         # share in parallel, and host results override at merge time
         new_data, new_lens, scores, meta = step(base, case, data, lens, scores)
@@ -128,7 +140,8 @@ def run_tpu_batch(opts: dict, batch: int = 1024) -> int:
         total += len(results)
         host_total += len(host_idx)
         if state_path:
-            save_state(state_path, opts["seed"], case + 1, scores)
+            save_state(state_path, opts["seed"], case + 1, scores,
+                       host_scores=hybrid.host_scores)
     hybrid.close()
     dt = time.perf_counter() - t0
     logger.log("info", "tpu backend: %d samples in %.2fs (%.0f samples/s)",

@@ -16,16 +16,23 @@ import os
 import numpy as np
 
 
-def save_state(path: str, seed, case_idx: int, scores) -> None:
+def save_state(path: str, seed, case_idx: int, scores,
+               host_scores: dict | None = None) -> None:
     """Atomic write (tmp + rename): a kill mid-save — the very interruption
-    checkpoints exist for — must never corrupt the previous checkpoint."""
+    checkpoints exist for — must never corrupt the previous checkpoint.
+    host_scores: the hybrid dispatcher's evolving per-mutator scores —
+    part of the routing state, so a resumed run splits host/device exactly
+    like the uninterrupted one would."""
     tmp = path + ".tmp"
+    hs = host_scores or {}
     with open(tmp, "wb") as f:
         np.savez(
             f,
             seed=np.asarray(seed, np.int64),
             case_idx=np.asarray(case_idx, np.int64),
             scores=np.asarray(scores, np.int32),
+            host_codes=np.asarray(sorted(hs), "U8"),
+            host_values=np.asarray([hs[k] for k in sorted(hs)], np.float64),
         )
         # data must be durable BEFORE the rename publishes it, or a crash
         # right after os.replace leaves a truncated checkpoint and the run
@@ -44,13 +51,19 @@ def save_state(path: str, seed, case_idx: int, scores) -> None:
 
 
 def load_state(path: str):
-    """-> (seed tuple, case_idx, scores ndarray), or None when the file is
-    unreadable/corrupt (callers start fresh)."""
+    """-> (seed tuple, case_idx, scores ndarray, host_scores dict), or
+    None when the file is unreadable/corrupt (callers start fresh)."""
     try:
         with np.load(path) as z:
             seed = tuple(int(x) for x in z["seed"])
             case_idx = int(z["case_idx"])
             scores = z["scores"].copy()
-        return seed, case_idx, scores
+            host_scores = {}
+            if "host_codes" in z:
+                host_scores = {
+                    str(c): float(v)
+                    for c, v in zip(z["host_codes"], z["host_values"])
+                }
+        return seed, case_idx, scores, host_scores
     except Exception:
         return None

@@ -104,6 +104,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--skip", type=int, default=0)
     p.add_argument("--sleep", type=int, default=0, help="ms between cases")
     p.add_argument("--maxfails", type=int, default=10)
+    p.add_argument("-T", "--maxrunningtime", type=float, default=None,
+                   help="per-case wall-clock budget in seconds (0 = "
+                        "unlimited); hung cases/writers are abandoned "
+                        "(reference MaxRunningTime; service modes default "
+                        "to 30, CLI runs to unlimited)")
     p.add_argument("-S", "--sequence-muta", action="store_true")
     p.add_argument("-l", "--list", action="store_true", help="list engines")
     p.add_argument("-v", "--verbose", action="count", default=0)
@@ -112,6 +117,9 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("-M", "--meta", default=None, help="write metadata to path")
     p.add_argument("-r", "--recursive", action="store_true")
     p.add_argument("-H", "--httpsvc", default=None, help="run FaaS at host:port")
+    p.add_argument("--cmanager-store", default=None, metavar="PATH",
+                   help="persist FaaS tokens/sessions to a JSON file "
+                        "(the reference keeps them in mnesia)")
     p.add_argument("-i", "--proxy", default=None,
                    help="fuzzing proxy spec proto://lport:rhost:rport")
     p.add_argument("-P", "--proxy-prob", default="0.1,0.1",
@@ -186,6 +194,8 @@ def main(argv=None) -> int:
         "skip": args.skip,
         "sleep": args.sleep,
         "maxfails": args.maxfails,
+        # None = unset: engines treat it as unlimited, service modes as 30s
+        "maxrunningtime": args.maxrunningtime,
         "sequence_muta": args.sequence_muta,
         "recursive": args.recursive,
         "workers": args.workers,
@@ -234,6 +244,7 @@ def main(argv=None) -> int:
         from .faas import serve
 
         host, _, port = args.httpsvc.rpartition(":")
+        opts["cmanager_store"] = args.cmanager_store
         return serve(host or "0.0.0.0", int(port), opts, backend=args.backend,
                      batch=args.batch)
     if args.proxy:

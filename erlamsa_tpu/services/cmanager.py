@@ -1,13 +1,16 @@
 """Cloud manager: API tokens and sessions for the FaaS service.
 
 Reference: src/erlamsa_cmanager.erl — 160-bit base64 tokens and sessions
-with 600s expiry kept in mnesia, token CRUD gated by an admin token. Here
-an in-memory store with a lock (the FaaS server is threaded).
+with 600s expiry kept in mnesia (records src/erlamsa.hrl:104-106), token
+CRUD gated by an admin token. Here a locked in-memory store (the FaaS
+server is threaded) with optional JSON persistence standing in for
+mnesia: pass store_path and tokens/sessions survive a process restart.
 """
 
 from __future__ import annotations
 
 import base64
+import json
 import os
 import threading
 import time
@@ -23,12 +26,54 @@ def _new_token() -> str:
 
 
 class CloudManager:
-    def __init__(self, admin_token: str | None = None, auth_required: bool = False):
+    def __init__(self, admin_token: str | None = None,
+                 auth_required: bool = False,
+                 store_path: str | None = None):
+        self._explicit_admin = admin_token is not None
         self.admin_token = admin_token or _new_token()
         self.auth_required = auth_required
+        self.store_path = store_path
         self._tokens: dict[str, dict] = {}
         self._sessions: dict[str, dict] = {}
         self._lock = threading.Lock()
+        self._load()
+
+    # --- persistence (mnesia stand-in, erlamsa_cmanager.erl:124-133) -----
+
+    def _load(self):
+        if not self.store_path or not os.path.exists(self.store_path):
+            return
+        try:
+            with open(self.store_path) as f:
+                st = json.load(f)
+            self._tokens = dict(st.get("tokens", {}))
+            self._sessions = dict(st.get("sessions", {}))
+            # lastaccess refreshes are in-memory only (persisting every
+            # request would hammer the store); treat the restart itself as
+            # activity so sessions that were live at save time stay usable
+            now = time.time()
+            for v in self._sessions.values():
+                v["lastaccess"] = now
+            if not self._explicit_admin and st.get("admin_token"):
+                # a persisted admin token wins over a freshly generated one
+                # (a restarted service must honor tokens it already issued)
+                self.admin_token = st["admin_token"]
+        except (OSError, ValueError):
+            pass  # unreadable store: start empty, overwrite on next save
+
+    def _save_locked(self):
+        """Caller holds self._lock."""
+        if not self.store_path:
+            return
+        tmp = self.store_path + ".tmp"
+        try:
+            with open(tmp, "w") as f:
+                json.dump({"admin_token": self.admin_token,
+                           "tokens": self._tokens,
+                           "sessions": self._sessions}, f)
+            os.replace(tmp, self.store_path)
+        except OSError:
+            pass  # persistence is best-effort; the live store stays valid
 
     # --- token CRUD (admin-gated, erlamsa_cmanager.erl:174-179) ----------
 
@@ -38,13 +83,17 @@ class CloudManager:
         t = _new_token()
         with self._lock:
             self._tokens[t] = {"date": time.time(), "type": kind}
+            self._save_locked()
         return t
 
     def del_token(self, admin: str, token: str) -> bool:
         if admin != self.admin_token:
             return False
         with self._lock:
-            return self._tokens.pop(token, None) is not None
+            existed = self._tokens.pop(token, None) is not None
+            if existed:
+                self._save_locked()
+            return existed
 
     def list_tokens(self, admin: str) -> list[str] | None:
         if admin != self.admin_token:
@@ -67,6 +116,7 @@ class CloudManager:
             if token and (token in self._tokens or token == self.admin_token):
                 s = _new_token()[:27]
                 self._sessions[s] = {"token": token, "lastaccess": time.time()}
+                self._save_locked()
                 return "ok", s
         return "unauthorized", ""
 

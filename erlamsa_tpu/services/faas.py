@@ -144,11 +144,16 @@ def serve(host: str, port: int, opts: dict, backend: str = "oracle",
           batch: int = 256, auth_required: bool = False,
           block: bool = True):
     """Start the FaaS server; returns the server object when block=False."""
+    from .batcher import service_budget
+
     _Handler.batcher = make_batcher(
         backend, batch=batch, workers=opts.get("workers", 10),
-        seed=opts.get("seed"),
+        seed=opts.get("seed"), max_running_time=service_budget(opts),
     )
-    _Handler.cmanager = CloudManager(auth_required=auth_required)
+    _Handler.cmanager = CloudManager(
+        auth_required=auth_required,
+        store_path=opts.get("cmanager_store"),
+    )
     srv = ThreadingHTTPServer((host, port), _Handler)
     logger.log("info", "faas listening on %s:%d (backend=%s)", host, port, backend)
     print(f"# faas listening on {host}:{port} backend={backend} "

@@ -13,8 +13,11 @@ the hybrid dispatcher reproduces that split at the *sample* level:
 
 This keeps the TPU busy with the bulk of the corpus while the host handles
 the structured minority (SURVEY.md §7 phase 3's host/device split). The
-split probabilities follow priorities, not evolving scores (documented
-approximation — scores evolve within each engine).
+split weights use score*priority mass like the reference's mux
+(src/erlamsa_mutations.erl:1238-1250): device scores come from the live
+scheduler state the batch runner passes in, host scores evolve here from
+observed outcomes (+1 on a mutator that changed data, -1 on a failed
+draw, clamped to [MIN_SCORE, MAX_SCORE]).
 """
 
 from __future__ import annotations
@@ -30,22 +33,11 @@ from ..ops.registry import DEVICE_CODES, HOST_CODES
 from ..utils.bytehelpers import binarish
 
 
-def host_applicable_mass(data: bytes, selected: dict[str, int]) -> int:
-    """Priority mass of host mutators whose guards plausibly pass for this
-    sample (mirrors each mutator's own cheap precondition)."""
+def sample_traits(data: bytes) -> dict:
+    """Cheap per-sample predicates the host-mutator guards key on —
+    computed ONCE per sample, whatever the number of registry rows."""
     import re
 
-    mass = 0
-    is_bin = binarish(data)
-    # a '<' immediately followed by a name/bang/slash — the shape the SGML
-    # tokenizer actually turns into a tag, unlike a bare 0x3C byte
-    has_tag = re.search(rb"<[A-Za-z!/?]", data[:4096]) is not None
-    stripped = data[:64].lstrip()
-    looks_json = stripped[:1] in (b"{", b"[", b'"') or (
-        stripped[:1].isdigit()
-    )
-    is_zip = data[:4] in (b"PK\x03\x04", b"PK\x05\x06")
-    has_uri = b"://" in data
     maybe_b64 = False
     chunk = data.strip()
     if len(chunk) > 6 and len(chunk) % 4 == 0:
@@ -54,62 +46,109 @@ def host_applicable_mass(data: bytes, selected: dict[str, int]) -> int:
             maybe_b64 = True
         except (binascii.Error, ValueError):
             pass
+    stripped = data[:64].lstrip()
+    return {
+        "is_bin": binarish(data),
+        # a '<' immediately followed by a name/bang/slash — the shape the
+        # SGML tokenizer actually turns into a tag, unlike a bare 0x3C byte
+        "has_tag": re.search(rb"<[A-Za-z!/?]", data[:4096]) is not None,
+        "looks_json": stripped[:1] in (b"{", b"[", b'"')
+        or stripped[:1].isdigit(),
+        "is_zip": data[:4] in (b"PK\x03\x04", b"PK\x05\x06"),
+        "has_uri": b"://" in data,
+        "maybe_b64": maybe_b64,
+        "size": len(data),
+    }
 
-    for code, pri in selected.items():
-        if code not in HOST_CODES or pri <= 0:
-            continue
-        if code == "sgm" and not has_tag:
-            continue
-        if code == "js" and not looks_json:
-            continue
-        if code == "zip" and not is_zip:
-            continue
-        if code == "uri" and not has_uri:
-            continue
-        if code == "b64" and not maybe_b64:
-            continue
-        if code in ("tr2", "td", "ts1", "ts2", "tr", "ab", "ad") and is_bin:
-            continue
-        if code == "len" and len(data) <= 10:
-            continue
-        mass += pri
-    return mass
+
+def row_applicable(code: str, traits: dict) -> bool:
+    """Does host mutator `code`'s guard plausibly pass for a sample with
+    these traits (mirrors each mutator's own cheap precondition)."""
+    if code == "sgm":
+        return traits["has_tag"]
+    if code == "js":
+        return traits["looks_json"]
+    if code == "zip":
+        return traits["is_zip"]
+    if code == "uri":
+        return traits["has_uri"]
+    if code == "b64":
+        return traits["maybe_b64"]
+    if code in ("tr2", "td", "ts1", "ts2", "tr", "ab", "ad"):
+        return not traits["is_bin"]
+    if code == "len":
+        return traits["size"] > 10
+    return True
+
+
+def host_applicable_mass(data: bytes, selected: dict[str, int]) -> int:
+    """Priority mass of host mutators whose guards plausibly pass for this
+    sample."""
+    traits = sample_traits(data)
+    return sum(
+        pri for code, pri in selected.items()
+        if code in HOST_CODES and pri > 0 and row_applicable(code, traits)
+    )
 
 
 class HybridDispatcher:
     """Splits a corpus batch into device and host work per case."""
 
+    #: neutral starting score — the reference inits rows at max(2, rand(10))
+    #: (src/erlamsa_mutations.erl:1385-1395), mean ~6
+    NEUTRAL_SCORE = 6.0
+    MIN_SCORE, MAX_SCORE = 2.0, 10.0
+
     def __init__(self, selected: list[tuple[str, int]], seed,
-                 host_workers: int | None = None):
+                 host_workers: int | None = None,
+                 max_running_time: float = 30.0):
         self.selected = dict(selected)
-        self.device_mass = sum(
-            p for c, p in self.selected.items() if c in DEVICE_CODES and p > 0
+        self.device_pri = np.asarray(
+            [max(self.selected.get(c, 0), 0) for c in DEVICE_CODES], np.float64
         )
         self.host_rows = [
             (c, p) for c, p in self.selected.items() if c in HOST_CODES and p > 0
         ]
+        # evolving per-mutator host scores (reference adjust_priority
+        # semantics, src/erlamsa_mutations.erl:1238-1242)
+        self.host_scores = {c: self.NEUTRAL_SCORE for c, _ in self.host_rows}
         self.seed = seed
-        self._mass_cache: np.ndarray | None = None
-        self._mass_corpus: list | None = None
+        # per-sample wall-clock budget for host-routed oracle cases
+        # (reference service-mode MaxRunningTime default 30s,
+        # src/erlamsa_cmdparse.erl:109-111); a hung structured mutator is
+        # abandoned and the device output stands in at merge time
+        self.max_running_time = max_running_time
+        self._appl_cache: np.ndarray | None = None
+        self._appl_corpus: list | None = None
         self._pool = cf.ThreadPoolExecutor(
             max_workers=host_workers or min(8, (os.cpu_count() or 2))
         )
 
-    def _masses(self, seeds: list[bytes]) -> np.ndarray:
-        """Per-sample host priority mass, computed once per corpus (the
-        batch runner reuses one immutable corpus across cases)."""
-        if self._mass_cache is None or self._mass_corpus is not seeds:
-            self._mass_cache = np.asarray(
-                [host_applicable_mass(s, self.selected) for s in seeds],
-                np.int64,
+    def _applicability(self, seeds: list[bytes]) -> np.ndarray:
+        """bool[B, H]: host row h applicable to sample b. Computed once per
+        corpus (the batch runner reuses one immutable corpus across cases);
+        scores multiply in per case, so the cache stays valid as they
+        evolve."""
+        if self._appl_cache is None or self._appl_corpus is not seeds:
+            rows = []
+            for s in seeds:
+                traits = sample_traits(s)  # one scan per sample
+                rows.append([row_applicable(c, traits)
+                             for c, _p in self.host_rows])
+            self._appl_cache = np.asarray(rows, bool).reshape(
+                len(seeds), len(self.host_rows)
             )
-            self._mass_corpus = seeds
-        return self._mass_cache
+            self._appl_corpus = seeds
+        return self._appl_cache
 
-    def split(self, case_idx: int, seeds: list[bytes]) -> np.ndarray:
-        """bool[B]: True = host-routed. Deterministic in (seed, case) —
-        the RNG is keyed on the integer seed values, NOT Python's salted
-        hash, so routing reproduces across processes."""
+    def split(self, case_idx: int, seeds: list[bytes],
+              device_scores=None) -> np.ndarray:
+        """bool[B]: True = host-routed. Deterministic in (seed, case,
+        score state) — the RNG is keyed on the integer seed values, NOT
+        Python's salted hash, so routing reproduces across processes.
+
+        device_scores: the live int32[B, M] scheduler state (registry
+        order); when omitted, a neutral score stands in."""
         out = np.zeros(len(seeds), bool)
         if not self.host_rows:
             return out
@@ -117,28 +156,71 @@ class HybridDispatcher:
             list(self.seed) if isinstance(self.seed, tuple) else [int(self.seed)]
         )
         rng = np.random.default_rng([*seed_ints, case_idx, 0x48594252])
-        hm = self._masses(seeds)
-        total = hm + self.device_mass
+        host_w = np.asarray(
+            [p * self.host_scores[c] for c, p in self.host_rows], np.float64
+        )
+        hm = self._applicability(seeds) @ host_w
+        if device_scores is not None:
+            dm = np.asarray(device_scores, np.float64) @ self.device_pri
+        else:
+            dm = np.full(len(seeds), self.NEUTRAL_SCORE * self.device_pri.sum())
+        total = hm + dm
         draws = rng.random(len(seeds))
-        probs = np.where(total > 0, hm / np.maximum(total, 1), 0.0)
+        probs = np.where(total > 0, hm / np.maximum(total, 1e-9), 0.0)
         return draws < probs
 
+    def _bump(self, name: str, delta: float):
+        if name in self.host_scores:
+            self.host_scores[name] = min(
+                self.MAX_SCORE, max(self.MIN_SCORE,
+                                    self.host_scores[name] + delta)
+            )
+
     def fuzz_host(self, case_idx: int, idx_seeds: list[tuple[int, bytes]]):
-        """Oracle fuzz for host-routed samples; returns {index: bytes}."""
-        from ..oracle.engine import fuzz
+        """Oracle fuzz for host-routed samples; returns {index: bytes}.
+        Observed outcomes feed the evolving host scores. A case exceeding
+        max_running_time is abandoned (absent from the result dict), so
+        the batch loop never stalls on one adversarial sample."""
+        from ..oracle.engine import Engine
+        from ..utils.watchdog import CaseTimeout, run_with_timeout
 
         def one(item):
             i, data = item
-            return i, fuzz(
-                data,
-                seed=(self.seed[0], self.seed[1] ^ case_idx,
-                      self.seed[2] ^ (i + 1))
+            ts = (
+                (self.seed[0], self.seed[1] ^ case_idx,
+                 self.seed[2] ^ (i + 1))
                 if isinstance(self.seed, tuple)
-                else (1, case_idx, i + 1),
-                mutations=self.host_rows,
+                else (1, case_idx, i + 1)
             )
 
-        return dict(self._pool.map(one, idx_seeds))
+            def case():
+                eng = Engine({"paths": ["direct"], "input": data, "seed": ts,
+                              "n": 1, "mutations": self.host_rows})
+                return eng.run_case(1)
+
+            try:
+                out, meta = run_with_timeout(case, self.max_running_time)
+            except CaseTimeout:
+                return i, None, []
+            return i, out, meta
+
+        results = {}
+        metas = []
+        for i, out, meta in self._pool.map(one, idx_seeds):
+            if out is None:
+                continue
+            results[i] = out
+            metas.append(meta)
+        for meta in metas:
+            for entry in meta:
+                if not (isinstance(entry, tuple) and len(entry) == 2):
+                    continue
+                tag, val = entry
+                if tag == "used":
+                    self._bump(val, +1.0)
+                elif tag == "failed":
+                    self._bump(val, -1.0)
+        return results
 
     def close(self):
         self._pool.shutdown(wait=False)

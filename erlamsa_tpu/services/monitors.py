@@ -18,6 +18,12 @@ each reporting findings through the logger and optionally running an
           on an available `adb` binary
   lxi     SCPI measurement-range monitor over TCP
           (src/erlamsa_mon_lxi.erl)
+
+Deliberately absent: the reference's Windows CDB monitor
+(src/erlamsa_mon_cdb.erl — cdb.exe backtrace/minidump/restart). This
+framework targets Linux hosts; `exec` covers exit-status triage and `r2`
+covers debugger-grade backtraces there. Port a cdb driver in the same
+ExecMonitor shape if Windows targets ever matter.
 """
 
 from __future__ import annotations

@@ -16,28 +16,30 @@ from ..utils.erlrand import ErlRand
 
 def _worker_main(opts: dict, lo: int, hi: int, extra: int, wseed):
     from ..oracle.engine import Engine
+    from ..utils.watchdog import CaseTimeout, run_with_timeout
     from . import out as outmod
 
     wopts = dict(opts)
     wopts["seed"] = wseed
     writer, _ = outmod.string_outputs(opts.get("output", "-"))
     eng = Engine(wopts)
-    i = max(lo, 1)
-    while i <= hi:
-        data, meta = eng.run_case(i)
+    budget = opts.get("maxrunningtime") or 0
+
+    def one_case(idx: int):
+        try:
+            data, meta = run_with_timeout(eng.run_case, budget, idx)
+        except CaseTimeout:
+            return  # abandoned like the reference's per-case kill
         if writer is not None and data:
             try:
-                writer(i, data, meta)
-            except ConnectionError:
+                run_with_timeout(writer, budget, idx, data, meta)
+            except (ConnectionError, CaseTimeout):
                 pass
-        i += 1
+
+    for i in range(max(lo, 1), hi + 1):
+        one_case(i)
     if extra:
-        data, meta = eng.run_case(extra)
-        if writer is not None and data:
-            try:
-                writer(extra, data, meta)
-            except ConnectionError:
-                pass
+        one_case(extra)
 
 
 def split_ranges(n: int, workers: int) -> list[tuple[int, int, int]]:

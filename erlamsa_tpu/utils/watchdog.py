@@ -1,0 +1,52 @@
+"""Per-case watchdog: survive hung cases and writers.
+
+Reference: each fuzzing case runs in a killable Erlang process that the
+main loop abandons after MaxRunningTime (src/erlamsa_main.erl:211-220),
+and the service-side fuzzing supervisor reaps stuck fuzzing processes
+older than that budget (src/erlamsa_fsupervisor.erl:96-105). Python
+threads can't be killed, so the equivalent contract here is *abandonment*:
+the hung call keeps its daemon thread (it is almost always blocked on IO —
+a dead socket writer, a wedged exec target), the caller gets CaseTimeout
+and the run continues.
+
+Known limit vs the reference's process kill: an abandoned WRITER that
+later unblocks may still flush its bytes, which can interleave with later
+cases on single-stream outputs (stdout, one TCP connection). Per-case
+outputs (file %n templates, per-request FaaS replies) are unaffected, and
+`-w N` worker *processes* give the reference's full isolation. Oracle
+PRNG state is safe either way — Ctx.r is thread-local.
+"""
+
+from __future__ import annotations
+
+import threading
+
+
+class CaseTimeout(Exception):
+    """A case/writer exceeded its max running time and was abandoned."""
+
+
+def run_with_timeout(fn, timeout: float, /, *args, **kwargs):
+    """Run fn(*args, **kwargs) with a wall-clock budget. timeout <= 0 or
+    None means no budget (direct call). Raises CaseTimeout on expiry;
+    otherwise returns/raises exactly what fn did."""
+    if not timeout or timeout <= 0:
+        return fn(*args, **kwargs)
+    box: dict = {}
+    done = threading.Event()
+
+    def target():
+        try:
+            box["result"] = fn(*args, **kwargs)
+        except BaseException as e:  # re-raised in the caller below
+            box["error"] = e
+        finally:
+            done.set()
+
+    t = threading.Thread(target=target, daemon=True)
+    t.start()
+    if not done.wait(timeout):
+        raise CaseTimeout(f"abandoned after {timeout}s: {fn!r}")
+    if "error" in box:
+        raise box["error"]
+    return box.get("result")

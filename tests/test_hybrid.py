@@ -61,10 +61,14 @@ def test_checkpoint_roundtrip(tmp_path):
 
     p = str(tmp_path / "st.npz")
     scores = np.random.default_rng(0).integers(2, 11, (16, 25), dtype=np.int32)
-    save_state(p, (1, 2, 3), 42, scores)
-    seed, case, sc = load_state(p)
+    save_state(p, (1, 2, 3), 42, scores, host_scores={"sgm": 8.0, "js": 3.5})
+    seed, case, sc, hs = load_state(p)
     assert seed == (1, 2, 3) and case == 42
     assert np.array_equal(sc, scores)
+    assert hs == {"sgm": 8.0, "js": 3.5}
+    # legacy shape without host scores loads too
+    save_state(p, (1, 2, 3), 7, scores)
+    assert load_state(p)[3] == {}
 
 
 def test_batchrunner_resume(tmp_path, monkeypatch, capsys):
@@ -81,14 +85,14 @@ def test_batchrunner_resume(tmp_path, monkeypatch, capsys):
     assert run_tpu_batch(dict(opts), batch=8) == 0
     from erlamsa_tpu.services.checkpoint import load_state
 
-    _s, case, _sc = load_state(state)
+    _s, case, _sc, _hs = load_state(state)
     assert case == 2
     # -n is the TOTAL target: rerunning the completed command is a no-op
     assert run_tpu_batch(dict(opts), batch=8) == 0
-    _s, case2, _sc2 = load_state(state)
+    _s, case2, _sc2, _hs2 = load_state(state)
     assert case2 == 2
     # raising -n completes the remainder only
     opts["n"] = 3
     assert run_tpu_batch(dict(opts), batch=8) == 0
-    _s, case3, _sc3 = load_state(state)
+    _s, case3, _sc3, _hs3 = load_state(state)
     assert case3 == 3

@@ -1,0 +1,134 @@
+"""Parity goldens: fixed seed -> exact bytes, per mutator and pattern.
+
+Two golden layers (VERDICT r1 #7, SURVEY.md §4/§7.2 Phase 0):
+
+1. **Self-goldens** (checked in, tests/goldens/self_goldens.*): the
+   oracle's own output locked at fixed seeds — any change to a draw
+   anywhere in the oracle chain (erlrand, generators, patterns, mutators)
+   breaks these loudly. 256 cases: every default mutator x 3 inputs x 2
+   seeds, every pattern, and whole-default-config runs.
+
+2. **Reference goldens** (drop-in, tests/goldens/reference/): the same
+   key scheme produced by actual erlamsa (`./erlamsa --seed S -m M -p P`)
+   the moment an image ships escript — no Erlang/OTP exists in this one.
+   Place files named <flattened-key>.bin there and the harness compares
+   byte-for-byte; see make_reference_cmd() for the exact CLI per key.
+
+Key scheme: muta/<name>/<input>/<s1-s2-s3>, pattern/<name>/<input>/<seed>,
+default/<input>/<seed>/case<N>. Inputs are reconstructed here and verified
+against their recorded sha256 so the corpus can't silently drift.
+"""
+
+import hashlib
+import json
+import os
+
+import pytest
+
+from erlamsa_tpu.oracle.engine import Engine, fuzz
+
+HERE = os.path.dirname(__file__)
+GOLDEN_JSON = os.path.join(HERE, "goldens", "self_goldens.json")
+GOLDEN_BLOB = os.path.join(HERE, "goldens", "self_goldens.bin")
+REFERENCE_DIR = os.path.join(HERE, "goldens", "reference")
+
+INPUTS = {
+    "text": b"Golden sample: value=12345 name=test <tag attr='x'>text body"
+            b"</tag> [1,2,3] {\"k\": 42}\n" * 3,
+    "binary": bytes(range(256)) * 2,
+    "lines": b"".join(
+        b"line %03d with number %d\n" % (i, i * 7) for i in range(20)
+    ),
+}
+
+with open(GOLDEN_JSON) as f:
+    _MANIFEST = json.load(f)
+with open(GOLDEN_BLOB, "rb") as f:
+    _BLOB = f.read()
+
+
+def _expected(key: str) -> bytes:
+    g = _MANIFEST["goldens"][key]
+    out = _BLOB[g["offset"] : g["offset"] + g["size"]]
+    assert hashlib.sha256(out).hexdigest() == g["sha256"], (
+        f"golden blob corrupt at {key}"
+    )
+    return out
+
+
+def _parse_key(key: str):
+    parts = key.split("/")
+    kind = parts[0]
+    if kind == "muta":
+        _, name, inp, seed = parts
+        return kind, INPUTS[inp], tuple(map(int, seed.split("-"))), {
+            "mutations": [(name, 1)], "patterns": [("od", 1)]}
+    if kind == "pattern":
+        _, name, inp, seed = parts
+        return kind, INPUTS[inp], tuple(map(int, seed.split("-"))), {
+            "patterns": [(name, 1)]}
+    _, inp, seed, case = parts
+    return kind, INPUTS[inp], tuple(map(int, seed.split("-"))), {
+        "case": int(case[4:])}
+
+
+def make_reference_cmd(key: str) -> str:
+    """The erlamsa CLI line producing this key's reference golden."""
+    kind, _data, seed, opts = _parse_key(key)
+    s = ",".join(map(str, seed))
+    if kind == "muta":
+        name = key.split("/")[1]
+        return f"./erlamsa --seed {s} -m {name}=1 -p od input_file"
+    if kind == "pattern":
+        name = key.split("/")[1]
+        return f"./erlamsa --seed {s} -p {name}=1 input_file"
+    n = opts["case"]
+    return f"./erlamsa --seed {s} -n {n} input_file  # last case only"
+
+
+def test_inputs_unchanged():
+    for k, v in INPUTS.items():
+        assert hashlib.sha256(v).hexdigest() == _MANIFEST["inputs"][k], (
+            f"golden input {k!r} drifted from the recorded corpus"
+        )
+
+
+@pytest.mark.parametrize(
+    "key",
+    sorted(k for k in _MANIFEST["goldens"] if not k.startswith("default/")),
+)
+def test_self_golden(key):
+    _kind, data, seed, opts = _parse_key(key)
+    assert fuzz(data, seed=seed, **opts) == _expected(key)
+
+
+@pytest.mark.parametrize(
+    "seed_s", sorted({k.split("/")[2] for k in _MANIFEST["goldens"]
+                      if k.startswith("default/")})
+)
+def test_self_golden_default_stream(seed_s):
+    seed = tuple(map(int, seed_s.split("-")))
+    eng = Engine({"paths": ["direct"], "input": INPUTS["text"],
+                  "seed": seed, "n": 3})
+    outs = eng.run()
+    for i, o in enumerate(outs):
+        assert o == _expected(f"default/text/{seed_s}/case{i + 1}")
+
+
+def _reference_files():
+    if not os.path.isdir(REFERENCE_DIR):
+        return []
+    return sorted(os.listdir(REFERENCE_DIR))
+
+
+@pytest.mark.parametrize("fname", _reference_files() or ["__absent__"])
+def test_reference_golden(fname):
+    """Byte-exact vs real erlamsa output, once goldens are dropped in."""
+    if fname == "__absent__":
+        pytest.skip("no reference goldens (image has no Erlang/OTP); "
+                    "generate with make_reference_cmd() per key")
+    key = fname[: -len(".bin")].replace("__", "/")
+    with open(os.path.join(REFERENCE_DIR, fname), "rb") as f:
+        expected = f.read()
+    _kind, data, seed, opts = _parse_key(key)
+    assert fuzz(data, seed=seed, **opts) == expected

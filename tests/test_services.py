@@ -114,6 +114,26 @@ def test_cmanager_tokens_and_sessions():
     assert cm.del_token(cm.admin_token, tok)
 
 
+def test_cmanager_persistence(tmp_path):
+    """Tokens/sessions survive a process restart via the JSON store (the
+    reference's mnesia tables, erlamsa_cmanager.erl:124-133)."""
+    store = str(tmp_path / "cm.json")
+    cm = CloudManager(auth_required=True, store_path=store)
+    tok = cm.add_token(cm.admin_token)
+    _status, session = cm.get_client_context(tok, None)
+
+    cm2 = CloudManager(auth_required=True, store_path=store)
+    # the restarted manager honors the persisted admin token, user token,
+    # and live session
+    assert cm2.admin_token == cm.admin_token
+    assert cm2.get_client_context(None, session)[0] == "ok"
+    assert cm2.get_client_context(tok, None)[0] == "ok"
+    # deletion persists too
+    assert cm2.del_token(cm2.admin_token, tok)
+    cm3 = CloudManager(auth_required=True, store_path=store)
+    assert cm3.get_client_context(tok, None)[0] == "unauthorized"
+
+
 # ---- faas ---------------------------------------------------------------
 
 
@@ -169,13 +189,14 @@ def test_faas_concurrent_requests(faas_server):
             f"http://127.0.0.1:{faas_server}/erlamsa/erlamsa_esi:fuzz",
             data=b"concurrent %d\n" % i,
         )
-        results.append(urllib.request.urlopen(req, timeout=30).read())
+        # generous: CI may run the whole suite in parallel on few cores
+        results.append(urllib.request.urlopen(req, timeout=120).read())
 
     threads = [threading.Thread(target=post, args=(i,)) for i in range(16)]
     for t in threads:
         t.start()
     for t in threads:
-        t.join(30)
+        t.join(120)
     assert len(results) == 16
 
 

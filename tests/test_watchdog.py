@@ -1,0 +1,113 @@
+"""Stuck-run protection: per-case watchdog + pool reaper.
+
+Reference semantics being matched: a case is killed after MaxRunningTime
+and the loop continues (src/erlamsa_main.erl:211-220); the service
+supervisor reaps stuck fuzzing processes so the pool survives
+(src/erlamsa_fsupervisor.erl:96-105)."""
+
+import threading
+import time
+
+import pytest
+
+from erlamsa_tpu.utils.watchdog import CaseTimeout, run_with_timeout
+
+
+def test_run_with_timeout_passthrough():
+    assert run_with_timeout(lambda a, b: a + b, 5.0, 1, 2) == 3
+    # no budget = direct call
+    assert run_with_timeout(lambda: 42, 0) == 42
+
+
+def test_run_with_timeout_propagates_exceptions():
+    with pytest.raises(KeyError):
+        run_with_timeout(lambda: {}[1], 5.0)
+
+
+def test_run_with_timeout_abandons_hung_call():
+    release = threading.Event()
+
+    def hang():
+        release.wait(30)
+        return "late"
+
+    t0 = time.monotonic()
+    with pytest.raises(CaseTimeout):
+        run_with_timeout(hang, 0.2)
+    assert time.monotonic() - t0 < 5
+    release.set()
+
+
+def test_engine_survives_hanging_writer():
+    """A writer that hangs forever on one case must not stall the run:
+    the case is abandoned and maxfails eventually breaks the loop."""
+    from erlamsa_tpu.oracle.engine import Engine
+
+    release = threading.Event()
+    wrote = []
+
+    def writer(idx, data, meta):
+        if idx == 2:
+            release.wait(30)  # deliberate hang
+        wrote.append(idx)
+
+    eng = Engine({
+        "paths": ["direct"], "input": b"watchdog sample data 123\n",
+        "seed": (4, 5, 6), "n": 4, "maxrunningtime": 0.2, "maxfails": 10,
+    })
+    t0 = time.monotonic()
+    eng.run(writer)
+    dt = time.monotonic() - t0
+    release.set()
+    assert dt < 20
+    # cases 1, 3, 4 were written; the hung case 2 was abandoned
+    assert set(wrote) >= {1, 3, 4}
+
+
+def test_engine_hung_case_does_not_break_determinism():
+    """After an abandoned writer, later cases still produce the same bytes
+    as an undisturbed run (the PRNG chain is parent-stream based)."""
+    from erlamsa_tpu.oracle.engine import Engine
+
+    opts = {"paths": ["direct"], "input": b"determinism check 42\n",
+            "seed": (9, 8, 7), "n": 3}
+
+    plain = Engine(dict(opts)).run()
+
+    release = threading.Event()
+    got = {}
+
+    def writer(idx, data, meta):
+        if idx == 2:
+            release.wait(30)
+        got[idx] = data
+
+    eng = Engine(dict(opts, maxrunningtime=0.3, maxfails=50))
+    eng.run(writer)
+    release.set()
+    assert got[1] == plain[0]
+    assert got[3] == plain[2]
+
+
+def test_oracle_batcher_pool_survives_hung_case(monkeypatch):
+    """One hung case must not drain the worker pool: the request gets an
+    empty answer and the worker serves the next request."""
+    import erlamsa_tpu.oracle.engine as engmod
+    from erlamsa_tpu.services.batcher import OracleBatcher
+
+    real_fuzz = engmod.fuzz
+    release = threading.Event()
+
+    def sometimes_hung(data, seed=None, **opts):
+        if data == b"HANG":
+            release.wait(30)
+            return b"late"
+        return real_fuzz(data, seed=seed, **opts)
+
+    monkeypatch.setattr(engmod, "fuzz", sometimes_hung)
+    b = OracleBatcher(workers=1, max_running_time=0.2)
+    assert b.fuzz(b"HANG", {"seed": (1, 2, 3)}, timeout=10) == b""
+    # the single pool worker is free again despite the zombie case
+    out = b.fuzz(b"next request payload\n", {"seed": (1, 2, 3)}, timeout=30)
+    release.set()
+    assert out != b""
