@@ -493,19 +493,27 @@ _PARAM_BRANCHES = tuple(_PARAM_GENS[c] for c in DEVICE_CODES)
 # --- the four applications ------------------------------------------------
 
 
+def _splice_geometry(p, n, L):
+    """Shared splice length math: (pos, drop, rlen, n_out). The jnp apply,
+    the Pallas whole-round kernel and the post-kernel scalar path must all
+    agree on these."""
+    pos = jnp.clip(p["pos"], 0, n)
+    drop = jnp.clip(p["drop"], 0, n - pos)
+    rlen = jnp.select(
+        [p["src"] == SRC_SPAN, p["src"] == SRC_LIT],
+        [p["src_len"] * p["reps"], p["lit_len"]],
+        0,
+    )
+    n_out = jnp.clip(n - drop + rlen, 0, L)
+    return pos, drop, rlen, n_out
+
+
 def _apply_splice(p, data, n):
     """out = data[:pos] ++ R ++ data[pos+drop:] in one gather."""
     L = data.shape[0]
     i = jnp.arange(L, dtype=jnp.int32)
     active = p["kind"] == K_SPLICE
-    pos = jnp.clip(p["pos"], 0, n)
-    drop = jnp.clip(p["drop"], 0, n - pos)
-    span_total = p["src_len"] * p["reps"]
-    rlen = jnp.select(
-        [p["src"] == SRC_SPAN, p["src"] == SRC_LIT],
-        [span_total, p["lit_len"]],
-        0,
-    )
+    pos, drop, rlen, _n_out = _splice_geometry(p, n, L)
     end_ins = pos + rlen
     src_span = p["src_start"] + jnp.mod(
         i - pos, jnp.maximum(p["src_len"], 1)
@@ -522,7 +530,7 @@ def _apply_splice(p, data, n):
         data,
         jnp.where(i < end_ins, repl_byte, data[tail_src]),
     )
-    n_out = jnp.clip(n - drop + rlen, 0, L)
+    n_out = _n_out
     out = jnp.where(i < n_out, out, jnp.uint8(0))
     return (
         jnp.where(active, out, data),
@@ -646,13 +654,38 @@ def fused_mutate_step(key, data, n, scores, pri):
     )
     params = jax.lax.switch(applied, branches, site_key)
 
-    out, n1 = _apply_splice(params, data, n)
-    out, n1 = _apply_swap(params, out, n1)
-    out, n1 = _apply_perm_bytes(site_key, params, out, n1)
-    out, n1 = _apply_perm_lines(
-        site_key, params, out, n1, t.line_starts, t.line_lens, t.nlines
-    )
-    out, n1 = _apply_mask(site_key, params, out, n1)
+    from .pallas_kernels import fused_round_single, pallas_enabled
+
+    if pallas_enabled():
+        # whole-round Pallas kernel: splice/swap/perm-bytes/mask fused in
+        # one VMEM-resident pass (pallas_kernels._round_logic); only the
+        # line-table-dependent lp apply stays out here
+        L = data.shape[0]
+        params_row = jnp.stack([
+            params["kind"], params["pos"], params["drop"], params["src"],
+            params["src_start"], params["src_len"], params["reps"],
+            params["lit_len"], params["a1"], params["l1"], params["l2"],
+            params["ps"], params["pl"], params["mask_op"],
+            params["mask_prob"], n,
+        ]).astype(jnp.int32)
+        out = fused_round_single(
+            prng.sub(site_key, prng.TAG_VAL), params_row, params["scratch"],
+            data
+        )
+        # n only changes on splice; shared geometry math, scalar-only here
+        _pos, _drop, _rlen, n_splice = _splice_geometry(params, n, L)
+        n1 = jnp.where(params["kind"] == K_SPLICE, n_splice, n)
+        out, n1 = _apply_perm_lines(
+            site_key, params, out, n1, t.line_starts, t.line_lens, t.nlines
+        )
+    else:
+        out, n1 = _apply_splice(params, data, n)
+        out, n1 = _apply_swap(params, out, n1)
+        out, n1 = _apply_perm_bytes(site_key, params, out, n1)
+        out, n1 = _apply_perm_lines(
+            site_key, params, out, n1, t.line_starts, t.line_lens, t.nlines
+        )
+        out, n1 = _apply_mask(site_key, params, out, n1)
 
     out = jnp.where(any_app, out, data)
     n1 = jnp.where(any_app, n1, n)

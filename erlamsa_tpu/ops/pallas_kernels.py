@@ -1,20 +1,29 @@
 """Pallas TPU kernels for the hot applies.
 
-First kernel: the randmask pass (snand/srnd). The jnp version draws three
-[L] threefry arrays per round per sample (occurrence, bit index, random
-byte) — counter-PRNG bits are the dominant cost of the mask apply. This
-kernel generates all three streams with the TPU hardware PRNG
-(pltpu.prng_random_bits) seeded per sample, in VMEM, in one pass.
+Two layers:
 
-Determinism: the kernel is seeded from the sample key's fold, so results
+- ``pallas_randmask`` / ``randmask_single``: the standalone mask pass
+  (snand/srnd). The jnp version draws three [L] threefry arrays per round
+  per sample; the kernel generates the streams with the TPU hardware PRNG
+  (pltpu.prng_random_bits) seeded per sample, in VMEM, in one pass.
+- ``fused_round_single``: the WHOLE-ROUND kernel — splice, swap,
+  byte-permute and mask in one VMEM-resident pallas_call per scheduler
+  round (see the banner further down for the primitive discipline).
+
+Determinism: kernels are seeded from the sample key's fold, so results
 are reproducible for a fixed (seed, case, sample) like the rest of the
-throughput path — but the bitstream differs from the jnp engine's threefry
-draws.
+throughput path — but PERM/MASK bitstreams differ from the jnp engine's
+threefry draws (splice/swap are bit-identical; tests/test_pallas_round.py
+locks 20 mutators to byte-equality across engines).
 
-STATUS: wired into the fused engine behind ERLAMSA_PALLAS=1 (the randmask
-apply, ops/fused.py) and tested end-to-end in interpret mode off-TPU, so
-the same tests cover CPU CI. The hardware-PRNG build still needs
-validation on a real chip (this image's relay has blocked chip access).
+STATUS: wired into the fused engine behind ERLAMSA_PALLAS=1
+(ops/fused.py routes all four applies through fused_round_single; the
+line-table-dependent lp apply stays jnp) and tested end-to-end in
+interpret mode off-TPU, so the same tests cover CPU CI. The hardware
+build (pltpu PRNG, Mosaic lowering of the roll-based applies) still
+needs validation on a real chip — this image's relay has blocked chip
+access; remaining VMEM-residency step after that: moving the round LOOP
+(decisions + tables) in-kernel so a sample stays resident across rounds.
 """
 
 from __future__ import annotations
@@ -122,6 +131,194 @@ def pallas_randmask(seeds, params, data):
         out_shape=jax.ShapeDtypeStruct((B, L), jnp.uint8),
         interpret=True,
     )(bits, params, data)
+
+
+# --- whole-round kernel ----------------------------------------------------
+#
+# One pallas_call per scheduler round covering three of the four fused
+# applies (ops/fused.py): SPLICE, SWAP and MASK are computed from the
+# original row and selected by `kind` (only one apply is ever active per
+# round, so select == the jnp engine's identity-chain), then an in-place
+# Fisher-Yates pass handles PERM_BYTES under pl.when. The sample row stays
+# in VMEM across all of it — the jnp engine pays ~4 HBM round-trips per
+# round for the same work. PERM_LINES stays in jnp outside (it needs the
+# per-round line table; `lp` is a single default-priority mutator).
+#
+# Primitive discipline (TPU Mosaic has no arbitrary vector gather):
+# everything is jnp.roll by traced scalars, iota masks, and scalar pl.ds
+# ref accesses. The splice's repeated-span source d[src_start + (i-pos)
+# mod src_len] is built by bit-decomposing (i-pos)//src_len: conditional
+# global rolls by src_len<<k applied LSB-first — a per-element shift by
+# any multiple of src_len in ceil(log2(L)) vector passes.
+#
+# Determinism: reproducible for fixed (seed, case, sample) but NOT
+# byte-identical to the jnp engine for PERM_BYTES/MASK (hardware-PRNG
+# bitstream + Fisher-Yates vs argsort-of-uniforms) — same documented
+# divergence class as the existing randmask kernel. SPLICE and SWAP are
+# bit-identical to the jnp applies.
+
+# the engine's enums/caps are the single source of truth (ops/fused.py);
+# imported lazily inside functions there, so this module-level import is
+# cycle-free
+from .fused import (  # noqa: E402
+    K_MASK,
+    K_NONE,
+    K_PERM_BYTES,
+    K_PERM_LINES,
+    K_SPLICE,
+    K_SWAP,
+    PERM_WINDOW as _FY_CAP,
+    SRC_LIT,
+    SRC_NONE,
+    SRC_SPAN,
+)
+from .num_mutators import _SCRATCH  # noqa: E402
+
+
+def _round_logic(bits, params_ref, lit_ref, data_ref, out_ref, sref):
+    """bits: uint32[4, L] random stream (3 mask rows + 1 Fisher-Yates row).
+    params: int32[1, 16] = (kind, pos, drop, src, src_start, src_len,
+    reps, lit_len, a1, l1, l2, ps, pl, mask_op, mask_prob, n).
+    lit: uint8[1, _SCRATCH] splice literal bytes; sref: uint8[1, L] VMEM
+    scratch used to position them without an L-sized HBM operand."""
+    d = data_ref[...]
+    L = d.shape[-1]
+    P = params_ref
+    kind = P[0, 0]
+    pos, drop = P[0, 1], P[0, 2]
+    src, src_start, src_len = P[0, 3], P[0, 4], P[0, 5]
+    reps, lit_len = P[0, 6], P[0, 7]
+    a1, l1, l2 = P[0, 8], P[0, 9], P[0, 10]
+    ps, plen = P[0, 11], P[0, 12]
+    mask_op, mask_prob = P[0, 13], P[0, 14]
+    n = P[0, 15]
+    i = jax.lax.broadcasted_iota(jnp.int32, (1, L), 1)
+
+    # ---- SPLICE: out = d[:pos] ++ R ++ d[pos+drop:] ----
+    pos_c = jnp.clip(pos, 0, n)
+    drop_c = jnp.clip(drop, 0, n - pos_c)
+    span_total = src_len * reps
+    rlen = jnp.where(
+        src == SRC_SPAN, span_total, jnp.where(src == SRC_LIT, lit_len, 0)
+    )
+    sl_c = jnp.maximum(src_len, 1)
+    o = i - pos_c
+    # repeated-span source: conditional rolls by src_len * 2^k, LSB-first
+    cur = jnp.roll(d, pos_c - src_start, axis=1)
+    odiv = jnp.where(o >= 0, o // sl_c, 0)
+    for k in range(max(1, (L - 1).bit_length())):
+        bitk = (odiv >> k) & 1
+        cur = jnp.where(bitk == 1, jnp.roll(cur, sl_c << k, axis=1), cur)
+    # place the <=24 literal bytes at offset 0 of the VMEM scratch row,
+    # then roll them to pos — no L-sized literal operand from HBM
+    S = lit_ref.shape[-1]
+    sref[...] = jnp.zeros((1, L), jnp.uint8)
+    sref[0:1, 0 : min(S, L)] = lit_ref[0:1, 0 : min(S, L)]
+    lit_rolled = jnp.roll(sref[...], pos_c, axis=1)
+    repl = jnp.where(src == SRC_LIT, lit_rolled, cur)
+    tail = jnp.roll(d, rlen - drop_c, axis=1)
+    end_ins = pos_c + rlen
+    n_sp = jnp.clip(n - drop_c + rlen, 0, L)
+    sp = jnp.where(i < pos_c, d, jnp.where(i < end_ins, repl, tail))
+    sp = jnp.where(i < n_sp, sp, jnp.uint8(0))
+
+    # ---- SWAP: exchange adjacent spans [a1,a1+l1) and [a1+l1,a1+l1+l2) ----
+    sw = jnp.where(
+        (i >= a1) & (i < a1 + l2),
+        jnp.roll(d, -l1, axis=1),
+        jnp.where(
+            (i >= a1 + l2) & (i < a1 + l2 + l1),
+            jnp.roll(d, l2, axis=1),
+            d,
+        ),
+    )
+
+    # ---- MASK (same math as _mask_logic) ----
+    occurs_n = (bits[0:1] % 100).astype(jnp.int32)
+    occurs = jnp.where(mask_prob == 1, occurs_n != 0, occurs_n < mask_prob)
+    bit = (bits[1:2] % 8).astype(jnp.uint8)
+    rnd = (bits[2:3] & 0xFF).astype(jnp.uint8)
+    one = jnp.left_shift(jnp.uint8(1), bit)
+    masked = jnp.where(
+        mask_op == 0, d & ~one,
+        jnp.where(mask_op == 1, d | one,
+                  jnp.where(mask_op == 2, d ^ one, rnd)),
+    )
+    mk = jnp.where((i >= ps) & (i < ps + plen) & occurs, masked, d)
+
+    out_ref[...] = jnp.where(
+        kind == K_SPLICE, sp,
+        jnp.where(kind == K_SWAP, sw,
+                  jnp.where(kind == K_MASK, mk, d)),
+    )
+
+    # ---- PERM_BYTES: in-place Fisher-Yates over [ps, ps+plen) ----
+    @pl.when(kind == K_PERM_BYTES)
+    def _fisher_yates():
+        span = jnp.clip(plen, 0, _FY_CAP)
+
+        def body(t, carry):
+            j = span - 1 - t
+
+            @pl.when(j > 0)
+            def _swap_one():
+                r = (
+                    bits[3, jnp.clip(j, 0, L - 1)]
+                    % (j + 1).astype(jnp.uint32)
+                ).astype(jnp.int32)
+                aj = jnp.clip(ps + j, 0, L - 1)
+                ar = jnp.clip(ps + r, 0, L - 1)
+                vj = out_ref[0, aj]
+                vr = out_ref[0, ar]
+                out_ref[0, aj] = vr
+                out_ref[0, ar] = vj
+
+            return carry
+
+        jax.lax.fori_loop(0, _FY_CAP - 1, body, 0)
+
+
+def _round_kernel_hw(seed_ref, params_ref, lit_ref, data_ref, out_ref, sref):
+    pltpu.prng_seed(seed_ref[0])
+    L = data_ref.shape[-1]
+    bits = pltpu.prng_random_bits((4, L)).astype(jnp.uint32)
+    _round_logic(bits, params_ref, lit_ref, data_ref, out_ref, sref)
+
+
+def _round_kernel_bits(bits_ref, params_ref, lit_ref, data_ref, out_ref, sref):
+    _round_logic(bits_ref[0], params_ref, lit_ref, data_ref, out_ref, sref)
+
+
+def fused_round_single(key, params_row, lit_row, data_row):
+    """Single-sample whole-round apply for use INSIDE the vmapped fused
+    engine. params_row int32[16] (see _round_logic), lit_row
+    uint8[_SCRATCH] splice literal bytes, data_row uint8[L]. Returns
+    uint8[L]; the caller derives n_out from the params (scalar math)."""
+    L = data_row.shape[0]
+    params2 = params_row.reshape(1, 16)
+    lit2 = lit_row.reshape(1, -1)
+    data2 = data_row.reshape(1, L)
+    if pltpu is None:  # pragma: no cover - jax always ships pallas.tpu
+        raise RuntimeError(
+            "ERLAMSA_PALLAS=1 requires jax.experimental.pallas.tpu"
+        )
+    scratch = [pltpu.VMEM((1, L), jnp.uint8)]
+    if not _interpret():
+        seed = jax.random.randint(key, (1,), 0, 2**31 - 1, dtype=jnp.int32)
+        out = pl.pallas_call(
+            _round_kernel_hw,
+            out_shape=jax.ShapeDtypeStruct((1, L), jnp.uint8),
+            scratch_shapes=scratch,
+        )(seed, params2, lit2, data2)
+        return out[0]
+    bits = jax.random.bits(key, (1, 4, L), jnp.uint32)
+    out = pl.pallas_call(
+        _round_kernel_bits,
+        out_shape=jax.ShapeDtypeStruct((1, L), jnp.uint8),
+        scratch_shapes=scratch,
+        interpret=True,
+    )(bits, params2, lit2, data2)
+    return out[0]
 
 
 def pallas_enabled() -> bool:
