@@ -162,17 +162,13 @@ def pallas_randmask(seeds, params, data):
 # cycle-free
 from .fused import (  # noqa: E402
     K_MASK,
-    K_NONE,
     K_PERM_BYTES,
-    K_PERM_LINES,
     K_SPLICE,
     K_SWAP,
     PERM_WINDOW as _FY_CAP,
     SRC_LIT,
-    SRC_NONE,
     SRC_SPAN,
 )
-from .num_mutators import _SCRATCH  # noqa: E402
 
 
 def _round_logic(bits, params_ref, lit_ref, data_ref, out_ref, sref):

@@ -178,16 +178,18 @@ def fuzz_batch(keys, data, lens, scores, pri, pat_pri, engine: str = "fused",
     return out, n_out, sc, FuzzMeta(pat, log)
 
 
-def make_fuzzer(capacity: int, batch: int, mutator_pri=None, pattern_pri=None,
-                engine: str = "fused"):
-    """Host convenience: returns (jitted_step, initial_state_fn).
+def make_class_fuzzer(mutator_pri=None, pattern_pri=None,
+                      engine: str = "fused"):
+    """Capacity-class step (SURVEY.md §5.7): one jitted function reused
+    across class batches — XLA retraces per (B, L) shape, compiling one
+    program per class. Keys derive from the ORIGINAL corpus index passed
+    in `indices`, so a sample's stream is a pure function of (seed, case,
+    corpus index) no matter how the classes partition the batch.
 
-    jitted_step(case_idx, data, lens, scores) -> (data', lens', scores', meta)
-    with keys derived from (base_seed, case_idx, sample_idx) — the resume
-    format is just (seed, case counter), like the reference's
-    last_seed.txt + --skip (SURVEY.md §5.4).
+    step(base, case_idx, indices, data, lens, scores)
+      -> (data', lens', scores', meta)
     """
-    from .patterns import NUM_PATTERNS
+    from .patterns import CS, NUM_PATTERNS, SZ
 
     pri = np.asarray(
         mutator_pri if mutator_pri is not None else DEFAULT_DEVICE_PRI,
@@ -203,22 +205,39 @@ def make_fuzzer(capacity: int, batch: int, mutator_pri=None, pattern_pri=None,
         raise ValueError(f"pattern_pri must have {NUM_PATTERNS} entries")
     if engine not in ENGINES:
         raise ValueError(f"engine must be one of {ENGINES}, got {engine!r}")
-
-    from .patterns import CS, SZ
-
     enable_sizer = bool(pat_pri[SZ] > 0)
     enable_csum = bool(pat_pri[CS] > 0)
+
+    def step(base, case_idx, indices, data, lens, scores):
+        ckey = prng.case_key(base, case_idx)
+        keys = jax.vmap(lambda i: jax.random.fold_in(ckey, i))(indices)
+        return fuzz_batch(
+            keys, data, lens, scores, jnp.asarray(pri), jnp.asarray(pat_pri),
+            engine=engine, enable_sizer=enable_sizer, enable_csum=enable_csum,
+        )
+
+    return jax.jit(step)
+
+
+def make_fuzzer(capacity: int, batch: int, mutator_pri=None, pattern_pri=None,
+                engine: str = "fused"):
+    """Host convenience: returns (jitted_step, initial_state_fn).
+
+    jitted_step(case_idx, data, lens, scores) -> (data', lens', scores', meta)
+    with keys derived from (base_seed, case_idx, sample_idx) — the resume
+    format is just (seed, case counter), like the reference's
+    last_seed.txt + --skip (SURVEY.md §5.4).
+    """
+    class_step = make_class_fuzzer(mutator_pri, pattern_pri, engine)
+    indices = jnp.arange(batch, dtype=jnp.int32)
 
     def step(base, case_idx, data, lens, scores):
         if data.shape != (batch, capacity):
             raise ValueError(
                 f"batch shape {data.shape} != ({batch}, {capacity})"
             )
-        ckey = prng.case_key(base, case_idx)
-        keys = prng.sample_keys(ckey, batch)
-        return fuzz_batch(
-            keys, data, lens, scores, jnp.asarray(pri), jnp.asarray(pat_pri),
-            engine=engine, enable_sizer=enable_sizer, enable_csum=enable_csum,
-        )
+        # identical keys to the class step with indices = arange(batch):
+        # prng.sample_keys is exactly vmap(fold_in) over arange
+        return class_step(base, case_idx, indices, data, lens, scores)
 
-    return jax.jit(step), init_scores
+    return step, init_scores

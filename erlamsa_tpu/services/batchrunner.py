@@ -33,9 +33,10 @@ def _load_corpus(paths: list[str], recursive: bool) -> list[bytes]:
 def run_tpu_batch(opts: dict, batch: int = 1024) -> int:
     import jax
 
+    from ..constants import CAPACITY_CLASSES
     from ..ops import prng
     from ..ops.buffers import Batch, capacity_for, pack, unpack
-    from ..ops.pipeline import make_fuzzer
+    from ..ops.pipeline import make_class_fuzzer
     from ..ops.registry import DEVICE_CODES
     from ..ops.scheduler import init_scores
 
@@ -46,8 +47,27 @@ def run_tpu_batch(opts: dict, batch: int = 1024) -> int:
 
     # replicate seeds round-robin up to the batch size
     corpus = [seeds[i % len(seeds)] for i in range(batch)]
-    cap = capacity_for(max(len(s) for s in corpus))
-    packed = pack(corpus, capacity=cap)
+
+    # capacity classes (SURVEY.md §5.7/§7.3-2): group samples by the
+    # smallest capacity class that fits them so a corpus with one huge
+    # file doesn't pad every sample to the giant class — XLA compiles one
+    # program per class and each runs at its natural width. Samples beyond
+    # the device budget overflow to the host oracle entirely.
+    device_max = int(opts.get("device_capacity_max", CAPACITY_CLASSES[-1]))
+    class_indices: dict[int, list[int]] = {}
+    overflow_idx: list[int] = []
+    for i, s in enumerate(corpus):
+        cls = capacity_for(len(s))
+        if cls > device_max:
+            overflow_idx.append(i)
+        else:
+            class_indices.setdefault(cls, []).append(i)
+    class_batches = {
+        cls: (np.asarray(idx, np.int32),
+              pack([corpus[i] for i in idx], capacity=cls))
+        for cls, idx in sorted(class_indices.items())
+    }
+    overflow_set = set(overflow_idx)
 
     # device-capable subset of the selected mutators; host-capable rows go
     # to the hybrid dispatcher's oracle pool
@@ -68,7 +88,10 @@ def run_tpu_batch(opts: dict, batch: int = 1024) -> int:
     hybrid = HybridDispatcher(list(selected.items()), opts["seed"],
                               max_running_time=service_budget(opts))
 
-    step, _ = make_fuzzer(cap, batch, mutator_pri=pri)
+    # one jitted class step, retraced per (B_cls, capacity) shape; keys are
+    # derived from the ORIGINAL corpus index, so per-sample streams don't
+    # depend on how the classes partition the batch
+    step = make_class_fuzzer(mutator_pri=pri)
     base = prng.base_key(opts["seed"])
     scores = init_scores(jax.random.fold_in(base, 999), batch)
 
@@ -111,11 +134,37 @@ def run_tpu_batch(opts: dict, batch: int = 1024) -> int:
                   file=sys.stderr)
             return 0
 
+    if overflow_idx:
+        print(f"# {len(overflow_idx)} samples exceed the device budget "
+              f"({device_max}B class): oracle-routed", file=sys.stderr)
+
+    from ..oracle.engine import fuzz as oracle_fuzz
+    from ..utils.watchdog import CaseTimeout, run_with_timeout
+
+    overflow_budget = service_budget(opts)
+
+    def fuzz_overflow(case_idx: int) -> dict[int, bytes]:
+        """Host escape for samples beyond the largest device class: the
+        full oracle pipeline with the complete selected mutator set, under
+        the same per-case budget as host-routed hybrid samples (overflow
+        samples are the biggest files — the likeliest to be slow)."""
+        res = {}
+        for i in overflow_idx:
+            seed3 = (opts["seed"][0], opts["seed"][1] ^ case_idx,
+                     opts["seed"][2] ^ (i + 1))
+            try:
+                res[i] = run_with_timeout(
+                    oracle_fuzz, overflow_budget, corpus[i], seed=seed3,
+                    mutations=list(selected.items()),
+                )
+            except CaseTimeout:
+                res[i] = b""  # abandoned; the slot still emits
+        return res
+
     writer, _mt = out.string_outputs(opts.get("output", "-"))
     total = 0
     host_total = 0
     t0 = time.perf_counter()
-    data, lens = packed.data, packed.lens
     # -n is the TOTAL case target, like the reference: resume completes the
     # original run rather than adding n more cases
     for case in range(start_case, n_cases):
@@ -123,22 +172,36 @@ def run_tpu_batch(opts: dict, batch: int = 1024) -> int:
         # reference's score*pri mux mass (erlamsa_mutations.erl:1244-1250)
         host_mask = hybrid.split(case, corpus,
                                  device_scores=np.asarray(scores))
-        # device mutates the WHOLE batch (async); the host pool handles its
-        # share in parallel, and host results override at merge time
-        new_data, new_lens, scores, meta = step(base, case, data, lens, scores)
+        # device mutates every class batch (async dispatch); the host pool
+        # handles its share in parallel, and host results override at merge
+        results: dict[int, bytes] = {}
+        class_outputs = []
+        for cls, (idx, packed) in class_batches.items():
+            new_data, new_lens, new_cls_scores, _meta = step(
+                base, case, idx, packed.data, packed.lens, scores[idx],
+            )
+            class_outputs.append((idx, new_data, new_lens, new_cls_scores))
         host_results = {}
-        host_idx = [(i, corpus[i]) for i in np.nonzero(host_mask)[0]]
+        host_idx = [(i, corpus[i]) for i in np.nonzero(host_mask)[0]
+                    if i not in overflow_set]
         if host_idx:
             host_results = hybrid.fuzz_host(case, host_idx)
-        results = unpack(Batch(new_data, new_lens))
-        for i, rdata in enumerate(results):
-            payload = host_results.get(i, rdata)
+        overflow_results = fuzz_overflow(case) if overflow_idx else {}
+        for idx, new_data, new_lens, new_cls_scores in class_outputs:
+            outs = unpack(Batch(new_data, new_lens))
+            for j, i in enumerate(idx):
+                results[int(i)] = outs[j]
+            scores = scores.at[idx].set(new_cls_scores)
+        results.update(host_results)
+        results.update(overflow_results)
+        for i in range(batch):
+            payload = results.get(i, b"")
             if writer is not None:
                 writer(case * batch + i, payload, [])
             else:
                 sys.stdout.buffer.write(payload)
         total += len(results)
-        host_total += len(host_idx)
+        host_total += len(host_idx) + len(overflow_idx)
         if state_path:
             save_state(state_path, opts["seed"], case + 1, scores,
                        host_scores=hybrid.host_scores)

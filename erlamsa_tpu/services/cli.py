@@ -117,6 +117,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("-M", "--meta", default=None, help="write metadata to path")
     p.add_argument("-r", "--recursive", action="store_true")
     p.add_argument("-H", "--httpsvc", default=None, help="run FaaS at host:port")
+    p.add_argument("--device-capacity-max", type=int, default=None,
+                   metavar="BYTES",
+                   help="largest capacity class run on the device; bigger "
+                        "samples overflow to the host oracle")
     p.add_argument("--cmanager-store", default=None, metavar="PATH",
                    help="persist FaaS tokens/sessions to a JSON file "
                         "(the reference keeps them in mnesia)")
@@ -198,6 +202,8 @@ def main(argv=None) -> int:
         "maxrunningtime": args.maxrunningtime,
         "sequence_muta": args.sequence_muta,
         "recursive": args.recursive,
+        **({"device_capacity_max": args.device_capacity_max}
+           if args.device_capacity_max is not None else {}),
         "workers": args.workers,
         "workers_same_seed": args.workers_same_seed,
         "output": args.output,

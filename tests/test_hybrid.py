@@ -71,6 +71,35 @@ def test_checkpoint_roundtrip(tmp_path):
     assert load_state(p)[3] == {}
 
 
+def test_batchrunner_capacity_classes_and_overflow(tmp_path):
+    """Mixed-size corpus: small and large seeds run in separate capacity
+    classes; seeds beyond the device budget overflow to the host oracle —
+    and every case still emits one output per batch slot."""
+    from erlamsa_tpu.services.batchrunner import run_tpu_batch
+
+    small = tmp_path / "small.bin"
+    small.write_bytes(b"tiny seed 1\n" * 4)          # 256B class
+    big = tmp_path / "big.bin"
+    big.write_bytes(b"BIGSEED %d\n" % 7 * 150)       # 1500B -> 4096B class
+    huge = tmp_path / "huge.bin"
+    huge.write_bytes(b"H" * 3000)                    # beyond device_max below
+
+    opts = {
+        "paths": [str(small), str(big), str(huge)], "n": 1,
+        "seed": (3, 3, 3), "output": str(tmp_path / "o-%n.bin"),
+        "mutations": [("bd", 1), ("bf", 1)],
+        "device_capacity_max": 4096,
+    }
+    assert run_tpu_batch(dict(opts), batch=6) == 0
+    outs = [(tmp_path / f"o-{i}.bin").read_bytes() for i in range(6)]
+    assert all(o != b"" for o in outs)
+    # determinism across runs with the same grouping
+    opts["output"] = str(tmp_path / "p-%n.bin")
+    assert run_tpu_batch(dict(opts), batch=6) == 0
+    outs2 = [(tmp_path / f"p-{i}.bin").read_bytes() for i in range(6)]
+    assert outs == outs2
+
+
 def test_batchrunner_resume(tmp_path, monkeypatch, capsys):
     from erlamsa_tpu.services.batchrunner import run_tpu_batch
 
