@@ -1,0 +1,150 @@
+"""Device crc32: trailer-checksum detection and recompute for the cs
+pattern.
+
+The reference brute-forces preamble offsets, recomputing crc32 of each
+suffix with erlang:crc32 (src/erlamsa_field_predict.erl:129-161) — O(n*k)
+sequential work. The TPU-native trick is GF(2) linearity: for a message
+ending at byte e, the pure (init-free) CRC is the XOR of per-byte
+contributions G[d, bit] that depend only on the byte's distance d from
+the end — so
+
+  crc32(data[a:e)) = Z[e-a]  ^  XOR_{j=a..e-1} G[e-1-j, bits(data[j])]
+
+where Z[m] = crc32 of m zero bytes carries the init/final-xor affine
+part. One reversed associative XOR-scan over the per-byte contributions
+yields the crc of EVERY suffix at once; the tables are host-precomputed
+per capacity (static at trace time) and addressed with a single roll by
+the scalar e — no gathers.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..constants import PREAMBLE_MAX_BYTES
+from . import prng
+
+_POLY = 0xEDB88320  # reflected crc32 polynomial
+
+
+@functools.lru_cache(maxsize=None)
+def _byte_table() -> np.ndarray:
+    """Standard reflected per-byte step table T[x] (linear in x)."""
+    t = np.empty(256, np.uint32)
+    for x in range(256):
+        c = x
+        for _ in range(8):
+            c = (c >> 1) ^ (_POLY if c & 1 else 0)
+        t[x] = c
+    return t
+
+
+@functools.lru_cache(maxsize=None)
+def _tables(L: int) -> tuple[np.ndarray, np.ndarray]:
+    """(G, Z) for buffers of capacity L.
+
+    G: uint32[L, 8] — G[d, k] is the pure-linear crc contribution of bit k
+    of a byte d positions before the message end. Z: uint32[L + 1] — Z[m]
+    = crc32 of m zero bytes (the affine init/final part).
+    """
+    t = _byte_table()
+    G = np.empty((L, 8), np.uint32)
+    # d = 0: the byte is last; its pure contribution is T-step from zero
+    # state, which for value v is t[v]; bits are linear so G[0, k] = t[1<<k]
+    state = np.array([t[1 << k] for k in range(8)], np.uint32)
+    for d in range(L):
+        G[d] = state
+        # append one zero byte: s' = (s >> 8) ^ T[s & 0xff] (linear in s)
+        state = (state >> 8) ^ t[state & 0xFF]
+    Z = np.empty(L + 1, np.uint32)
+    z = 0xFFFFFFFF
+    Z[0] = z ^ 0xFFFFFFFF
+    for m in range(1, L + 1):
+        z = (z >> 8) ^ t[z & 0xFF]
+        Z[m] = z ^ 0xFFFFFFFF
+    return G, Z
+
+
+def _per_byte_contrib(data, e):
+    """uint32[L]: pure-linear contribution of each byte toward the crc of
+    a message ending at e (zeros at and beyond e)."""
+    L = data.shape[0]
+    i = jnp.arange(L, dtype=jnp.int32)
+    G_np, _ = _tables(L)
+    # Gr_static[i] = G[L-1-i]; rolling by (e - L) lands G[e-1-j] at row j
+    Gr = jnp.roll(jnp.asarray(G_np[::-1]), e - L, axis=0)
+    bits = (data[:, None] >> jnp.arange(8, dtype=jnp.uint8)[None, :]) & 1
+    contrib = jnp.where((bits == 1) & (i < e)[:, None], Gr, jnp.uint32(0))
+    out = jnp.zeros(L, jnp.uint32)
+    for k in range(8):
+        out = out ^ contrib[:, k]
+    return out
+
+
+def _z_at(L, m):
+    """Z[m] for a traced scalar m (gather on the tiny static Z table)."""
+    _, Z_np = _tables(L)
+    return jnp.asarray(Z_np)[jnp.clip(m, 0, L)]
+
+
+def crc32_of_range(data, a, b):
+    """uint32 scalar: crc32(data[a:b)), matching zlib.crc32."""
+    L = data.shape[0]
+    i = jnp.arange(L, dtype=jnp.int32)
+    c = _per_byte_contrib(data, b)
+    c = jnp.where(i >= a, c, jnp.uint32(0))
+    acc = jax.lax.associative_scan(jnp.bitwise_xor, c)[L - 1]
+    return acc ^ _z_at(L, jnp.maximum(b - a, 0))
+
+
+def crc32_suffixes(data, e):
+    """uint32[L]: out[a] = crc32(data[a:e)) for every preamble a <= e —
+    one reversed XOR-scan instead of the reference's per-offset rescans."""
+    L = data.shape[0]
+    i = jnp.arange(L, dtype=jnp.int32)
+    c = _per_byte_contrib(data, e)
+    sfx = jnp.flip(jax.lax.associative_scan(jnp.bitwise_xor, jnp.flip(c)))
+    Zr = jnp.roll(jnp.asarray(_tables(L)[1][::-1]), e - L)[
+        jnp.clip(i, 0, L - 1)
+    ]
+    # Zr[a] = Z[e - a] (Z reversed, rolled by the scalar e)
+    return sfx ^ Zr
+
+
+def detect_crc32(key, data, n):
+    """Find a random crc32 trailer: preambles a where the last 4 bytes
+    (big-endian, matching the oracle's fieldpred) equal crc32(data[a:n-4)).
+
+    Returns (found, a).
+    """
+    L = data.shape[0]
+    i = jnp.arange(L, dtype=jnp.int32)
+    e = jnp.maximum(n - 4, 0)
+    stored = (
+        data[jnp.clip(n - 4, 0, L - 1)].astype(jnp.uint32) << 24
+        | data[jnp.clip(n - 3, 0, L - 1)].astype(jnp.uint32) << 16
+        | data[jnp.clip(n - 2, 0, L - 1)].astype(jnp.uint32) << 8
+        | data[jnp.clip(n - 1, 0, L - 1)].astype(jnp.uint32)
+    )
+    crcs = crc32_suffixes(jnp.where(i < n, data, jnp.uint8(0)), e)
+    limit = jnp.minimum(2 * n // 3, 30 * PREAMBLE_MAX_BYTES)
+    cand = (crcs == stored) & (i <= limit) & (n - i >= 4) & (n >= 4)
+    total = jnp.sum(cand).astype(jnp.int32)
+    found = total > 0
+    r = prng.rand(prng.sub(key, prng.TAG_LEN), total)
+    cum = jnp.cumsum(cand).astype(jnp.int32)
+    a = jnp.argmax(cand & (cum == r + 1)).astype(jnp.int32)
+    return found, a
+
+
+def write_crc32_be(data, pos, crc):
+    """Write the 4 big-endian crc bytes at [pos, pos+4)."""
+    L = data.shape[0]
+    i = jnp.arange(L, dtype=jnp.int32)
+    k = i - pos
+    byte = (crc >> jnp.clip((3 - k) * 8, 0, 31)).astype(jnp.uint32) & 0xFF
+    return jnp.where((k >= 0) & (k < 4), byte.astype(jnp.uint8), data)

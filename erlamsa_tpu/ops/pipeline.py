@@ -95,20 +95,34 @@ def fuzz_sample(key, data, n, scores, pri, pat_pri, engine: str = "fused",
         use_sz = jnp.bool_(False)
         field_a = field_w = field_kind = jnp.int32(0)
 
-    # cs: mutate the body behind a detected xor8 trailer checksum, keep the
-    # preamble, recompute the trailer afterwards (device path covers xor8;
-    # crc32 stays on the oracle)
+    # cs: mutate the body behind a detected trailer checksum (xor8 1-byte
+    # or big-endian crc32 4-byte, ops/crc32.py), keep the preamble,
+    # recompute the trailer afterwards. The oracle draws uniformly over
+    # all candidate locations of both kinds; the device picks a location
+    # per kind and then a kind (uniform when both exist) — documented
+    # divergence, same detection envelope.
     if enable_csum:
-        cs_found, cs_a = detect_xor8(prng.sub(key, prng.TAG_VAL), data, n)
+        from .crc32 import crc32_of_range, detect_crc32, write_crc32_be
+
+        kx = prng.sub(key, prng.TAG_VAL)
+        x_found, x_a = detect_xor8(kx, data, n)
+        c_found, c_a = detect_crc32(kx, data, n)
+        both = x_found & c_found
+        pick_crc = jnp.where(
+            both, prng.rand(prng.sub(kx, prng.TAG_POS), 2) == 1, c_found
+        )
+        cs_found = x_found | c_found
+        cs_a = jnp.where(pick_crc, c_a, x_a)
+        cs_w = jnp.where(pick_crc, 4, 1)  # trailer width held out below
         use_cs = (pat == CS) & cs_found & ~use_sz
         skip = jnp.where(use_cs, cs_a, skip)
     else:
         use_cs = jnp.bool_(False)
 
     work, wn = _shift_left(data, n, skip)
-    # the checksum byte itself is held out of the mutable region
+    # the checksum bytes themselves are held out of the mutable region
     if enable_csum:
-        wn = jnp.where(use_cs, jnp.maximum(wn - 1, 0), wn)
+        wn = jnp.where(use_cs, jnp.maximum(wn - cs_w, 0), wn)
 
     def body(r, carry):
         wdata, wlen, sc, log = carry
@@ -143,12 +157,17 @@ def fuzz_sample(key, data, n, scores, pri, pat_pri, engine: str = "fused",
             out,
         )
     if enable_csum:
-        # cs: append the recomputed xor8 trailer over the mutated body
+        # cs: append the recomputed trailer over the mutated body
         L = data.shape[0]
-        cs_pos = jnp.minimum(n_out, L - 1)
-        csum = xor8_of_range(out, skip, cs_pos)
-        out_cs = out.at[cs_pos].set(csum)
-        n_out_cs = jnp.minimum(n_out + 1, L)
+        cs_pos = jnp.minimum(n_out, L - cs_w)
+        xsum = xor8_of_range(out, skip, cs_pos)
+        crc = crc32_of_range(out, skip, cs_pos)
+        out_cs = jnp.where(
+            pick_crc,
+            write_crc32_be(out, cs_pos, crc),
+            out.at[jnp.clip(cs_pos, 0, L - 1)].set(xsum),
+        )
+        n_out_cs = jnp.minimum(n_out + cs_w, L)
         out = jnp.where(use_cs, out_cs, out)
         n_out = jnp.where(use_cs, n_out_cs, n_out)
     return out, n_out, scores, pat, log

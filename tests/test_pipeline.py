@@ -166,3 +166,50 @@ def test_checksum_pattern_recomputes_xor8(state):
         if x == o[-1]:
             fixed += 1
     assert fixed > 10
+
+
+def test_checksum_pattern_recomputes_crc32(state):
+    """A crc32-trailered sample under the cs pattern must come out with a
+    VALID crc32 over the mutated body (ops/crc32.py device recompute —
+    the reference's erlang:crc32 path, erlamsa_field_predict.erl:148)."""
+    import zlib
+
+    base, scores = state
+    pat_pri = [0, 0, 0, 0, 0, 0, 0, 1]  # cs only
+    f, _ = make_fuzzer(L, 32, pattern_pri=pat_pri)
+    body = b"CRC32_GUARDED_BODY_0123456789abcdefghij"
+    trailer = (zlib.crc32(body) & 0xFFFFFFFF).to_bytes(4, "big")
+    seeds = [body + trailer] * 32
+    batch = pack(seeds, capacity=L)
+    data, lens, _, _ = f(base, 0, batch.data, batch.lens, scores[:32])
+    outs = unpack(Batch(data, lens))
+    fixed = mutated = 0
+    for o in outs:
+        if o == seeds[0] or len(o) < 5:
+            continue
+        mutated += 1
+        want = (zlib.crc32(o[:-4]) & 0xFFFFFFFF).to_bytes(4, "big")
+        if o[-4:] == want:
+            fixed += 1
+    assert mutated > 10
+    assert fixed > 10
+
+
+def test_crc32_device_matches_zlib():
+    import zlib
+
+    import jax.numpy as jnp
+
+    from erlamsa_tpu.ops.crc32 import crc32_of_range, crc32_suffixes
+
+    rng = np.random.default_rng(5)
+    raw = rng.integers(0, 256, L, dtype=np.uint8)
+    d = jnp.asarray(raw)
+    for a, b in [(0, L), (3, 97), (50, 51), (10, 10)]:
+        assert int(crc32_of_range(d, a, b)) == (
+            zlib.crc32(raw[a:b].tobytes()) & 0xFFFFFFFF
+        )
+    e = 113
+    sfx = np.asarray(crc32_suffixes(d, e))
+    for a in (0, 1, 57, 112, 113):
+        assert int(sfx[a]) == zlib.crc32(raw[a:e].tobytes()) & 0xFFFFFFFF
