@@ -36,10 +36,12 @@ class OracleBatcher:
     fsupervisor reaper's job (src/erlamsa_fsupervisor.erl:96-105)."""
 
     def __init__(self, workers: int = 10, max_running_time: float = 30.0):
+        from .supervisor import supervise
+
         self._q: queue.Queue[_Req] = queue.Queue()
         self.max_running_time = max_running_time
-        for _ in range(workers):
-            threading.Thread(target=self._worker, daemon=True).start()
+        for w in range(workers):
+            supervise(f"oracle-batcher-{w}", self._worker)
 
     def _worker(self):
         from ..oracle.engine import fuzz
@@ -89,7 +91,9 @@ class TpuBatcher:
         self._base = prng.base_key(seed or gen_urandom_seed())
         self._scores = init_scores(jax.random.fold_in(self._base, 999), batch)
         self._case = 0
-        threading.Thread(target=self._flusher, daemon=True).start()
+        from .supervisor import supervise
+
+        supervise("tpu-batcher-flusher", self._flusher)
 
     def _flusher(self):
         import numpy as np

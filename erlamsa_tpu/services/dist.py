@@ -56,7 +56,9 @@ class NodePool:
         import random as _pyrandom
 
         self._rng = _pyrandom.Random(str(gen_urandom_seed()))
-        threading.Thread(target=self._evict_loop, daemon=True).start()
+        from .supervisor import supervise
+
+        supervise("nodepool-evict", self._evict_loop)
 
     def join(self, host: str, port: int):
         with self._lock:
@@ -149,7 +151,9 @@ class ParentServer:
         if block:
             loop()
             return 0
-        threading.Thread(target=loop, daemon=True).start()
+        from .supervisor import supervise
+
+        supervise("dist-parent-accept", loop)
         return self
 
     def stop(self):
@@ -196,8 +200,9 @@ class WorkerNode:
                     logger.log("warning", "keepalive to parent failed: %s", e)
                 self._stop.wait(NODE_KEEPALIVE)
 
-        t = threading.Thread(target=keepalive, daemon=True)
-        t.start()
+        from .supervisor import supervise
+
+        t = supervise("node-keepalive", keepalive)
         if block:
             t.join()
             return 0

@@ -413,7 +413,9 @@ class FuzzProxy:
         if block:
             target()
             return 0
-        threading.Thread(target=target, daemon=True).start()
+        from .supervisor import supervise
+
+        supervise(f"fuzzproxy-{self.proto}", target)
         return self
 
     def stop(self):

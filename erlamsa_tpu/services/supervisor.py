@@ -1,0 +1,81 @@
+"""Restart supervision for service threads.
+
+Reference: erlamsa's OTP supervisor runs logger/fsupervisor/monitors/
+proxy/httpsvc one_for_one with intensity 5 restarts per 1 second
+(src/erlamsa_sup.erl:51-54) — a crashed service child is restarted, and a
+crash loop terminates the tree instead of spinning. Python threads don't
+restart themselves, so service loops here run under ``supervise``: the
+target is re-invoked on an unhandled exception, with the reference's
+intensity/period circuit breaker.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+from . import logger
+
+RESTART_INTENSITY = 5  # src/erlamsa_sup.erl:51-54
+RESTART_PERIOD = 1.0
+
+
+class SupervisedThread:
+    """A daemon thread whose target is restarted on crash (one_for_one).
+
+    After more than `intensity` crashes within `period` seconds the
+    supervisor gives up (like OTP escalating a restart storm), logs at
+    critical, and the thread exits. A target that RETURNS normally is
+    considered finished — only exceptions restart it.
+    """
+
+    def __init__(self, name: str, target, args=(), kwargs=None,
+                 intensity: int = RESTART_INTENSITY,
+                 period: float = RESTART_PERIOD):
+        self.name = name
+        self.target = target
+        self.args = args
+        self.kwargs = kwargs or {}
+        self.intensity = intensity
+        self.period = period
+        self.crashes: list[float] = []
+        self.gave_up = False
+        self._thread = threading.Thread(
+            target=self._run, name=f"sup:{name}", daemon=True
+        )
+
+    def _run(self):
+        while True:
+            try:
+                self.target(*self.args, **self.kwargs)
+                return  # normal completion: don't resurrect
+            except Exception as e:
+                now = time.monotonic()
+                self.crashes = [
+                    t for t in self.crashes if now - t < self.period
+                ] + [now]
+                if len(self.crashes) > self.intensity:
+                    self.gave_up = True
+                    logger.log(
+                        "critical",
+                        "service %s crashed %d times in %.1fs, giving up: %s",
+                        self.name, len(self.crashes), self.period, e,
+                    )
+                    return
+                logger.log("error", "service %s crashed, restarting: %s",
+                           self.name, e)
+
+    def start(self) -> "SupervisedThread":
+        self._thread.start()
+        return self
+
+    def join(self, timeout=None):
+        self._thread.join(timeout)
+
+    def is_alive(self) -> bool:
+        return self._thread.is_alive()
+
+
+def supervise(name: str, target, *args, **kwargs) -> SupervisedThread:
+    """Start `target(*args)` in a supervised daemon thread."""
+    return SupervisedThread(name, target, args, kwargs).start()

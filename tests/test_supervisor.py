@@ -1,0 +1,63 @@
+"""Service-thread restart supervision (erlamsa_sup.erl:51-54 semantics)."""
+
+import threading
+import time
+
+from erlamsa_tpu.services.supervisor import SupervisedThread, supervise
+
+
+def test_crashing_target_is_restarted():
+    attempts = []
+    done = threading.Event()
+
+    def flaky():
+        attempts.append(1)
+        if len(attempts) < 3:
+            raise RuntimeError("boom")
+        done.set()
+
+    t = supervise("flaky", flaky)
+    assert done.wait(10)
+    t.join(5)
+    assert len(attempts) == 3
+    assert not t.gave_up
+
+
+def test_crash_storm_gives_up():
+    attempts = []
+
+    def storm():
+        attempts.append(1)
+        raise RuntimeError("always")
+
+    t = SupervisedThread("storm", storm, intensity=3, period=60.0).start()
+    t.join(10)
+    assert not t.is_alive()
+    assert t.gave_up
+    # intensity 3 => at most 4 attempts (the 4th crash trips the breaker)
+    assert len(attempts) == 4
+
+
+def test_slow_crashes_outside_period_keep_restarting():
+    attempts = []
+    done = threading.Event()
+
+    def slow_flaky():
+        attempts.append(1)
+        if len(attempts) <= 4:
+            time.sleep(0.05)
+            raise RuntimeError("spread out")
+        done.set()
+
+    # period so short every crash window holds one crash: never gives up
+    t = SupervisedThread("slow", slow_flaky, intensity=1, period=0.01).start()
+    assert done.wait(10)
+    t.join(5)
+    assert not t.gave_up and len(attempts) == 5
+
+
+def test_normal_return_is_not_restarted():
+    calls = []
+    t = supervise("oneshot", lambda: calls.append(1))
+    t.join(5)
+    assert calls == [1] and not t.is_alive()
