@@ -17,6 +17,7 @@ import time
 from dataclasses import dataclass, field
 
 from ..utils.erlrand import gen_urandom_seed
+from .supervisor import supervise
 
 
 @dataclass
@@ -36,8 +37,6 @@ class OracleBatcher:
     fsupervisor reaper's job (src/erlamsa_fsupervisor.erl:96-105)."""
 
     def __init__(self, workers: int = 10, max_running_time: float = 30.0):
-        from .supervisor import supervise
-
         self._q: queue.Queue[_Req] = queue.Queue()
         self.max_running_time = max_running_time
         for w in range(workers):
@@ -91,8 +90,6 @@ class TpuBatcher:
         self._base = prng.base_key(seed or gen_urandom_seed())
         self._scores = init_scores(jax.random.fold_in(self._base, 999), batch)
         self._case = 0
-        from .supervisor import supervise
-
         supervise("tpu-batcher-flusher", self._flusher)
 
     def _flusher(self):
@@ -111,17 +108,27 @@ class TpuBatcher:
                     reqs.append(self._q.get(timeout=remaining))
                 except queue.Empty:
                     break
-            seeds = [r.data[: self.capacity] for r in reqs]
-            pad = [b"\x00"] * (self.batch - len(seeds))
-            packed = pack(seeds + pad, capacity=self.capacity)
-            data, lens, self._scores, _meta = self._step(
-                self._base, self._case, packed.data, packed.lens, self._scores
-            )
-            self._case += 1
-            results = unpack(Batch(data, lens))
-            for r, res in zip(reqs, results):
-                r.result = res
-                r.done.set()
+            try:
+                seeds = [r.data[: self.capacity] for r in reqs]
+                pad = [b"\x00"] * (self.batch - len(seeds))
+                packed = pack(seeds + pad, capacity=self.capacity)
+                data, lens, self._scores, _meta = self._step(
+                    self._base, self._case, packed.data, packed.lens,
+                    self._scores,
+                )
+                self._case += 1
+                results = unpack(Batch(data, lens))
+                for r, res in zip(reqs, results):
+                    r.result = res
+                    r.done.set()
+            except BaseException:
+                # a device error mid-batch must not strand the collected
+                # requests until their client timeout: answer empty (the
+                # fsupervisor give-up convention) before the supervisor
+                # restarts this loop
+                for r in reqs:
+                    r.done.set()
+                raise
 
     def fuzz(self, data: bytes, opts: dict, timeout: float = 90.0) -> bytes:
         req = _Req(data, opts)

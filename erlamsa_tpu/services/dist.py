@@ -26,6 +26,7 @@ from ..constants import NODE_ALIVE_DELTA, NODE_KEEPALIVE, NODES_CHECKTIMER
 from ..utils.erlrand import gen_urandom_seed
 from . import logger
 from .batcher import make_batcher
+from .supervisor import supervise
 
 
 def _send_json(sock: socket.socket, obj: dict):
@@ -56,8 +57,6 @@ class NodePool:
         import random as _pyrandom
 
         self._rng = _pyrandom.Random(str(gen_urandom_seed()))
-        from .supervisor import supervise
-
         supervise("nodepool-evict", self._evict_loop)
 
     def join(self, host: str, port: int):
@@ -151,8 +150,6 @@ class ParentServer:
         if block:
             loop()
             return 0
-        from .supervisor import supervise
-
         supervise("dist-parent-accept", loop)
         return self
 
@@ -199,8 +196,6 @@ class WorkerNode:
                 except (OSError, ValueError) as e:
                     logger.log("warning", "keepalive to parent failed: %s", e)
                 self._stop.wait(NODE_KEEPALIVE)
-
-        from .supervisor import supervise
 
         t = supervise("node-keepalive", keepalive)
         if block:

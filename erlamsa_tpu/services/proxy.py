@@ -29,6 +29,7 @@ import threading
 from ..utils.erlrand import gen_urandom_seed
 from . import logger
 from .batcher import make_batcher
+from .supervisor import supervise
 
 
 def parse_proxy_spec(spec: str):
@@ -334,24 +335,31 @@ class FuzzProxy:
         self._srv = srv
         logger.log("info", "fuzzproxy %s://%d -> %s:%d",
                    self.proto, self.lport, self.rhost, self.rport)
-        while not self._stop.is_set():
-            try:
-                client, _addr = srv.accept()
-            except OSError:
-                break
-            if self.proto == "connect":
-                threading.Thread(
-                    target=self._handle_connect, args=(client,), daemon=True
-                ).start()
-            else:
-                self._handle_tcp(client)
+        try:
+            while not self._stop.is_set():
+                try:
+                    client, _addr = srv.accept()
+                except OSError:
+                    break
+                if self.proto == "connect":
+                    threading.Thread(
+                        target=self._handle_connect, args=(client,),
+                        daemon=True,
+                    ).start()
+                else:
+                    self._handle_tcp(client)
+        finally:
+            srv.close()  # a supervised restart must be able to re-bind
 
     # --- UDP (loop_udp, erlamsa_fuzzproxy.erl:226-259) --------------------
 
     def _serve_udp(self):
         import select
 
+        # bound OUTSIDE the try: a bind failure must not look like a
+        # recoverable crash to the supervisor
         srv = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         srv.bind(("0.0.0.0", self.lport))
         self._srv = srv
         # upstream-facing socket: client packets go out of it, so server
@@ -391,7 +399,12 @@ class FuzzProxy:
                             data, self.prob_sc, counts["s->c"], "s->c",
                             conn_state,
                         )
-                        srv.sendto(out, client_addr)
+                        try:
+                            srv.sendto(out, client_addr)
+                        except OSError:
+                            # a vanished client answers with ICMP
+                            # port-unreachable; drop, keep serving
+                            pass
                     else:
                         client_addr = addr
                         counts["c->s"] += 1
@@ -399,9 +412,15 @@ class FuzzProxy:
                             data, self.prob_cs, counts["c->s"], "c->s",
                             conn_state,
                         )
-                        up.sendto(out, (r_ip, self.rport))
+                        try:
+                            up.sendto(out, (r_ip, self.rport))
+                        except OSError:
+                            pass
         finally:
+            # release the listen port too, so a supervised restart can
+            # re-bind instead of dying on EADDRINUSE
             up.close()
+            srv.close()
 
     def start(self, block: bool = True):
         if self.proto == "serial":
@@ -413,8 +432,6 @@ class FuzzProxy:
         if block:
             target()
             return 0
-        from .supervisor import supervise
-
         supervise(f"fuzzproxy-{self.proto}", target)
         return self
 
