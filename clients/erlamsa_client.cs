@@ -1,0 +1,54 @@
+// C# client for the erlamsa_tpu fuzzing-as-a-service endpoint
+// (python -m erlamsa_tpu -H host:port). Octet-stream API with options in
+// erlamsa-* headers, the contract of services/faas.py. Mirrors the role
+// of the reference's clients/csharp project.
+//
+// Build:  csc erlamsa_client.cs   (or drop the class into any project)
+// Usage:  erlamsa_client.exe http://127.0.0.1:17771 < input.bin > fuzzed.bin
+
+using System;
+using System.IO;
+using System.Net.Http;
+using System.Threading.Tasks;
+
+public static class ErlamsaClient
+{
+    /// Fuzz data via the octet-stream endpoint. seed/mutations/patterns
+    /// may be null; token enables authenticated services.
+    public static async Task<byte[]> Fuzz(
+        string baseUrl, byte[] data,
+        string seed = null, string mutations = null,
+        string patterns = null, string token = null)
+    {
+        using (var http = new HttpClient())
+        {
+            var content = new ByteArrayContent(data);
+            content.Headers.Add("Content-Type", "application/octet-stream");
+            if (seed != null) content.Headers.Add("erlamsa-seed", seed);
+            if (mutations != null) content.Headers.Add("erlamsa-mutations", mutations);
+            if (patterns != null) content.Headers.Add("erlamsa-patterns", patterns);
+            if (token != null) content.Headers.Add("erlamsa-token", token);
+
+            var resp = await http.PostAsync(
+                baseUrl + "/erlamsa/erlamsa_esi:fuzz", content);
+            resp.EnsureSuccessStatusCode();
+            return await resp.Content.ReadAsByteArrayAsync();
+        }
+    }
+
+    public static void Main(string[] args)
+    {
+        var url = args.Length > 0 ? args[0] : "http://127.0.0.1:17771";
+        byte[] input;
+        using (var ms = new MemoryStream())
+        {
+            Console.OpenStandardInput().CopyTo(ms);
+            input = ms.ToArray();
+        }
+        var fuzzed = Fuzz(url, input, seed: null).GetAwaiter().GetResult();
+        using (var stdout = Console.OpenStandardOutput())
+        {
+            stdout.Write(fuzzed, 0, fuzzed.Length);
+        }
+    }
+}

@@ -1,0 +1,84 @@
+// Go client for the erlamsa_tpu fuzzing-as-a-service endpoint
+// (python -m erlamsa_tpu -H host:port). JSON API with base64 payloads;
+// options ride in the same JSON object (seed/mutations/patterns), the
+// contract of services/faas.py. Mirrors the role of the reference's
+// clients/erlamsa_go_client_json.go.
+//
+// Usage:
+//
+//	go run erlamsa_client.go http://127.0.0.1:17771 < input.bin > fuzzed.bin
+package main
+
+import (
+	"bytes"
+	"encoding/base64"
+	"encoding/json"
+	"fmt"
+	"io"
+	"log"
+	"net/http"
+	"os"
+)
+
+// Fuzz sends data to the service and returns the mutated bytes.
+// opts may carry "seed", "mutations", "patterns", "blockscale", "token",
+// "session" — the fields services/faas.py accepts in the JSON body.
+func Fuzz(baseURL string, data []byte, opts map[string]string) ([]byte, error) {
+	body := map[string]string{
+		"data": base64.StdEncoding.EncodeToString(data),
+	}
+	for k, v := range opts {
+		body[k] = v
+	}
+	payload, err := json.Marshal(body)
+	if err != nil {
+		return nil, err
+	}
+	resp, err := http.Post(
+		baseURL+"/erlamsa/erlamsa_esi:json",
+		"application/json",
+		bytes.NewReader(payload),
+	)
+	if err != nil {
+		return nil, err
+	}
+	defer resp.Body.Close()
+	raw, err := io.ReadAll(resp.Body)
+	if err != nil {
+		return nil, err
+	}
+	var result map[string]interface{}
+	if err := json.Unmarshal(raw, &result); err != nil {
+		// non-JSON reply: surface the status and raw body
+		return nil, fmt.Errorf("HTTP %d: %s", resp.StatusCode, raw)
+	}
+	if errMsg, ok := result["error"].(string); ok {
+		return nil, fmt.Errorf("service error (HTTP %d): %s",
+			resp.StatusCode, errMsg)
+	}
+	if resp.StatusCode != http.StatusOK {
+		return nil, fmt.Errorf("HTTP %d: %s", resp.StatusCode, raw)
+	}
+	encoded, ok := result["data"].(string)
+	if !ok {
+		return nil, fmt.Errorf("no data field in reply")
+	}
+	return base64.StdEncoding.DecodeString(encoded)
+}
+
+func main() {
+	url := "http://127.0.0.1:17771"
+	if len(os.Args) > 1 {
+		url = os.Args[1]
+	}
+	input, err := io.ReadAll(os.Stdin)
+	if err != nil {
+		log.Fatalln(err)
+	}
+	// e.g. map[string]string{"seed": "1,2,3", "mutations": "bd,bf"}
+	fuzzed, err := Fuzz(url, input, nil)
+	if err != nil {
+		log.Fatalln(err)
+	}
+	os.Stdout.Write(fuzzed)
+}

@@ -1,0 +1,89 @@
+#!/usr/bin/env python3
+"""Frida bridge: fuzz a live process's input buffers through the
+erlamsa_tpu FaaS endpoint.
+
+Spawns (or attaches to) the target, loads intercept.js, and for every
+intercepted buffer posts it to the service and writes the mutated bytes
+back into the target's memory before the hooked function returns.
+Mirrors the role of the reference's clients/frida bridge.
+
+Usage:
+    python -m erlamsa_tpu -H 127.0.0.1:17771 &       # the service
+    ./fuzz_intercept.py /path/to/target [args...]    # the bridge
+"""
+
+import http.client
+import os
+import sys
+
+SERVICE = os.environ.get("ERLAMSA_URL", "127.0.0.1:17771")
+HEADERS = {"content-type": "application/octet-stream"}
+# forward fuzzing options, e.g. {"erlamsa-mutations": "bd,bf",
+# "erlamsa-seed": "1,2,3"} — services/faas.py header contract
+for key in ("erlamsa-seed", "erlamsa-mutations", "erlamsa-patterns"):
+    val = os.environ.get(key.replace("-", "_").upper())
+    if val:
+        HEADERS[key] = val
+
+
+def call_erlamsa(data: bytes) -> bytes:
+    """One octet-stream fuzz round-trip; b'' on ANY failure so the agent
+    leaves the intercepted buffer untouched instead of writing an HTTP
+    error body (or hanging the hooked thread) into the target."""
+    conn = http.client.HTTPConnection(SERVICE)
+    try:
+        conn.request("POST", "/erlamsa/erlamsa_esi:fuzz", data, HEADERS)
+        resp = conn.getresponse()
+        body = resp.read()
+        if resp.status != 200:
+            print(f"[!] service error {resp.status}: {body[:120]!r}",
+                  file=sys.stderr)
+            return b""
+        return body
+    except OSError as e:
+        print(f"[!] service unreachable: {e}", file=sys.stderr)
+        return b""
+    finally:
+        conn.close()
+
+
+def main(argv: list[str]) -> int:
+    try:
+        import frida
+    except ImportError:
+        print("frida is not installed (pip install frida-tools)",
+              file=sys.stderr)
+        return 1
+
+    pid = frida.spawn(argv)
+    session = frida.attach(pid)
+    here = os.path.dirname(os.path.abspath(__file__))
+    with open(os.path.join(here, "intercept.js")) as f:
+        script = session.create_script(f.read())
+
+    def on_message(message, data):
+        if message.get("type") != "send":
+            print(message, file=sys.stderr)
+            return
+        # per-call correlated reply: the agent waits on "fuzzed-<id>"
+        req_id = message.get("payload", {}).get("id", 0)
+        fuzzed = call_erlamsa(data or b"")
+        script.post({"type": f"fuzzed-{req_id}"}, fuzzed)
+
+    script.on("message", on_message)
+    script.load()
+    frida.resume(pid)
+    print("[*] fuzzing buffers; Ctrl+C to detach", file=sys.stderr)
+    try:
+        sys.stdin.read()
+    except KeyboardInterrupt:
+        pass
+    session.detach()
+    return 0
+
+
+if __name__ == "__main__":
+    if len(sys.argv) < 2:
+        print(f"usage: {sys.argv[0]} <target> [args...]", file=sys.stderr)
+        raise SystemExit(1)
+    raise SystemExit(main(sys.argv[1:]))

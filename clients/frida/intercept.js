@@ -1,0 +1,65 @@
+/*
+ * Frida agent: intercept a buffer-carrying function in the target and
+ * hand each buffer to the host script for fuzzing (clients/frida/
+ * fuzz_intercept.py -> erlamsa_tpu FaaS). The host posts back the
+ * mutated bytes, which overwrite the buffer in place before the
+ * original function returns — in-process fuzzing without touching the
+ * target's source. Mirrors the role of the reference's clients/frida.
+ *
+ * Replies are correlated per call (recv type "fuzzed-<id>"), so
+ * concurrent hooked calls on different threads can't cross-wire
+ * buffers. An empty reply means "leave the buffer untouched" (the host
+ * sends that when the service call fails).
+ *
+ * Configure TARGET below (module/export and which arg holds buf/len).
+ */
+
+const TARGET = {
+    module: null,          // e.g. "libc.so" (null = any loaded module)
+    symbol: "read",        // function whose buffer we fuzz
+    bufArg: 1,             // index of the buffer pointer argument
+    lenFromRet: true,      // buffer length = return value (read-style)
+    lenArg: 2,             // else: index of the length argument
+};
+
+function findTarget(mod, sym) {
+    // Frida >= 17 removed Module.findExportByName(mod, sym)
+    if (typeof Module.findExportByName === "function") {
+        return Module.findExportByName(mod, sym);
+    }
+    if (mod !== null) {
+        return Process.getModuleByName(mod).findExportByName(sym);
+    }
+    return Module.findGlobalExportByName(sym);
+}
+
+const addr = findTarget(TARGET.module, TARGET.symbol);
+if (addr === null) {
+    throw new Error("symbol not found: " + TARGET.symbol);
+}
+
+let nextId = 0;
+
+Interceptor.attach(addr, {
+    onEnter(args) {
+        this.buf = args[TARGET.bufArg];
+        this.len = TARGET.lenFromRet ? 0 : args[TARGET.lenArg].toInt32();
+    },
+    onLeave(retval) {
+        const n = TARGET.lenFromRet ? retval.toInt32() : this.len;
+        if (n <= 0) {
+            return;
+        }
+        const id = nextId++;
+        const data = this.buf.readByteArray(n);
+        send({ op: "fuzz", id: id, len: n }, data);
+        const buf = this.buf;
+        recv("fuzzed-" + id, (message, fuzzed) => {
+            if (fuzzed && fuzzed.byteLength > 0) {
+                // never grow past the target's buffer
+                const m = Math.min(fuzzed.byteLength, n);
+                buf.writeByteArray(fuzzed.slice(0, m));
+            }
+        }).wait();
+    },
+});

@@ -10,6 +10,7 @@ from the adaptive batcher instead of one process per request.
 from __future__ import annotations
 
 import base64
+import binascii
 import json
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
@@ -19,29 +20,49 @@ from .batcher import make_batcher
 from .cmanager import CloudManager
 
 
+def _parse_opts(get) -> dict:
+    """Shared option parsing for both transports: `get(name)` returns the
+    raw value for mutations/patterns/seed/blockscale or None. Values must
+    be strings (numbers allowed for blockscale) — anything else raises
+    ValueError so callers answer HTTP 400, never a connection abort."""
+    opts: dict = {}
+
+    def _want_str(name, v):
+        if not isinstance(v, str):
+            raise ValueError(f"{name} must be a string")
+        return v
+
+    m = get("mutations")
+    if m:
+        from ..oracle.mutations import default_mutations
+        from .cli import _parse_actions
+
+        opts["mutations"] = _parse_actions(
+            _want_str("mutations", m), default_mutations()
+        )
+    p = get("patterns")
+    if p:
+        from ..oracle.patterns import default_patterns
+        from .cli import _parse_actions
+
+        opts["patterns"] = _parse_actions(
+            _want_str("patterns", p), default_patterns()
+        )
+    s = get("seed")
+    if s:
+        opts["seed"] = parse_seed(_want_str("seed", s))
+    b = get("blockscale")
+    if b:
+        if not isinstance(b, (str, int, float)) or isinstance(b, bool):
+            raise ValueError("blockscale must be a number")
+        opts["blockscale"] = float(b)
+    return opts
+
+
 def _parse_header_opts(headers) -> dict:
     """erlamsa-mutations/patterns/seed/blockscale headers
     (erlamsa_esi:parse_headers, src/erlamsa_esi.erl:34-56)."""
-    opts: dict = {}
-    m = headers.get("erlamsa-mutations")
-    if m:
-        from .cli import _parse_actions
-        from ..oracle.mutations import default_mutations
-
-        opts["mutations"] = _parse_actions(m, default_mutations())
-    p = headers.get("erlamsa-patterns")
-    if p:
-        from .cli import _parse_actions
-        from ..oracle.patterns import default_patterns
-
-        opts["patterns"] = _parse_actions(p, default_patterns())
-    s = headers.get("erlamsa-seed")
-    if s:
-        opts["seed"] = parse_seed(s)
-    b = headers.get("erlamsa-blockscale")
-    if b:
-        opts["blockscale"] = float(b)
-    return opts
+    return _parse_opts(lambda name: headers.get(f"erlamsa-{name}"))
 
 
 class _Handler(BaseHTTPRequestHandler):
@@ -52,10 +73,23 @@ class _Handler(BaseHTTPRequestHandler):
     def log_message(self, fmt, *args):
         logger.log("debug", "faas: " + fmt, *args)
 
-    def _auth(self):
+    def _auth(self, body_req: dict | None = None):
+        """Token/session from erlamsa-* headers, or (JSON API) from the
+        request body — the reference accepts both (erlamsa_esi.erl
+        parse_headers:34-56 / parse_json:70-82)."""
         cm = self.cmanager
+        body_req = body_req or {}
+
+        def _str_or_none(v):
+            # non-string JSON values (dict/list/number) must not reach the
+            # token store — an unhashable value would crash pre-auth
+            return v if isinstance(v, str) else None
+
         status, session = cm.get_client_context(
-            self.headers.get("erlamsa-token"), self.headers.get("erlamsa-session")
+            self.headers.get("erlamsa-token")
+            or _str_or_none(body_req.get("token")),
+            self.headers.get("erlamsa-session")
+            or _str_or_none(body_req.get("session")),
         )
         return status, session
 
@@ -74,9 +108,24 @@ class _Handler(BaseHTTPRequestHandler):
         length = int(self.headers.get("Content-Length", 0))
         body = self.rfile.read(length)
         path = self.path.rstrip("/")
-        status, session = self._auth()
+        is_json = path.endswith(("erlamsa_esi:json", "/json"))
+        body_req: dict = {}
+        if is_json:
+            try:
+                body_req = json.loads(body)
+                if not isinstance(body_req, dict):
+                    raise ValueError("JSON body must be an object")
+            except ValueError as e:
+                self._reply(400, json.dumps({"error": f"bad json: {e}"})
+                            .encode(), ctype="application/json")
+                return
+        status, session = self._auth(body_req)
         if status != "ok":
-            self._reply(401, b"unauthorized")
+            if is_json:
+                self._reply(401, json.dumps({"error": "unauthorized"})
+                            .encode(), ctype="application/json")
+            else:
+                self._reply(401, b"unauthorized")
             return
         if path.endswith(("erlamsa_esi:fuzz", "/fuzz")):
             try:
@@ -87,20 +136,10 @@ class _Handler(BaseHTTPRequestHandler):
             out = self.batcher.fuzz(body, opts)
             self._reply(200, out, session)
             return
-        if path.endswith(("erlamsa_esi:json", "/json")):
+        if is_json:
             try:
-                req = json.loads(body)
-                data = base64.b64decode(req.get("data", ""))
-                opts: dict = {}
-                if "seed" in req:
-                    opts["seed"] = parse_seed(req["seed"])
-                if "mutations" in req:
-                    from .cli import _parse_actions
-                    from ..oracle.mutations import default_mutations
-
-                    opts["mutations"] = _parse_actions(
-                        req["mutations"], default_mutations()
-                    )
+                data = base64.b64decode(body_req.get("data", ""))
+                opts = _parse_opts(body_req.get)
                 out = self.batcher.fuzz(data, opts)
                 self._reply(
                     200,
@@ -108,10 +147,12 @@ class _Handler(BaseHTTPRequestHandler):
                     session,
                     ctype="application/json",
                 )
-            except (ValueError, KeyError, SystemExit) as e:
+            except (ValueError, KeyError, TypeError, binascii.Error,
+                    SystemExit) as e:
                 # _parse_actions raises SystemExit for unknown names —
                 # a bad request here, not a server exit
-                self._reply(400, f"bad request: {e}".encode())
+                self._reply(400, json.dumps({"error": f"bad request: {e}"})
+                            .encode(), ctype="application/json")
             return
         if path.endswith(("erlamsa_esi:manage", "/manage")):
             try:
@@ -146,18 +187,28 @@ def serve(host: str, port: int, opts: dict, backend: str = "oracle",
     """Start the FaaS server; returns the server object when block=False."""
     from .batcher import service_budget
 
-    _Handler.batcher = make_batcher(
-        backend, batch=batch, workers=opts.get("workers", 10),
-        seed=opts.get("seed"), max_running_time=service_budget(opts),
+    # a per-server handler subclass: batcher/cmanager must not be shared
+    # class state, or starting a second service (e.g. one with auth)
+    # would silently reconfigure every running server
+    handler = type(
+        "_BoundHandler",
+        (_Handler,),
+        {
+            "batcher": make_batcher(
+                backend, batch=batch, workers=opts.get("workers", 10),
+                seed=opts.get("seed"),
+                max_running_time=service_budget(opts),
+            ),
+            "cmanager": CloudManager(
+                auth_required=auth_required,
+                store_path=opts.get("cmanager_store"),
+            ),
+        },
     )
-    _Handler.cmanager = CloudManager(
-        auth_required=auth_required,
-        store_path=opts.get("cmanager_store"),
-    )
-    srv = ThreadingHTTPServer((host, port), _Handler)
+    srv = ThreadingHTTPServer((host, port), handler)
     logger.log("info", "faas listening on %s:%d (backend=%s)", host, port, backend)
     print(f"# faas listening on {host}:{port} backend={backend} "
-          f"admin-token={_Handler.cmanager.admin_token}", flush=True)
+          f"admin-token={handler.cmanager.admin_token}", flush=True)
     if not block:
         import threading
 

@@ -181,6 +181,90 @@ def test_faas_json_endpoint(faas_server):
     assert base64.b64decode(resp["data"]) != b""
 
 
+def test_faas_json_body_options_and_errors(faas_server):
+    """The JSON API accepts patterns/blockscale in the body (the
+    reference's parse_json fields, erlamsa_esi.erl:70-82) and answers
+    errors as JSON."""
+    payload = json.dumps({
+        "data": base64.b64encode(b"json options 123\n").decode(),
+        "seed": "7,8,9", "mutations": "bf=1", "patterns": "od",
+        "blockscale": 1.0,
+    }).encode()
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{faas_server}/erlamsa/erlamsa_esi:json", data=payload
+    )
+    resp = json.loads(urllib.request.urlopen(req, timeout=30).read())
+    out = base64.b64decode(resp["data"])
+    # bf with od: exactly one bit flipped
+    assert len(out) == len(b"json options 123\n")
+
+    bad = json.dumps({"data": "!!", "mutations": "nope"}).encode()
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{faas_server}/erlamsa/erlamsa_esi:json", data=bad
+    )
+    try:
+        urllib.request.urlopen(req, timeout=30)
+        raise AssertionError("expected HTTP 400")
+    except urllib.error.HTTPError as e:
+        assert e.code == 400
+        assert "error" in json.loads(e.read())
+
+
+def test_faas_json_body_auth(tmp_path):
+    """token/session may ride in the JSON body, not only headers."""
+    port = _free_port()
+    srv = serve("127.0.0.1", port, {"workers": 2, "seed": (1, 2, 3)},
+                backend="oracle", auth_required=True, block=False)
+    try:
+        admin = srv.RequestHandlerClass.cmanager.admin_token
+        payload = json.dumps({
+            "data": base64.b64encode(b"authed 1\n").decode(),
+            "token": admin,
+        }).encode()
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/erlamsa/erlamsa_esi:json", data=payload
+        )
+        resp = urllib.request.urlopen(req, timeout=30)
+        assert resp.headers["erlamsa-status"] == "ok"
+        assert base64.b64decode(json.loads(resp.read())["data"])
+        # and no token -> JSON 401
+        bad = json.dumps({"data": base64.b64encode(b"x").decode()}).encode()
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/erlamsa/erlamsa_esi:json", data=bad
+        )
+        try:
+            urllib.request.urlopen(req, timeout=30)
+            raise AssertionError("expected HTTP 401")
+        except urllib.error.HTTPError as e:
+            assert e.code == 401
+            assert json.loads(e.read())["error"] == "unauthorized"
+    finally:
+        srv.shutdown()
+
+
+def test_faas_json_malformed_values_get_400(faas_server):
+    """Unhashable auth values and non-string options must answer clean
+    JSON errors, never a connection abort."""
+    for body in (
+        {"data": "", "token": {"a": 1}},          # unhashable token
+        {"data": "", "seed": 5},                  # non-string seed
+        {"data": "", "mutations": ["bd"]},        # non-string mutations
+        {"data": "", "blockscale": None},
+        {"data": "!!not-base64!!"},
+    ):
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{faas_server}/erlamsa/erlamsa_esi:json",
+            data=json.dumps(body).encode(),
+        )
+        try:
+            resp = urllib.request.urlopen(req, timeout=30)
+            # unhashable token with auth off: served fine is acceptable
+            assert resp.status == 200
+        except urllib.error.HTTPError as e:
+            assert e.code == 400
+            assert "error" in json.loads(e.read())
+
+
 def test_faas_concurrent_requests(faas_server):
     results = []
 
