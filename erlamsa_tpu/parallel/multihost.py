@@ -1,0 +1,110 @@
+"""Multi-host fuzzing: DCN corpus fan-out over a jax.distributed cluster.
+
+The reference scales across machines with Erlang distribution — worker
+nodes join a parent and requests route to a random node
+(src/erlamsa_app.erl:144-190). That control plane survives here as
+services/dist.py; THIS module is the data plane the reference never had:
+all participating hosts form one jax.distributed cluster, the (data, seq)
+mesh spans every host's devices, and one pjit'd fuzz step runs globally —
+batch shards ride ICI within a host and DCN between hosts, which is the
+right layout because per-sample mutation never crosses samples
+(SURVEY.md §5.8).
+
+Usage (per host):
+
+    from erlamsa_tpu.parallel import multihost
+    multihost.init(coordinator="host0:8476", num_processes=N, process_id=i)
+    mesh = multihost.global_mesh()
+    step = make_sharded_fuzzer(mesh, global_batch)
+    gdata, glens, gscores = multihost.host_batch_to_global(
+        mesh, local_data, local_lens, local_scores)
+    out, n_out, sc, meta = step(base, case, gdata, glens, gscores)
+    local_out = multihost.local_shard(out)
+
+Each host packs only its own corpus shard (batch axis is contiguous per
+process), so corpus IO also scales with hosts.
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+
+from .mesh import batch_sharding, lens_sharding, make_mesh, scores_sharding
+
+
+_initialized = False
+
+
+def init(coordinator: str, num_processes: int, process_id: int,
+         **kw) -> None:
+    """Join the cluster (idempotent via a module flag — deliberately NOT
+    via jax.process_count(), which would initialize the XLA backend and
+    make jax.distributed.initialize refuse to run). Must be called before
+    any other jax use, like jax.distributed.initialize itself. Works for
+    TPU pods and for CPU test clusters (with
+    xla_force_host_platform_device_count set)."""
+    global _initialized
+    if _initialized or num_processes == 1:
+        return
+    jax.distributed.initialize(
+        coordinator_address=coordinator,
+        num_processes=num_processes,
+        process_id=process_id,
+        **kw,
+    )
+    _initialized = True
+
+
+def global_mesh(data: int | None = None, seq: int = 1):
+    """A (data, seq) mesh over EVERY device in the cluster (jax.devices()
+    is global after init)."""
+    return make_mesh(jax.devices(), data=data, seq=seq)
+
+
+def host_batch_to_global(mesh, data, lens, scores):
+    """Assemble global sharded arrays from each host's LOCAL batch shard.
+
+    Every process passes its own [B_local, L] slice; the global batch is
+    the concatenation over processes along the batch axis. No host ever
+    materializes the whole corpus.
+    """
+    mk = jax.make_array_from_process_local_data
+    return (
+        mk(batch_sharding(mesh), np.asarray(data)),
+        mk(lens_sharding(mesh), np.asarray(lens)),
+        mk(scores_sharding(mesh), np.asarray(scores)),
+    )
+
+
+def local_shard(garr) -> np.ndarray:
+    """This host's block of a sharded global array, assembled across ALL
+    sharded axes (a seq>1 mesh splits L too, so a host holds a grid of
+    shards, not just batch rows)."""
+    shards = list(garr.addressable_shards)
+    nd = garr.ndim
+    block = np.asarray(shards[0].data).shape
+    starts = [
+        sorted({(s.index[d].start or 0) for s in shards}) for d in range(nd)
+    ]
+    out = np.empty(
+        tuple(len(starts[d]) * block[d] for d in range(nd)), dtype=garr.dtype
+    )
+    for s in shards:
+        sel = tuple(
+            slice(
+                starts[d].index(s.index[d].start or 0) * block[d],
+                starts[d].index(s.index[d].start or 0) * block[d] + block[d],
+            )
+            for d in range(nd)
+        )
+        out[sel] = np.asarray(s.data)
+    return out
+
+
+def allgather(garr) -> np.ndarray:
+    """Full global array on every host (DCN gather) — for result
+    collection/verification, not the steady-state path."""
+    from jax.experimental import multihost_utils
+
+    return np.asarray(multihost_utils.process_allgather(garr, tiled=True))
