@@ -2,9 +2,14 @@
 
 The throughput path (SURVEY.md §7 phase 1): pack seed files into
 ``uint8[B, L]`` buffers, run the jitted fuzz_batch per case with
-counter-derived keys, and stream results to the output writer. The host
-stays on IO while the device mutates the next batch (double-buffered via
-jax's async dispatch).
+counter-derived keys, and stream results to the output writer.
+
+Pipelined: case c+1's device steps dispatch (async) BEFORE case c's
+results are unpacked/written, so host IO and host-routed oracle work
+overlap device compute. Determinism is preserved by construction: the
+split for case c uses device scores through c-1 (a tiny forced sync) and
+host outcome scores through c-2, and checkpoints record exactly those
+states so a resumed run routes identically.
 """
 
 from __future__ import annotations
@@ -102,6 +107,10 @@ def run_tpu_batch(opts: dict, batch: int = 1024) -> int:
     start_case = 0
     n_cases = opts.get("n", 1)
     state_path = opts.get("state_path")
+    # post-outcome host scores to swap in AFTER the first resumed launch:
+    # split(k) must see the pre state (one-case outcome lag), split(k+1)
+    # the post state — exactly what an uninterrupted run's splits saw
+    resume_host_post: dict | None = None
     if state_path:
         import os as _os
 
@@ -112,7 +121,7 @@ def run_tpu_batch(opts: dict, batch: int = 1024) -> int:
             if st is None:
                 print("# checkpoint unreadable, starting fresh", file=sys.stderr)
             else:
-                ck_seed, start_case, ck_scores, ck_host = st
+                ck_seed, start_case, ck_scores, ck_host, ck_host_post = st
                 if (ck_seed != tuple(opts["seed"])
                         or ck_scores.shape != (batch, NUM_DEVICE_MUTATORS)):
                     print("# checkpoint mismatch (seed/shape), starting fresh",
@@ -128,6 +137,7 @@ def run_tpu_batch(opts: dict, batch: int = 1024) -> int:
                     for code, val in ck_host.items():
                         if code in hybrid.host_scores:
                             hybrid.host_scores[code] = val
+                    resume_host_post = ck_host_post
                     print(f"# resumed at case {start_case}", file=sys.stderr)
         if start_case >= n_cases:
             print(f"# run already complete ({start_case}/{n_cases} cases)",
@@ -161,39 +171,73 @@ def run_tpu_batch(opts: dict, batch: int = 1024) -> int:
                 res[i] = b""  # abandoned; the slot still emits
         return res
 
+    import concurrent.futures as cf
+    from typing import NamedTuple
+
+    class _Launched(NamedTuple):
+        case: int
+        class_outputs: list
+        host_idx: list
+        host_fut: object
+        of_fut: object
+        scores_after: object
+
     writer, _mt = out.string_outputs(opts.get("output", "-"))
     total = 0
     host_total = 0
+    # checkpoint cadence: an fsync'd save per case throttles short cases;
+    # a coarser interval re-runs at most (interval-1) deterministic cases
+    # after a crash
+    ckpt_every = max(1, int(opts.get("checkpoint_every", 1)))
+    host_pool = cf.ThreadPoolExecutor(max_workers=2)
     t0 = time.perf_counter()
-    # -n is the TOTAL case target, like the reference: resume completes the
-    # original run rather than adding n more cases
-    for case in range(start_case, n_cases):
-        # live scheduler scores weight the host/device split like the
-        # reference's score*pri mux mass (erlamsa_mutations.erl:1244-1250)
+
+    def launch(case, scores_in):
+        """Dispatch one case: split on the previous case's scores (a tiny
+        forced sync), device steps async, host/overflow work on threads.
+        Nothing here waits for the device data."""
         host_mask = hybrid.split(case, corpus,
-                                 device_scores=np.asarray(scores))
-        # device mutates every class batch (async dispatch); the host pool
-        # handles its share in parallel, and host results override at merge
-        results: dict[int, bytes] = {}
+                                 device_scores=np.asarray(scores_in))
         class_outputs = []
+        scores_out = scores_in
         for cls, (idx, packed) in class_batches.items():
             new_data, new_lens, new_cls_scores, _meta = step(
-                base, case, idx, packed.data, packed.lens, scores[idx],
+                base, case, idx, packed.data, packed.lens, scores_out[idx],
             )
             class_outputs.append((idx, new_data, new_lens, new_cls_scores))
-        host_results = {}
+            scores_out = scores_out.at[idx].set(new_cls_scores)
         host_idx = [(i, corpus[i]) for i in np.nonzero(host_mask)[0]
                     if i not in overflow_set]
-        if host_idx:
-            host_results = hybrid.fuzz_host(case, host_idx)
-        overflow_results = fuzz_overflow(case) if overflow_idx else {}
-        for idx, new_data, new_lens, new_cls_scores in class_outputs:
+        host_fut = (host_pool.submit(hybrid.fuzz_host, case, host_idx,
+                                     defer_scores=True)
+                    if host_idx else None)
+        of_fut = (host_pool.submit(fuzz_overflow, case)
+                  if overflow_idx else None)
+        return _Launched(case, class_outputs, host_idx, host_fut, of_fut,
+                         scores_out)
+
+    def finish(pend: "_Launched"):
+        """Unpack + write one launched case (device of the NEXT case is
+        already running — this is the overlap)."""
+        nonlocal total, host_total
+        case, class_outputs, host_idx, host_fut, of_fut, scores_after = pend
+        results: dict[int, bytes] = {}
+        for idx, new_data, new_lens, _nsc in class_outputs:
             outs = unpack(Batch(new_data, new_lens))
             for j, i in enumerate(idx):
                 results[int(i)] = outs[j]
-            scores = scores.at[idx].set(new_cls_scores)
-        results.update(host_results)
-        results.update(overflow_results)
+        # the overlapped next case's split already ran and saw host scores
+        # through case-1; checkpoint that same pre-outcome state so a
+        # resumed run's split(case+1) routes identically to this one
+        host_scores_for_ckpt = dict(hybrid.host_scores)
+        if host_fut is not None:
+            host_results, host_metas = host_fut.result()
+            results.update(host_results)
+            # score outcomes apply HERE, in case order — the overlapped
+            # next case's split must see a deterministic routing state
+            hybrid.apply_outcomes(host_metas)
+        if of_fut is not None:
+            results.update(of_fut.result())
         for i in range(batch):
             payload = results.get(i, b"")
             if writer is not None:
@@ -202,10 +246,35 @@ def run_tpu_batch(opts: dict, batch: int = 1024) -> int:
                 sys.stdout.buffer.write(payload)
         total += len(results)
         host_total += len(host_idx) + len(overflow_idx)
-        if state_path:
-            save_state(state_path, opts["seed"], case + 1, scores,
-                       host_scores=hybrid.host_scores)
-    hybrid.close()
+        if state_path and ((case + 1 - start_case) % ckpt_every == 0
+                           or case + 1 == n_cases):
+            save_state(state_path, opts["seed"], case + 1, scores_after,
+                       host_scores=host_scores_for_ckpt,
+                       host_scores_post=dict(hybrid.host_scores))
+
+    # -n is the TOTAL case target, like the reference: resume completes the
+    # original run rather than adding n more cases
+    pending = None
+    try:
+        for case in range(start_case, n_cases):
+            cur = launch(case, scores)
+            scores = cur.scores_after
+            if resume_host_post is not None:
+                # first resumed launch done: later splits build on the
+                # post-outcome state, like the uninterrupted run's did
+                for code, val in resume_host_post.items():
+                    if code in hybrid.host_scores:
+                        hybrid.host_scores[code] = val
+                resume_host_post = None
+            if pending is not None:
+                finish(pending)
+            pending = cur
+        if pending is not None:
+            finish(pending)
+            pending = None
+    finally:
+        host_pool.shutdown(wait=False, cancel_futures=True)
+        hybrid.close()
     dt = time.perf_counter() - t0
     logger.log("info", "tpu backend: %d samples in %.2fs (%.0f samples/s)",
                total, dt, total / max(dt, 1e-9))

@@ -17,14 +17,19 @@ import numpy as np
 
 
 def save_state(path: str, seed, case_idx: int, scores,
-               host_scores: dict | None = None) -> None:
+               host_scores: dict | None = None,
+               host_scores_post: dict | None = None) -> None:
     """Atomic write (tmp + rename): a kill mid-save — the very interruption
     checkpoints exist for — must never corrupt the previous checkpoint.
-    host_scores: the hybrid dispatcher's evolving per-mutator scores —
-    part of the routing state, so a resumed run splits host/device exactly
-    like the uninterrupted one would."""
+    host_scores: the hybrid routing scores the resumed case's split must
+    see (the pipelined loop gives split a one-case outcome lag);
+    host_scores_post: the same scores WITH the just-finished case's
+    outcomes folded in — the state every later split builds on. Saving
+    both is what makes an interrupted run route identically to an
+    uninterrupted one."""
     tmp = path + ".tmp"
     hs = host_scores or {}
+    hsp = host_scores_post if host_scores_post is not None else hs
     with open(tmp, "wb") as f:
         np.savez(
             f,
@@ -33,6 +38,10 @@ def save_state(path: str, seed, case_idx: int, scores,
             scores=np.asarray(scores, np.int32),
             host_codes=np.asarray(sorted(hs), "U8"),
             host_values=np.asarray([hs[k] for k in sorted(hs)], np.float64),
+            host_codes_post=np.asarray(sorted(hsp), "U8"),
+            host_values_post=np.asarray(
+                [hsp[k] for k in sorted(hsp)], np.float64
+            ),
         )
         # data must be durable BEFORE the rename publishes it, or a crash
         # right after os.replace leaves a truncated checkpoint and the run
@@ -51,8 +60,10 @@ def save_state(path: str, seed, case_idx: int, scores,
 
 
 def load_state(path: str):
-    """-> (seed tuple, case_idx, scores ndarray, host_scores dict), or
-    None when the file is unreadable/corrupt (callers start fresh)."""
+    """-> (seed tuple, case_idx, scores ndarray, host_scores dict,
+    host_scores_post dict), or None when the file is unreadable/corrupt
+    (callers start fresh). Older files without the post state fall back
+    to the pre state."""
     try:
         with np.load(path) as z:
             seed = tuple(int(x) for x in z["seed"])
@@ -64,6 +75,13 @@ def load_state(path: str):
                     str(c): float(v)
                     for c, v in zip(z["host_codes"], z["host_values"])
                 }
-        return seed, case_idx, scores, host_scores
+            host_post = dict(host_scores)
+            if "host_codes_post" in z:
+                host_post = {
+                    str(c): float(v)
+                    for c, v in zip(z["host_codes_post"],
+                                    z["host_values_post"])
+                }
+        return seed, case_idx, scores, host_scores, host_post
     except Exception:
         return None

@@ -117,6 +117,9 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("-M", "--meta", default=None, help="write metadata to path")
     p.add_argument("-r", "--recursive", action="store_true")
     p.add_argument("-H", "--httpsvc", default=None, help="run FaaS at host:port")
+    p.add_argument("--checkpoint-every", type=int, default=1, metavar="N",
+                   help="--state save cadence in cases (fsync per save; "
+                        "a crash re-runs at most N-1 deterministic cases)")
     p.add_argument("--device-capacity-max", type=int, default=None,
                    metavar="BYTES",
                    help="largest capacity class run on the device; bigger "
@@ -202,6 +205,7 @@ def main(argv=None) -> int:
         "maxrunningtime": args.maxrunningtime,
         "sequence_muta": args.sequence_muta,
         "recursive": args.recursive,
+        "checkpoint_every": args.checkpoint_every,
         **({"device_capacity_max": args.device_capacity_max}
            if args.device_capacity_max is not None else {}),
         "workers": args.workers,

@@ -176,11 +176,15 @@ class HybridDispatcher:
                                     self.host_scores[name] + delta)
             )
 
-    def fuzz_host(self, case_idx: int, idx_seeds: list[tuple[int, bytes]]):
-        """Oracle fuzz for host-routed samples; returns {index: bytes}.
-        Observed outcomes feed the evolving host scores. A case exceeding
-        max_running_time is abandoned (absent from the result dict), so
-        the batch loop never stalls on one adversarial sample."""
+    def fuzz_host(self, case_idx: int, idx_seeds: list[tuple[int, bytes]],
+                  defer_scores: bool = False):
+        """Oracle fuzz for host-routed samples; returns {index: bytes}
+        (or (results, metas) with defer_scores=True — a pipelined caller
+        applies outcomes via apply_outcomes() at a deterministic point so
+        overlapped cases can't race the routing state). Observed outcomes
+        feed the evolving host scores. A case exceeding max_running_time
+        is abandoned (absent from the result dict), so the batch loop
+        never stalls on one adversarial sample."""
         from ..oracle.engine import Engine
         from ..utils.watchdog import CaseTimeout, run_with_timeout
 
@@ -211,6 +215,13 @@ class HybridDispatcher:
                 continue
             results[i] = out
             metas.append(meta)
+        if defer_scores:
+            return results, metas
+        self.apply_outcomes(metas)
+        return results
+
+    def apply_outcomes(self, metas) -> None:
+        """Fold observed used/failed outcomes into the host scores."""
         for meta in metas:
             for entry in meta:
                 if not (isinstance(entry, tuple) and len(entry) == 2):
@@ -220,7 +231,6 @@ class HybridDispatcher:
                     self._bump(val, +1.0)
                 elif tag == "failed":
                     self._bump(val, -1.0)
-        return results
 
     def close(self):
         self._pool.shutdown(wait=False)

@@ -62,13 +62,14 @@ def test_checkpoint_roundtrip(tmp_path):
     p = str(tmp_path / "st.npz")
     scores = np.random.default_rng(0).integers(2, 11, (16, 25), dtype=np.int32)
     save_state(p, (1, 2, 3), 42, scores, host_scores={"sgm": 8.0, "js": 3.5})
-    seed, case, sc, hs = load_state(p)
+    seed, case, sc, hs, hs_post = load_state(p)
     assert seed == (1, 2, 3) and case == 42
     assert np.array_equal(sc, scores)
     assert hs == {"sgm": 8.0, "js": 3.5}
+    assert hs_post == hs  # defaults to pre when not given
     # legacy shape without host scores loads too
     save_state(p, (1, 2, 3), 7, scores)
-    assert load_state(p)[3] == {}
+    assert load_state(p)[3] == {} and load_state(p)[4] == {}
 
 
 def test_batchrunner_capacity_classes_and_overflow(tmp_path):
@@ -100,6 +101,58 @@ def test_batchrunner_capacity_classes_and_overflow(tmp_path):
     assert outs == outs2
 
 
+def test_batchrunner_pipelined_determinism_with_host_routing(tmp_path):
+    """The overlapped loop (device case c+1 dispatched before case c's
+    results are processed, host work on threads) must stay byte-
+    deterministic when host routing and evolving scores are active."""
+    from erlamsa_tpu.services.batchrunner import run_tpu_batch
+
+    seedfile = tmp_path / "seed.xml"
+    seedfile.write_bytes(b"<cfg n='1'><v>123</v><v>456</v></cfg>\n" * 3)
+
+    def run(tag):
+        opts = {
+            "paths": [str(seedfile)], "n": 4, "seed": (5, 5, 5),
+            "output": str(tmp_path / f"{tag}-%n.bin"),
+            "mutations": [("bd", 1), ("bf", 1), ("sgm", 10)],
+        }
+        assert run_tpu_batch(opts, batch=8) == 0
+        return [(tmp_path / f"{tag}-{i}.bin").read_bytes()
+                for i in range(4 * 8)]
+
+    assert run("a") == run("b")
+
+
+def test_batchrunner_resume_routes_identically(tmp_path):
+    """An interrupted+resumed run must emit byte-identical outputs to an
+    uninterrupted one — device scores, host outcome scores, and the
+    pipelined one-case routing lag are all part of the checkpoint
+    contract."""
+    from erlamsa_tpu.services.batchrunner import run_tpu_batch
+
+    seedfile = tmp_path / "seed.xml"
+    seedfile.write_bytes(b"<a><b>val 9</b></a> num=77\n" * 4)
+    common = {
+        "paths": [str(seedfile)], "seed": (6, 6, 6),
+        "mutations": [("bd", 1), ("bf", 1), ("sgm", 10)],
+    }
+
+    full = dict(common, n=4, output=str(tmp_path / "full-%n.bin"))
+    assert run_tpu_batch(full, batch=4) == 0
+
+    part = dict(common, n=2, output=str(tmp_path / "res-%n.bin"),
+                state_path=str(tmp_path / "ck.npz"))
+    assert run_tpu_batch(part, batch=4) == 0
+    cont = dict(common, n=4, output=str(tmp_path / "res-%n.bin"),
+                state_path=str(tmp_path / "ck.npz"))
+    assert run_tpu_batch(cont, batch=4) == 0
+
+    for i in range(16):
+        a = (tmp_path / f"full-{i}.bin").read_bytes()
+        b = (tmp_path / f"res-{i}.bin").read_bytes()
+        assert a == b, f"slot {i} diverged after resume"
+
+
 def test_batchrunner_resume(tmp_path, monkeypatch, capsys):
     from erlamsa_tpu.services.batchrunner import run_tpu_batch
 
@@ -114,14 +167,14 @@ def test_batchrunner_resume(tmp_path, monkeypatch, capsys):
     assert run_tpu_batch(dict(opts), batch=8) == 0
     from erlamsa_tpu.services.checkpoint import load_state
 
-    _s, case, _sc, _hs = load_state(state)
+    _s, case, _sc, _hs, _hsp = load_state(state)
     assert case == 2
     # -n is the TOTAL target: rerunning the completed command is a no-op
     assert run_tpu_batch(dict(opts), batch=8) == 0
-    _s, case2, _sc2, _hs2 = load_state(state)
+    _s, case2, _sc2, _hs2, _hsp2 = load_state(state)
     assert case2 == 2
     # raising -n completes the remainder only
     opts["n"] = 3
     assert run_tpu_batch(dict(opts), batch=8) == 0
-    _s, case3, _sc3, _hs3 = load_state(state)
+    _s, case3, _sc3, _hs3, _hsp3 = load_state(state)
     assert case3 == 3
