@@ -48,10 +48,15 @@ class OracleBatcher:
 
         while True:
             req = self._q.get()
+            # CLI-built opts carry maxrunningtime: None for "unset" — that
+            # must fall back to the service budget, not mean "no budget"
+            budget = req.opts.get("maxrunningtime")
+            if budget is None:
+                budget = self.max_running_time
             try:
                 req.result = run_with_timeout(
                     fuzz,
-                    req.opts.get("maxrunningtime", self.max_running_time),
+                    budget,
                     req.data,
                     seed=req.opts.get("seed") or gen_urandom_seed(),
                     **{k: v for k, v in req.opts.items()
@@ -72,10 +77,13 @@ class OracleBatcher:
 
 class TpuBatcher:
     """Accumulate requests; flush as one padded device batch when the batch
-    fills or max_latency_ms passes."""
+    fills or max_latency_ms passes. Requests larger than the device
+    capacity take the oracle escape (same overflow rule as the batch
+    runner's capacity classes) instead of being truncated."""
 
     def __init__(self, batch: int = 256, capacity: int = 16384,
-                 max_latency_ms: float = 20.0, seed=None):
+                 max_latency_ms: float = 20.0, seed=None,
+                 max_running_time: float = 30.0):
         import jax
 
         from ..ops import prng
@@ -90,6 +98,9 @@ class TpuBatcher:
         self._base = prng.base_key(seed or gen_urandom_seed())
         self._scores = init_scores(jax.random.fold_in(self._base, 999), batch)
         self._case = 0
+        self._max_running_time = max_running_time
+        self._overflow = None  # built lazily on the first oversized request
+        self._overflow_lock = threading.Lock()
         supervise("tpu-batcher-flusher", self._flusher)
 
     def _flusher(self):
@@ -109,7 +120,7 @@ class TpuBatcher:
                 except queue.Empty:
                     break
             try:
-                seeds = [r.data[: self.capacity] for r in reqs]
+                seeds = [r.data for r in reqs]
                 pad = [b"\x00"] * (self.batch - len(seeds))
                 packed = pack(seeds + pad, capacity=self.capacity)
                 data, lens, self._scores, _meta = self._step(
@@ -131,6 +142,14 @@ class TpuBatcher:
                 raise
 
     def fuzz(self, data: bytes, opts: dict, timeout: float = 90.0) -> bytes:
+        if len(data) > self.capacity:
+            # overflow-to-host escape: full fidelity beats truncation
+            with self._overflow_lock:
+                if self._overflow is None:
+                    self._overflow = OracleBatcher(
+                        workers=2, max_running_time=self._max_running_time
+                    )
+            return self._overflow.fuzz(data, opts, timeout)
         req = _Req(data, opts)
         self._q.put(req)
         if not req.done.wait(timeout):
@@ -148,6 +167,7 @@ def service_budget(opts: dict) -> float:
 def make_batcher(backend: str, **kw):
     if backend == "tpu":
         return TpuBatcher(**{k: v for k, v in kw.items()
-                             if k in ("batch", "capacity", "max_latency_ms", "seed")})
+                             if k in ("batch", "capacity", "max_latency_ms",
+                                      "seed", "max_running_time")})
     return OracleBatcher(workers=kw.get("workers", 10),
                          max_running_time=kw.get("max_running_time", 30.0))

@@ -284,6 +284,18 @@ def test_faas_concurrent_requests(faas_server):
     assert len(results) == 16
 
 
+def test_tpu_batcher_oversized_request_takes_oracle_escape():
+    from erlamsa_tpu.services.batcher import TpuBatcher
+
+    b = TpuBatcher(batch=4, capacity=256, seed=(1, 2, 3))
+    big = b"oversized request payload! " * 50  # 1350B > 256B capacity
+    out = b.fuzz(big, {"seed": (1, 2, 3)}, timeout=120)
+    # full-fidelity oracle output, not a 256-byte truncation
+    assert out != b"" and len(out) > 256
+    small = b.fuzz(b"fits fine 123", {"seed": (1, 2, 3)}, timeout=120)
+    assert small != b""
+
+
 # ---- proxy --------------------------------------------------------------
 
 
