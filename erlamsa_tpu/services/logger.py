@@ -35,6 +35,11 @@ class Logger:
         self._sinks.append((LEVELS.get(level, 4), write))
         self._ensure_thread()
 
+    def remove_sink(self, write: Callable[[str], None]):
+        """Detach a sink added with add_sink (tests and short-lived
+        captures; the reference's logger never detaches sinks)."""
+        self._sinks = [(lv, w) for lv, w in self._sinks if w is not write]
+
     def configure(self, spec: dict):
         """spec like the -L options: {"stdout": level, "file": (path, level),
         "csv": (path, level), "syslog": (host, port, level)}

@@ -58,10 +58,10 @@ class Profiler(threading.Thread):
     def __init__(self, interval: float = 5.0):
         super().__init__(daemon=True)
         self.interval = interval
-        self._stop = threading.Event()
+        self._stop_evt = threading.Event()
 
     def run(self):
-        while not self._stop.is_set():
+        while not self._stop_evt.is_set():
             try:
                 with open("/proc/self/status") as f:
                     status = f.read()
@@ -81,7 +81,7 @@ class Profiler(threading.Thread):
                 "profiler: rss=%skB threads=%s samples=%d (%.1f/s)",
                 rss, threads, snap["samples"], snap["samples_per_sec"],
             )
-            self._stop.wait(self.interval)
+            self._stop_evt.wait(self.interval)
 
     def stop(self):
-        self._stop.set()
+        self._stop_evt.set()

@@ -18,16 +18,14 @@ each reporting findings through the logger and optionally running an
           on an available `adb` binary
   lxi     SCPI measurement-range monitor over TCP
           (src/erlamsa_mon_lxi.erl)
-
-Deliberately absent: the reference's Windows CDB monitor
-(src/erlamsa_mon_cdb.erl — cdb.exe backtrace/minidump/restart). This
-framework targets Linux hosts; `exec` covers exit-status triage and `r2`
-covers debugger-grade backtraces there. Port a cdb driver in the same
-ExecMonitor shape if Windows targets ever matter.
+  cdb     Windows CDB console-debugger driver: on a debugger break-in log
+          backtrace/registers, write a minidump, restart
+          (src/erlamsa_mon_cdb.erl); gated on an available `cdb` binary
 """
 
 from __future__ import annotations
 
+import re
 import shlex
 import shutil
 import socket
@@ -56,10 +54,10 @@ class Monitor(threading.Thread):
     def __init__(self, params: dict):
         super().__init__(daemon=True)
         self.params = params
-        self._stop = threading.Event()
+        self._stop_evt = threading.Event()
 
     def stop(self):
-        self._stop.set()
+        self._stop_evt.set()
 
 
 class ConnectMonitor(Monitor):
@@ -81,7 +79,7 @@ class ConnectMonitor(Monitor):
         srv.listen(16)
         srv.settimeout(1.0)
         logger.log("info", "connect monitor listening on :%d", port)
-        while not self._stop.is_set():
+        while not self._stop_evt.is_set():
             try:
                 conn, addr = srv.accept()
             except socket.timeout:
@@ -115,7 +113,7 @@ class NetworkProbeMonitor(Monitor):
         proto = self.params.get("proto", "tcp")
         interval = float(self.params.get("interval", 5.0))
         hello = self.params.get("hello", "hello").encode()
-        while not self._stop.is_set():
+        while not self._stop_evt.is_set():
             ok = False
             try:
                 if proto == "udp":
@@ -132,7 +130,7 @@ class NetworkProbeMonitor(Monitor):
                 _run_after(self.params)
             if ok:
                 logger.log("debug", "probe: %s:%d alive", host, port)
-            self._stop.wait(interval)
+            self._stop_evt.wait(interval)
 
 
 class ExecMonitor(Monitor):
@@ -147,13 +145,13 @@ class ExecMonitor(Monitor):
         if not cmd:
             logger.log("error", "exec monitor needs app=<cmdline>")
             return
-        while not self._stop.is_set():
+        while not self._stop_evt.is_set():
             proc = subprocess.Popen(
                 shlex.split(cmd), stdout=subprocess.PIPE, stderr=subprocess.STDOUT
             )
             out, _ = proc.communicate()
             rc = proc.returncode
-            if rc and not self._stop.is_set():
+            if rc and not self._stop_evt.is_set():
                 level = "finding" if rc < 0 else "warning"
                 logger.log(level, "exec target exited rc=%d; tail: %r",
                            rc, out[-500:] if out else b"")
@@ -172,7 +170,7 @@ class R2Monitor(Monitor):
             logger.log("error", "r2 monitor: radare2 not found in PATH")
             return
         app = self.params.get("app")
-        while not self._stop.is_set():
+        while not self._stop_evt.is_set():
             proc = subprocess.Popen(
                 ["r2", "-q0", "-d", *shlex.split(app)],
                 stdin=subprocess.PIPE, stdout=subprocess.PIPE,
@@ -212,7 +210,7 @@ class LogcatMonitor(Monitor):
         )
         crash_lines: list[bytes] = []
         for line in proc.stdout:
-            if self._stop.is_set():
+            if self._stop_evt.is_set():
                 break
             if b"FATAL EXCEPTION" in line or b"SIGSEGV" in line:
                 crash_lines = [line]
@@ -238,7 +236,7 @@ class LxiMonitor(Monitor):
         lo = float(self.params.get("lvalue", 0.0))
         hi = float(self.params.get("uvalue", 1.0))
         interval = float(self.params.get("interval", 2.0))
-        while not self._stop.is_set():
+        while not self._stop_evt.is_set():
             try:
                 with socket.create_connection((host, port), timeout=3.0) as s:
                     s.sendall(b"MEAS:CURR?\n")
@@ -249,13 +247,153 @@ class LxiMonitor(Monitor):
                         _run_after(self.params)
             except (OSError, ValueError) as e:
                 logger.log("warning", "lxi probe failed: %s", e)
-            self._stop.wait(interval)
+            self._stop_evt.wait(interval)
+
+
+class CdbMonitor(Monitor):
+    """cdb: drive the Windows CDB console debugger over stdio
+    (src/erlamsa_mon_cdb.erl:72-94). Attach with ``pid=N`` (-p),
+    ``attach=name`` (-pn) or launch with ``app=<cmdline>``; `g` resumes the
+    target, and when the debugger breaks back in (crash/exception) the
+    monitor logs the event, a `k` backtrace and `r` registers as findings,
+    saves a timestamped minidump via ``.dump /m``, runs the after actions
+    and re-attaches. ``cdb=<binary>`` overrides the debugger path (used by
+    tests to substitute an emulator; real use needs cdb.exe in PATH).
+
+    The stdio protocol matches the reference's port loop: every command's
+    reply is read until the "> " debugger prompt (read_cdb_data,
+    src/erlamsa_mon_cdb.erl:131-141).
+    """
+
+    name_code = "cdb"
+    ATTEMPTS = 5  # ?START_MONITOR_ATTEMPTS
+
+    def __init__(self, params: dict):
+        super().__init__(params)
+        self._proc: subprocess.Popen | None = None
+
+    def stop(self):
+        super().stop()
+        proc = self._proc
+        if proc is not None and proc.poll() is None:
+            proc.kill()
+
+    def _target_args(self):
+        if "pid" in self.params:
+            return ["-p", str(self.params["pid"])]
+        if "attach" in self.params:
+            return ["-pn", str(self.params["attach"])]
+        if "app" in self.params:
+            return shlex.split(self.params["app"])
+        return None
+
+    def _read_to_prompt(self) -> bytes | None:
+        """Accumulate debugger output until the trailing '> ' prompt; None
+        when the debugger exits first (closed/process_exit in the ref)."""
+        buf = b""
+        while True:
+            chunk = self._proc.stdout.read(1)
+            if not chunk:
+                return None
+            buf += chunk
+            if buf.endswith(b"> "):
+                return buf
+
+    def _call(self, cmd: bytes) -> bytes | None:
+        try:
+            self._proc.stdin.write(cmd)
+            self._proc.stdin.flush()
+        except OSError:
+            return None
+        return self._read_to_prompt()
+
+    def run(self):
+        cdb = self.params.get("cdb", "cdb")
+        if shutil.which(cdb) is None:
+            logger.log("error", "cdb monitor: %s not found in PATH", cdb)
+            return
+        args = self._target_args()
+        if args is None:
+            logger.log("error", "cdb monitor needs pid=/attach=/app=")
+            return
+        attempts = self.ATTEMPTS
+        while not self._stop_evt.is_set():
+            if attempts <= 0:
+                logger.log("error",
+                           "cdb monitor: too many failures (%d), giving up",
+                           self.ATTEMPTS)
+                return
+            try:
+                self._proc = subprocess.Popen(
+                    [cdb, *args], stdin=subprocess.PIPE,
+                    stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+                )
+            except OSError as e:
+                logger.log("warning", "cdb monitor spawn failed: %s", e)
+                attempts -= 1
+                self._stop_evt.wait(1.0)
+                continue
+            if self._stop_evt.is_set():  # stop() may have raced the spawn
+                self._kill()
+                return
+            banner = self._read_to_prompt()
+            if banner is None:
+                logger.log("warning", "cdb monitor: debugger exited at start")
+                attempts -= 1
+                self._stop_evt.wait(1.0)
+                continue
+            logger.log("info", "cdb monitor attached: %r", banner[-200:])
+            # `g` blocks until the debugger breaks back in — that IS the event
+            crash = self._call(b"g\r\n")
+            if crash is None or self._stop_evt.is_set():
+                if not self._stop_evt.is_set():
+                    logger.log("warning",
+                               "cdb monitor: debugger exited while running")
+                    attempts -= 1
+                    self._stop_evt.wait(1.0)
+                self._kill()
+                continue
+            # a full cycle reached the break-in: reset the give-up budget
+            # (cdb_start(..., ?START_MONITOR_ATTEMPTS) after each cycle)
+            attempts = self.ATTEMPTS
+            logger.log("finding", "cdb monitor detected event (crash?): %r",
+                       crash[:1000])
+            bt = self._call(b"k\r\n")
+            logger.log("finding", "cdb monitor backtrace: %r",
+                       (bt or b"")[:2000])
+            regs = self._call(b"r\r\n")
+            logger.log("finding", "cdb monitor registers: %r",
+                       (regs or b"")[:2000])
+            name = re.sub(r"[^A-Za-z0-9._-]", "_",
+                          self.params.get("app", "cdb_target"))
+            dump = name + time.strftime("_%Y_%m_%d_%H_%M_%S.minidump")
+            res = self._call(f".dump /m {dump} \r\n".encode())
+            logger.log("finding", "cdb monitor minidump saved to %s: %r",
+                       dump, (res or b"")[:500])
+            try:
+                self._proc.stdin.write(b"q\r\n")
+                self._proc.stdin.flush()
+            except OSError:
+                pass
+            self._kill()
+            _run_after(self.params)
+
+    def _kill(self):
+        proc = self._proc
+        if proc is None:
+            return
+        try:
+            if proc.poll() is None:
+                proc.kill()
+            proc.wait(timeout=5)
+        except OSError:
+            pass
 
 
 MONITORS = {
     m.name_code: m
     for m in (ConnectMonitor, NetworkProbeMonitor, ExecMonitor, R2Monitor,
-              LogcatMonitor, LxiMonitor)
+              LogcatMonitor, LxiMonitor, CdbMonitor)
 }
 
 

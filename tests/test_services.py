@@ -363,6 +363,73 @@ def test_parse_monitor_spec():
     assert parse_monitor_spec("!cm:off") is None
 
 
+_FAKE_CDB = r'''#!/usr/bin/env python3
+"""cdb.exe emulator for CdbMonitor tests: banner + '> ' prompt protocol."""
+import sys
+
+def prompt(text=""):
+    sys.stdout.write(text + "0:000> ")
+    sys.stdout.flush()
+
+prompt("Microsoft (R) Windows Debugger emulator\nCommandLine: target.exe\n")
+for line in sys.stdin:
+    cmd = line.strip()
+    if cmd == "g":
+        prompt("(1a2b.3c4d): Access violation - code c0000005\n")
+    elif cmd == "k":
+        prompt("Child-SP          RetAddr           Call Site\n"
+               "00000000`0012ff58 00000000`00401000 target!crash+0x12\n")
+    elif cmd == "r":
+        prompt("rax=0000000000000000 rbx=dead0000beef0000\n")
+    elif cmd.startswith(".dump /m "):
+        path = cmd.split()[2]
+        open(path, "wb").write(b"MDMP")
+        prompt("Dump successfully written\n")
+    elif cmd == "q":
+        sys.exit(0)
+    else:
+        prompt()
+'''
+
+
+def test_cdb_monitor_crash_cycle(tmp_path, monkeypatch):
+    """One full cdb cycle: attach -> g breaks in -> backtrace/registers
+    findings -> minidump on disk -> after action -> re-attach."""
+    from erlamsa_tpu.services import logger as logmod
+    from erlamsa_tpu.services.monitors import CdbMonitor
+
+    monkeypatch.chdir(tmp_path)
+    fake = tmp_path / "cdb"
+    fake.write_text(_FAKE_CDB)
+    fake.chmod(0o755)
+    marker = tmp_path / "after_ran"
+
+    lines: list[str] = []
+    sink = lines.append  # bind once: remove_sink matches by identity
+    logmod.GLOBAL.add_sink("debug", sink)
+    try:
+        mon = CdbMonitor({
+            "cdb": str(fake), "app": "target.exe",
+            "after": f"touch {marker}",
+        })
+        mon.start()
+        deadline = time.time() + 15
+        while time.time() < deadline and not marker.exists():
+            time.sleep(0.1)
+        mon.stop()
+        mon.join(timeout=10)
+        assert marker.exists(), "after action never ran"
+        dumps = list(tmp_path.glob("*.minidump"))
+        assert dumps and dumps[0].read_bytes() == b"MDMP"
+        time.sleep(0.3)  # let the fire-and-forget sink drain
+        text = "\n".join(lines)
+        assert "Access violation" in text
+        assert "target!crash" in text
+        assert "rax=" in text
+    finally:
+        logmod.GLOBAL.remove_sink(sink)
+
+
 def test_connect_monitor_catches_connection():
     port = _free_port()
     mon = ConnectMonitor({"port": str(port)})
