@@ -115,12 +115,9 @@ def crc32_suffixes(data, e):
     return sfx ^ Zr
 
 
-def detect_crc32(key, data, n):
-    """Find a random crc32 trailer: preambles a where the last 4 bytes
-    (big-endian, matching the oracle's fieldpred) equal crc32(data[a:n-4)).
-
-    Returns (found, a).
-    """
+def crc32_candidates(data, n):
+    """bool[L]: preambles a where the last 4 bytes (big-endian, matching
+    the oracle's fieldpred) equal crc32(data[a:n-4))."""
     L = data.shape[0]
     i = jnp.arange(L, dtype=jnp.int32)
     e = jnp.maximum(n - 4, 0)
@@ -132,13 +129,28 @@ def detect_crc32(key, data, n):
     )
     crcs = crc32_suffixes(jnp.where(i < n, data, jnp.uint8(0)), e)
     limit = jnp.minimum(2 * n // 3, 30 * PREAMBLE_MAX_BYTES)
-    cand = (crcs == stored) & (i <= limit) & (n - i >= 4) & (n >= 4)
+    return (crcs == stored) & (i <= limit) & (n - i >= 4) & (n >= 4)
+
+
+def detect_csum(key, data, n):
+    """ONE uniform draw over the union of xor8 and crc32 trailer
+    candidates — the same index order as the oracle's single rand_elem
+    over get_possible_csum_locations (xor8 locations ascending, then
+    crc32 locations ascending; models/fieldpred.py:134-155), closing the
+    former pick-per-kind-then-kind divergence.
+
+    Returns (found, a, is_crc).
+    """
+    from .sizer import xor8_candidates
+
+    L = data.shape[0]
+    cand = jnp.concatenate([xor8_candidates(data, n), crc32_candidates(data, n)])
     total = jnp.sum(cand).astype(jnp.int32)
     found = total > 0
-    r = prng.rand(prng.sub(key, prng.TAG_LEN), total)
+    r = prng.rand(prng.sub(key, prng.TAG_MASK), total)
     cum = jnp.cumsum(cand).astype(jnp.int32)
-    a = jnp.argmax(cand & (cum == r + 1)).astype(jnp.int32)
-    return found, a
+    flat = jnp.argmax(cand & (cum == r + 1)).astype(jnp.int32)
+    return found, flat % L, flat >= L
 
 
 def write_crc32_be(data, pos, crc):

@@ -78,7 +78,7 @@ def fuzz_sample(key, data, n, scores, pri, pat_pri, engine: str = "fused",
     else:
         step_fn = mutate_step
     from .patterns import CS, SZ
-    from .sizer import detect_sizer, detect_xor8, rebuild_sizer, xor8_of_range
+    from .sizer import detect_sizer, rebuild_sizer, xor8_of_range
 
     pat, rounds, skip = pattern_plan(prng.sub(key, prng.TAG_PROB), n, pat_pri)
 
@@ -97,22 +97,14 @@ def fuzz_sample(key, data, n, scores, pri, pat_pri, engine: str = "fused",
 
     # cs: mutate the body behind a detected trailer checksum (xor8 1-byte
     # or big-endian crc32 4-byte, ops/crc32.py), keep the preamble,
-    # recompute the trailer afterwards. The oracle draws uniformly over
-    # all candidate locations of both kinds; the device picks a location
-    # per kind and then a kind (uniform when both exist) — documented
-    # divergence, same detection envelope.
+    # recompute the trailer afterwards. One uniform draw over the union of
+    # both kinds' candidate locations — the oracle's rand_elem semantics
+    # (crc32.detect_csum).
     if enable_csum:
-        from .crc32 import crc32_of_range, detect_crc32, write_crc32_be
+        from .crc32 import crc32_of_range, detect_csum, write_crc32_be
 
         kx = prng.sub(key, prng.TAG_VAL)
-        x_found, x_a = detect_xor8(kx, data, n)
-        c_found, c_a = detect_crc32(kx, data, n)
-        both = x_found & c_found
-        pick_crc = jnp.where(
-            both, prng.rand(prng.sub(kx, prng.TAG_POS), 2) == 1, c_found
-        )
-        cs_found = x_found | c_found
-        cs_a = jnp.where(pick_crc, c_a, x_a)
+        cs_found, cs_a, pick_crc = detect_csum(kx, data, n)
         cs_w = jnp.where(pick_crc, 4, 1)  # trailer width held out below
         use_cs = (pat == CS) & cs_found & ~use_sz
         skip = jnp.where(use_cs, cs_a, skip)

@@ -70,14 +70,11 @@ def detect_sizer(key, data, n):
     return any_found, a, width, kind
 
 
-def detect_xor8(key, data, n):
-    """Find a random xor8 trailer checksum: offsets a where
+def xor8_candidates(data, n):
+    """bool[L]: preambles a with a plausible xor8 trailer —
     xor(data[a:n-1]) == data[n-1], i.e. the suffix-xor at a is zero —
     one reversed cumulative-xor pass instead of the reference's
-    O(n*k) per-preamble rescan (erlamsa_field_predict.erl:129-161).
-
-    Returns (found, a): preamble length of a plausible checksummed body.
-    """
+    O(n*k) per-preamble rescan (erlamsa_field_predict.erl:129-161)."""
     L = data.shape[0]
     i = jnp.arange(L, dtype=jnp.int32)
     x = jnp.where(i < n, data, jnp.uint8(0))
@@ -87,13 +84,8 @@ def detect_xor8(key, data, n):
     # inclusive preamble envelope, same as the oracle's range(0, limit + 1)
     # (models/fieldpred.py get_possible_csum_locations)
     limit = jnp.minimum(2 * n // 3, 30 * PREAMBLE_MAX_BYTES)
-    cand = (sfx == 0) & (i <= limit) & (i < n - 1) & (n > 2)
-    total = jnp.sum(cand).astype(jnp.int32)
-    found = total > 0
-    r = prng.rand(prng.sub(key, prng.TAG_MASK), total)
-    cum = jnp.cumsum(cand).astype(jnp.int32)
-    a = jnp.argmax(cand & (cum == r + 1)).astype(jnp.int32)
-    return found, a
+    # i < n - 1 == the oracle's non-empty-body guard (n - a - 1 > 0)
+    return (sfx == 0) & (i <= limit) & (i < n - 1)
 
 
 def xor8_of_range(data, start, end):

@@ -195,6 +195,45 @@ def test_checksum_pattern_recomputes_crc32(state):
     assert fixed > 10
 
 
+def test_detect_csum_union_matches_oracle_candidates():
+    """detect_csum draws ONE uniform index over xor8-then-crc32 candidates
+    — the oracle's rand_elem over get_possible_csum_locations. On a buffer
+    where BOTH kinds validate, every draw must land on an oracle-listed
+    (kind, preamble) pair and both kinds must be reachable."""
+    import zlib
+
+    import jax
+    import jax.numpy as jnp
+
+    from erlamsa_tpu.models import fieldpred
+    from erlamsa_tpu.ops.crc32 import detect_csum
+
+    body = b"DUAL_TRAILER_BODY_0123456789"
+    c4 = (zlib.crc32(body) & 0xFFFFFFFF).to_bytes(4, "big")
+    # prefix byte chosen so xor(data[0:n-1]) == data[n-1]: xor8 validates
+    # at a=0 while crc32 validates at a=1
+    x = 0
+    for b_ in body + c4[:3]:
+        x ^= b_
+    buf = bytes([x ^ c4[3]]) + body + c4
+    locs = fieldpred.get_possible_csum_locations(buf)
+    want = {("crc32" if k == "crc32" else "xor8", a) for k, _, a, _ in locs}
+    assert ("xor8", 0) in want and ("crc32", 1) in want
+
+    d = jnp.zeros(L, jnp.uint8).at[: len(buf)].set(
+        jnp.frombuffer(buf, jnp.uint8)
+    )
+    n = jnp.int32(len(buf))
+    seen = set()
+    for s in range(64):
+        found, a, is_crc = detect_csum(jax.random.key(s), d, n)
+        assert bool(found)
+        pair = ("crc32" if bool(is_crc) else "xor8", int(a))
+        assert pair in want, f"draw {pair} not an oracle candidate {want}"
+        seen.add(pair)
+    assert len(seen) >= 2, "union draw never reached the second kind"
+
+
 def test_crc32_device_matches_zlib():
     import zlib
 
