@@ -22,8 +22,8 @@ line-table-dependent lp apply stays jnp) and tested end-to-end in
 interpret mode off-TPU, so the same tests cover CPU CI. The hardware
 build (pltpu PRNG, Mosaic lowering of the roll-based applies) still
 needs validation on a real chip — this image's relay has blocked chip
-access; remaining VMEM-residency step after that: moving the round LOOP
-(decisions + tables) in-kernel so a sample stays resident across rounds.
+access. The next residency level — the whole round LOOP (decisions +
+tables) in one kernel — exists as ERLAMSA_PALLAS=2 (ops/pallas_rounds.py).
 """
 
 from __future__ import annotations
@@ -319,8 +319,15 @@ def fused_round_single(key, params_row, lit_row, data_row):
 
 def pallas_enabled() -> bool:
     """Opt-in until validated on real chips (the relay in this image blocks
-    live TPU testing): ERLAMSA_PALLAS=1."""
+    live TPU testing): ERLAMSA_PALLAS=1 = per-round applies kernel."""
     return os.environ.get("ERLAMSA_PALLAS") == "1"
+
+
+def pallas_rounds_enabled() -> bool:
+    """ERLAMSA_PALLAS=2 = the whole-CASE kernel (ops/pallas_rounds.py):
+    decisions + tables + applies for every round in one VMEM-resident
+    pallas_call."""
+    return os.environ.get("ERLAMSA_PALLAS") == "2"
 
 
 def randmask_single(key, params_row, data_row):

@@ -1,0 +1,732 @@
+"""Whole-CASE Pallas kernel: the full round LOOP in VMEM.
+
+This is the final residency step past ``fused_round_single``
+(ops/pallas_kernels.py, which fuses one round's applies): here the
+scheduler's weighted pick, the applicability predicates, the per-round
+tables (line spans, digit runs, widenable/binarish scans) and ALL 25
+device param generators run INSIDE one pallas_call, so a sample's bytes
+enter VMEM once, take every mutation round there, and leave once. Per-
+round HBM traffic is zero on hardware (random bits come from the TPU
+PRNG; the portable build passes precomputed threefry bits as operands and
+runs under interpret mode for CPU CI).
+
+A second structural win over the vmapped jnp engines: the rounds count is
+the kernel's OWN fori_loop trip, so each sample pays exactly its drawn
+rounds — no max-over-batch lane masking (ops/pipeline.py pays
+max(rounds) across the vmap batch).
+
+Primitive discipline follows pallas_kernels.py: rolls by traced scalars,
+iota masks, cumulative scans, scalar ref reads/writes (Fisher-Yates, the
+number parser), one-hot sums instead of vector gathers. PERM_LINES is new
+here: up to 64 whole-line segments move via 64 static conditional rolls.
+
+Determinism: reproducible for a fixed (seed, case, sample); bitstreams
+diverge from the jnp engines (documented divergence class — raw-bits
+modulo draws vs jax.random.randint, shared scalar slots vs tagged
+subkeys). Distributions mirror erlamsa_rnd semantics (rand/erand/
+rand_log/rand_delta shapes, the mask nom==1 quirk).
+
+Enabled with ERLAMSA_PALLAS=2 (level 1 = per-round applies kernel).
+Reference being re-expressed: the per-case mutation loop of
+src/erlamsa_main.erl:180-221 over mux_fuzzers
+(src/erlamsa_mutations.erl:1256-1280).
+
+STATUS: interpret-mode tested end-to-end (CPU CI); the hardware build
+(pltpu PRNG, Mosaic lowering — int64 number math and the [64, L] line-
+window reductions are the risky spots) still needs a live chip, which
+this image's relay blocks.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+try:  # pallas TPU backend is optional off-TPU
+    from jax.experimental.pallas import tpu as pltpu
+except ImportError:  # pragma: no cover
+    pltpu = None
+
+from ..constants import MAX_BURST_MUTATIONS, MAX_SCORE, MIN_SCORE
+from . import prng
+from .fused import (
+    K_MASK,
+    K_NONE,
+    K_PERM_BYTES,
+    K_PERM_LINES,
+    K_SPLICE,
+    K_SWAP,
+    PERM_WINDOW,
+    SRC_LIT,
+    SRC_SPAN,
+)
+from .num_mutators import (
+    _INTERESTING_NP,
+    _MAX_PARSE_DIGITS,
+    _SCRATCH,
+    INT64_MAX,
+    _render_decimal,
+)
+from .registry import DEVICE_CODES, DEVICE_MUTATORS, NUM_DEVICE_MUTATORS
+from .registry import (
+    P_HAS_DIGIT,
+    P_NEVER,
+    P_NONEMPTY,
+    P_PAIR,
+    P_TEXT,
+    P_TEXT_2L,
+    P_TEXT_3L,
+    P_WIDENABLE,
+)
+from .utf8_mutators import _FUNNY_LENS, _FUNNY_TABLE
+
+R_MAX = MAX_BURST_MUTATIONS
+M = NUM_DEVICE_MUTATORS
+_PERM_LINES_W = 64  # line-permute window (== fused.PERM_LINES)
+
+_IDX = {c: k for k, c in enumerate(DEVICE_CODES)}
+
+# the kernel's setp() calls mirror fused._PARAM_GENS mutator-for-mutator;
+# guard the shared index space against registry/fused drift
+from .fused import _PARAM_GENS as _FUSED_PGS  # noqa: E402
+
+assert tuple(_FUSED_PGS) == DEVICE_CODES, (
+    "pallas_rounds param generators are ordered by DEVICE_CODES; "
+    "fused._PARAM_GENS drifted"
+)
+
+# scalar-draw slots in the per-round [64] uint32 row. Slots 0..M-1 are the
+# weighted-pick draws; the rest are PER-PURPOSE and SHARED between param
+# generators (only the applied generator's params are ever used, so
+# overlap is harmless and keeps the row small).
+_SB_POS = M  # primary position / which-run / which-line
+_SB_VAL = M + 1  # value / donor row / repeat magnitude
+_SB_LEN = M + 2  # span length / count
+_SB_AUX = M + 3  # secondary line (donor for lis/lrs)
+_SB_DELTA = M + 4  # rand_delta sign bit
+_SB_MASKOP = M + 5
+_SB_PROB = M + 6
+_SB_LOG2 = M + 7  # rand_log second draw
+_SB_NUM = M + 8  # ..+17: the textual-number mutator's draws
+_SB_ROW_LEN = 64
+
+# vector-bit rows in the per-round [6, L] uint32 block
+_VB_MASK0, _VB_MASK1, _VB_MASK2, _VB_FY, _VB_WIDE, _VB_LPERM = range(6)
+
+
+def _interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+# --- raw-bit draw helpers (erlamsa_rnd distribution shapes) ---------------
+
+
+def _krand(b, n):
+    """rand: uniform-ish int32 in [0, N) from one uint32 (modulo draw);
+    0 when N <= 0 (erlamsa_rnd:rand/1 shape)."""
+    n = jnp.asarray(n, jnp.int32)
+    safe = jnp.maximum(n, 1).astype(jnp.uint32)
+    return jnp.where(n <= 0, 0, (b % safe).astype(jnp.int32))
+
+
+def _kerand(b, n):
+    """erand: [1, N]; 0 when N <= 0."""
+    n = jnp.asarray(n, jnp.int32)
+    return jnp.where(n <= 0, 0, _krand(b, n) + 1)
+
+
+def _krand_range(b, lo, hi):
+    """[lo, hi); lo when hi == lo; 0 when hi < lo."""
+    lo = jnp.asarray(lo, jnp.int32)
+    hi = jnp.asarray(hi, jnp.int32)
+    v = _krand(b, hi - lo) + lo
+    return jnp.where(hi > lo, v, jnp.where(hi == lo, lo, 0))
+
+
+def _krand_log(b1, b2, n):
+    """2^rand(n)-scale magnitude (int32 range; n <= 30)."""
+    bits = _krand(b1, n)
+    hi = jnp.left_shift(jnp.int32(1), jnp.maximum(bits - 1, 0))
+    v = hi | _krand(b2, hi)
+    return jnp.where(jnp.asarray(n, jnp.int32) <= 0, 0, v)
+
+
+def _kdelta(b):
+    """+1/-1 from one bit (erlamsa_rnd:rand_delta shape)."""
+    return jnp.where((b & jnp.uint32(1)) == 1, -1, 1).astype(jnp.int32)
+
+
+def _u64(hi, lo):
+    return (hi.astype(jnp.uint64) << 32) | lo.astype(jnp.uint64)
+
+
+# --- in-kernel scans ------------------------------------------------------
+
+
+def _binarish(sref, n):
+    """erlamsa_utils:binarish on the first 8 bytes via scalar ref reads
+    (num_mutators._device_binarish semantics)."""
+    L = sref.shape[-1]
+    b = [sref[0, min(k, L - 1)].astype(jnp.int32) for k in range(10)]
+    first_bad = jnp.int32(8)
+    first_bom = jnp.int32(8)
+    for k in reversed(range(8)):
+        v = k < jnp.minimum(n, 8)
+        bad = ((b[k] == 0) | (b[k] >= 128)) & v
+        bom = (
+            ((b[k] == 0xEF) & (b[k + 1] == 0xBB) & (b[k + 2] == 0xBF))
+            | ((b[k] == 0xFE) & (b[k + 1] == 0x0F))
+        ) & v
+        first_bad = jnp.where(bad, k, first_bad)
+        first_bom = jnp.where(bom, k, first_bom)
+    return (first_bad < 8) & (first_bad < first_bom)
+
+
+# --- the per-round body ---------------------------------------------------
+
+
+def _round(sref, log_ref, tables, r, n, scores, pri_vec, sb, vb):
+    """One mutation event on the VMEM-resident sample.
+
+    sref: uint8[1, L] working row (read AND written). log_ref: the
+    int32[1, R] applied-log output ref. tables: (funny_table[179,4] u8,
+    funny_lens[179] i32, interesting[33] i64) constant operands (pallas
+    kernels cannot capture array constants). n: current length.
+    scores/pri_vec: int32[M]. sb: uint32[64] scalar draws. vb: uint32[6, L]
+    vector draws. Returns (n', scores').
+    """
+    L = sref.shape[-1]
+    d = sref[...]
+    i = jax.lax.broadcasted_iota(jnp.int32, (1, L), 1)
+    valid = i < n
+    di = d.astype(jnp.int32)
+
+    # ---- tables (line segments, digit runs, widenable) ----
+    is_nl = (di == 10) & valid
+    prev_nl = jnp.roll(is_nl, 1, axis=1) & (i > 0)
+    start_mask = valid & ((i == 0) | prev_nl)
+    rank = jnp.cumsum(start_mask.astype(jnp.int32), axis=1) - 1
+    nlines = jnp.sum(start_mask.astype(jnp.int32)).astype(jnp.int32)
+    is_digit = (di >= 48) & (di <= 57) & valid
+    prev_digit = jnp.roll(is_digit, 1, axis=1) & (i > 0)
+    digit_starts = is_digit & ~prev_digit
+    run_count = jnp.sum(digit_starts.astype(jnp.int32)).astype(jnp.int32)
+    widenable = ((di & 0x3F) == di) & valid
+    binarish = _binarish(sref, n)
+    nonempty = n > 0
+    text = nonempty & ~binarish
+
+    def start_of(k):
+        m = start_mask & (rank == k)
+        return jnp.where(
+            jnp.any(m), jnp.argmax(m.reshape(-1)), 0
+        ).astype(jnp.int32)
+
+    def line_span(k):
+        k = jnp.clip(k, 0, jnp.maximum(nlines - 1, 0))
+        s = start_of(k)
+        e = jnp.where(k == nlines - 1, n, start_of(k + 1))
+        return s, jnp.maximum(e - s, 0)
+
+    # ---- applicability + weighted pick (scheduler.weighted_pick) ----
+    preds = {
+        P_NONEMPTY: nonempty,
+        P_PAIR: n >= 2,
+        P_HAS_DIGIT: (run_count > 0) & nonempty,
+        P_TEXT: text,
+        P_TEXT_2L: text & (nlines >= 2),
+        P_TEXT_3L: text & (nlines >= 3),
+        P_WIDENABLE: jnp.any(widenable) & nonempty,
+        P_NEVER: jnp.bool_(False),
+    }
+    applicable = jnp.stack([preds[m.pred] for m in DEVICE_MUTATORS]) & (
+        pri_vec > 0
+    )
+    bits_m = sb[:M].astype(jnp.uint32)
+    bounds = jnp.maximum(scores * pri_vec, 1).astype(jnp.uint32)
+    draws = (bits_m % bounds).astype(jnp.int32)
+    midx = jnp.arange(M, dtype=jnp.int32)
+    best = jnp.max(jnp.where(applicable, draws, -1))
+    applied = jnp.argmax(applicable & (draws == best)).astype(jnp.int32)
+    any_app = jnp.any(applicable)
+    d_app = jnp.sum(jnp.where(midx == applied, draws, 0))
+    # tried-and-failed = earlier in the descending stable order
+    tried_before = ((draws > d_app) | ((draws == d_app) & (midx < applied))) \
+        & any_app
+
+    # ---- param generation (all M sets; one-hot select by `applied`) ----
+    # every generator is scalar work over the shared tables; mirrors
+    # fused._PARAM_GENS order exactly (asserted at import below)
+    delta_c = _kdelta(sb[_SB_DELTA])
+
+    def span_draw():
+        s = _krand(sb[_SB_POS], n)
+        ln = _krand(sb[_SB_LEN], n - s) + 1
+        return s, ln
+
+    pos_u = _krand(sb[_SB_POS], n)  # shared single-position draw
+    b_at = sref[0, jnp.clip(pos_u, 0, L - 1)].astype(jnp.int32)
+    s_sp, l_sp = span_draw()
+
+    z = jnp.int32(0)
+    P = {
+        f: jnp.zeros(M, jnp.int32)
+        for f in (
+            "kind", "pos", "drop", "src", "src_start", "src_len", "reps",
+            "lit_len", "a1", "l1", "l2", "ps", "pl", "mask_op", "mask_prob",
+            "delta",
+        )
+    }
+
+    def setp(code, **kw):
+        k = _IDX[code]
+        for f, v in kw.items():
+            P[f] = P[f].at[k].set(jnp.asarray(v, jnp.int32))
+
+    # byte ops (splices with span/literal sources)
+    setp("bd", kind=K_SPLICE, pos=pos_u, drop=1, delta=delta_c)
+    nb_flip = b_at ^ jnp.left_shift(1, _krand(sb[_SB_VAL], 8))
+    nb_rand = _krand(sb[_SB_VAL], 256)
+    for code in ("bei", "bed", "bf", "ber"):  # literal byte built below
+        setp(code, kind=K_SPLICE, pos=pos_u, drop=1, src=SRC_LIT, lit_len=1,
+             delta=delta_c)
+    setp("bi", kind=K_SPLICE, pos=pos_u, drop=1, src=SRC_LIT, lit_len=2,
+         delta=delta_c)
+    setp("br", kind=K_SPLICE, pos=pos_u, drop=0, src=SRC_SPAN,
+         src_start=pos_u, src_len=1, reps=1, delta=delta_c)
+
+    # seq ops
+    W = min(PERM_WINDOW, L)
+    lmax_sp = jnp.minimum(n - pos_u, W)
+    setp("sp", kind=K_PERM_BYTES, ps=pos_u,
+         pl=_krand(sb[_SB_LEN], lmax_sp) + 1, delta=delta_c)
+    reps_sr = jnp.maximum(2, _krand_log(sb[_SB_VAL], sb[_SB_LOG2], 10))
+    setp("sr", kind=K_SPLICE, pos=s_sp, drop=l_sp, src=SRC_SPAN,
+         src_start=s_sp, src_len=l_sp, reps=reps_sr, delta=delta_c)
+    setp("sd", kind=K_SPLICE, pos=s_sp, drop=l_sp, delta=delta_c)
+    setp("snand", kind=K_MASK, ps=s_sp, pl=l_sp,
+         mask_op=_krand(sb[_SB_MASKOP], 3),
+         mask_prob=_kerand(sb[_SB_PROB], 100), delta=delta_c)
+    setp("srnd", kind=K_MASK, ps=s_sp, pl=l_sp, mask_op=3,
+         mask_prob=_kerand(sb[_SB_PROB], 100), delta=delta_c)
+
+    # utf8
+    wide_keys = jnp.where(widenable, vb[_VB_WIDE : _VB_WIDE + 1], 0)
+    pos_uw = jnp.argmax(wide_keys.reshape(-1)).astype(jnp.int32)
+    b_uw = sref[0, jnp.clip(pos_uw, 0, L - 1)]
+    setp("uw", kind=K_SPLICE, pos=pos_uw, drop=1, src=SRC_LIT, lit_len=2,
+         delta=delta_c)
+    funny_t, funny_l, int_tbl = tables
+    row_ui = _krand(sb[_SB_VAL], funny_t.shape[0])
+    seq_ui = jax.lax.dynamic_slice(
+        funny_t, (row_ui, jnp.int32(0)), (1, 4)
+    )[0]
+    len_ui = jax.lax.dynamic_slice(funny_l, (row_ui,), (1,))[0]
+    setp("ui", kind=K_SPLICE, pos=pos_u + 1, src=SRC_LIT, lit_len=len_ui,
+         delta=delta_c)
+
+    # num: parse -> mutate (int64 scalar math) -> render
+    which = _krand(sb[_SB_POS], run_count)
+    target = run_count - 1 - which
+    csum = jnp.cumsum(digit_starts.astype(jnp.int32), axis=1)
+    hit = digit_starts & (csum == target + 1)
+    a_num = jnp.where(
+        jnp.any(hit), jnp.argmax(hit.reshape(-1)), 0
+    ).astype(jnp.int32)
+    break_mask = (i >= a_num) & ~is_digit
+    b_end = jnp.where(
+        jnp.any(break_mask), jnp.argmax(break_mask.reshape(-1)), n
+    ).astype(jnp.int32)
+
+    def dash_cond(c):
+        idx = a_num - 1 - c
+        return (idx >= 0) & (sref[0, jnp.clip(idx, 0, L - 1)] == 45)
+
+    dash_count = jax.lax.while_loop(dash_cond, lambda c: c + 1, jnp.int32(0))
+    neg_in = dash_count > 0
+    a_ext = a_num - dash_count
+
+    def parse_body(k, v):
+        idx = jnp.clip(a_num + k, 0, L - 1)
+        take = (a_num + k < b_end) & (k < _MAX_PARSE_DIGITS)
+        dig = (sref[0, idx].astype(jnp.int64)) - 48
+        return jnp.where(take, v * 10 + dig, v)
+
+    mag = jax.lax.fori_loop(0, _MAX_PARSE_DIGITS, parse_body, jnp.int64(0))
+    value = jnp.where(neg_in, -mag, mag)
+    new_value = _mutate_num_bits(sb, value, int_tbl)
+    sc_num, len_num = _render_decimal(new_value)
+    setp("num", kind=K_SPLICE, pos=a_ext, drop=b_end - a_ext, src=SRC_LIT,
+         lit_len=len_num, delta=2)  # real num delta recomputed post-apply
+
+    # line ops (spans via the scalar line-table queries)
+    k_ld = _kerand(sb[_SB_POS], nlines) - 1
+    s_ld, l_ld = line_span(k_ld)
+    setp("ld", kind=K_SPLICE, pos=s_ld, drop=l_ld, delta=1)
+    start_lds = _kerand(sb[_SB_POS], nlines)
+    cnt_lds = _kerand(sb[_SB_LEN], nlines - start_lds + 1)
+    s0_lds, _ = line_span(start_lds - 1)
+    s2_lds, l2_lds = line_span(start_lds - 1 + cnt_lds - 1)
+    setp("lds", kind=K_SPLICE, pos=s0_lds, drop=s2_lds + l2_lds - s0_lds,
+         delta=1)
+    setp("lr2", kind=K_SPLICE, pos=s_ld, drop=0, src=SRC_SPAN,
+         src_start=s_ld, src_len=l_ld, reps=1, delta=1)
+    frm_lri = _kerand(sb[_SB_POS], nlines) - 1
+    to_lri = _kerand(sb[_SB_VAL], nlines) - 1
+    fs_lri, fl_lri = line_span(frm_lri)
+    ts_lri, tl_lri = line_span(to_lri)
+    setp("lri", kind=K_SPLICE, pos=ts_lri, drop=tl_lri, src=SRC_SPAN,
+         src_start=fs_lri, src_len=fl_lri, reps=1, delta=1)
+    reps_lr = jnp.maximum(2, _krand_log(sb[_SB_VAL], sb[_SB_LOG2], 10))
+    setp("lr", kind=K_SPLICE, pos=s_ld, drop=l_ld, src=SRC_SPAN,
+         src_start=s_ld, src_len=l_ld, reps=reps_lr, delta=1)
+    k_ls = _kerand(sb[_SB_POS], jnp.maximum(nlines - 1, 0)) - 1
+    s1_ls, l1_ls = line_span(k_ls)
+    _s2_ls, l2_ls = line_span(k_ls + 1)
+    setp("ls", kind=K_SWAP, a1=s1_ls, l1=l1_ls, l2=l2_ls, delta=1)
+    frm_lp = _kerand(sb[_SB_POS], jnp.maximum(nlines - 1, 0)) - 1
+    a_lp = _krand_range(sb[_SB_LEN], 2, jnp.maximum(nlines - frm_lp - 1, 2))
+    b_lp = _krand_log(sb[_SB_VAL], sb[_SB_LOG2], 10)
+    cnt_lp = jnp.clip(
+        jnp.maximum(2, jnp.minimum(a_lp, b_lp)), 0, _PERM_LINES_W
+    )
+    setp("lp", kind=K_PERM_LINES, ps=frm_lp, pl=cnt_lp, delta=1)
+    don_lis = _kerand(sb[_SB_AUX], nlines) - 1
+    to_lis = _kerand(sb[_SB_POS], nlines) - 1
+    ds_lis, dl_lis = line_span(don_lis)
+    ts_lis, tl_lis = line_span(to_lis)
+    setp("lis", kind=K_SPLICE, pos=ts_lis, drop=0, src=SRC_SPAN,
+         src_start=ds_lis, src_len=dl_lis, reps=1, delta=1)
+    setp("lrs", kind=K_SPLICE, pos=ts_lis, drop=tl_lis, src=SRC_SPAN,
+         src_start=ds_lis, src_len=dl_lis, reps=1, delta=1)
+    # "nil": all-zero row (K_NONE) already
+
+    # select the applied row (+ gate to no-op when nothing applicable)
+    def sel(f):
+        return jnp.sum(jnp.where(midx == applied, P[f], 0)).astype(jnp.int32)
+
+    kind = jnp.where(any_app, sel("kind"), K_NONE)
+    pos, drop = sel("pos"), sel("drop")
+    src, src_start, src_len = sel("src"), sel("src_start"), sel("src_len")
+    reps, lit_len = sel("reps"), sel("lit_len")
+    a1, l1, l2 = sel("a1"), sel("l1"), sel("l2")
+    ps, plen = sel("ps"), sel("pl")
+    mask_op, mask_prob = sel("mask_op"), sel("mask_prob")
+    delta_sel = sel("delta")
+
+    # literal scratch for the applied splice (byte ops / uw / ui / num)
+    is_bi = applied == _IDX["bi"]
+    byte0 = jnp.select(
+        [applied == _IDX["bei"], applied == _IDX["bed"],
+         applied == _IDX["bf"], applied == _IDX["ber"]],
+        [(b_at + 1) % 256, (b_at - 1) % 256, nb_flip, nb_rand],
+        nb_rand,  # bi's inserted byte is the same rand_byte draw
+    ).astype(jnp.uint8)
+    si = jnp.arange(_SCRATCH, dtype=jnp.int32)
+    sc_byte = jnp.where(
+        si == 0, byte0,
+        jnp.where(si == 1, jnp.where(is_bi, d[0, jnp.clip(pos_u, 0, L - 1)],
+                                     jnp.uint8(0)), jnp.uint8(0)),
+    ).astype(jnp.uint8)
+    sc_uw = jnp.where(
+        si == 0, jnp.uint8(0xC0),
+        jnp.where(si == 1, b_uw | jnp.uint8(0x80), jnp.uint8(0)),
+    )
+    sc_ui = jnp.where(si < 4, seq_ui[jnp.clip(si, 0, 3)], jnp.uint8(0))
+    lit = jnp.where(
+        applied == _IDX["num"], sc_num,
+        jnp.where(applied == _IDX["ui"], sc_ui,
+                  jnp.where(applied == _IDX["uw"], sc_uw, sc_byte)),
+    )
+
+    # ---- applies (pallas_kernels._round_logic discipline) ----
+    pos_c = jnp.clip(pos, 0, n)
+    drop_c = jnp.clip(drop, 0, n - pos_c)
+    rlen = jnp.where(
+        src == SRC_SPAN, src_len * reps,
+        jnp.where(src == SRC_LIT, lit_len, 0),
+    )
+    sl_c = jnp.maximum(src_len, 1)
+    o = i - pos_c
+    cur = jnp.roll(d, pos_c - src_start, axis=1)
+    odiv = jnp.where(o >= 0, o // sl_c, 0)
+    for k in range(max(1, (L - 1).bit_length())):
+        bitk = (odiv >> k) & 1
+        cur = jnp.where(bitk == 1, jnp.roll(cur, sl_c << k, axis=1), cur)
+    lit_at = jnp.zeros((1, L), jnp.uint8)
+    for k in range(_SCRATCH):
+        lit_at = jnp.where(o == k, lit[k], lit_at)
+    repl = jnp.where(src == SRC_LIT, lit_at, cur)
+    tail = jnp.roll(d, rlen - drop_c, axis=1)
+    n_sp = jnp.clip(n - drop_c + rlen, 0, L)
+    sp = jnp.where(i < pos_c, d, jnp.where(i < pos_c + rlen, repl, tail))
+    sp = jnp.where(i < n_sp, sp, jnp.uint8(0))
+
+    sw = jnp.where(
+        (i >= a1) & (i < a1 + l2),
+        jnp.roll(d, -l1, axis=1),
+        jnp.where(
+            (i >= a1 + l2) & (i < a1 + l2 + l1), jnp.roll(d, l2, axis=1), d
+        ),
+    )
+
+    occ_n = (vb[_VB_MASK0 : _VB_MASK0 + 1] % 100).astype(jnp.int32)
+    occurs = jnp.where(mask_prob == 1, occ_n != 0, occ_n < mask_prob)
+    mbit = (vb[_VB_MASK1 : _VB_MASK1 + 1] % 8).astype(jnp.uint8)
+    mrnd = (vb[_VB_MASK2 : _VB_MASK2 + 1] & 0xFF).astype(jnp.uint8)
+    one = jnp.left_shift(jnp.uint8(1), mbit)
+    masked = jnp.where(
+        mask_op == 0, d & ~one,
+        jnp.where(mask_op == 1, d | one,
+                  jnp.where(mask_op == 2, d ^ one, mrnd)),
+    )
+    mk = jnp.where((i >= ps) & (i < ps + plen) & occurs, masked, d)
+
+    lp_out = _perm_lines(d, i, n, start_mask, rank, nlines, ps, plen, vb,
+                         line_span)
+
+    out = jnp.where(
+        kind == K_SPLICE, sp,
+        jnp.where(kind == K_SWAP, sw,
+                  jnp.where(kind == K_MASK, mk,
+                            jnp.where(kind == K_PERM_LINES, lp_out, d))),
+    )
+    n1 = jnp.where(kind == K_SPLICE, n_sp, n)
+    sref[...] = out
+
+    # PERM_BYTES: in-place Fisher-Yates over [ps, ps+plen), bits row _VB_FY
+    @pl.when(kind == K_PERM_BYTES)
+    def _fy():
+        span = jnp.clip(plen, 0, min(PERM_WINDOW, L))
+
+        def body(t, carry):
+            j = span - 1 - t
+
+            @pl.when(j > 0)
+            def _swap_one():
+                rr = (
+                    vb[_VB_FY, jnp.clip(j, 0, L - 1)]
+                    % (j + 1).astype(jnp.uint32)
+                ).astype(jnp.int32)
+                aj = jnp.clip(ps + j, 0, L - 1)
+                ar = jnp.clip(ps + rr, 0, L - 1)
+                vj = sref[0, aj]
+                vr = sref[0, ar]
+                sref[0, aj] = vr
+                sref[0, ar] = vj
+
+            return carry
+
+        jax.lax.fori_loop(0, min(PERM_WINDOW, L) - 1, body, 0)
+
+    # ---- score update (scheduler.adjust_scores) ----
+    bin2 = _binarish(sref, n1)
+    delta_f = jnp.where(
+        applied == _IDX["num"], jnp.where(bin2, -1, 2), delta_sel
+    )
+    deltas = jnp.where(tried_before, -1, 0) + jnp.where(
+        (midx == applied) & any_app, delta_f, 0
+    )
+    scores1 = jnp.clip(
+        scores + deltas, int(MIN_SCORE), int(MAX_SCORE)
+    ).astype(jnp.int32)
+
+    log_ref[0, r] = jnp.where(any_app, applied, -1)
+    return n1, scores1
+
+
+def _perm_lines(d, i, n, start_mask, rank, nlines, f, cnt, vb, line_span):
+    """Permute up to 64 whole lines via static conditional rolls (no
+    vector gather): output line w's bytes are source line order[w] rolled
+    to the destination offset."""
+    L = d.shape[-1]
+    Wl = _PERM_LINES_W
+    f = jnp.clip(f, 0, jnp.maximum(nlines - 1, 0))
+    cnt = jnp.clip(cnt, 0, jnp.clip(nlines - f, 0, Wl))
+    w = jnp.arange(Wl, dtype=jnp.int32)
+    w1 = jnp.arange(Wl + 1, dtype=jnp.int32)
+    # window line starts: [Wl+1, L] rank-match reduction (the +1 row gives
+    # the start of the line just past the window, for the last line's len)
+    wmask = start_mask[0][None, :] & (
+        rank[0][None, :] == (f + w1)[:, None]
+    )  # [Wl+1, L]
+    ii = jnp.arange(L, dtype=jnp.int32)
+    starts_ext = jnp.max(
+        jnp.where(wmask, ii[None, :], 0), axis=1
+    ).astype(jnp.int32)
+    starts_w = starts_ext[:Wl]
+    has_w = jnp.any(wmask, axis=1)[:Wl]
+    nxt = starts_ext[1:]
+    is_last_global = (f + w) == nlines - 1
+    lens_w = jnp.where(
+        w < cnt,
+        jnp.where(is_last_global, n - starts_w, nxt - starts_w),
+        0,
+    )
+    lens_w = jnp.where(has_w, jnp.maximum(lens_w, 0), 0)
+
+    # uniform permutation of the first cnt window lines: iterative argmax
+    lrow = vb[_VB_LPERM]
+    if L < Wl:  # tiny capacities: pad the key row statically
+        lrow = jnp.concatenate([lrow, jnp.zeros(Wl - L, lrow.dtype)])
+    keys = jnp.where(w < cnt, lrow[:Wl].astype(jnp.int64), jnp.int64(-1))
+    order = w
+    for j in range(Wl):
+        pick = jnp.argmax(keys).astype(jnp.int32)
+        oj = jnp.where(j < cnt, pick, j)
+        order = jnp.where(w == j, oj, order)
+        keys = jnp.where(w == pick, jnp.int64(-1), keys)
+
+    onehot = order[:, None] == w[None, :]  # [Wl, Wl]
+    plens = jnp.sum(jnp.where(onehot, lens_w[None, :], 0), axis=1)
+    pstarts = jnp.sum(jnp.where(onehot, starts_w[None, :], 0), axis=1)
+    cum = jnp.cumsum(plens)
+    prev_cum = cum - plens
+    win_start, _ = line_span(f)
+    total = jnp.sum(jnp.where(w == cnt - 1, cum, 0))
+
+    out = d
+    rel = i - win_start
+    for j in range(Wl):  # static rolls, one per window line
+        dst0 = win_start + prev_cum[j]
+        src0 = pstarts[j]
+        rolled = jnp.roll(d, dst0 - src0, axis=1)
+        in_seg = (i >= dst0) & (i < dst0 + plens[j]) & (j < cnt)
+        out = jnp.where(in_seg, rolled, out)
+    in_win = (rel >= 0) & (rel < total) & (cnt > 0)
+    return jnp.where(in_win, out, d)
+
+
+# --- int64 number mutate/render on raw bits -------------------------------
+
+
+def _mutate_num_bits(sb, v, tbl):
+    """num_mutators._mutate_num on kernel bits (12 strategies,
+    erlamsa_mutations.erl:95-112). tbl: interesting-numbers operand."""
+    t = _krand(sb[_SB_NUM], 12)
+    i1 = _krand(sb[_SB_NUM + 1], tbl.shape[0])
+    i2 = _krand(sb[_SB_NUM + 2], tbl.shape[0])
+    interesting = jax.lax.dynamic_slice(tbl, (i1,), (1,))[0]
+    interesting2 = jax.lax.dynamic_slice(tbl, (i2,), (1,))[0]
+    absv2 = jnp.minimum(jnp.abs(v), INT64_MAX // 2) * 2
+    u = _u64(sb[_SB_NUM + 3], sb[_SB_NUM + 4])
+    rnd_abs = (u % jnp.maximum(absv2, 1).astype(jnp.uint64)).astype(jnp.int64)
+    sign = jnp.where(v >= 0, jnp.int64(1), jnp.int64(-1))
+    n129 = _krand(sb[_SB_NUM + 5], 128) + 1  # rand_range(1, 129)
+    bits = jnp.minimum(_krand(sb[_SB_NUM + 6], n129), 62)
+    hi = jnp.left_shift(
+        jnp.int64(1), jnp.maximum(bits - 1, 0).astype(jnp.int64)
+    )
+    lo = (
+        _u64(sb[_SB_NUM + 7], sb[_SB_NUM + 8])
+        % jnp.maximum(hi, 1).astype(jnp.uint64)
+    ).astype(jnp.int64)
+    lg = jnp.where(bits <= 0, jnp.int64(0), hi | lo)
+    s3 = _krand(sb[_SB_NUM + 9], 3)
+    catch_all = jnp.where(s3 == 0, v - lg, v + lg)
+    return jnp.select(
+        [t == 0, t == 1, t == 2, t == 3, (t == 4) | (t == 5),
+         t == 7, t == 8, t == 9, t == 10],
+        [v + 1, v - 1, jnp.int64(0), jnp.int64(1), interesting,
+         v + interesting2, v - interesting2, v - rnd_abs * sign, -v],
+        catch_all,
+    )
+
+
+# --- kernels + wrapper ----------------------------------------------------
+
+
+def _run(meta_ref, pri_ref, sc_ref, funny_ref, flens_ref, itbl_ref,
+         data_ref, out_ref, nout_ref, scout_ref, log_ref, sref, get_bits):
+    tables = (funny_ref[...], flens_ref[0], itbl_ref[0])
+    sref[...] = data_ref[...]
+    log_ref[...] = jnp.full((1, R_MAX), -1, jnp.int32)
+    n0 = meta_ref[0, 0]
+    rounds = jnp.clip(meta_ref[0, 1], 0, R_MAX)
+    pri_vec = pri_ref[0]
+    scores0 = sc_ref[0]
+
+    def body(r, carry):
+        n, scores = carry
+        sb, vb = get_bits(r)
+        return _round(sref, log_ref, tables, r, n, scores, pri_vec, sb, vb)
+
+    # DYNAMIC trip count: this sample pays exactly its own rounds draw
+    n_f, sc_f = jax.lax.fori_loop(0, rounds, body, (n0, scores0))
+    out_ref[...] = sref[...]
+    nout_ref[0, 0] = n_f
+    scout_ref[...] = sc_f.reshape(1, M)
+
+
+def _kernel_portable(meta_ref, pri_ref, sc_ref, funny_ref, flens_ref,
+                     itbl_ref, sbits_ref, vbits_ref, data_ref, out_ref,
+                     nout_ref, scout_ref, log_ref, sref):
+    _run(meta_ref, pri_ref, sc_ref, funny_ref, flens_ref, itbl_ref,
+         data_ref, out_ref, nout_ref, scout_ref, log_ref, sref,
+         get_bits=lambda r: (sbits_ref[r], vbits_ref[r]))
+
+
+def _kernel_hw(seed_ref, meta_ref, pri_ref, sc_ref, funny_ref, flens_ref,
+               itbl_ref, data_ref, out_ref, nout_ref, scout_ref, log_ref,
+               sref):  # pragma: no cover - TPU
+    pltpu.prng_seed(seed_ref[0, 0], seed_ref[0, 1])
+    L = data_ref.shape[-1]
+
+    def get_bits(r):
+        sb = pltpu.prng_random_bits((1, _SB_ROW_LEN)).astype(jnp.uint32)[0]
+        vb = pltpu.prng_random_bits((6, L)).astype(jnp.uint32)
+        return sb, vb
+
+    _run(meta_ref, pri_ref, sc_ref, funny_ref, flens_ref, itbl_ref,
+         data_ref, out_ref, nout_ref, scout_ref, log_ref, sref, get_bits)
+
+
+def case_rounds_single(key, data_row, n, scores, pri, rounds):
+    """All mutation rounds for ONE sample in one pallas_call (vmapped by
+    the pipeline; vmap prepends a grid dimension).
+
+    Args: key (threefry key), data_row uint8[L], n int32, scores int32[M],
+    pri int32[M], rounds int32. Returns (out[L], n', scores'[M],
+    log[R_MAX]) — log holds applied registry indices, -1 for empty rounds.
+    """
+    L = data_row.shape[0]
+    meta = jnp.stack(
+        [jnp.asarray(n, jnp.int32), jnp.asarray(rounds, jnp.int32)]
+    ).reshape(1, 2)
+    pri2 = jnp.asarray(pri, jnp.int32).reshape(1, M)
+    sc2 = jnp.asarray(scores, jnp.int32).reshape(1, M)
+    data2 = data_row.reshape(1, L)
+    funny_t = jnp.asarray(_FUNNY_TABLE)
+    funny_l = jnp.asarray(_FUNNY_LENS, jnp.int32).reshape(1, -1)
+    int_tbl = jnp.asarray(_INTERESTING_NP).reshape(1, -1)
+    out_shape = (
+        jax.ShapeDtypeStruct((1, L), jnp.uint8),
+        jax.ShapeDtypeStruct((1, 1), jnp.int32),
+        jax.ShapeDtypeStruct((1, M), jnp.int32),
+        jax.ShapeDtypeStruct((1, R_MAX), jnp.int32),
+    )
+    if pltpu is None:  # pragma: no cover - jax always ships pallas.tpu
+        raise RuntimeError("ERLAMSA_PALLAS=2 requires pallas.tpu")
+    scratch = [pltpu.VMEM((1, L), jnp.uint8)]
+    if not _interpret():  # pragma: no cover - needs a chip
+        # full 64 key bits -> 2 seed words (a single int32 seed would
+        # cap the per-sample stream space at 2^31 and invite collisions)
+        seed = jax.lax.bitcast_convert_type(
+            jax.random.key_data(key), jnp.int32
+        ).reshape(1, 2)
+        out, nout, sc, log = pl.pallas_call(
+            _kernel_hw, out_shape=out_shape, scratch_shapes=scratch
+        )(seed, meta, pri2, sc2, funny_t, funny_l, int_tbl, data2)
+    else:
+        sbits = jax.random.bits(
+            prng.sub(key, prng.TAG_SITE), (R_MAX, _SB_ROW_LEN), jnp.uint32
+        )
+        vbits = jax.random.bits(
+            prng.sub(key, prng.TAG_PERM), (R_MAX, 6, L), jnp.uint32
+        )
+        out, nout, sc, log = pl.pallas_call(
+            _kernel_portable, out_shape=out_shape, scratch_shapes=scratch,
+            interpret=True,
+        )(meta, pri2, sc2, funny_t, funny_l, int_tbl, sbits, vbits, data2)
+    return out[0], nout[0, 0], sc[0], log[0]

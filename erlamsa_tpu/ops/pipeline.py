@@ -116,27 +116,41 @@ def fuzz_sample(key, data, n, scores, pri, pat_pri, engine: str = "fused",
     if enable_csum:
         wn = jnp.where(use_cs, jnp.maximum(wn - cs_w, 0), wn)
 
-    def body(r, carry):
-        wdata, wlen, sc, log = carry
-        active = r < rounds
-        kr = prng.sub(prng.sub(key, prng.TAG_SITE), r)
-        nd, nn, nsc, applied = step_fn(kr, wdata, wlen, sc, pri)
-        wdata = jnp.where(active, nd, wdata)
-        wlen = jnp.where(active, nn, wlen)
-        sc = jnp.where(active, nsc, sc)
-        log = log.at[r].set(jnp.where(active, applied, -1))
-        return wdata, wlen, sc, log
+    from .pallas_kernels import pallas_rounds_enabled
 
-    log0 = jnp.full((MAX_BURST_MUTATIONS,), -1, jnp.int32)
-    # adaptive trip count: the bound is the TRACED per-sample rounds draw,
-    # so under vmap the batched while_loop runs max(rounds)-over-batch
-    # iterations instead of a fixed MAX_BURST_MUTATIONS — typical patterns
-    # draw 1-5 rounds (od=1, nd geometric p=1/5), so most batches stop
-    # well short of 16. The r<rounds mask still gates lanes below the max.
-    work, wn, scores, log = jax.lax.fori_loop(
-        0, jnp.minimum(rounds, MAX_BURST_MUTATIONS), body,
-        (work, wn, scores, log0)
-    )
+    if engine == "fused" and pallas_rounds_enabled():
+        # ERLAMSA_PALLAS=2: the whole-case kernel — every round's
+        # decisions + tables + applies in ONE VMEM-resident pallas_call,
+        # with a per-sample dynamic trip count (ops/pallas_rounds.py)
+        from .pallas_rounds import case_rounds_single
+
+        work, wn, scores, log = case_rounds_single(
+            prng.sub(key, prng.TAG_SITE), work, wn, scores, pri,
+            jnp.minimum(rounds, MAX_BURST_MUTATIONS),
+        )
+    else:
+        def body(r, carry):
+            wdata, wlen, sc, log = carry
+            active = r < rounds
+            kr = prng.sub(prng.sub(key, prng.TAG_SITE), r)
+            nd, nn, nsc, applied = step_fn(kr, wdata, wlen, sc, pri)
+            wdata = jnp.where(active, nd, wdata)
+            wlen = jnp.where(active, nn, wlen)
+            sc = jnp.where(active, nsc, sc)
+            log = log.at[r].set(jnp.where(active, applied, -1))
+            return wdata, wlen, sc, log
+
+        log0 = jnp.full((MAX_BURST_MUTATIONS,), -1, jnp.int32)
+        # adaptive trip count: the bound is the TRACED per-sample rounds
+        # draw, so under vmap the batched while_loop runs max(rounds)-
+        # over-batch iterations instead of a fixed MAX_BURST_MUTATIONS —
+        # typical patterns draw 1-5 rounds (od=1, nd geometric p=1/5), so
+        # most batches stop well short of 16. The r<rounds mask still
+        # gates lanes below the max.
+        work, wn, scores, log = jax.lax.fori_loop(
+            0, jnp.minimum(rounds, MAX_BURST_MUTATIONS), body,
+            (work, wn, scores, log0)
+        )
 
     out, n_out = _splice_prefix(data, work, skip, wn)
     if enable_sizer:
