@@ -6,16 +6,29 @@ The reference publishes no numbers (BASELINE.md); vs_baseline is measured
 against the north-star target of 100k mutated 4KB samples/sec (v5e-8), i.e.
 vs_baseline = value / 100_000. Runs on whatever jax.devices() offers (the
 real TPU chip under the driver; CPU as fallback).
+
+Process structure (why this is not a single process): the axon TPU relay in
+this image can wedge machine-wide if a process holding (or initialising) the
+TPU dies abruptly — including a watchdog that execve()s or SIGTERMs itself
+mid-init. So the parent process never imports jax at all. It spawns the real
+run as a child (ERLAMSA_BENCH_CHILD=1) writing its JSON to a per-invocation
+file, waits up to ERLAMSA_BENCH_TIMEOUT (extended once the attempt log
+shows compile survived), and on timeout LEAVES THE CHILD RUNNING (detached,
+output to bench_tpu_attempt.<pid>.log) while it launches a small-shape CPU
+fallback child so the driver still gets a line. The abandoned TPU child can
+finish and leave its result in bench_tpu_result.<pid>.json without ever
+being killed.
 """
 
 from __future__ import annotations
 
 import json
 import os
+import subprocess
 import sys
 import time
 
-import numpy as np
+import numpy as np  # noqa: F401  (child uses it; import kept cheap)
 
 # env-overridable for smoke runs on weak hosts (CPU fallback)
 BATCH = int(os.environ.get("ERLAMSA_BENCH_BATCH", 2048))
@@ -23,43 +36,26 @@ SEED_LEN = int(os.environ.get("ERLAMSA_BENCH_SEED_LEN", 4096))
 CAPACITY = int(os.environ.get("ERLAMSA_BENCH_CAPACITY", 16384))  # 4x growth slack
 WARMUP = 2
 ITERS = int(os.environ.get("ERLAMSA_BENCH_ITERS", 10))
+REPO = os.path.dirname(os.path.abspath(__file__))
 
 
-def _watchdog_reexec(seconds: float) -> None:
-    """The axon relay in this image can wedge so hard that ANY jax backend
-    init blocks (see .claude/skills/verify/SKILL.md). If init doesn't
-    complete in time, re-exec on CPU with small shapes so the driver still
-    gets a JSON line instead of a hang."""
-    import os
-    import threading
-
-    if os.environ.get("ERLAMSA_BENCH_FALLBACK"):
-        return  # already the fallback process
-
-    def fire():
-        env = dict(os.environ)
-        env.pop("PALLAS_AXON_POOL_IPS", None)
-        env["JAX_PLATFORMS"] = "cpu"
-        env["ERLAMSA_BENCH_FALLBACK"] = "1"
-        env.setdefault("ERLAMSA_BENCH_BATCH", "128")
-        env.setdefault("ERLAMSA_BENCH_SEED_LEN", "1024")
-        env.setdefault("ERLAMSA_BENCH_CAPACITY", "4096")
-        env.setdefault("ERLAMSA_BENCH_ITERS", "3")
-        os.execve(sys.executable, [sys.executable, __file__], env)
-
-    t = threading.Timer(seconds, fire)
-    t.daemon = True
-    t.start()
-    global _watchdog
-    _watchdog = t
+def _phase(msg: str, t0: float) -> float:
+    t = time.perf_counter()
+    print(f"[bench +{t - t0:7.1f}s] {msg}", file=sys.stderr, flush=True)
+    return t
 
 
-_watchdog = None
-
-
-def main() -> None:
-    _watchdog_reexec(float(os.environ.get("ERLAMSA_BENCH_TIMEOUT", 240)))
+def child_main() -> None:
+    """The measured run. Writes its JSON record to $ERLAMSA_BENCH_RESULT
+    (and stdout); phase timings go to stderr."""
+    t0 = time.perf_counter()
+    # persistent compile cache: a recovered relay pays trace+compile once,
+    # later attempts in the same image reuse it
+    os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", os.path.join(REPO, ".jax_cache"))
+    os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "2")
     import jax
+
+    _phase(f"jax imported, backend={jax.default_backend()}", t0)
 
     from erlamsa_tpu.ops import prng
     from erlamsa_tpu.ops.buffers import pack
@@ -82,39 +78,155 @@ def main() -> None:
     step, _ = make_fuzzer(CAPACITY, BATCH)
 
     data, lens = batch.data, batch.lens
+    _phase("inputs packed", t0)
     for case in range(WARMUP):
         out = step(base, case, data, lens, scores)
         jax.block_until_ready(out)
         scores = out[2]
-        if case == 0 and _watchdog is not None:
-            # init + compile survived: the guard's job (wedged-relay hangs)
-            # is done — don't let it kill a legitimately slow timed run
-            _watchdog.cancel()
+        _phase(f"warmup case {case} done", t0)
 
-    t0 = time.perf_counter()
+    t1 = time.perf_counter()
     for case in range(WARMUP, WARMUP + ITERS):
         out = step(base, case, data, lens, scores)
         scores = out[2]
     jax.block_until_ready(out)
-    dt = time.perf_counter() - t0
+    dt = time.perf_counter() - t1
+    _phase(f"{ITERS} timed cases done ({dt:.2f}s)", t0)
 
-    if _watchdog is not None:
-        _watchdog.cancel()
     samples_per_sec = BATCH * ITERS / dt
     record = {
         "metric": f"mutated samples/sec/chip ({SEED_LEN}B seeds)",
         "value": round(samples_per_sec, 1),
         "unit": "samples/sec",
         "vs_baseline": round(samples_per_sec / 100_000.0, 4),
+        "platform": jax.default_backend(),
+        "seed_len": SEED_LEN,
+        "batch": BATCH,
+        "capacity": CAPACITY,
     }
     if os.environ.get("ERLAMSA_BENCH_FALLBACK"):
-        # the watchdog re-exec'd us on CPU with reduced shapes: mark the
-        # datapoint so it is never read as a real TPU/4KB number
+        # reduced-shape CPU fallback: mark the datapoint so it is never
+        # read as a real TPU/4KB number
         record["fallback"] = True
-        record["platform"] = jax.default_backend()
-        record["seed_len"] = SEED_LEN
-        record["batch"] = BATCH
-    print(json.dumps(record))
+    line = json.dumps(record)
+    result_path = os.environ.get("ERLAMSA_BENCH_RESULT")
+    if result_path:
+        with open(result_path, "w") as f:
+            f.write(line + "\n")
+            f.flush()
+            os.fsync(f.fileno())
+    print(line)
+
+
+def _spawn(env: dict, result_path: str, log_path: str | None) -> subprocess.Popen:
+    env = dict(env)
+    env["ERLAMSA_BENCH_CHILD"] = "1"
+    env["ERLAMSA_BENCH_RESULT"] = result_path
+    out = open(log_path, "ab") if log_path else None
+    return subprocess.Popen(
+        [sys.executable, os.path.abspath(__file__)],
+        env=env,
+        stdout=out or sys.stderr,  # JSON comes via result file; keep stdout clean
+        stderr=out or sys.stderr,
+        start_new_session=True,  # survives parent exit; never killed by us
+        cwd=REPO,
+    )
+
+
+def _read_result(path: str) -> str | None:
+    try:
+        with open(path) as f:
+            line = f.readline().strip()
+        return line or None
+    except OSError:
+        return None
+
+
+def _log_has(path: str, marker: str) -> bool:
+    try:
+        with open(path, "rb") as f:
+            return marker.encode() in f.read()
+    except OSError:
+        return False
+
+
+def parent_main() -> None:
+    timeout = float(os.environ.get("ERLAMSA_BENCH_TIMEOUT", 360))
+    pid = os.getpid()
+    attempt_log = os.path.join(REPO, f"bench_tpu_attempt.{pid}.log")
+    result_path = os.path.join(REPO, f"bench_tpu_result.{pid}.json")
+
+    child = _spawn(os.environ, result_path, attempt_log)
+    # the deadline gates reaching "init+compile survived" (warmup case 0);
+    # once the attempt demonstrably runs, a legitimately slow timed run gets
+    # one extra full budget rather than being abandoned
+    deadline = time.monotonic() + timeout
+    extended = False
+    while time.monotonic() < deadline:
+        if child.poll() is not None:
+            break
+        if not extended and _log_has(attempt_log, "warmup case 0 done"):
+            deadline += timeout
+            extended = True
+        time.sleep(2)
+
+    if child.poll() == 0:
+        line = _read_result(result_path)
+        if line:
+            print(line)
+            for p in (result_path, attempt_log):  # clean exit: no artifacts
+                try:
+                    os.unlink(p)
+                except OSError:
+                    pass
+            return
+
+    # Attempt hung or failed. Do NOT kill it (killing a process mid-TPU-init
+    # wedges the axon relay machine-wide) — leave it detached; if it finishes
+    # later its record stays in bench_tpu_result.json. Meanwhile give the
+    # driver a marked CPU datapoint.
+    print(
+        f"[bench] TPU attempt {'still running' if child.poll() is None else f'failed rc={child.returncode}'}"
+        f" after {timeout:.0f}s; falling back to CPU (attempt left in {attempt_log})",
+        file=sys.stderr,
+        flush=True,
+    )
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["ERLAMSA_BENCH_FALLBACK"] = "1"
+    env.setdefault("ERLAMSA_BENCH_BATCH", "128")
+    env.setdefault("ERLAMSA_BENCH_SEED_LEN", "1024")
+    env.setdefault("ERLAMSA_BENCH_CAPACITY", "4096")
+    env.setdefault("ERLAMSA_BENCH_ITERS", "3")
+    fb_result = os.path.join(REPO, f"bench_fb_result.{pid}.json")
+    fb = _spawn(env, fb_result, None)
+    try:
+        fb.wait(timeout=float(os.environ.get("ERLAMSA_BENCH_FB_TIMEOUT", 480)))
+    except subprocess.TimeoutExpired:
+        pass  # leave it too — same no-kill rule; emit the error record below
+    line = _read_result(fb_result)
+    try:
+        os.unlink(fb_result)
+    except OSError:
+        pass
+    if line:
+        print(line)
+    else:
+        print(json.dumps({
+            "metric": "mutated samples/sec/chip",
+            "value": 0.0,
+            "unit": "samples/sec",
+            "vs_baseline": 0.0,
+            "error": "both TPU attempt and CPU fallback failed",
+        }))
+
+
+def main() -> None:
+    if os.environ.get("ERLAMSA_BENCH_CHILD"):
+        child_main()
+    else:
+        parent_main()
 
 
 if __name__ == "__main__":

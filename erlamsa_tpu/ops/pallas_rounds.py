@@ -145,10 +145,12 @@ def _krand_range(b, lo, hi):
 
 
 def _krand_log(b1, b2, n):
-    """2^rand(n)-scale magnitude (int32 range; n <= 30)."""
+    """2^rand(n)-scale magnitude (int32 range; n <= 30). A 0-bit draw
+    yields 0, matching prng.rand_log / erlamsa_rnd:rand_log."""
     bits = _krand(b1, n)
     hi = jnp.left_shift(jnp.int32(1), jnp.maximum(bits - 1, 0))
     v = hi | _krand(b2, hi)
+    v = jnp.where(bits == 0, 0, v)
     return jnp.where(jnp.asarray(n, jnp.int32) <= 0, 0, v)
 
 
