@@ -4,13 +4,20 @@
 # The relay in this image wedges machine-wide if any process holding (or
 # initialising) the TPU dies abruptly — so this watcher NEVER kills anything.
 # The probe IS the attempt: it spawns bench.py's child path (full shapes,
-# no watchdog) and polls for its result file. A child that started while the
-# relay was wedged blocks in backend init and simply completes when the
-# relay recovers. If an attempt exits non-zero it is respawned; if it sits
-# silent for RESPAWN_AFTER seconds a fresh attempt is started alongside it
-# (the old one is left alone — its connection may be to a dead relay
-# endpoint that never answers), capped at MAX_LIVE live attempts so the
-# leak is bounded.
+# escalating, no watchdog) and waits for an attempt to EXIT 0 with its own
+# result file banked (per-attempt paths — a sibling's intermediate record
+# can never shadow a finished attempt's final one). A child that started
+# while the relay was wedged blocks in backend init and simply completes
+# when the relay recovers. If an attempt exits non-zero it is respawned; if
+# all live attempts sit silent for RESPAWN_AFTER seconds a fresh attempt is
+# started alongside (the old ones are left alone — their connection may be
+# to a dead relay endpoint that never answers), capped at MAX_LIVE live
+# attempts so the leak is bounded.
+#
+# The evidence suite (bin/tpu_evidence.py) needs the chip to itself, so it
+# only starts once NO attempt is still alive — bounded by EVIDENCE_WAIT,
+# after which it is skipped rather than risk contending with a stuck
+# attempt that might wake mid-suite.
 #
 # Usage: nohup bin/tpu_bench_watch.sh >> bench_watch.log 2>&1 &
 set -u
@@ -18,17 +25,24 @@ cd "$(dirname "$0")/.."
 POLL=${POLL:-60}
 RESPAWN_AFTER=${RESPAWN_AFTER:-7200}
 MAX_LIVE=${MAX_LIVE:-3}
+EVIDENCE_WAIT=${EVIDENCE_WAIT:-3600}
 
 declare -a PIDS=()
+declare -a TAGS=()
 spawn_attempt() {
     local tag
     tag=$(date +%s)
-    ERLAMSA_BENCH_CHILD=1 \
-    ERLAMSA_BENCH_RESULT="$PWD/bench_tpu_result.watch.json" \
-    setsid python bench.py > "bench_watch_attempt.$tag.log" 2>&1 < /dev/null &
+    (
+        ERLAMSA_BENCH_CHILD=1 \
+        ERLAMSA_BENCH_ESCALATE=1 \
+        ERLAMSA_BENCH_RESULT="$PWD/bench_watch_result.$tag.json" \
+        setsid python bench.py > "bench_watch_attempt.$tag.log" 2>&1 < /dev/null
+        echo $? > "bench_watch_attempt.$tag.rc"
+    ) &
     PIDS+=($!)
+    TAGS+=("$tag")
     LAST_SPAWN=$(date +%s)
-    echo "[watch $(date +%H:%M:%S)] spawned attempt pid=$! (live=${#PIDS[@]})"
+    echo "[watch $(date +%H:%M:%S)] spawned attempt tag=$tag (live=${#PIDS[@]})"
 }
 
 live_count() {
@@ -39,22 +53,46 @@ live_count() {
     echo "$n"
 }
 
-rm -f bench_tpu_result.watch.json
+finished_tag() {
+    # newest attempt that exited 0 with a banked result
+    local t
+    for ((idx=${#TAGS[@]}-1; idx>=0; idx--)); do
+        t="${TAGS[$idx]}"
+        [ -e "bench_watch_attempt.$t.rc" ] || continue
+        [ "$(cat "bench_watch_attempt.$t.rc")" = "0" ] || continue
+        [ -s "bench_watch_result.$t.json" ] && { echo "$t"; return 0; }
+    done
+    return 1
+}
+
+rm -f bench_watch_result.*.json bench_watch_attempt.*.rc
 spawn_attempt
 while true; do
     sleep "$POLL"
-    if [ -s bench_tpu_result.watch.json ]; then
-        echo "[watch $(date +%H:%M:%S)] RESULT:"
-        cat bench_tpu_result.watch.json
+    if tag=$(finished_tag); then
+        echo "[watch $(date +%H:%M:%S)] RESULT (attempt $tag):"
+        cat "bench_watch_result.$tag.json"
+        waited=0
+        while [ "$(live_count)" -gt 0 ] && [ "$waited" -lt "$EVIDENCE_WAIT" ]; do
+            echo "[watch $(date +%H:%M:%S)] evidence: waiting for $(live_count) stale attempt(s) to drain"
+            sleep "$POLL"; waited=$((waited + POLL))
+        done
+        if [ "$(live_count)" -gt 0 ]; then
+            echo "[watch $(date +%H:%M:%S)] evidence SKIPPED: stale attempts still alive after ${EVIDENCE_WAIT}s"
+            exit 0
+        fi
+        echo "[watch $(date +%H:%M:%S)] running evidence suite (A/Bs + profile)"
+        setsid python bin/tpu_evidence.py >> bench_watch.log 2>&1 < /dev/null
+        echo "[watch $(date +%H:%M:%S)] evidence suite done rc=$?"
         exit 0
     fi
     live=$(live_count)
     now=$(date +%s)
     if [ "$live" -eq 0 ]; then
-        echo "[watch $(date +%H:%M:%S)] no live attempt (last exited non-zero?); respawning"
+        echo "[watch $(date +%H:%M:%S)] no live attempt (exited non-zero); respawning"
         spawn_attempt
     elif [ $((now - LAST_SPAWN)) -ge "$RESPAWN_AFTER" ] && [ "$live" -lt "$MAX_LIVE" ]; then
-        echo "[watch $(date +%H:%M:%S)] attempt silent ${RESPAWN_AFTER}s; spawning a fresh one alongside"
+        echo "[watch $(date +%H:%M:%S)] attempts silent ${RESPAWN_AFTER}s; spawning a fresh one alongside"
         spawn_attempt
     fi
 done
