@@ -140,10 +140,14 @@ def child_main() -> None:
     if os.environ.get("ERLAMSA_BENCH_ESCALATE") and BATCH > 256:
         stages.insert(0, (256, SEED_LEN, CAPACITY, max(2, ITERS // 3)))
 
+    # honor a user-requested pallas level (pipeline reads it at trace time;
+    # _run_stage pops the env var to isolate stages, so thread it through)
+    pallas_lvl = os.environ.get("ERLAMSA_PALLAS", "")
     history = []
     for batch_n, seed_len, capacity, iters in stages:
         sps, _compile_s, _built = _run_stage(
-            jax, base, batch_n, seed_len, capacity, iters, t0
+            jax, base, batch_n, seed_len, capacity, iters, t0,
+            pallas=pallas_lvl,
         )
         history.append({"batch": batch_n, "samples_per_sec": round(sps, 1)})
         record = {
@@ -156,6 +160,8 @@ def child_main() -> None:
             "batch": batch_n,
             "capacity": capacity,
         }
+        if pallas_lvl:
+            record["pallas"] = pallas_lvl
         if len(history) > 1:
             record["stages"] = history
         if os.environ.get("ERLAMSA_BENCH_FALLBACK"):

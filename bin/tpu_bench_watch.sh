@@ -72,12 +72,15 @@ while true; do
     if tag=$(finished_tag); then
         echo "[watch $(date +%H:%M:%S)] RESULT (attempt $tag):"
         cat "bench_watch_result.$tag.json"
+        # count ANY bench child on the box (orphans from a previous watcher
+        # included), not just this instance's PIDS
+        any_bench() { pgrep -fc "python bench.py" 2>/dev/null || true; }
         waited=0
-        while [ "$(live_count)" -gt 0 ] && [ "$waited" -lt "$EVIDENCE_WAIT" ]; do
-            echo "[watch $(date +%H:%M:%S)] evidence: waiting for $(live_count) stale attempt(s) to drain"
+        while [ "$(any_bench)" -gt 0 ] && [ "$waited" -lt "$EVIDENCE_WAIT" ]; do
+            echo "[watch $(date +%H:%M:%S)] evidence: waiting for $(any_bench) bench process(es) to drain"
             sleep "$POLL"; waited=$((waited + POLL))
         done
-        if [ "$(live_count)" -gt 0 ]; then
+        if [ "$(any_bench)" -gt 0 ]; then
             echo "[watch $(date +%H:%M:%S)] evidence SKIPPED: stale attempts still alive after ${EVIDENCE_WAIT}s"
             exit 0
         fi

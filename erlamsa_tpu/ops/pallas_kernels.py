@@ -44,6 +44,14 @@ def _interpret() -> bool:
     return jax.default_backend() != "tpu"
 
 
+def _roll(x, shift):
+    """Lane-dim roll by a TRACED shift, Mosaic-safe: pltpu.roll lowers to
+    the hardware dynamic-rotate. The shift is reduced mod L (jnp.mod keeps
+    the divisor's sign, so the result is always in [0, L))."""
+    L = x.shape[-1]
+    return pltpu.roll(x, jnp.asarray(shift, jnp.int32) % L, len(x.shape) - 1)
+
+
 def _mask_logic(bits, params_ref, data, out_ref):
     """Shared masking math over a [3, L] uint32 random stream."""
     L = data.shape[-1]
@@ -145,11 +153,15 @@ def pallas_randmask(seeds, params, data):
 # per-round line table; `lp` is a single default-priority mutator).
 #
 # Primitive discipline (TPU Mosaic has no arbitrary vector gather):
-# everything is jnp.roll by traced scalars, iota masks, and scalar pl.ds
-# ref accesses. The splice's repeated-span source d[src_start + (i-pos)
-# mod src_len] is built by bit-decomposing (i-pos)//src_len: conditional
-# global rolls by src_len<<k applied LSB-first — a per-element shift by
-# any multiple of src_len in ceil(log2(L)) vector passes.
+# everything is rolls by traced scalars, iota masks, and scalar ref
+# accesses. Traced-shift rolls go through _roll -> pltpu.roll, which
+# lowers to Mosaic's dynamic-rotate (jnp.roll with a traced shift would
+# lower via concat + dynamic_slice, which Mosaic may reject); shifts are
+# reduced mod L so they are always non-negative. The splice's
+# repeated-span source d[src_start + (i-pos) mod src_len] is built by
+# bit-decomposing (i-pos)//src_len: conditional global rolls by
+# src_len<<k applied LSB-first — a per-element shift by any multiple of
+# src_len in ceil(log2(L)) vector passes.
 #
 # Determinism: reproducible for fixed (seed, case, sample) but NOT
 # byte-identical to the jnp engine for PERM_BYTES/MASK (hardware-PRNG
@@ -171,12 +183,12 @@ from .fused import (  # noqa: E402
 )
 
 
-def _round_logic(bits, params_ref, lit_ref, data_ref, out_ref, sref):
+def _round_logic(bits, params_ref, lit_ref, data_ref, out_ref):
     """bits: uint32[4, L] random stream (3 mask rows + 1 Fisher-Yates row).
     params: int32[1, 16] = (kind, pos, drop, src, src_start, src_len,
     reps, lit_len, a1, l1, l2, ps, pl, mask_op, mask_prob, n).
-    lit: uint8[1, _SCRATCH] splice literal bytes; sref: uint8[1, L] VMEM
-    scratch used to position them without an L-sized HBM operand."""
+    lit: uint8[1, _SCRATCH] splice literal bytes, placed at their splice
+    offsets by static scalar broadcasts inside the kernel."""
     d = data_ref[...]
     L = d.shape[-1]
     P = params_ref
@@ -200,19 +212,19 @@ def _round_logic(bits, params_ref, lit_ref, data_ref, out_ref, sref):
     sl_c = jnp.maximum(src_len, 1)
     o = i - pos_c
     # repeated-span source: conditional rolls by src_len * 2^k, LSB-first
-    cur = jnp.roll(d, pos_c - src_start, axis=1)
+    cur = _roll(d, pos_c - src_start)
     odiv = jnp.where(o >= 0, o // sl_c, 0)
     for k in range(max(1, (L - 1).bit_length())):
         bitk = (odiv >> k) & 1
-        cur = jnp.where(bitk == 1, jnp.roll(cur, sl_c << k, axis=1), cur)
-    # place the <=24 literal bytes at offset 0 of the VMEM scratch row,
-    # then roll them to pos — no L-sized literal operand from HBM
+        cur = jnp.where(bitk == 1, _roll(cur, sl_c << k), cur)
+    # place the <=_SCRATCH (24) literal bytes at their splice offsets via static
+    # scalar broadcasts (no sub-tile slice store, no gather)
     S = lit_ref.shape[-1]
-    sref[...] = jnp.zeros((1, L), jnp.uint8)
-    sref[0:1, 0 : min(S, L)] = lit_ref[0:1, 0 : min(S, L)]
-    lit_rolled = jnp.roll(sref[...], pos_c, axis=1)
+    lit_rolled = jnp.zeros((1, L), jnp.uint8)
+    for k in range(min(S, L)):
+        lit_rolled = jnp.where(o == k, lit_ref[0, k], lit_rolled)
     repl = jnp.where(src == SRC_LIT, lit_rolled, cur)
-    tail = jnp.roll(d, rlen - drop_c, axis=1)
+    tail = _roll(d, rlen - drop_c)
     end_ins = pos_c + rlen
     n_sp = jnp.clip(n - drop_c + rlen, 0, L)
     sp = jnp.where(i < pos_c, d, jnp.where(i < end_ins, repl, tail))
@@ -221,10 +233,10 @@ def _round_logic(bits, params_ref, lit_ref, data_ref, out_ref, sref):
     # ---- SWAP: exchange adjacent spans [a1,a1+l1) and [a1+l1,a1+l1+l2) ----
     sw = jnp.where(
         (i >= a1) & (i < a1 + l2),
-        jnp.roll(d, -l1, axis=1),
+        _roll(d, -l1),
         jnp.where(
             (i >= a1 + l2) & (i < a1 + l2 + l1),
-            jnp.roll(d, l2, axis=1),
+            _roll(d, l2),
             d,
         ),
     )
@@ -274,15 +286,15 @@ def _round_logic(bits, params_ref, lit_ref, data_ref, out_ref, sref):
         jax.lax.fori_loop(0, _FY_CAP - 1, body, 0)
 
 
-def _round_kernel_hw(seed_ref, params_ref, lit_ref, data_ref, out_ref, sref):
-    pltpu.prng_seed(seed_ref[0])
+def _round_kernel_hw(seed_ref, params_ref, lit_ref, data_ref, out_ref):
+    pltpu.prng_seed(seed_ref[0, 0])
     L = data_ref.shape[-1]
     bits = pltpu.prng_random_bits((4, L)).astype(jnp.uint32)
-    _round_logic(bits, params_ref, lit_ref, data_ref, out_ref, sref)
+    _round_logic(bits, params_ref, lit_ref, data_ref, out_ref)
 
 
-def _round_kernel_bits(bits_ref, params_ref, lit_ref, data_ref, out_ref, sref):
-    _round_logic(bits_ref[0], params_ref, lit_ref, data_ref, out_ref, sref)
+def _round_kernel_bits(bits_ref, params_ref, lit_ref, data_ref, out_ref):
+    _round_logic(bits_ref[0], params_ref, lit_ref, data_ref, out_ref)
 
 
 def fused_round_single(key, params_row, lit_row, data_row):
@@ -298,20 +310,19 @@ def fused_round_single(key, params_row, lit_row, data_row):
         raise RuntimeError(
             "ERLAMSA_PALLAS=1 requires jax.experimental.pallas.tpu"
         )
-    scratch = [pltpu.VMEM((1, L), jnp.uint8)]
     if not _interpret():
-        seed = jax.random.randint(key, (1,), 0, 2**31 - 1, dtype=jnp.int32)
+        # (1, 1) so the seed is a clean 2D scalar operand (pitfall: 0D/1D
+        # scalars are not Mosaic-friendly)
+        seed = jax.random.randint(key, (1, 1), 0, 2**31 - 1, dtype=jnp.int32)
         out = pl.pallas_call(
             _round_kernel_hw,
             out_shape=jax.ShapeDtypeStruct((1, L), jnp.uint8),
-            scratch_shapes=scratch,
         )(seed, params2, lit2, data2)
         return out[0]
     bits = jax.random.bits(key, (1, 4, L), jnp.uint32)
     out = pl.pallas_call(
         _round_kernel_bits,
         out_shape=jax.ShapeDtypeStruct((1, L), jnp.uint8),
-        scratch_shapes=scratch,
         interpret=True,
     )(bits, params2, lit2, data2)
     return out[0]

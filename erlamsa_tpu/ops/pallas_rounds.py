@@ -31,16 +31,23 @@ Reference being re-expressed: the per-case mutation loop of
 src/erlamsa_main.erl:180-221 over mux_fuzzers
 (src/erlamsa_mutations.erl:1256-1280).
 
-STATUS: interpret-mode tested end-to-end (CPU CI); the hardware build
-(pltpu PRNG, Mosaic lowering — int64 number math and the [64, L] line-
-window reductions are the risky spots) still needs a live chip, which
-this image's relay blocks.
+STATUS: interpret-mode tested end-to-end (CPU CI). Hardened for Mosaic
+lowering without a chip to iterate against, per the pallas guide's
+constraints: no 1D iota (2D-derived index vectors), no int64 anywhere
+(the num path runs on int32-pair scalar math, _p_* helpers), no vector
+gathers or dynamic table slices (one-hot sums), traced-shift rolls via
+pltpu.roll, first-index reductions instead of 1D argmax. Remaining
+hardware risks: dynamic scalar VMEM reads/writes (Fisher-Yates swaps,
+byte probes) and the [65, L] line-window reduction. Validation on a live
+chip still pending — bin/tpu_evidence.py stage pallas2_small banks the
+compile/run outcome the first healthy relay window.
 """
 
 from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax.experimental import pallas as pl
 
 try:  # pallas TPU backend is optional off-TPU
@@ -66,8 +73,8 @@ from .num_mutators import (
     _MAX_PARSE_DIGITS,
     _SCRATCH,
     INT64_MAX,
-    _render_decimal,
 )
+from .pallas_kernels import _roll
 from .registry import DEVICE_CODES, DEVICE_MUTATORS, NUM_DEVICE_MUTATORS
 from .registry import (
     P_HAS_DIGIT,
@@ -119,6 +126,20 @@ def _interpret() -> bool:
     return jax.default_backend() != "tpu"
 
 
+def _arange1d(n: int):
+    """1D index vector derived from a 2D iota (Mosaic rejects 1D iota —
+    pallas_guide 'Common Pitfalls #4'; 1D *vectors* are fine)."""
+    return jax.lax.broadcasted_iota(jnp.int32, (1, n), 1)[0]
+
+
+def _first_idx(mask2d, i2d, none_val):
+    """First index where mask is True (2D reduction form of
+    jnp.argmax(mask.reshape(-1)) with an explicit empty-mask value)."""
+    L = i2d.shape[-1]
+    hit = jnp.min(jnp.where(mask2d, i2d, L)).astype(jnp.int32)
+    return jnp.where(jnp.any(mask2d), hit, jnp.asarray(none_val, jnp.int32))
+
+
 # --- raw-bit draw helpers (erlamsa_rnd distribution shapes) ---------------
 
 
@@ -159,8 +180,145 @@ def _kdelta(b):
     return jnp.where((b & jnp.uint32(1)) == 1, -1, 1).astype(jnp.int32)
 
 
-def _u64(hi, lo):
-    return (hi.astype(jnp.uint64) << 32) | lo.astype(jnp.uint64)
+# --- 64-bit scalar math on int32 pairs ------------------------------------
+#
+# Mosaic's scalar core is 32-bit: jnp.int64 inside a TPU kernel does not
+# lower. The textual-number mutator needs true 64-bit semantics (the
+# reference's interesting numbers reach 2^63), so the kernel carries
+# values as (hi: int32 with the sign, lo: int32 reinterpreted unsigned).
+# All helpers are scalar-only; the interpret-mode tests lock them against
+# the int64 jnp engine draw-for-draw.
+
+
+def _p_mk(hi, lo):
+    return (jnp.asarray(hi, jnp.int32), jnp.asarray(lo, jnp.int32))
+
+
+def _p_const(v: int):
+    v &= (1 << 64) - 1
+    hi = (v >> 32) & 0xFFFFFFFF
+    lo = v & 0xFFFFFFFF
+    # python ints -> wrapped int32 constants
+    return (jnp.int32(hi - (1 << 32) if hi >= (1 << 31) else hi),
+            jnp.int32(lo - (1 << 32) if lo >= (1 << 31) else lo))
+
+
+def _p_u(x):
+    return x.astype(jnp.uint32)
+
+
+def _p_add(a, b):
+    lo = _p_u(a[1]) + _p_u(b[1])
+    carry = (lo < _p_u(a[1])).astype(jnp.int32)
+    hi = a[0] + b[0] + carry
+    return (hi.astype(jnp.int32), lo.astype(jnp.int32))
+
+
+def _p_not(a):
+    return ((~a[0]).astype(jnp.int32), (~a[1]).astype(jnp.int32))
+
+
+def _p_neg(a):
+    return _p_add(_p_not(a), _p_mk(0, 1))
+
+
+def _p_sub(a, b):
+    return _p_add(a, _p_neg(b))
+
+
+def _p_is_neg(a):
+    return a[0] < 0
+
+
+def _p_lt(a, b):
+    """Signed a < b."""
+    return (a[0] < b[0]) | ((a[0] == b[0]) & (_p_u(a[1]) < _p_u(b[1])))
+
+
+def _p_ult(a, b):
+    """Unsigned a < b."""
+    return (_p_u(a[0]) < _p_u(b[0])) | (
+        (a[0] == b[0]) & (_p_u(a[1]) < _p_u(b[1]))
+    )
+
+
+def _p_eq0(a):
+    return (a[0] == 0) & (a[1] == 0)
+
+
+def _p_sel(c, a, b):
+    return (jnp.where(c, a[0], b[0]).astype(jnp.int32),
+            jnp.where(c, a[1], b[1]).astype(jnp.int32))
+
+
+def _p_abs(a):
+    return _p_sel(_p_is_neg(a), _p_neg(a), a)
+
+
+def _p_shl1(a):
+    hi = (_p_u(a[0]) << 1) | (_p_u(a[1]) >> 31)
+    lo = _p_u(a[1]) << 1
+    return (hi.astype(jnp.int32), lo.astype(jnp.int32))
+
+
+def _p_shl(a, k):
+    """Logical left shift by a TRACED k in [0, 63]."""
+    ku = jnp.asarray(k, jnp.int32)
+    big = ku >= 32
+    ks = jnp.clip(jnp.where(big, ku - 32, ku), 0, 31).astype(jnp.uint32)
+    lo_u, hi_u = _p_u(a[1]), _p_u(a[0])
+    # k < 32 case (spill guarded against ks == 0: x >> 32 is UB-ish)
+    spill = jnp.where(ks == 0, jnp.uint32(0), lo_u >> (32 - ks))
+    hi_s = (hi_u << ks) | spill
+    lo_s = lo_u << ks
+    # k >= 32 case
+    hi_b = lo_u << ks
+    return (jnp.where(big, hi_b, hi_s).astype(jnp.int32),
+            jnp.where(big, jnp.uint32(0), lo_s).astype(jnp.int32))
+
+
+def _p_or(a, b):
+    return ((a[0] | b[0]).astype(jnp.int32), (a[1] | b[1]).astype(jnp.int32))
+
+
+def _p_mul10_add(a, digit):
+    """a * 10 + digit for a >= 0 (the parse accumulator)."""
+    x2 = _p_shl1(a)
+    x8 = _p_shl1(_p_shl1(x2))
+    return _p_add(_p_add(x8, x2), _p_mk(0, digit))
+
+
+def _p_divmod10(a):
+    """(a // 10, a % 10) for a >= 0, via base-2^16 long division."""
+    hi_u, lo_u = _p_u(a[0]), _p_u(a[1])
+    q_hi = hi_u // 10
+    r1 = hi_u % 10
+    d1 = (r1 << 16) | (lo_u >> 16)
+    q1 = d1 // 10
+    r2 = d1 % 10
+    d2 = (r2 << 16) | (lo_u & 0xFFFF)
+    q2 = d2 // 10
+    rem = d2 % 10
+    q_lo = (q1 << 16) | q2
+    return (q_hi.astype(jnp.int32), q_lo.astype(jnp.int32)), rem.astype(
+        jnp.int32
+    )
+
+
+def _p_umod(a, d):
+    """Unsigned a % d (d >= 1) by 64-step shift-subtract long division
+    (rolled fori_loop: ~15 scalar ops per step, tiny trace)."""
+
+    def step(t, rem):
+        bit = 63 - t
+        word = jnp.where(bit >= 32, a[0], a[1])
+        sh = jnp.clip(bit % 32, 0, 31).astype(jnp.uint32)
+        b = (_p_u(word) >> sh) & jnp.uint32(1)
+        rem = _p_or(_p_shl1(rem), _p_mk(0, b.astype(jnp.int32)))
+        ge = ~_p_ult(rem, d)
+        return _p_sel(ge, _p_sub(rem, d), rem)
+
+    return jax.lax.fori_loop(0, 64, step, _p_mk(0, 0))
 
 
 # --- in-kernel scans ------------------------------------------------------
@@ -206,7 +364,7 @@ def _round(sref, log_ref, tables, r, n, scores, pri_vec, sb, vb):
 
     # ---- tables (line segments, digit runs, widenable) ----
     is_nl = (di == 10) & valid
-    prev_nl = jnp.roll(is_nl, 1, axis=1) & (i > 0)
+    prev_nl = jnp.roll(is_nl, 1, axis=1) & (i > 0)  # static shift: safe
     start_mask = valid & ((i == 0) | prev_nl)
     rank = jnp.cumsum(start_mask.astype(jnp.int32), axis=1) - 1
     nlines = jnp.sum(start_mask.astype(jnp.int32)).astype(jnp.int32)
@@ -220,10 +378,7 @@ def _round(sref, log_ref, tables, r, n, scores, pri_vec, sb, vb):
     text = nonempty & ~binarish
 
     def start_of(k):
-        m = start_mask & (rank == k)
-        return jnp.where(
-            jnp.any(m), jnp.argmax(m.reshape(-1)), 0
-        ).astype(jnp.int32)
+        return _first_idx(start_mask & (rank == k), i, 0)
 
     def line_span(k):
         k = jnp.clip(k, 0, jnp.maximum(nlines - 1, 0))
@@ -248,10 +403,13 @@ def _round(sref, log_ref, tables, r, n, scores, pri_vec, sb, vb):
     bits_m = sb[:M].astype(jnp.uint32)
     bounds = jnp.maximum(scores * pri_vec, 1).astype(jnp.uint32)
     draws = (bits_m % bounds).astype(jnp.int32)
-    midx = jnp.arange(M, dtype=jnp.int32)
+    midx = _arange1d(M)
     best = jnp.max(jnp.where(applicable, draws, -1))
-    applied = jnp.argmax(applicable & (draws == best)).astype(jnp.int32)
+    pick_m = applicable & (draws == best)
+    # first True == min index (argmax-on-bool without a 1D argmax)
+    applied = jnp.min(jnp.where(pick_m, midx, M)).astype(jnp.int32)
     any_app = jnp.any(applicable)
+    applied = jnp.where(any_app, applied, 0)
     d_app = jnp.sum(jnp.where(midx == applied, draws, 0))
     # tried-and-failed = earlier in the descending stable order
     tried_before = ((draws > d_app) | ((draws == d_app) & (midx < applied))) \
@@ -315,16 +473,29 @@ def _round(sref, log_ref, tables, r, n, scores, pri_vec, sb, vb):
 
     # utf8
     wide_keys = jnp.where(widenable, vb[_VB_WIDE : _VB_WIDE + 1], 0)
-    pos_uw = jnp.argmax(wide_keys.reshape(-1)).astype(jnp.int32)
+    # first position holding the max key == argmax (2D reduction form)
+    mx_uw = jnp.max(wide_keys)
+    pos_uw = _first_idx(wide_keys == mx_uw, i, 0)
     b_uw = sref[0, jnp.clip(pos_uw, 0, L - 1)]
     setp("uw", kind=K_SPLICE, pos=pos_uw, drop=1, src=SRC_LIT, lit_len=2,
          delta=delta_c)
-    funny_t, funny_l, int_tbl = tables
-    row_ui = _krand(sb[_SB_VAL], funny_t.shape[0])
-    seq_ui = jax.lax.dynamic_slice(
-        funny_t, (row_ui, jnp.int32(0)), (1, 4)
-    )[0]
-    len_ui = jax.lax.dynamic_slice(funny_l, (row_ui,), (1,))[0]
+    funny_t, funny_l, itbl_hi, itbl_lo = tables
+    n_funny = funny_t.shape[0]
+    row_ui = _krand(sb[_SB_VAL], n_funny)
+    # row select via one-hot sums over static columns (no dynamic sublane
+    # slice, no vector gather): 4 scalar reductions over (n_funny, 1)
+    rows_col = jax.lax.broadcasted_iota(jnp.int32, (n_funny, 1), 0)
+    row_hit = rows_col == row_ui
+    seq_ui = [
+        jnp.sum(
+            jnp.where(row_hit, funny_t[:, k : k + 1].astype(jnp.int32), 0)
+        ).astype(jnp.uint8)
+        for k in range(4)
+    ]
+    flen_iota = jax.lax.broadcasted_iota(jnp.int32, funny_l.shape, 1)
+    len_ui = jnp.sum(
+        jnp.where(flen_iota == row_ui, funny_l, 0)
+    ).astype(jnp.int32)
     setp("ui", kind=K_SPLICE, pos=pos_u + 1, src=SRC_LIT, lit_len=len_ui,
          delta=delta_c)
 
@@ -332,14 +503,8 @@ def _round(sref, log_ref, tables, r, n, scores, pri_vec, sb, vb):
     which = _krand(sb[_SB_POS], run_count)
     target = run_count - 1 - which
     csum = jnp.cumsum(digit_starts.astype(jnp.int32), axis=1)
-    hit = digit_starts & (csum == target + 1)
-    a_num = jnp.where(
-        jnp.any(hit), jnp.argmax(hit.reshape(-1)), 0
-    ).astype(jnp.int32)
-    break_mask = (i >= a_num) & ~is_digit
-    b_end = jnp.where(
-        jnp.any(break_mask), jnp.argmax(break_mask.reshape(-1)), n
-    ).astype(jnp.int32)
+    a_num = _first_idx(digit_starts & (csum == target + 1), i, 0)
+    b_end = _first_idx((i >= a_num) & ~is_digit, i, n)
 
     def dash_cond(c):
         idx = a_num - 1 - c
@@ -349,16 +514,19 @@ def _round(sref, log_ref, tables, r, n, scores, pri_vec, sb, vb):
     neg_in = dash_count > 0
     a_ext = a_num - dash_count
 
-    def parse_body(k, v):
+    def parse_body(k, vp):
         idx = jnp.clip(a_num + k, 0, L - 1)
         take = (a_num + k < b_end) & (k < _MAX_PARSE_DIGITS)
-        dig = (sref[0, idx].astype(jnp.int64)) - 48
-        return jnp.where(take, v * 10 + dig, v)
+        dig = sref[0, idx].astype(jnp.int32) - 48
+        nv = _p_mul10_add(vp, dig)
+        return _p_sel(take, nv, vp)
 
-    mag = jax.lax.fori_loop(0, _MAX_PARSE_DIGITS, parse_body, jnp.int64(0))
-    value = jnp.where(neg_in, -mag, mag)
-    new_value = _mutate_num_bits(sb, value, int_tbl)
-    sc_num, len_num = _render_decimal(new_value)
+    mag = jax.lax.fori_loop(
+        0, _MAX_PARSE_DIGITS, parse_body, _p_mk(0, 0)
+    )
+    value = _p_sel(neg_in, _p_neg(mag), mag)
+    new_value = _mutate_num_bits(sb, value, itbl_hi, itbl_lo)
+    num_digits, len_num = _render_scalars(new_value)
     setp("num", kind=K_SPLICE, pos=a_ext, drop=b_end - a_ext, src=SRC_LIT,
          lit_len=len_num, delta=2)  # real num delta recomputed post-apply
 
@@ -417,7 +585,9 @@ def _round(sref, log_ref, tables, r, n, scores, pri_vec, sb, vb):
     mask_op, mask_prob = sel("mask_op"), sel("mask_prob")
     delta_sel = sel("delta")
 
-    # literal scratch for the applied splice (byte ops / uw / ui / num)
+    # literal bytes for the applied splice (byte ops / uw / ui / num) as a
+    # python list of _SCRATCH (24) traced SCALARS — no vector gather, no
+    # 1D scratch
     is_bi = applied == _IDX["bi"]
     byte0 = jnp.select(
         [applied == _IDX["bei"], applied == _IDX["bed"],
@@ -425,22 +595,24 @@ def _round(sref, log_ref, tables, r, n, scores, pri_vec, sb, vb):
         [(b_at + 1) % 256, (b_at - 1) % 256, nb_flip, nb_rand],
         nb_rand,  # bi's inserted byte is the same rand_byte draw
     ).astype(jnp.uint8)
-    si = jnp.arange(_SCRATCH, dtype=jnp.int32)
-    sc_byte = jnp.where(
-        si == 0, byte0,
-        jnp.where(si == 1, jnp.where(is_bi, d[0, jnp.clip(pos_u, 0, L - 1)],
-                                     jnp.uint8(0)), jnp.uint8(0)),
-    ).astype(jnp.uint8)
-    sc_uw = jnp.where(
-        si == 0, jnp.uint8(0xC0),
-        jnp.where(si == 1, b_uw | jnp.uint8(0x80), jnp.uint8(0)),
-    )
-    sc_ui = jnp.where(si < 4, seq_ui[jnp.clip(si, 0, 3)], jnp.uint8(0))
-    lit = jnp.where(
-        applied == _IDX["num"], sc_num,
-        jnp.where(applied == _IDX["ui"], sc_ui,
-                  jnp.where(applied == _IDX["uw"], sc_uw, sc_byte)),
-    )
+    z8 = jnp.uint8(0)
+    at_pos = d[0, jnp.clip(pos_u, 0, L - 1)]
+    is_num = applied == _IDX["num"]
+    is_ui = applied == _IDX["ui"]
+    is_uw = applied == _IDX["uw"]
+    lit = []
+    for k in range(_SCRATCH):
+        byte_k = byte0 if k == 0 else (
+            jnp.where(is_bi, at_pos, z8) if k == 1 else z8
+        )
+        uw_k = jnp.uint8(0xC0) if k == 0 else (
+            (b_uw | jnp.uint8(0x80)) if k == 1 else z8
+        )
+        ui_k = seq_ui[k] if k < 4 else z8
+        lit.append(jnp.where(
+            is_num, num_digits[k],
+            jnp.where(is_ui, ui_k, jnp.where(is_uw, uw_k, byte_k)),
+        ).astype(jnp.uint8))
 
     # ---- applies (pallas_kernels._round_logic discipline) ----
     pos_c = jnp.clip(pos, 0, n)
@@ -451,25 +623,25 @@ def _round(sref, log_ref, tables, r, n, scores, pri_vec, sb, vb):
     )
     sl_c = jnp.maximum(src_len, 1)
     o = i - pos_c
-    cur = jnp.roll(d, pos_c - src_start, axis=1)
+    cur = _roll(d, pos_c - src_start)
     odiv = jnp.where(o >= 0, o // sl_c, 0)
     for k in range(max(1, (L - 1).bit_length())):
         bitk = (odiv >> k) & 1
-        cur = jnp.where(bitk == 1, jnp.roll(cur, sl_c << k, axis=1), cur)
+        cur = jnp.where(bitk == 1, _roll(cur, sl_c << k), cur)
     lit_at = jnp.zeros((1, L), jnp.uint8)
     for k in range(_SCRATCH):
         lit_at = jnp.where(o == k, lit[k], lit_at)
     repl = jnp.where(src == SRC_LIT, lit_at, cur)
-    tail = jnp.roll(d, rlen - drop_c, axis=1)
+    tail = _roll(d, rlen - drop_c)
     n_sp = jnp.clip(n - drop_c + rlen, 0, L)
     sp = jnp.where(i < pos_c, d, jnp.where(i < pos_c + rlen, repl, tail))
     sp = jnp.where(i < n_sp, sp, jnp.uint8(0))
 
     sw = jnp.where(
         (i >= a1) & (i < a1 + l2),
-        jnp.roll(d, -l1, axis=1),
+        _roll(d, -l1),
         jnp.where(
-            (i >= a1 + l2) & (i < a1 + l2 + l1), jnp.roll(d, l2, axis=1), d
+            (i >= a1 + l2) & (i < a1 + l2 + l1), _roll(d, l2), d
         ),
     )
 
@@ -546,14 +718,14 @@ def _perm_lines(d, i, n, start_mask, rank, nlines, f, cnt, vb, line_span):
     Wl = _PERM_LINES_W
     f = jnp.clip(f, 0, jnp.maximum(nlines - 1, 0))
     cnt = jnp.clip(cnt, 0, jnp.clip(nlines - f, 0, Wl))
-    w = jnp.arange(Wl, dtype=jnp.int32)
-    w1 = jnp.arange(Wl + 1, dtype=jnp.int32)
+    w = _arange1d(Wl)
+    w1 = _arange1d(Wl + 1)
     # window line starts: [Wl+1, L] rank-match reduction (the +1 row gives
     # the start of the line just past the window, for the last line's len)
     wmask = start_mask[0][None, :] & (
         rank[0][None, :] == (f + w1)[:, None]
     )  # [Wl+1, L]
-    ii = jnp.arange(L, dtype=jnp.int32)
+    ii = i[0]  # 1D view of the 2D lane iota
     starts_ext = jnp.max(
         jnp.where(wmask, ii[None, :], 0), axis=1
     ).astype(jnp.int32)
@@ -568,17 +740,25 @@ def _perm_lines(d, i, n, start_mask, rank, nlines, f, cnt, vb, line_span):
     )
     lens_w = jnp.where(has_w, jnp.maximum(lens_w, 0), 0)
 
-    # uniform permutation of the first cnt window lines: iterative argmax
+    # uniform permutation of the first cnt window lines: iterative
+    # first-max pick over uint32 keys with an explicit used mask (the
+    # int64 -1-sentinel form does not lower on 32-bit Mosaic)
     lrow = vb[_VB_LPERM]
     if L < Wl:  # tiny capacities: pad the key row statically
         lrow = jnp.concatenate([lrow, jnp.zeros(Wl - L, lrow.dtype)])
-    keys = jnp.where(w < cnt, lrow[:Wl].astype(jnp.int64), jnp.int64(-1))
+    keys = lrow[:Wl].astype(jnp.uint32)
+    active = w < cnt
     order = w
     for j in range(Wl):
-        pick = jnp.argmax(keys).astype(jnp.int32)
+        mx = jnp.max(jnp.where(active, keys, jnp.uint32(0)))
+        hit = active & (keys == mx)
+        # first active max == the int64 argmax-with-sentinel pick; when
+        # nothing is active the pick is unused (oj keeps j)
+        pick = jnp.min(jnp.where(hit, w, Wl)).astype(jnp.int32)
+        pick = jnp.where(jnp.any(hit), pick, 0)
         oj = jnp.where(j < cnt, pick, j)
         order = jnp.where(w == j, oj, order)
-        keys = jnp.where(w == pick, jnp.int64(-1), keys)
+        active = active & (w != pick)
 
     onehot = order[:, None] == w[None, :]  # [Wl, Wl]
     plens = jnp.sum(jnp.where(onehot, lens_w[None, :], 0), axis=1)
@@ -593,7 +773,7 @@ def _perm_lines(d, i, n, start_mask, rank, nlines, f, cnt, vb, line_span):
     for j in range(Wl):  # static rolls, one per window line
         dst0 = win_start + prev_cum[j]
         src0 = pstarts[j]
-        rolled = jnp.roll(d, dst0 - src0, axis=1)
+        rolled = _roll(d, dst0 - src0)
         in_seg = (i >= dst0) & (i < dst0 + plens[j]) & (j < cnt)
         out = jnp.where(in_seg, rolled, out)
     in_win = (rel >= 0) & (rel < total) & (cnt > 0)
@@ -603,45 +783,103 @@ def _perm_lines(d, i, n, start_mask, rank, nlines, f, cnt, vb, line_span):
 # --- int64 number mutate/render on raw bits -------------------------------
 
 
-def _mutate_num_bits(sb, v, tbl):
+def _tbl_at64(hi_row, lo_row, idx):
+    """Pair-valued table lookup from split int32 hi/lo rows [1, T] via
+    one-hot sums (no dynamic slice, no int64 anywhere)."""
+    t_iota = jax.lax.broadcasted_iota(jnp.int32, hi_row.shape, 1)
+    m = t_iota == idx
+    hi = jnp.sum(jnp.where(m, hi_row, 0)).astype(jnp.int32)
+    lo = jnp.sum(jnp.where(m, lo_row, 0)).astype(jnp.int32)
+    return (hi, lo)
+
+
+def _mutate_num_bits(sb, v, itbl_hi, itbl_lo):
     """num_mutators._mutate_num on kernel bits (12 strategies,
-    erlamsa_mutations.erl:95-112). tbl: interesting-numbers operand."""
+    erlamsa_mutations.erl:95-112), in int32-pair math. v: (hi, lo) pair.
+    itbl_hi/lo: the interesting-numbers table split into int32 halves."""
     t = _krand(sb[_SB_NUM], 12)
-    i1 = _krand(sb[_SB_NUM + 1], tbl.shape[0])
-    i2 = _krand(sb[_SB_NUM + 2], tbl.shape[0])
-    interesting = jax.lax.dynamic_slice(tbl, (i1,), (1,))[0]
-    interesting2 = jax.lax.dynamic_slice(tbl, (i2,), (1,))[0]
-    absv2 = jnp.minimum(jnp.abs(v), INT64_MAX // 2) * 2
-    u = _u64(sb[_SB_NUM + 3], sb[_SB_NUM + 4])
-    rnd_abs = (u % jnp.maximum(absv2, 1).astype(jnp.uint64)).astype(jnp.int64)
-    sign = jnp.where(v >= 0, jnp.int64(1), jnp.int64(-1))
+    i1 = _krand(sb[_SB_NUM + 1], itbl_hi.shape[-1])
+    i2 = _krand(sb[_SB_NUM + 2], itbl_hi.shape[-1])
+    interesting = _tbl_at64(itbl_hi, itbl_lo, i1)
+    interesting2 = _tbl_at64(itbl_hi, itbl_lo, i2)
+    one = _p_mk(0, 1)
+    zero = _p_mk(0, 0)
+    half_max = _p_const(INT64_MAX // 2)
+
+    absv = _p_abs(v)
+    absv_cap = _p_sel(_p_lt(half_max, absv), half_max, absv)
+    absv2 = _p_shl1(absv_cap)
+    u = _p_mk(sb[_SB_NUM + 3].astype(jnp.int32),
+              sb[_SB_NUM + 4].astype(jnp.int32))
+    rnd_abs = _p_umod(u, _p_sel(_p_eq0(absv2), one, absv2))
+    v_neg = _p_is_neg(v)
+    # v - rnd_abs * sign(v): toward zero for positive v, away for negative
+    strat9 = _p_sel(v_neg, _p_add(v, rnd_abs), _p_sub(v, rnd_abs))
+
     n129 = _krand(sb[_SB_NUM + 5], 128) + 1  # rand_range(1, 129)
     bits = jnp.minimum(_krand(sb[_SB_NUM + 6], n129), 62)
-    hi = jnp.left_shift(
-        jnp.int64(1), jnp.maximum(bits - 1, 0).astype(jnp.int64)
-    )
-    lo = (
-        _u64(sb[_SB_NUM + 7], sb[_SB_NUM + 8])
-        % jnp.maximum(hi, 1).astype(jnp.uint64)
-    ).astype(jnp.int64)
-    lg = jnp.where(bits <= 0, jnp.int64(0), hi | lo)
+    hi_p = _p_shl(one, jnp.maximum(bits - 1, 0))
+    u2 = _p_mk(sb[_SB_NUM + 7].astype(jnp.int32),
+               sb[_SB_NUM + 8].astype(jnp.int32))
+    lo_p = _p_umod(u2, hi_p)  # hi_p >= 1 always
+    lg = _p_sel(bits <= 0, zero, _p_or(hi_p, lo_p))
     s3 = _krand(sb[_SB_NUM + 9], 3)
-    catch_all = jnp.where(s3 == 0, v - lg, v + lg)
-    return jnp.select(
-        [t == 0, t == 1, t == 2, t == 3, (t == 4) | (t == 5),
-         t == 7, t == 8, t == 9, t == 10],
-        [v + 1, v - 1, jnp.int64(0), jnp.int64(1), interesting,
-         v + interesting2, v - interesting2, v - rnd_abs * sign, -v],
-        catch_all,
-    )
+    catch_all = _p_sel(s3 == 0, _p_sub(v, lg), _p_add(v, lg))
+
+    out = catch_all
+    out = _p_sel(t == 10, _p_neg(v), out)
+    out = _p_sel(t == 9, strat9, out)
+    out = _p_sel(t == 8, _p_sub(v, interesting2), out)
+    out = _p_sel(t == 7, _p_add(v, interesting2), out)
+    out = _p_sel((t == 4) | (t == 5), interesting, out)
+    out = _p_sel(t == 3, one, out)
+    out = _p_sel(t == 2, zero, out)
+    out = _p_sel(t == 1, _p_sub(v, one), out)
+    out = _p_sel(t == 0, _p_add(v, one), out)
+    return out
+
+
+def _render_scalars(v):
+    """num_mutators._render_decimal as pure scalar pair math: (hi, lo) ->
+    _SCRATCH (24) literal-byte SCALARS + length. (The shared vector version uses 1D
+    scatters, flip/argmax, a vector gather and int64 — none of which
+    lower on Mosaic; digits here are a python list of traced scalars.)"""
+    neg = _p_is_neg(v)
+    neg_i = neg.astype(jnp.int32)
+    neg_max = _p_neg(_p_const(INT64_MAX))
+    floored = _p_sel(_p_lt(v, neg_max), neg_max, v)
+    mag = _p_sel(neg, _p_neg(floored), v)
+
+    rev = []  # digit chars, least-significant first ('0'-padded to 20)
+    mag_k = mag
+    for _ in range(20):
+        mag_k, dig = _p_divmod10(mag_k)
+        rev.append(dig.astype(jnp.uint8) + jnp.uint8(48))
+    idx_max = jnp.int32(-1)  # last significant-digit index
+    for k in range(20):
+        idx_max = jnp.where(rev[k] != jnp.uint8(48), jnp.int32(k), idx_max)
+    ndig = jnp.maximum(idx_max + 1, 1)
+    ndig = jnp.where(_p_eq0(mag), 1, ndig)
+    total = (ndig + neg_i).astype(jnp.int32)
+
+    out = []
+    for k in range(_SCRATCH):
+        digit_idx = jnp.clip(ndig - 1 - (k - neg_i), 0, 19)
+        dk = jnp.uint8(48)
+        for t in range(20):
+            dk = jnp.where(digit_idx == t, rev[t], dk)
+        dk = jnp.where((k == 0) & neg, jnp.uint8(45), dk)
+        out.append(jnp.where(k < total, dk, jnp.uint8(0)))
+    return out, total
 
 
 # --- kernels + wrapper ----------------------------------------------------
 
 
-def _run(meta_ref, pri_ref, sc_ref, funny_ref, flens_ref, itbl_ref,
-         data_ref, out_ref, nout_ref, scout_ref, log_ref, sref, get_bits):
-    tables = (funny_ref[...], flens_ref[0], itbl_ref[0])
+def _run(meta_ref, pri_ref, sc_ref, funny_ref, flens_ref, itblh_ref,
+         itbll_ref, data_ref, out_ref, nout_ref, scout_ref, log_ref, sref,
+         get_bits):
+    tables = (funny_ref[...], flens_ref[...], itblh_ref[...], itbll_ref[...])
     sref[...] = data_ref[...]
     log_ref[...] = jnp.full((1, R_MAX), -1, jnp.int32)
     n0 = meta_ref[0, 0]
@@ -662,16 +900,16 @@ def _run(meta_ref, pri_ref, sc_ref, funny_ref, flens_ref, itbl_ref,
 
 
 def _kernel_portable(meta_ref, pri_ref, sc_ref, funny_ref, flens_ref,
-                     itbl_ref, sbits_ref, vbits_ref, data_ref, out_ref,
-                     nout_ref, scout_ref, log_ref, sref):
-    _run(meta_ref, pri_ref, sc_ref, funny_ref, flens_ref, itbl_ref,
-         data_ref, out_ref, nout_ref, scout_ref, log_ref, sref,
+                     itblh_ref, itbll_ref, sbits_ref, vbits_ref, data_ref,
+                     out_ref, nout_ref, scout_ref, log_ref, sref):
+    _run(meta_ref, pri_ref, sc_ref, funny_ref, flens_ref, itblh_ref,
+         itbll_ref, data_ref, out_ref, nout_ref, scout_ref, log_ref, sref,
          get_bits=lambda r: (sbits_ref[r], vbits_ref[r]))
 
 
 def _kernel_hw(seed_ref, meta_ref, pri_ref, sc_ref, funny_ref, flens_ref,
-               itbl_ref, data_ref, out_ref, nout_ref, scout_ref, log_ref,
-               sref):  # pragma: no cover - TPU
+               itblh_ref, itbll_ref, data_ref, out_ref, nout_ref, scout_ref,
+               log_ref, sref):  # pragma: no cover - TPU
     pltpu.prng_seed(seed_ref[0, 0], seed_ref[0, 1])
     L = data_ref.shape[-1]
 
@@ -680,8 +918,9 @@ def _kernel_hw(seed_ref, meta_ref, pri_ref, sc_ref, funny_ref, flens_ref,
         vb = pltpu.prng_random_bits((6, L)).astype(jnp.uint32)
         return sb, vb
 
-    _run(meta_ref, pri_ref, sc_ref, funny_ref, flens_ref, itbl_ref,
-         data_ref, out_ref, nout_ref, scout_ref, log_ref, sref, get_bits)
+    _run(meta_ref, pri_ref, sc_ref, funny_ref, flens_ref, itblh_ref,
+         itbll_ref, data_ref, out_ref, nout_ref, scout_ref, log_ref, sref,
+         get_bits)
 
 
 def case_rounds_single(key, data_row, n, scores, pri, rounds):
@@ -701,7 +940,13 @@ def case_rounds_single(key, data_row, n, scores, pri, rounds):
     data2 = data_row.reshape(1, L)
     funny_t = jnp.asarray(_FUNNY_TABLE)
     funny_l = jnp.asarray(_FUNNY_LENS, jnp.int32).reshape(1, -1)
-    int_tbl = jnp.asarray(_INTERESTING_NP).reshape(1, -1)
+    # interesting numbers as int32 halves: int64 VECTORS never enter the
+    # kernel (32-bit Mosaic); scalars are reassembled in _tbl_at64
+    _itbl64 = np.asarray(_INTERESTING_NP, np.int64)
+    int_hi = jnp.asarray((_itbl64 >> 32).astype(np.int32)).reshape(1, -1)
+    int_lo = jnp.asarray(
+        (_itbl64 & 0xFFFFFFFF).astype(np.uint32).astype(np.int32)
+    ).reshape(1, -1)
     out_shape = (
         jax.ShapeDtypeStruct((1, L), jnp.uint8),
         jax.ShapeDtypeStruct((1, 1), jnp.int32),
@@ -719,7 +964,7 @@ def case_rounds_single(key, data_row, n, scores, pri, rounds):
         ).reshape(1, 2)
         out, nout, sc, log = pl.pallas_call(
             _kernel_hw, out_shape=out_shape, scratch_shapes=scratch
-        )(seed, meta, pri2, sc2, funny_t, funny_l, int_tbl, data2)
+        )(seed, meta, pri2, sc2, funny_t, funny_l, int_hi, int_lo, data2)
     else:
         sbits = jax.random.bits(
             prng.sub(key, prng.TAG_SITE), (R_MAX, _SB_ROW_LEN), jnp.uint32
@@ -730,5 +975,5 @@ def case_rounds_single(key, data_row, n, scores, pri, rounds):
         out, nout, sc, log = pl.pallas_call(
             _kernel_portable, out_shape=out_shape, scratch_shapes=scratch,
             interpret=True,
-        )(meta, pri2, sc2, funny_t, funny_l, int_tbl, sbits, vbits, data2)
+        )(meta, pri2, sc2, funny_t, funny_l, int_hi, int_lo, sbits, vbits, data2)
     return out[0], nout[0, 0], sc[0], log[0]
