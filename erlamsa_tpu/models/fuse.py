@@ -14,6 +14,8 @@ suffix.
 
 from __future__ import annotations
 
+import numpy as np
+
 from ..utils.erlrand import ErlRand
 
 SEARCH_FUEL = 100_000
@@ -43,47 +45,93 @@ def _char_suffixes(buf: bytes, sufs: list[int]) -> dict[int, list[int]]:
 def _any_position_pair(r: ErlRand, buf_a: bytes, buf_b: bytes, nodes) -> tuple[int, int]:
     """Pick a random node, then a random source and target suffix
     (erlamsa_fuse.erl:72-77). rand_elem([]) yields the empty suffix without
-    a draw (erlamsa_rnd:rand_elem clause for [])."""
+    a draw (erlamsa_rnd:rand_elem clause for []). Nodes hold offset arrays;
+    the empty-suffix marker is the offset len(buf) itself (same value the
+    marker mapped to), so tolist() keeps draw counts and results exact."""
     froms, tos = r.rand_elem(nodes)
-    frm = r.rand_elem(froms) if froms else []
-    to = r.rand_elem(tos) if tos else []
+    frm = r.rand_elem(list(map(int, froms))) if len(froms) else []
+    to = r.rand_elem(list(map(int, tos))) if len(tos) else []
     frm = frm if isinstance(frm, int) else len(buf_a)
     to = to if isinstance(to, int) else len(buf_b)
     return frm, to
 
 
+def _round_buckets(buf_arr: np.ndarray, n: int, parts) -> dict:
+    """One round's bucketing for EVERY node at once: {node_id*256 + ch:
+    bucket_offsets}, dict insertion order ascending in (node, ch) — the
+    reference's per-node gb_trees ascending walk. Bucket order is the
+    reference's prepend order (reversed input walk); a bucket holding only
+    the exhausted suffix collapses to []."""
+    sizes = np.fromiter((p.size for p in parts), np.int64, len(parts))
+    total = int(sizes.sum())
+    if total == 0:
+        return {}
+    offs = np.concatenate(parts)
+    ids = np.repeat(np.arange(len(parts), dtype=np.int64), sizes)
+    m = offs < n
+    offs, ids = offs[m], ids[m]
+    if offs.size == 0:
+        return {}
+    keys = ids * 256 + buf_arr[offs].astype(np.int64)
+    order = np.argsort(keys, kind="stable")
+    sk = keys[order]
+    so = offs[order]
+    uk, starts = np.unique(sk, return_index=True)
+    bounds = np.append(starts, len(sk))
+    groups: dict[int, np.ndarray] = {}
+    for g in range(len(uk)):
+        grp = so[starts[g] : bounds[g + 1]]  # walk order within the bucket
+        # fix_empty_list fires AT INSERT time: the exhausted suffix
+        # (offset n-1 -> marker n) is discarded iff it is the FIRST
+        # walked element of its bucket ([n] collapses to [], and later
+        # inserts start from the emptied bucket); a marker walked into a
+        # non-empty bucket is kept (erlamsa_fuse.erl:57-70)
+        if grp.size and grp[0] == n - 1:
+            grp = grp[1:]
+        groups[int(uk[g])] = (grp + 1)[::-1]
+    return groups
+
+
 def find_jump_points(r: ErlRand, a: bytes, b: bytes) -> tuple[int, int]:
     """Walk shared-prefix refinements until the stop draw fires
-    (erlamsa_fuse.erl:102-128). Returns byte offsets (from_a, to_b)."""
+    (erlamsa_fuse.erl:102-128). Returns byte offsets (from_a, to_b).
+
+    Vectorized over the reference walk (this was the oracle's #2 hotspot:
+    per-suffix dict prepends over every node every round). Each round is
+    ONE grouped argsort per side — node count no longer matters. Bucket
+    contents and refinement order reproduce the scalar walk element-for-
+    element; tests lock both the draw stream and the results."""
+    na, nb = len(a), len(b)
+    arr_a = np.frombuffer(a, dtype=np.uint8)
+    arr_b = np.frombuffer(b, dtype=np.uint8)
     # suffixes(X) excludes the empty suffix (erlamsa_fuse.erl:52-55)
-    nodes: list[tuple[list, list]] = [
-        (list(range(len(a))), list(range(len(b))))
-    ]
+    nodes = [(np.arange(na, dtype=np.int64), np.arange(nb, dtype=np.int64))]
+    sent_a = np.asarray([na], np.int64)  # the degenerate node's [[]]
+    empty = np.asarray([], np.int64)
     fuel = SEARCH_FUEL
     while True:
         if fuel < 0:
             return _any_position_pair(r, a, b, nodes)
         if r.rand(SEARCH_STOP_IP) == 0:
             return _any_position_pair(r, a, b, nodes)
-        refined: list[tuple[list, list]] = []
-        for froms, tos in nodes:
-            sas = _char_suffixes(a, froms)
-            sbs = _char_suffixes(b, tos)
-            # gb_trees:to_list iterates in ascending key order
-            for ch in sorted(sas):
-                asufs = sas[ch]
-                if asufs == []:
-                    # collapsed bucket: the reference pushes a degenerate
-                    # node #([[]], []) unconditionally (erlamsa_fuse.erl:90-92)
-                    refined.insert(0, ([[]], []))
-                    continue
-                bsufs = sbs.get(ch)
-                if bsufs is not None:
-                    refined.insert(0, (asufs, bsufs))
-        if not refined:
+        ga = _round_buckets(arr_a, na, [f for f, _ in nodes])
+        gb = _round_buckets(arr_b, nb, [t for _, t in nodes])
+        acc: list[tuple[np.ndarray, np.ndarray]] = []
+        # ga iterates ascending (node, ch): the per-node gb_trees order
+        for key, asufs in ga.items():
+            if asufs.size == 0:
+                # collapsed bucket: the reference pushes a degenerate
+                # node #([[]], []) unconditionally (erlamsa_fuse.erl:90-92)
+                acc.append((sent_a, empty))
+                continue
+            bsufs = gb.get(key)
+            if bsufs is not None:
+                acc.append((asufs, bsufs))
+        if not acc:
             return _any_position_pair(r, a, b, nodes)
-        nodes = refined
-        fuel -= len(refined)
+        # the reference insert(0)s every node: final order is reversed
+        nodes = acc[::-1]
+        fuel -= len(acc)
 
 
 def fuse(r: ErlRand, a: bytes, b: bytes) -> bytes:

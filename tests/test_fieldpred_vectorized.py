@@ -1,0 +1,97 @@
+"""The vectorized sizer scan must be list-identical (values AND order) to
+the reference-shaped scalar scan it replaced (erlamsa_field_predict.erl:
+90-105 semantics), including the draw order of the sampled end offsets."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from erlamsa_tpu.models.fieldpred import (
+    _simple_len,
+    _simple_u8len,
+    get_possible_simple_lens,
+)
+from erlamsa_tpu.constants import SIZER_MAX_FIRST_BYTES
+from erlamsa_tpu.utils.erlrand import ErlRand
+
+
+def scalar_reference(r: ErlRand, data: bytes) -> list[tuple]:
+    """The original O(A^2 * clauses) loop, verbatim."""
+    n = len(data)
+    if n > 10:
+        sublen = min(n // 5, SIZER_MAX_FIRST_BYTES)
+        first_seq = list(range(0, sublen + 1))
+        var_b = [r.rand_range(sublen, n) for _ in first_seq]
+        ranges = [(x, y) for x in first_seq for y in var_b]
+        all_ranges = [(a, n) for a in first_seq] + ranges
+        big = []
+        for a, b in all_ranges:
+            big = _simple_len(a, b, data) + big
+        small = [loc for a in first_seq for loc in _simple_u8len(a, data)]
+        return small + big
+    out = []
+    for x in range(0, 4):
+        out.extend(_simple_len(x, n, data))
+        out.extend(_simple_u8len(x, data))
+    return out
+
+
+def craft_with_fields(rng, n: int) -> bytes:
+    """Random bytes with several real length fields planted so matches
+    actually occur (random data almost never matches)."""
+    buf = bytearray(rng.integers(0, 256, n, dtype=np.uint8).tobytes())
+    # u8 field at offset 2 covering the tail
+    if n > 20:
+        buf[2] = n - 2 - 1 if n - 3 < 256 else 200
+    # u16 BE at offset 5 pointing at the exact end
+    if n > 40:
+        v = n - 5 - 2
+        buf[5:7] = v.to_bytes(2, "big")
+    # u32 LE at offset 11 pointing somewhere inside
+    if n > 64:
+        v = n // 2
+        buf[11:15] = v.to_bytes(4, "little")
+    # u8 matching an n-x tail for x in 1..8
+    if n > 30:
+        buf[9] = min(255, max(3, n - 9 - 1 - 4))
+    return bytes(buf)
+
+
+def test_vectorized_matches_scalar_small_inputs():
+    rng = np.random.default_rng(3)
+    for n in (0, 1, 3, 7, 10):
+        data = rng.integers(0, 256, n, dtype=np.uint8).tobytes()
+        assert get_possible_simple_lens(ErlRand((1, 2, 3)), data) == \
+            scalar_reference(ErlRand((1, 2, 3)), data)
+
+
+def test_vectorized_matches_scalar_random_and_crafted():
+    rng = np.random.default_rng(11)
+    for trial in range(6):
+        n = int(rng.integers(11, 700))
+        data = craft_with_fields(rng, n)
+        seed = (1, 2, 100 + trial)
+        got = get_possible_simple_lens(ErlRand(seed), data)
+        want = scalar_reference(ErlRand(seed), data)
+        assert got == want, (n, trial)
+        assert any(want), "crafted fields should produce at least one hit"
+
+
+def test_vectorized_matches_scalar_texty():
+    line = b"field=%d value=12345 name=test\n"
+    data = (line % 7) * 20
+    got = get_possible_simple_lens(ErlRand((9, 9, 9)), data)
+    want = scalar_reference(ErlRand((9, 9, 9)), data)
+    assert got == want
+
+
+def test_vectorized_4kb_has_draw_parity():
+    """On >SIZER_MAX_FIRST_BYTES inputs both paths must consume the same
+    number of PRNG draws (the stream position defines downstream draws)."""
+    rng = np.random.default_rng(5)
+    data = craft_with_fields(rng, 4096)
+    r1, r2 = ErlRand((4, 5, 6)), ErlRand((4, 5, 6))
+    got = get_possible_simple_lens(r1, data)
+    want = scalar_reference(r2, data)
+    assert got == want
+    assert r1.rand(1 << 30) == r2.rand(1 << 30)  # identical stream position

@@ -1,0 +1,123 @@
+"""The vectorized fuse walk must match the original scalar walk exactly:
+same PRNG draw stream, same jump points (erlamsa_fuse.erl:102-128)."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from erlamsa_tpu.models.fuse import (
+    SEARCH_FUEL,
+    SEARCH_STOP_IP,
+    _char_suffixes,
+    find_jump_points,
+    fuse,
+)
+from erlamsa_tpu.utils.erlrand import ErlRand
+
+
+def _any_position_pair_ref(r, buf_a, buf_b, nodes):
+    froms, tos = r.rand_elem(nodes)
+    frm = r.rand_elem(froms) if froms else []
+    to = r.rand_elem(tos) if tos else []
+    frm = frm if isinstance(frm, int) else len(buf_a)
+    to = to if isinstance(to, int) else len(buf_b)
+    return frm, to
+
+
+def find_jump_points_ref(r, a, b):
+    """The original scalar walk, verbatim."""
+    nodes = [(list(range(len(a))), list(range(len(b))))]
+    fuel = SEARCH_FUEL
+    while True:
+        if fuel < 0:
+            return _any_position_pair_ref(r, a, b, nodes)
+        if r.rand(SEARCH_STOP_IP) == 0:
+            return _any_position_pair_ref(r, a, b, nodes)
+        refined = []
+        for froms, tos in nodes:
+            sas = _char_suffixes(a, froms)
+            sbs = _char_suffixes(b, tos)
+            for ch in sorted(sas):
+                asufs = sas[ch]
+                if asufs == []:
+                    refined.insert(0, ([[]], []))
+                    continue
+                bsufs = sbs.get(ch)
+                if bsufs is not None:
+                    refined.insert(0, (asufs, bsufs))
+        if not refined:
+            return _any_position_pair_ref(r, a, b, nodes)
+        nodes = refined
+        fuel -= len(refined)
+
+
+CASES = []
+rng = np.random.default_rng(42)
+line = b"key=value one two three 12345\n"
+CASES.append((line * 8, line * 6))
+CASES.append((b"abcabcabcabc" * 10, b"xbcabcQQQ" * 9))
+CASES.append((bytes(rng.integers(0, 256, 300, dtype=np.uint8)),
+              bytes(rng.integers(0, 256, 251, dtype=np.uint8))))
+CASES.append((bytes(rng.integers(0, 4, 400, dtype=np.uint8)),
+              bytes(rng.integers(0, 4, 380, dtype=np.uint8))))  # heavy overlap
+CASES.append((b"a", b"b"))
+CASES.append((b"aaaa", b"aaaa"))
+
+
+def test_differential_sweep_small_inputs():
+    """Randomized sweep over small inputs / tiny alphabets — the regime
+    where the per-insert fix_empty_list marker rule (exhausted suffix
+    walked first vs later) actually fires."""
+    rng = np.random.default_rng(99)
+    mismatches = 0
+    for trial in range(600):
+        alpha = int(rng.choice([2, 3, 4, 256]))
+        la, lb = int(rng.integers(0, 13)), int(rng.integers(0, 13))
+        a = bytes(rng.integers(0, alpha, la, dtype=np.uint8))
+        b = bytes(rng.integers(0, alpha, lb, dtype=np.uint8))
+        if not a or not b:
+            continue
+        seed = (11, 13, trial)
+        r1, r2 = ErlRand(seed), ErlRand(seed)
+        got = find_jump_points(r1, a, b)
+        want = find_jump_points_ref(r2, a, b)
+        if got != want or r1.rand(1 << 30) != r2.rand(1 << 30):
+            mismatches += 1
+    assert mismatches == 0
+
+
+def test_marker_in_multimember_bucket():
+    """The exact mechanism from review: node where offset n-1 is walked
+    into a bucket that also holds live suffixes."""
+    a = b"\x01\x00\x01\x00"
+    b = b"\x01\x01\x01\x01\x00\x00\x01\x00\x00\x00"
+    for s in range(40):
+        seed = (5, 17, s)
+        r1, r2 = ErlRand(seed), ErlRand(seed)
+        assert find_jump_points(r1, a, b) == find_jump_points_ref(r2, a, b)
+        assert r1.rand(1 << 30) == r2.rand(1 << 30)
+
+
+def test_jump_points_match_scalar_walk():
+    for idx, (a, b) in enumerate(CASES):
+        for s in range(8):
+            seed = (7, idx, s)
+            got = find_jump_points(ErlRand(seed), a, b)
+            want = find_jump_points_ref(ErlRand(seed), a, b)
+            assert got == want, (idx, s)
+
+
+def test_stream_position_identical():
+    a, b = CASES[0]
+    r1, r2 = ErlRand((3, 3, 3)), ErlRand((3, 3, 3))
+    assert find_jump_points(r1, a, b) == find_jump_points_ref(r2, a, b)
+    assert r1.rand(1 << 30) == r2.rand(1 << 30)
+
+
+def test_fuse_output_matches():
+    for idx, (a, b) in enumerate(CASES):
+        seed = (1, 2, idx)
+        assert fuse(ErlRand(seed), a, b) == (
+            lambda r: (a[: (fj := find_jump_points_ref(r, a, b))[0]]
+                       + b[fj[1]:])
+        )(ErlRand(seed))

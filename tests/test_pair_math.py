@@ -1,0 +1,96 @@
+"""Property tests for the int32-pair 64-bit scalar math used by the
+whole-case Pallas kernel (ops/pallas_rounds._p_*).
+
+Mosaic has no int64, so the kernel's textual-number path carries values
+as (hi, lo) int32 pairs; these tests lock every helper against python
+arbitrary-precision ground truth over random and adversarial values.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+jnp = pytest.importorskip("jax.numpy")
+
+from erlamsa_tpu.ops import pallas_rounds as pr  # noqa: E402
+
+MASK64 = (1 << 64) - 1
+
+
+def to_pair(v: int):
+    v = int(v) & MASK64
+    hi, lo = v >> 32, v & 0xFFFFFFFF
+
+    def wrap(x):
+        return np.int32(x - (1 << 32) if x >= (1 << 31) else x)
+
+    return (jnp.int32(wrap(hi)), jnp.int32(wrap(lo)))
+
+
+def from_pair(p) -> int:
+    return ((int(p[0]) << 32) | (int(p[1]) & 0xFFFFFFFF)) & MASK64
+
+
+def s64(x: int) -> int:
+    x = int(x) & MASK64
+    return x - (1 << 64) if x >= (1 << 63) else x
+
+
+EDGE = [0, 1, -1, 9, 10, 2**31 - 1, 2**31, -(2**31), 2**32 - 1, 2**32,
+        10**18, -(10**18), 2**62 + 12345, 2**63 - 1, -(2**63)]
+RNG = np.random.default_rng(20260729)
+VALS = EDGE + [int(v) for v in RNG.integers(-(2**62), 2**62, 40)]
+
+
+@pytest.mark.parametrize("a", VALS)
+def test_roundtrip_neg_abs(a):
+    assert s64(from_pair(to_pair(a))) == s64(a)
+    assert s64(from_pair(pr._p_neg(to_pair(a)))) == s64(-a)
+    assert s64(from_pair(pr._p_abs(to_pair(a)))) == s64(abs(s64(a)))
+
+
+def test_add_sub_lt():
+    for a in VALS:
+        for b in VALS[:15]:
+            pa, pb = to_pair(a), to_pair(b)
+            assert s64(from_pair(pr._p_add(pa, pb))) == s64(a + b)
+            assert s64(from_pair(pr._p_sub(pa, pb))) == s64(a - b)
+            assert bool(pr._p_lt(pa, pb)) == (s64(a) < s64(b))
+            assert bool(pr._p_ult(pa, pb)) == (
+                (int(a) & MASK64) < (int(b) & MASK64)
+            )
+
+
+def test_shl():
+    for a in VALS:
+        for k in (0, 1, 5, 31, 32, 33, 63):
+            got = from_pair(pr._p_shl(to_pair(a), k))
+            assert got == ((int(a) & MASK64) << k) & MASK64, (a, k)
+
+
+def test_mul10_add_divmod10():
+    for a in VALS:
+        m = abs(s64(a)) % 10**17  # mul10 headroom
+        for d in (0, 1, 9):
+            assert s64(from_pair(pr._p_mul10_add(to_pair(m), d))) == m * 10 + d
+        nn = abs(s64(a))
+        q, r = pr._p_divmod10(to_pair(nn))
+        assert from_pair(q) == nn // 10 and int(r) == nn % 10
+
+
+def test_umod():
+    for _ in range(30):
+        a = int(RNG.integers(0, 2**63)) * 2 + int(RNG.integers(0, 2))
+        d = int(RNG.integers(1, 2**63))
+        assert from_pair(pr._p_umod(to_pair(a), to_pair(d))) == a % d
+    # divisor 1 and max-value edges
+    assert from_pair(pr._p_umod(to_pair(MASK64), to_pair(1))) == 0
+    assert from_pair(pr._p_umod(to_pair(5), to_pair(7))) == 5
+
+
+def test_const_matches_python():
+    from erlamsa_tpu.ops.num_mutators import INT64_MAX
+
+    assert s64(from_pair(pr._p_const(INT64_MAX))) == INT64_MAX
+    assert s64(from_pair(pr._p_const(-1))) == -1
