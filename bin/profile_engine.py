@@ -1,0 +1,107 @@
+#!/usr/bin/env python
+"""Stage-by-stage timing of the fused engine on CPU (VERDICT r2 item 2).
+
+Times each pipeline stage in isolation (jitted + vmapped, warm) so the
+per-sample microsecond budget can be attributed:
+  pattern_plan | detect_sizer | detect_csum | weighted_pick | Tables |
+  param switch | applies | full fuzz_batch
+
+Run: env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu python bin/profile_engine.py [B] [L]
+"""
+import os
+import sys
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from erlamsa_tpu.ops import prng
+from erlamsa_tpu.ops.fused import Tables, _PARAM_BRANCHES, fused_mutate_step
+from erlamsa_tpu.ops.patterns import DEFAULT_PATTERN_PRI_NP, pattern_plan
+from erlamsa_tpu.ops.pipeline import fuzz_batch, make_fuzzer
+from erlamsa_tpu.ops.registry import DEFAULT_DEVICE_PRI
+from erlamsa_tpu.ops.scheduler import init_scores, weighted_pick
+from erlamsa_tpu.ops.sizer import detect_sizer
+from erlamsa_tpu.ops.crc32 import detect_csum
+
+B = int(sys.argv[1]) if len(sys.argv) > 1 else 256
+L = int(sys.argv[2]) if len(sys.argv) > 2 else 1024
+REPS = 5
+
+rng = np.random.default_rng(0)
+data = jnp.asarray(rng.integers(32, 127, (B, L), dtype=np.uint8))
+lens = jnp.full((B,), L, jnp.int32)
+base = prng.base_key(1)
+keys = prng.sample_keys(prng.case_key(base, 0), B)
+scores = init_scores(jax.random.key(7), B)
+pri = jnp.asarray(DEFAULT_DEVICE_PRI, jnp.int32)
+pat_pri = jnp.asarray(DEFAULT_PATTERN_PRI_NP, jnp.int32)
+
+
+def bench(name, fn, *args):
+    f = jax.jit(fn)
+    out = f(*args)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(REPS):
+        out = f(*args)
+        jax.block_until_ready(out)
+    dt = (time.perf_counter() - t0) / REPS
+    us = dt / B * 1e6
+    print(f"{name:28s} {dt * 1e3:9.2f} ms/call  {us:9.1f} us/sample")
+    return dt
+
+
+print(f"== stage timing B={B} L={L} backend={jax.default_backend()} ==")
+
+bench("pattern_plan", jax.vmap(
+    lambda k, n: pattern_plan(prng.sub(k, prng.TAG_PROB), n, pat_pri)),
+    keys, lens)
+bench("detect_sizer", jax.vmap(
+    lambda k, d, n: detect_sizer(prng.sub(k, prng.TAG_LEN), d, n)),
+    keys, data, lens)
+bench("detect_csum", jax.vmap(
+    lambda k, d, n: detect_csum(prng.sub(k, prng.TAG_VAL), d, n)),
+    keys, data, lens)
+bench("weighted_pick", jax.vmap(
+    lambda k, d, n, s: weighted_pick(k, d, n, s, pri)),
+    keys, data, lens, scores)
+
+
+def _params_only(k, d, n):
+    t = Tables(k, d, n)
+    site_key = prng.sub(k, prng.TAG_SITE)
+    branches = tuple((lambda g: (lambda kk: g(kk, t)))(g) for g in _PARAM_BRANCHES)
+    which = prng.rand(prng.sub(k, prng.TAG_AUX), len(branches))
+    return jax.lax.switch(which, branches, site_key)
+
+
+bench("Tables+param_switch", jax.vmap(_params_only), keys, data, lens)
+
+bench("fused_step_1round", jax.vmap(
+    lambda k, d, n, s: fused_mutate_step(k, d, n, s, pri)),
+    keys, data, lens, scores)
+
+bench("fuzz_batch_full", lambda: fuzz_batch(
+    keys, data, lens, scores, pri, pat_pri))
+
+bench("fuzz_batch_nosizer", lambda: fuzz_batch(
+    keys, data, lens, scores, pri, pat_pri,
+    enable_sizer=False, enable_csum=False))
+
+step, _ = make_fuzzer(L, B)
+sc = init_scores(jax.random.key(7), B)
+f = lambda: step(base, jnp.int32(0), data, lens, sc)
+out = f(); jax.block_until_ready(out)
+t0 = time.perf_counter()
+for _ in range(REPS):
+    jax.block_until_ready(f())
+dt = (time.perf_counter() - t0) / REPS
+print(f"{'make_fuzzer step (e2e)':28s} {dt * 1e3:9.2f} ms/call  "
+      f"{dt / B * 1e6:9.1f} us/sample  -> {B / dt:,.0f} samples/sec")

@@ -605,6 +605,146 @@ def _apply_perm_lines(key, p, data, n, starts, lens, nlines):
     return jnp.where(active, out, data), n
 
 
+def _composite_src(key, p, data, n, starts, lens, nlines):
+    """One index map for the whole round: since exactly one application
+    kind is active per sample per round, the four data movements (splice,
+    swap, byte-permute, line-permute) are all expressible as
+    ``out[i] = data[src[i]]`` for a kind-selected src — so the round pays
+    ONE [L] gather instead of four sequential gather+select passes.
+
+    Returns (src, use_lit, lit_idx, n_out, zero_tail):
+      src: int32[L] gather indices (already clipped);
+      use_lit/lit_idx: literal-overlay positions into p["scratch"];
+      n_out: post-round length; zero_tail: bool[L] positions to zero.
+    """
+    L = data.shape[0]
+    i = jnp.arange(L, dtype=jnp.int32)
+    kind = p["kind"]
+
+    # splice: head | replacement span (or literal overlay) | shifted tail
+    pos, drop, rlen, n_splice = _splice_geometry(p, n, L)
+    end_ins = pos + rlen
+    span_src = jnp.clip(
+        p["src_start"] + jnp.mod(i - pos, jnp.maximum(p["src_len"], 1)),
+        0, L - 1,
+    )
+    tail_src = jnp.clip(i - rlen + drop, 0, L - 1)
+    splice_src = jnp.where(
+        i < pos, i, jnp.where(i < end_ins, span_src, tail_src)
+    )
+    use_lit = (
+        (kind == K_SPLICE) & (p["src"] == SRC_LIT) & (i >= pos) & (i < end_ins)
+    )
+    lit_idx = jnp.clip(i - pos, 0, _SCRATCH - 1)
+
+    # swap: exchange adjacent spans [a1, a1+l1) and [a1+l1, a1+l1+l2)
+    a1, l1, l2 = p["a1"], p["l1"], p["l2"]
+    a2 = a1 + l1
+    in_first = (i >= a1) & (i < a1 + l2)
+    in_second = (i >= a1 + l2) & (i < a1 + l2 + l1)
+    swap_src = jnp.clip(
+        jnp.where(
+            in_first, a2 + (i - a1), jnp.where(in_second, a1 + (i - a1 - l2), i)
+        ),
+        0, L - 1,
+    )
+
+    # byte permute: keyed argsort over the PERM_WINDOW slice (same draw and
+    # window math as the standalone _apply_perm_bytes)
+    W = min(PERM_WINDOW, L)
+    ss = jnp.clip(p["ps"], 0, jnp.maximum(L - W, 0))
+    offset = p["ps"] - ss
+    u = prng.uniform_f32(prng.sub(key, prng.TAG_PERM), (W,))
+    w = jnp.arange(W, dtype=jnp.int32)
+    in_span_w = (w >= offset) & (w < offset + p["pl"])
+    sortkey = jnp.where(in_span_w, u, 2.0 + w.astype(jnp.float32))
+    order = jnp.argsort(sortkey).astype(jnp.int32)
+    wg = i - ss
+    in_pw = (wg >= offset) & (wg < offset + p["pl"]) & (wg >= 0) & (wg < W)
+    permb_src = jnp.where(
+        in_pw, ss + order[jnp.clip(wg - offset, 0, W - 1)], i
+    )
+
+    # line permute: gather via the per-line cum-length table (same draws as
+    # the standalone _apply_perm_lines)
+    f = jnp.clip(p["ps"], 0, jnp.maximum(nlines - 1, 0))
+    cnt = jnp.clip(p["pl"], 0, jnp.maximum(nlines - f, 0))
+    k = jnp.arange(PERM_LINES, dtype=jnp.int32)
+    line_idx = jnp.clip(f + k, 0, L - 1)
+    wlens = jnp.where(k < cnt, lens[line_idx], 0)
+    ul = prng.uniform_f32(prng.sub(key, prng.TAG_PERM), (PERM_LINES,))
+    sortkey_l = jnp.where(k < cnt, ul, 2.0 + k.astype(jnp.float32))
+    order_l = jnp.argsort(sortkey_l).astype(jnp.int32)
+    out_lens = wlens[order_l]
+    cum = jnp.cumsum(out_lens).astype(jnp.int32)
+    win_start = starts[jnp.clip(f, 0, L - 1)]
+    total = cum[jnp.clip(cnt - 1, 0, PERM_LINES - 1)]
+    rel = i - win_start
+    in_win = (rel >= 0) & (rel < total)
+    j = jnp.clip(
+        jnp.searchsorted(cum, rel, side="right").astype(jnp.int32),
+        0, PERM_LINES - 1,
+    )
+    prev_cum = jnp.where(j > 0, cum[jnp.clip(j - 1, 0, PERM_LINES - 1)], 0)
+    src_line = jnp.clip(f + order_l[j], 0, L - 1)
+    perml_src = jnp.where(
+        in_win, jnp.clip(starts[src_line] + (rel - prev_cum), 0, L - 1), i
+    )
+
+    src = jnp.select(
+        [kind == K_SPLICE, kind == K_SWAP, kind == K_PERM_BYTES,
+         kind == K_PERM_LINES],
+        [splice_src, swap_src, permb_src, perml_src],
+        i,
+    )
+    n_out = jnp.where(kind == K_SPLICE, n_splice, n)
+    zero_tail = (kind == K_SPLICE) & (i >= n_splice)
+    return src, use_lit, lit_idx, n_out, zero_tail
+
+
+def _mask_transform(key, p, out):
+    """Post-gather byte transform for the MASK kind.
+
+    One uint32 of entropy per byte, bit-sliced: bits 0-2 select the flip
+    bit, 3-10 the replacement byte, 11-31 drive the occurrence draw
+    (mod-100 over 21 bits; bias < 3e-5). The standalone _apply_mask drew
+    three separate randint streams — one raw-bits draw is 3x cheaper per
+    round and the per-byte marginals are identical (disjoint bit ranges of
+    a threefry word are independent). Distribution change only: snand/srnd
+    byte streams differ from pre-r3 engines (engine-version note in
+    ops/pipeline.py).
+    """
+    L = out.shape[0]
+    i = jnp.arange(L, dtype=jnp.int32)
+    active = p["kind"] == K_MASK
+    in_span = (i >= p["ps"]) & (i < p["ps"] + p["pl"])
+    r = jax.random.bits(prng.sub(key, prng.TAG_VAL), (L,), jnp.uint32)
+    occurs_n = ((r >> 11) % jnp.uint32(100)).astype(jnp.int32)
+    occurs = jnp.where(
+        p["mask_prob"] == 1, occurs_n != 0, occurs_n < p["mask_prob"]
+    )
+    rnd = ((r >> 3) & jnp.uint32(0xFF)).astype(jnp.uint8)
+    one = jnp.left_shift(jnp.uint8(1), (r & jnp.uint32(7)).astype(jnp.uint8))
+    masked = jnp.select(
+        [p["mask_op"] == 0, p["mask_op"] == 1, p["mask_op"] == 2],
+        [out & ~one, out | one, out ^ one],
+        rnd,
+    )
+    return jnp.where(active & in_span & occurs, masked, out)
+
+
+def _apply_composite(key, p, data, n, starts, lens, nlines):
+    """The whole round's data movement in one gather + one transform."""
+    src, use_lit, lit_idx, n_out, zero_tail = _composite_src(
+        key, p, data, n, starts, lens, nlines
+    )
+    out = data[src]
+    out = jnp.where(use_lit, p["scratch"][lit_idx], out)
+    out = _mask_transform(key, p, out)
+    out = jnp.where(zero_tail, jnp.uint8(0), out)
+    return out, n_out
+
+
 def _apply_mask(key, p, data, n):
     from .pallas_kernels import pallas_enabled, randmask_single
 
@@ -679,13 +819,13 @@ def fused_mutate_step(key, data, n, scores, pri):
             site_key, params, out, n1, t.line_starts, t.line_lens, t.nlines
         )
     else:
-        out, n1 = _apply_splice(params, data, n)
-        out, n1 = _apply_swap(params, out, n1)
-        out, n1 = _apply_perm_bytes(site_key, params, out, n1)
-        out, n1 = _apply_perm_lines(
-            site_key, params, out, n1, t.line_starts, t.line_lens, t.nlines
+        # one gather + one transform for the whole round (the kinds are
+        # mutually exclusive, so the four movement passes collapse into a
+        # single kind-selected index map — bit-identical to running the
+        # standalone applies in sequence)
+        out, n1 = _apply_composite(
+            site_key, params, data, n, t.line_starts, t.line_lens, t.nlines
         )
-        out, n1 = _apply_mask(site_key, params, out, n1)
 
     out = jnp.where(any_app, out, data)
     n1 = jnp.where(any_app, n1, n)

@@ -180,7 +180,8 @@ def fuzz_sample(key, data, n, scores, pri, pat_pri, engine: str = "fused",
 
 
 def fuzz_batch(keys, data, lens, scores, pri, pat_pri, engine: str = "fused",
-               enable_sizer: bool = True, enable_csum: bool = True):
+               enable_sizer: bool = True, enable_csum: bool = True,
+               slices: int = 0):
     """One device call: mutate a [B, L] batch.
 
     Args:
@@ -192,19 +193,68 @@ def fuzz_batch(keys, data, lens, scores, pri, pat_pri, engine: str = "fused",
         kernel per mutator — the reference-shaped baseline).
       enable_sizer/enable_csum: trace-time switches for the sz/cs scans
         (set False when those patterns carry zero priority).
+      slices: rounds-sorted execution (0/1 = off). The per-sample rounds
+        draw is a truncated geometric (patterns._geometric_rounds): its
+        batch MEAN is ~3 but at realistic B its MAX is ~MAX_BURST_MUTATIONS
+        — and a vmapped while_loop runs every lane to the batch max. With
+        slices=S the batch is pre-sorted by its (cheap, re-derived) rounds
+        draw and processed as S sequential [B/S] sub-batches via lax.map,
+        so each sub-batch's loop stops at ITS OWN max — the quantiles of
+        the rounds distribution instead of the global max. Results are
+        bit-identical to the unsorted path (everything is keyed per
+        sample); single-device throughput only — under pjit the sort would
+        become a cross-device gather, so the mesh path leaves it off.
 
     Returns (data', lens', scores', FuzzMeta).
     """
-    out, n_out, sc, pat, log = jax.vmap(
-        lambda k, d, n, s: fuzz_sample(
-            k, d, n, s, pri, pat_pri, engine, enable_sizer, enable_csum
-        )
-    )(keys, data, lens, scores)
-    return out, n_out, sc, FuzzMeta(pat, log)
+    B = data.shape[0]
+    s = 1 if slices <= 1 else slices
+    while s > 1 and B % s:
+        s //= 2
+
+    def run(k, d, n, sc):
+        out, n_out, scn, pat, log = jax.vmap(
+            lambda ki, di, ni, si: fuzz_sample(
+                ki, di, ni, si, pri, pat_pri, engine, enable_sizer,
+                enable_csum
+            )
+        )(k, d, n, sc)
+        return out, n_out, scn, pat, log
+
+    if s <= 1:
+        out, n_out, sc, pat, log = run(keys, data, lens, scores)
+        return out, n_out, sc, FuzzMeta(pat, log)
+
+    # the sort key re-derives each sample's rounds draw exactly as
+    # fuzz_sample will (same key tag), so the grouping is exact
+    rounds = jax.vmap(
+        lambda k, n: pattern_plan(prng.sub(k, prng.TAG_PROB), n, pat_pri)[1]
+    )(keys, lens)
+    order = jnp.argsort(rounds).astype(jnp.int32)
+    inv = jnp.argsort(order).astype(jnp.int32)
+
+    def part(x):
+        return x[order].reshape((s, B // s) + x.shape[1:])
+
+    out, n_out, sc, pat, log = jax.lax.map(
+        lambda a: run(*a),
+        (part(keys), part(data), part(lens), part(scores)),
+    )
+
+    def unpart(x):
+        return x.reshape((B,) + x.shape[2:])[inv]
+
+    return (
+        unpart(out), unpart(n_out), unpart(sc),
+        FuzzMeta(unpart(pat), unpart(log)),
+    )
+
+
+DEFAULT_SLICES = 8  # rounds-sorted sub-batches on the single-device path
 
 
 def make_class_fuzzer(mutator_pri=None, pattern_pri=None,
-                      engine: str = "fused"):
+                      engine: str = "fused", slices: int = DEFAULT_SLICES):
     """Capacity-class step (SURVEY.md §5.7): one jitted function reused
     across class batches — XLA retraces per (B, L) shape, compiling one
     program per class. Keys derive from the ORIGINAL corpus index passed
@@ -239,13 +289,14 @@ def make_class_fuzzer(mutator_pri=None, pattern_pri=None,
         return fuzz_batch(
             keys, data, lens, scores, jnp.asarray(pri), jnp.asarray(pat_pri),
             engine=engine, enable_sizer=enable_sizer, enable_csum=enable_csum,
+            slices=slices,
         )
 
     return jax.jit(step)
 
 
 def make_fuzzer(capacity: int, batch: int, mutator_pri=None, pattern_pri=None,
-                engine: str = "fused"):
+                engine: str = "fused", slices: int = DEFAULT_SLICES):
     """Host convenience: returns (jitted_step, initial_state_fn).
 
     jitted_step(case_idx, data, lens, scores) -> (data', lens', scores', meta)
@@ -253,7 +304,7 @@ def make_fuzzer(capacity: int, batch: int, mutator_pri=None, pattern_pri=None,
     format is just (seed, case counter), like the reference's
     last_seed.txt + --skip (SURVEY.md §5.4).
     """
-    class_step = make_class_fuzzer(mutator_pri, pattern_pri, engine)
+    class_step = make_class_fuzzer(mutator_pri, pattern_pri, engine, slices)
     indices = jnp.arange(batch, dtype=jnp.int32)
 
     def step(base, case_idx, data, lens, scores):
