@@ -14,18 +14,24 @@
 # to a dead relay endpoint that never answers), capped at MAX_LIVE live
 # attempts so the leak is bounded.
 #
+# All attempt artifacts live under tpu_attempts/ (gitignored); after every
+# finished attempt the ledger (TPU_ATTEMPTS.json, tracked) is refreshed so
+# the audit trail survives even if this watcher dies.
+#
 # The evidence suite (bin/tpu_evidence.py) needs the chip to itself, so it
 # only starts once NO attempt is still alive — bounded by EVIDENCE_WAIT,
 # after which it is skipped rather than risk contending with a stuck
 # attempt that might wake mid-suite.
 #
-# Usage: nohup bin/tpu_bench_watch.sh >> bench_watch.log 2>&1 &
+# Usage: mkdir -p tpu_attempts && nohup bin/tpu_bench_watch.sh >> tpu_attempts/watch.log 2>&1 &
 set -u
 cd "$(dirname "$0")/.."
 POLL=${POLL:-60}
 RESPAWN_AFTER=${RESPAWN_AFTER:-7200}
-MAX_LIVE=${MAX_LIVE:-3}
+MAX_LIVE=${MAX_LIVE:-2}
 EVIDENCE_WAIT=${EVIDENCE_WAIT:-3600}
+DIR=tpu_attempts
+mkdir -p "$DIR"
 
 declare -a PIDS=()
 declare -a TAGS=()
@@ -35,14 +41,17 @@ spawn_attempt() {
     (
         ERLAMSA_BENCH_CHILD=1 \
         ERLAMSA_BENCH_ESCALATE=1 \
-        ERLAMSA_BENCH_RESULT="$PWD/bench_watch_result.$tag.json" \
-        setsid python bench.py > "bench_watch_attempt.$tag.log" 2>&1 < /dev/null
-        echo $? > "bench_watch_attempt.$tag.rc"
+        ERLAMSA_BENCH_RESULT="$PWD/$DIR/result.$tag.json" \
+        setsid python bench.py > "$DIR/attempt.$tag.log" 2>&1 < /dev/null &
+        echo $! > "$DIR/attempt.$tag.pid"
+        wait $!
+        echo $? > "$DIR/attempt.$tag.rc"
+        python bin/tpu_ledger.py > /dev/null 2>&1 || true
     ) &
     PIDS+=($!)
     TAGS+=("$tag")
     LAST_SPAWN=$(date +%s)
-    echo "[watch $(date +%H:%M:%S)] spawned attempt tag=$tag (live=${#PIDS[@]})"
+    echo "[watch $(date +%H:%M:%S)] spawned attempt tag=$tag (live=$(live_count))"
 }
 
 live_count() {
@@ -58,20 +67,22 @@ finished_tag() {
     local t
     for ((idx=${#TAGS[@]}-1; idx>=0; idx--)); do
         t="${TAGS[$idx]}"
-        [ -e "bench_watch_attempt.$t.rc" ] || continue
-        [ "$(cat "bench_watch_attempt.$t.rc")" = "0" ] || continue
-        [ -s "bench_watch_result.$t.json" ] && { echo "$t"; return 0; }
+        [ -e "$DIR/attempt.$t.rc" ] || continue
+        [ "$(cat "$DIR/attempt.$t.rc")" = "0" ] || continue
+        [ -s "$DIR/result.$t.json" ] && { echo "$t"; return 0; }
     done
     return 1
 }
 
-rm -f bench_watch_result.*.json bench_watch_attempt.*.rc
+rm -f "$DIR"/result.*.json "$DIR"/attempt.*.rc
 spawn_attempt
 while true; do
     sleep "$POLL"
     if tag=$(finished_tag); then
         echo "[watch $(date +%H:%M:%S)] RESULT (attempt $tag):"
-        cat "bench_watch_result.$tag.json"
+        cat "$DIR/result.$tag.json"
+        cp "$DIR/result.$tag.json" TPU_BENCH_RESULT.json
+        python bin/tpu_ledger.py || true
         # count ANY bench child on the box (orphans from a previous watcher
         # included), not just this instance's PIDS
         any_bench() { pgrep -fc "python bench.py" 2>/dev/null || true; }
@@ -85,7 +96,7 @@ while true; do
             exit 0
         fi
         echo "[watch $(date +%H:%M:%S)] running evidence suite (A/Bs + profile)"
-        setsid python bin/tpu_evidence.py >> bench_watch.log 2>&1 < /dev/null
+        setsid python bin/tpu_evidence.py >> "$DIR/watch.log" 2>&1 < /dev/null
         echo "[watch $(date +%H:%M:%S)] evidence suite done rc=$?"
         exit 0
     fi

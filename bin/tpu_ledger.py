@@ -1,0 +1,149 @@
+"""Build TPU_ATTEMPTS.json — the per-attempt audit trail of every try at
+initialising the axon TPU backend in this image.
+
+Each bench attempt spawned by bin/tpu_bench_watch.sh leaves a
+``attempt.<unix-ts>.log`` (stdout+stderr) and, once it exits, a matching
+``.rc`` file.  This script folds all of them — current ``tpu_attempts/``
+dir plus the legacy repo-root ``bench_watch_attempt.*`` /
+``bench_tpu_attempt.*`` names from rounds 2-3 — into one sorted JSON
+ledger: timestamp, duration, exit code, and the error tail, so "the relay
+was wedged all round" is evidence rather than assertion.
+
+Run standalone or let the watcher invoke it after every finished attempt:
+    python bin/tpu_ledger.py
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+import re
+import tempfile
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+OUT = os.path.join(REPO, "TPU_ATTEMPTS.json")
+
+# the one line that names the failure, if present
+_ERR_RE = re.compile(r"(RuntimeError|jaxlib\.|XlaRuntimeError|Error):? .*")
+
+
+def _tail(path: str, lines: int = 4, max_chars: int = 600) -> str:
+    try:
+        with open(path, "rb") as f:
+            f.seek(0, os.SEEK_END)
+            f.seek(max(0, f.tell() - 8192))
+            text = f.read().decode("utf-8", "replace")
+    except OSError:
+        return ""
+    return "\n".join(text.strip().splitlines()[-lines:])[-max_chars:]
+
+
+def _error_line(path: str) -> str | None:
+    try:
+        with open(path, "rb") as f:
+            f.seek(0, os.SEEK_END)
+            f.seek(max(0, f.tell() - 16384))
+            text = f.read().decode("utf-8", "replace")
+    except OSError:
+        return None
+    hits = [m.group(0) for m in _ERR_RE.finditer(text)]
+    return hits[-1][:400] if hits else None
+
+
+def collect() -> list[dict]:
+    patterns = [
+        os.path.join(REPO, "tpu_attempts", "attempt.*.log"),
+        os.path.join(REPO, "tpu_attempts", "legacy", "*attempt.*.log"),
+        os.path.join(REPO, "bench_watch_attempt.*.log"),
+        os.path.join(REPO, "bench_tpu_attempt.*.log"),
+    ]
+    entries: dict[str, dict] = {}
+    for pat in patterns:
+        for log in glob.glob(pat):
+            m = re.search(r"attempt\.(\d+)\.log$", log)
+            if not m:
+                continue
+            tag = m.group(1)
+            if tag in entries:
+                continue
+            rc_path = log[: -len(".log")] + ".rc"
+            rc: int | None = None
+            finished = None
+            if os.path.exists(rc_path):
+                try:
+                    rc = int(open(rc_path).read().strip() or "1")
+                except ValueError:
+                    rc = 1
+                finished = int(os.path.getmtime(rc_path))
+            # legacy bench_tpu_attempt tags are PIDs, not timestamps —
+            # fall back to the log's mtime for those
+            ts = int(tag) if int(tag) > 10_000_000 else int(os.path.getmtime(log))
+            err = _error_line(log)
+            pid_path = log[: -len(".log")] + ".pid"
+            pid = None
+            if os.path.exists(pid_path):
+                try:
+                    pid = int(open(pid_path).read().strip())
+                except ValueError:
+                    pid = None
+            if rc is not None:
+                status = "ok" if rc == 0 else "failed"
+            elif err:
+                # the log ends in a backend error but the .rc was lost
+                # (cleaned by a watcher restart): the attempt did fail
+                status = "failed"
+            elif pid is not None:
+                # liveness is ground truth: an attempt blocked in backend
+                # init legitimately sits silent for hours, so log age says
+                # nothing — only a dead pid with no rc means abandoned
+                status = ("running" if os.path.exists(f"/proc/{pid}")
+                          else "abandoned")
+            elif time.time() - os.path.getmtime(log) > 3 * 3600:
+                # legacy entries (no pid file): age is the only signal
+                status = "abandoned"
+            else:
+                status = "running"
+            entry = {
+                "started_utc": time.strftime("%Y-%m-%d %H:%M:%S",
+                                             time.gmtime(ts)),
+                "tag": tag,
+                "rc": rc,
+                "status": status,
+            }
+            if finished:
+                entry["duration_s"] = max(0, finished - ts)
+            if err:
+                entry["error"] = err
+            elif rc not in (0, None):
+                entry["error_tail"] = _tail(log)
+            entries[tag] = entry
+    return sorted(entries.values(), key=lambda e: e["started_utc"])
+
+
+def main() -> None:
+    attempts = collect()
+    failed = sum(1 for a in attempts if a["status"] == "failed")
+    report = {
+        "updated_utc": time.strftime("%Y-%m-%d %H:%M:%S", time.gmtime()),
+        "summary": {
+            "attempts": len(attempts),
+            "failed": failed,
+            "succeeded": sum(1 for a in attempts if a["status"] == "ok"),
+            "running": sum(1 for a in attempts if a["status"] == "running"),
+            "abandoned": sum(1 for a in attempts if a["status"] == "abandoned"),
+        },
+        "attempts": attempts,
+    }
+    # unique tmp name: concurrent ledger refreshes (two attempts finishing
+    # together) must not truncate each other's half-written file
+    fd, tmp = tempfile.mkstemp(dir=REPO, suffix=".ledger.tmp")
+    with os.fdopen(fd, "w") as f:
+        json.dump(report, f, indent=1)
+    os.replace(tmp, OUT)
+    print(f"{OUT}: {len(attempts)} attempts ({failed} failed)")
+
+
+if __name__ == "__main__":
+    main()

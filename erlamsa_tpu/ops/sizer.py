@@ -10,8 +10,9 @@ instead of hundreds of per-offset re-reads.
 
 Scope vs the oracle: device sizers are *tail* sizers (blob runs to the end
 of the sample, the overwhelmingly common layout); the oracle also samples
-random interior end offsets. Checksum-preserving (cs) stays host-side this
-round (crc32 isn't suffix-decomposable; xor8 is a candidate for later).
+random interior end offsets. The checksum-preserving (cs) pattern runs on
+device too — ops/crc32.py decomposes crc32 as a GF(2)-linear suffix scan
+(and xor8 trivially), wired into the pipeline's cs branch.
 """
 
 from __future__ import annotations
