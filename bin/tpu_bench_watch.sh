@@ -74,7 +74,9 @@ finished_tag() {
     return 1
 }
 
-rm -f "$DIR"/result.*.json "$DIR"/attempt.*.rc
+# NOTE: no startup cleanup — finished/stale artifacts from a previous
+# watcher are the ledger's ground truth (tpu_ledger.py folds them in),
+# and finished_tag only ever matches tags THIS instance spawned.
 spawn_attempt
 while true; do
     sleep "$POLL"

@@ -25,8 +25,10 @@ import time
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 OUT = os.path.join(REPO, "TPU_ATTEMPTS.json")
 
-# the one line that names the failure, if present
-_ERR_RE = re.compile(r"(RuntimeError|jaxlib\.|XlaRuntimeError|Error):? .*")
+# the one line that names the failure, if present: a line starting with a
+# dotted exception path ending in Error/Exception (word-anchored so
+# 'ValueError' is not truncated to 'Error')
+_ERR_RE = re.compile(r"(?m)^[\w.]*(?:Error|Exception): .*")
 
 
 def _tail(path: str, lines: int = 4, max_chars: int = 600) -> str:
@@ -90,16 +92,19 @@ def collect() -> list[dict]:
                     pid = None
             if rc is not None:
                 status = "ok" if rc == 0 else "failed"
+            elif pid is not None and os.path.exists(f"/proc/{pid}"):
+                # liveness is ground truth and outranks the error-line
+                # heuristic: a live attempt's log may contain a non-fatal
+                # error from an earlier retry, and an attempt blocked in
+                # backend init legitimately sits silent for hours
+                status = "running"
             elif err:
-                # the log ends in a backend error but the .rc was lost
-                # (cleaned by a watcher restart): the attempt did fail
+                # dead (or pid unknown) and the log ends in a backend
+                # error, but the .rc was lost: the attempt did fail
                 status = "failed"
             elif pid is not None:
-                # liveness is ground truth: an attempt blocked in backend
-                # init legitimately sits silent for hours, so log age says
-                # nothing — only a dead pid with no rc means abandoned
-                status = ("running" if os.path.exists(f"/proc/{pid}")
-                          else "abandoned")
+                # pid recorded but dead, no rc, no error: abandoned
+                status = "abandoned"
             elif time.time() - os.path.getmtime(log) > 3 * 3600:
                 # legacy entries (no pid file): age is the only signal
                 status = "abandoned"

@@ -179,9 +179,34 @@ def fuzz_sample(key, data, n, scores, pri, pat_pri, engine: str = "fused",
     return out, n_out, scores, pat, log
 
 
+def _auto_slices(B: int, L: int) -> int:
+    """Pick the rounds-sorted slice count for a [B, L] batch.
+
+    CPU (profiled on this image's 1-core host, PROFILE.md): per-sample
+    cost is minimized when one sub-batch's byte panel stays
+    cache-resident, which happens at a roughly constant sub-batch
+    FOOTPRINT — width*L ~ 64KB — not a constant slice count (the pre-r4
+    default of 8 slices made per-sample cost grow ~20% from B=256 to
+    B=2048). Width is floored at 8 (sub-batches thinner than that pay
+    more per-slice overhead than they save in cache hits) and capped at
+    B/8 so small batches still get the rounds-quantile win.
+
+    Accelerators: the footprint logic does NOT transfer — a TPU wants
+    thousands of parallel lanes per step, and narrow sub-batches would
+    serialize the chip (B=2048 at bench capacity would become 256
+    sequential 8-wide steps). There the slice count stays at the fixed
+    rounds-quantile setting of 8, sized so each sub-batch still fills
+    the device while its fori_loop stops at its own rounds quantile.
+    """
+    if jax.default_backend() != "cpu":
+        return min(8, max(1, B // 8))
+    width = max(8, min(65536 // max(L, 1), B // 8))
+    return max(1, B // width)
+
+
 def fuzz_batch(keys, data, lens, scores, pri, pat_pri, engine: str = "fused",
                enable_sizer: bool = True, enable_csum: bool = True,
-               slices: int = 0):
+               slices="auto"):
     """One device call: mutate a [B, L] batch.
 
     Args:
@@ -193,22 +218,28 @@ def fuzz_batch(keys, data, lens, scores, pri, pat_pri, engine: str = "fused",
         kernel per mutator — the reference-shaped baseline).
       enable_sizer/enable_csum: trace-time switches for the sz/cs scans
         (set False when those patterns carry zero priority).
-      slices: rounds-sorted execution (0/1 = off). The per-sample rounds
-        draw is a truncated geometric (patterns._geometric_rounds): its
-        batch MEAN is ~3 but at realistic B its MAX is ~MAX_BURST_MUTATIONS
-        — and a vmapped while_loop runs every lane to the batch max. With
-        slices=S the batch is pre-sorted by its (cheap, re-derived) rounds
-        draw and processed as S sequential [B/S] sub-batches via lax.map,
-        so each sub-batch's loop stops at ITS OWN max — the quantiles of
-        the rounds distribution instead of the global max. Results are
-        bit-identical to the unsorted path (everything is keyed per
-        sample); single-device throughput only — under pjit the sort would
-        become a cross-device gather, so the mesh path leaves it off.
+      slices: rounds-sorted execution (0/1 = off, "auto" = footprint-based
+        pick, see _auto_slices). The per-sample rounds draw is a truncated
+        geometric (patterns._geometric_rounds): its batch MEAN is ~3 but at
+        realistic B its MAX is ~MAX_BURST_MUTATIONS — and a vmapped
+        while_loop runs every lane to the batch max. With slices=S the
+        batch is pre-sorted by its (cheap, re-derived) rounds draw and
+        processed as S sequential [B/S] sub-batches via lax.map, so each
+        sub-batch's loop stops at ITS OWN max — the quantiles of the
+        rounds distribution instead of the global max. A second, equally
+        large effect on CPU: a sub-batch sized to stay cache-resident
+        keeps per-sample cost flat in B. Results are bit-identical to the
+        unsorted path (everything is keyed per sample); single-device
+        throughput only — under pjit the sort would become a cross-device
+        gather, so the mesh path leaves it off.
 
     Returns (data', lens', scores', FuzzMeta).
     """
     B = data.shape[0]
-    s = 1 if slices <= 1 else slices
+    if slices == "auto":
+        s = _auto_slices(B, data.shape[1])
+    else:
+        s = 1 if slices <= 1 else slices
     while s > 1 and B % s:
         s //= 2
 
@@ -250,11 +281,11 @@ def fuzz_batch(keys, data, lens, scores, pri, pat_pri, engine: str = "fused",
     )
 
 
-DEFAULT_SLICES = 8  # rounds-sorted sub-batches on the single-device path
+DEFAULT_SLICES = "auto"  # footprint-sized sub-batches (see _auto_slices)
 
 
 def make_class_fuzzer(mutator_pri=None, pattern_pri=None,
-                      engine: str = "fused", slices: int = DEFAULT_SLICES):
+                      engine: str = "fused", slices=DEFAULT_SLICES):
     """Capacity-class step (SURVEY.md §5.7): one jitted function reused
     across class batches — XLA retraces per (B, L) shape, compiling one
     program per class. Keys derive from the ORIGINAL corpus index passed
@@ -296,7 +327,7 @@ def make_class_fuzzer(mutator_pri=None, pattern_pri=None,
 
 
 def make_fuzzer(capacity: int, batch: int, mutator_pri=None, pattern_pri=None,
-                engine: str = "fused", slices: int = DEFAULT_SLICES):
+                engine: str = "fused", slices=DEFAULT_SLICES):
     """Host convenience: returns (jitted_step, initial_state_fn).
 
     jitted_step(case_idx, data, lens, scores) -> (data', lens', scores', meta)

@@ -87,7 +87,10 @@ def make_sharded_fuzzer(mesh: Mesh, batch: int, mutator_pri=None, pattern_pri=No
         keys = prng.sample_keys(ckey, batch)
         keys = jax.lax.with_sharding_constraint(keys, lsh)
         data = jax.lax.with_sharding_constraint(data, dsh)
-        out, n_out, sc, meta = fuzz_batch(keys, data, lens, scores, pri, pat_pri)
+        # slices=0: the rounds-sorted path is single-device only — under
+        # pjit its argsort/gather would turn into cross-device collectives
+        out, n_out, sc, meta = fuzz_batch(keys, data, lens, scores, pri,
+                                          pat_pri, slices=0)
         return (
             jax.lax.with_sharding_constraint(out, dsh),
             n_out,

@@ -252,3 +252,35 @@ def test_crc32_device_matches_zlib():
     sfx = np.asarray(crc32_suffixes(d, e))
     for a in (0, 1, 57, 112, 113):
         assert int(sfx[a]) == zlib.crc32(raw[a:e].tobytes()) & 0xFFFFFFFF
+
+
+def test_slices_bit_identical(state):
+    """The rounds-sorted slices path is a pure execution regrouping: every
+    output (data/lens/scores/meta) must be bit-identical to the unsliced
+    path, for divisible and non-divisible slice counts and the auto pick."""
+    from erlamsa_tpu.ops.patterns import DEFAULT_PATTERN_PRI_NP
+    from erlamsa_tpu.ops.registry import DEFAULT_DEVICE_PRI
+    import jax.numpy as jnp
+
+    base, scores = state
+    # B=100 is deliberately not a power of two: slices=8 hits the halving
+    # fallback and lands on a REAL partition (8 -> 4, 100 % 4 == 0), and
+    # slices=10 divides exactly — both paths must match unsliced output
+    nb = 100
+    batch = pack(SEEDS[:nb], capacity=L)
+    keys = prng.sample_keys(prng.case_key(base, 3), nb)
+    sc = scores[:nb]
+    pri = jnp.asarray(np.asarray(DEFAULT_DEVICE_PRI, np.int32))
+    pat_pri = jnp.asarray(DEFAULT_PATTERN_PRI_NP)
+
+    ref = fuzz_batch(keys, batch.data, batch.lens, sc, pri, pat_pri, slices=0)
+    for s in (8, 10, "auto"):
+        got = fuzz_batch(keys, batch.data, batch.lens, sc, pri, pat_pri,
+                         slices=s)
+        for name, a, b in zip(
+            ("data", "lens", "scores", "pattern", "applied"),
+            (*ref[:3], *ref[3]), (*got[:3], *got[3]),
+        ):
+            assert np.array_equal(np.asarray(a), np.asarray(b)), (
+                f"slices={s}: {name} diverged from unsliced run"
+            )
