@@ -116,6 +116,40 @@ def _run_stage(jax, base, batch_n: int, seed_len: int, capacity: int,
             os.environ.pop("ERLAMSA_PALLAS", None)
 
 
+def _run_full_set_stage(batch_n: int, seed_len: int, cases: int, t0: float):
+    """The honest product number: end-to-end throughput with the FULL
+    reference mutator set at default weights — device mutators ride
+    fuzz_batch, the structured tail (sgm/js/ab/ad/tree/fuse/len/b64/uri/
+    zip) routes through the hybrid dispatcher's host oracle pool, exactly
+    the services/batchrunner.py path a `--backend tpu` CLI run takes.
+
+    Returns (warm_samples_per_sec, host_routed_fraction). Warm = the first
+    case (which pays trace+compile) is dropped via the runner's per-case
+    finish timestamps; needs cases >= 2.
+    """
+    from erlamsa_tpu.services.batchrunner import run_tpu_batch
+
+    stats: dict = {}
+    opts = {
+        "corpus": make_seeds(batch_n, seed_len),
+        "seed": (1, 2, 3),
+        "n": max(2, cases),
+        "output": os.devnull,
+        "_stats": stats,
+    }
+    rc = run_tpu_batch(opts, batch=batch_n)
+    if rc != 0 or len(stats.get("finish_times", [])) < 2:
+        raise RuntimeError(f"full-set stage failed rc={rc} stats={stats}")
+    ft = stats["finish_times"]
+    warm_sps = batch_n * (len(ft) - 1) / (ft[-1] - ft[0])
+    host_frac = stats["host_total"] / max(stats["total"], 1)
+    _phase(
+        f"full-set stage: {warm_sps:,.0f} samples/s warm, "
+        f"{host_frac:.1%} host-routed", t0,
+    )
+    return warm_sps, host_frac
+
+
 def child_main() -> None:
     """The measured run. Writes its JSON record to $ERLAMSA_BENCH_RESULT
     (and stdout); phase timings go to stderr.
@@ -170,6 +204,20 @@ def child_main() -> None:
             record["fallback"] = True
         line = json.dumps(record)
         _write_result(line)  # banked immediately; overwritten by next stage
+
+    # the device-subset number above is the kernel-engine metric; the
+    # full-set stage below is the end-to-end product number (default
+    # weights, host pool busy). Device record stays banked if this fails.
+    try:
+        full_sps, host_frac = _run_full_set_stage(
+            BATCH, SEED_LEN, max(2, ITERS // 3), t0
+        )
+        record["full_set_samples_per_sec"] = round(full_sps, 1)
+        record["full_set_host_routed_frac"] = round(host_frac, 4)
+        line = json.dumps(record)
+        _write_result(line)
+    except Exception as e:  # noqa: BLE001 — device number still stands
+        _phase(f"full-set stage FAILED: {type(e).__name__}: {e}", t0)
     print(line)
 
 
@@ -275,7 +323,10 @@ def parent_main() -> None:
     env.pop("PALLAS_AXON_POOL_IPS", None)
     env["JAX_PLATFORMS"] = "cpu"
     env["ERLAMSA_BENCH_FALLBACK"] = "1"
-    env.setdefault("ERLAMSA_BENCH_BATCH", "128")
+    # reduced L (cache-resident footprint) but FULL batch: with auto
+    # slicing the CPU engine is fastest at large B (PROFILE.md), and the
+    # fallback number should show the engine at its best on this host
+    env.setdefault("ERLAMSA_BENCH_BATCH", "2048")
     env.setdefault("ERLAMSA_BENCH_SEED_LEN", "1024")
     env.setdefault("ERLAMSA_BENCH_CAPACITY", "4096")
     env.setdefault("ERLAMSA_BENCH_ITERS", "3")

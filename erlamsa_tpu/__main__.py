@@ -1,8 +1,15 @@
 """python -m erlamsa_tpu — the CLI entry point (the reference's escript
-main, src/erlamsa.erl:5-17)."""
+main, src/erlamsa.erl:5-17).
+
+The __main__ guard is load-bearing: the hybrid dispatcher's host pool
+spawns worker processes, and multiprocessing re-executes the parent's
+main module in each worker under __mp_main__ — without the guard every
+worker would re-run the whole CLI.
+"""
 
 import sys
 
 from .services.cli import main
 
-sys.exit(main())
+if __name__ == "__main__":
+    sys.exit(main())

@@ -711,8 +711,8 @@ def _mask_transform(key, p, out):
     three separate randint streams — one raw-bits draw is 3x cheaper per
     round and the per-byte marginals are identical (disjoint bit ranges of
     a threefry word are independent). Distribution change only: snand/srnd
-    byte streams differ from pre-r3 engines (engine-version note in
-    ops/pipeline.py).
+    byte streams differ from pre-r3 engines (see the ENGINE VERSION NOTE
+    in ops/pipeline.py:fuzz_sample's docstring).
     """
     L = out.shape[0]
     i = jnp.arange(L, dtype=jnp.int32)
@@ -745,36 +745,12 @@ def _apply_composite(key, p, data, n, starts, lens, nlines):
     return out, n_out
 
 
-def _apply_mask(key, p, data, n):
-    from .pallas_kernels import pallas_enabled, randmask_single
-
-    if pallas_enabled():
-        # Pallas path: random bits come from the TPU hardware PRNG inside
-        # the kernel (threefry bits in interpret mode off-TPU)
-        params_row = jnp.stack(
-            [p["ps"], p["pl"], p["mask_op"], p["mask_prob"],
-             (p["kind"] == K_MASK).astype(jnp.int32)]
-        ).astype(jnp.int32)
-        out = randmask_single(prng.sub(key, prng.TAG_VAL), params_row, data)
-        return out, n
-
-    L = data.shape[0]
-    i = jnp.arange(L, dtype=jnp.int32)
-    active = p["kind"] == K_MASK
-    in_span = (i >= p["ps"]) & (i < p["ps"] + p["pl"])
-    kb = jax.random.split(prng.sub(key, prng.TAG_VAL), 3)
-    occurs_n = jax.random.randint(kb[0], (L,), 0, 100, dtype=jnp.int32)
-    occurs = jnp.where(p["mask_prob"] == 1, occurs_n != 0, occurs_n < p["mask_prob"])
-    bit = jax.random.randint(kb[1], (L,), 0, 8, dtype=jnp.int32)
-    rnd = jax.random.randint(kb[2], (L,), 0, 256, dtype=jnp.int32).astype(jnp.uint8)
-    one = jnp.left_shift(jnp.uint8(1), bit.astype(jnp.uint8))
-    masked = jnp.select(
-        [p["mask_op"] == 0, p["mask_op"] == 1, p["mask_op"] == 2],
-        [data & ~one, data | one, data ^ one],
-        rnd,
-    )
-    out = jnp.where(in_span & occurs, masked, data)
-    return jnp.where(active, out, data), n
+# NOTE: the standalone _apply_mask was deleted in r4 (ADVICE r3): unlike
+# the movement applies above it was only distribution-equivalent to the
+# composite's _mask_transform (different random streams), so it could not
+# be pinned by the composite-equivalence test that now guards
+# _apply_splice/_apply_swap/_apply_perm_bytes/_apply_perm_lines
+# (tests/test_fused.py::test_composite_matches_standalone_applies).
 
 
 # --- fused scheduler step -------------------------------------------------
@@ -821,8 +797,10 @@ def fused_mutate_step(key, data, n, scores, pri):
     else:
         # one gather + one transform for the whole round (the kinds are
         # mutually exclusive, so the four movement passes collapse into a
-        # single kind-selected index map — bit-identical to running the
-        # standalone applies in sequence)
+        # single kind-selected index map — bit-identical to the standalone
+        # movement applies, pinned by test_composite_matches_standalone_
+        # applies; the MASK kinds are distribution-equivalent only, see
+        # _mask_transform's docstring)
         out, n1 = _apply_composite(
             site_key, params, data, n, t.line_starts, t.line_lens, t.nlines
         )

@@ -70,6 +70,13 @@ def fuzz_sample(key, data, n, scores, pri, pat_pri, engine: str = "fused",
     NOTE: the two engines draw sp/lp permutations differently (fused caps
     the window), so (seed, case) reproducibility holds only within one
     engine; record the engine alongside the seed when archiving cases.
+
+    ENGINE VERSION NOTE (r3): the fused engine's snand/srnd byte streams
+    changed when _mask_transform switched to one bit-sliced uint32 draw
+    per byte (ops/fused.py) — per-byte marginals identical, streams not.
+    (seed, case) replay of pre-r3 archives reproduces structure but not
+    the exact mask bytes; re-archive under the current engine for
+    bit-exact replay.
     """
     if engine not in ENGINES:
         raise ValueError(f"engine must be one of {ENGINES}, got {engine!r}")
@@ -82,18 +89,23 @@ def fuzz_sample(key, data, n, scores, pri, pat_pri, engine: str = "fused",
 
     pat, rounds, skip = pattern_plan(prng.sub(key, prng.TAG_PROB), n, pat_pri)
 
-    # sz: mutate only the blob behind a detected tail length field, then
-    # rewrite the field with the blob's new length (vectorized sizer scan,
-    # ops/sizer.py). Not found -> degenerates to an od-ish whole-buffer pass.
+    # sz: mutate only the blob behind a detected length field, then rewrite
+    # the field with the blob's new length (vectorized sizer scan,
+    # ops/sizer.py). The field's end may be interior (near-tail deltas or
+    # sampled interior probes, like the oracle's var_b draws) — bytes past
+    # the blob's end are held out of mutation and re-attached after the
+    # rounds. Not found -> degenerates to an od-ish whole-buffer pass.
     if enable_sizer:
-        found, field_a, field_w, field_kind = detect_sizer(
+        found, field_a, field_w, field_kind, field_end = detect_sizer(
             prng.sub(key, prng.TAG_LEN), data, n
         )
         use_sz = (pat == SZ) & found
         skip = jnp.where(use_sz, field_a + field_w, skip)
+        sz_tail = jnp.where(use_sz, jnp.maximum(n - field_end, 0), 0)
     else:
         use_sz = jnp.bool_(False)
-        field_a = field_w = field_kind = jnp.int32(0)
+        field_a = field_w = field_kind = field_end = jnp.int32(0)
+        sz_tail = jnp.int32(0)
 
     # cs: mutate the body behind a detected trailer checksum (xor8 1-byte
     # or big-endian crc32 4-byte, ops/crc32.py), keep the preamble,
@@ -115,6 +127,10 @@ def fuzz_sample(key, data, n, scores, pri, pat_pri, engine: str = "fused",
     # the checksum bytes themselves are held out of the mutable region
     if enable_csum:
         wn = jnp.where(use_cs, jnp.maximum(wn - cs_w, 0), wn)
+    # interior sizer: only the blob [skip, field_end) is mutable; the
+    # original tail re-attaches after the rounds
+    if enable_sizer:
+        wn = jnp.where(use_sz, jnp.maximum(wn - sz_tail, 0), wn)
 
     from .pallas_kernels import pallas_rounds_enabled
 
@@ -156,10 +172,17 @@ def fuzz_sample(key, data, n, scores, pri, pat_pri, engine: str = "fused",
     if enable_sizer:
         # field value = the blob length that actually fit (splice may have
         # truncated growth at capacity), not the pre-truncation wn
+        blob_len = jnp.maximum(n_out - skip, 0)
+        # interior sizer: re-attach the original bytes past the blob's end
+        L = data.shape[0]
+        i = jnp.arange(L, dtype=jnp.int32)
+        tail_src = data[jnp.clip(i - n_out + field_end, 0, L - 1)]
+        in_tail = use_sz & (i >= n_out) & (i < n_out + sz_tail)
+        out = jnp.where(in_tail, tail_src, out)
+        n_out = jnp.where(use_sz, jnp.minimum(n_out + sz_tail, L), n_out)
         out = jnp.where(
             use_sz,
-            rebuild_sizer(out, n_out, field_a, field_w, field_kind,
-                          jnp.maximum(n_out - skip, 0)),
+            rebuild_sizer(out, n_out, field_a, field_w, field_kind, blob_len),
             out,
         )
     if enable_csum:

@@ -8,11 +8,20 @@ offset is tested simultaneously for u8/u16/u32 big/little fields whose
 value equals the distance to the end of the buffer — one vector pass
 instead of hundreds of per-offset re-reads.
 
-Scope vs the oracle: device sizers are *tail* sizers (blob runs to the end
-of the sample, the overwhelmingly common layout); the oracle also samples
-random interior end offsets. The checksum-preserving (cs) pattern runs on
-device too — ops/crc32.py decomposes crc32 as a GF(2)-linear suffix scan
-(and xor8 trivially), wired into the pipeline's cs branch.
+Scope vs the oracle: the device detects tail sizers (blob ends at n — the
+overwhelmingly common layout), the reference's near-tail delta probes
+(ends n-1, n-2, n-4, n-8, and for u8 fields every n-x down to n-8,
+erlamsa_field_predict.erl simple_len/simple_u8len), AND sampled interior
+ends like the oracle's random var_b draws — the key identity being that a
+candidate's end offset is DERIVED from its field value (end = value +
+offset + width), so interior support is a membership test on the same
+[5, L] masks, not a mask explosion. Documented divergences: the device
+draws a fixed 4 interior probes per sample (the oracle draws sublen+1,
+scaling with n) and restricts only interior candidates to the reference's
+a <= sublen window (tail/near-tail candidates keep the device's historic
+any-offset scope). The checksum-preserving (cs) pattern runs on device
+too — ops/crc32.py decomposes crc32 as a GF(2)-linear suffix scan (and
+xor8 trivially), wired into the pipeline's cs branch.
 """
 
 from __future__ import annotations
@@ -20,20 +29,26 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
-from ..constants import PREAMBLE_MAX_BYTES
+from ..constants import PREAMBLE_MAX_BYTES, SIZER_MAX_FIRST_BYTES
 from . import prng
 
 # field kinds: (width_bytes, endianness) — index into these tables
 KIND_U8, KIND_U16BE, KIND_U16LE, KIND_U32BE, KIND_U32LE = range(5)
 _WIDTHS = (1, 2, 4)
 
+N_INTERIOR_PROBES = 4  # keyed interior-end draws per sample (fixed; see top)
+
 
 def detect_sizer(key, data, n):
-    """Find a random plausible tail length field.
+    """Find a random plausible length field (tail, near-tail, or sampled
+    interior end).
 
-    Returns (found, a, width_bytes, kind): field at [a, a+width), value ==
-    n - a - width (> 2). One uniform pick among all candidates via keyed
-    argmax.
+    Returns (found, a, width_bytes, kind, end): field at [a, a+width)
+    whose value v > 2 satisfies a + width + v == end, where end is n, a
+    near-tail delta (reference simple_len/simple_u8len probes), or one of
+    N_INTERIOR_PROBES keyed draws from [sublen, n) (the oracle's var_b
+    sampling, erlamsa_field_predict.erl:90-105). One uniform pick among
+    all candidates via keyed cumsum order.
     """
     L = data.shape[0]
     i = jnp.arange(L, dtype=jnp.int32)
@@ -49,13 +64,34 @@ def detect_sizer(key, data, n):
     v_u32be = ((b0 * 256 + b1) * 256 + b2) * 256 + b3
     v_u32le = ((b3 * 256 + b2) * 256 + b1) * 256 + b0
 
-    cands = []
-    for kind, (v, w) in enumerate(
-        ((v_u8, 1), (v_u16be, 2), (v_u16le, 2), (v_u32be, 4), (v_u32le, 4))
-    ):
-        want = n - i - w
-        ok = (v == want) & (v > 2) & (i + w < n)
+    # interior end probes: uniform in [sublen, n) like the oracle's
+    # rand_range(SubLen, Len); a candidate may only sit in the reference's
+    # first-bytes window for these
+    sublen = jnp.minimum(n // 5, SIZER_MAX_FIRST_BYTES)
+    kp = prng.sub(key, prng.TAG_LEN)
+    probes = [
+        sublen + prng.rand(prng.sub(kp, j + 1),
+                           jnp.maximum(n - sublen, 1)).astype(jnp.int32)
+        for j in range(N_INTERIOR_PROBES)
+    ]
+
+    kinds = ((v_u8, 1), (v_u16be, 2), (v_u16le, 2), (v_u32be, 4), (v_u32le, 4))
+    cands, vals = [], []
+    for kind, (v, w) in enumerate(kinds):
+        e = v + i + w  # the end offset this field value implies
+        dlt = n - e
+        if w == 1:
+            # u8 probes every end from n down to n-8 (simple_u8len)
+            near = (dlt >= 0) & (dlt <= 8)
+        else:
+            near = (dlt == 0) | (dlt == 1) | (dlt == 2) | (dlt == 4) | (dlt == 8)
+        interior = jnp.zeros_like(near)
+        for p in probes:
+            interior = interior | (e == p)
+        interior = interior & (i <= sublen)
+        ok = (v > 2) & (e <= n) & (near | interior)
         cands.append(ok)
+        vals.append(v)
     cand = jnp.stack(cands)  # [5, L]
 
     # uniform pick with ONE scalar draw: r-th candidate in flat cumsum order
@@ -68,7 +104,9 @@ def detect_sizer(key, data, n):
     kind = (flat // L).astype(jnp.int32)
     a = (flat % L).astype(jnp.int32)
     width = jnp.asarray((1, 2, 2, 4, 4), jnp.int32)[kind]
-    return any_found, a, width, kind
+    val = jnp.stack(vals)[kind, a]
+    end = jnp.minimum(val + a + width, n)
+    return any_found, a, width, kind, end
 
 
 def xor8_candidates(data, n):

@@ -22,9 +22,14 @@ import numpy as np
 from . import logger, out
 
 
-def _load_corpus(paths: list[str], recursive: bool) -> list[bytes]:
+def _load_corpus(paths: list[str], recursive: bool,
+                 direct: list[bytes] | None = None) -> list[bytes]:
     from ..oracle.gen import _expand_paths
 
+    if direct is not None:
+        # in-process callers (bench full-set stage, tests) hand the corpus
+        # over directly instead of staging files
+        return list(direct)
     if paths in ([], ["-"]):
         data = sys.stdin.buffer.read()
         return [data]
@@ -45,7 +50,8 @@ def run_tpu_batch(opts: dict, batch: int = 1024) -> int:
     from ..ops.registry import DEVICE_CODES
     from ..ops.scheduler import init_scores
 
-    seeds = _load_corpus(opts.get("paths", ["-"]), opts.get("recursive", False))
+    seeds = _load_corpus(opts.get("paths", ["-"]), opts.get("recursive", False),
+                         direct=opts.get("corpus"))
     if not seeds:
         print("no corpus", file=sys.stderr)
         return 1
@@ -185,6 +191,7 @@ def run_tpu_batch(opts: dict, batch: int = 1024) -> int:
     writer, _mt = out.string_outputs(opts.get("output", "-"))
     total = 0
     host_total = 0
+    stats = opts.get("_stats")  # caller-owned dict for measured numbers
     # checkpoint cadence: an fsync'd save per case throttles short cases;
     # a coarser interval re-runs at most (interval-1) deterministic cases
     # after a crash
@@ -246,6 +253,11 @@ def run_tpu_batch(opts: dict, batch: int = 1024) -> int:
                 sys.stdout.buffer.write(payload)
         total += len(results)
         host_total += len(host_idx) + len(overflow_idx)
+        if stats is not None:
+            # per-case completion timestamps: callers that measure warm
+            # throughput (bench full-set stage) drop the first case's
+            # compile+trace cost by differencing these
+            stats.setdefault("finish_times", []).append(time.perf_counter())
         if state_path and ((case + 1 - start_case) % ckpt_every == 0
                            or case + 1 == n_cases):
             save_state(state_path, opts["seed"], case + 1, scores_after,
@@ -276,6 +288,8 @@ def run_tpu_batch(opts: dict, batch: int = 1024) -> int:
         host_pool.shutdown(wait=False, cancel_futures=True)
         hybrid.close()
     dt = time.perf_counter() - t0
+    if stats is not None:
+        stats.update(total=total, host_total=host_total, dt=dt, batch=batch)
     logger.log("info", "tpu backend: %d samples in %.2fs (%.0f samples/s)",
                total, dt, total / max(dt, 1e-9))
     print(

@@ -113,7 +113,12 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("-l", "--list", action="store_true", help="list engines")
     p.add_argument("-v", "--verbose", action="count", default=0)
     p.add_argument("-L", "--logger", default=None,
-                   help="log spec: stdout|stderr|file=path[,level]")
+                   help="log spec: stdout|stderr|file=path|sqlite=path "
+                        "(sqlite is the queryable findings store; see "
+                        "--list-findings)")
+    p.add_argument("--list-findings", default=None, metavar="DB",
+                   help="print findings recorded in a '-L sqlite=DB' "
+                        "store from any past run, then exit")
     p.add_argument("-M", "--meta", default=None, help="write metadata to path")
     p.add_argument("-r", "--recursive", action="store_true")
     p.add_argument("-H", "--httpsvc", default=None, help="run FaaS at host:port")
@@ -165,6 +170,14 @@ def main(argv=None) -> int:
         _show_list()
         return 0
 
+    if args.list_findings:
+        rows = logger.query_log(args.list_findings, level="finding",
+                                limit=None)
+        for _id, ts, _level, message in rows:
+            print(f"{ts}\t{message}")
+        print(f"# {len(rows)} finding(s)", file=sys.stderr)
+        return 0
+
     if args.logger:
         spec = {}
         for part in args.logger.split(","):
@@ -172,6 +185,8 @@ def main(argv=None) -> int:
                 spec[part] = "debug" if args.verbose else "info"
             elif part.startswith("file="):
                 spec["file"] = (part[5:], "debug")
+            elif part.startswith("sqlite="):
+                spec["sqlite"] = (part[7:], "debug")
         logger.GLOBAL.configure(spec)
 
     try:
@@ -277,9 +292,17 @@ def main(argv=None) -> int:
     if args.backend == "tpu":
         from .batchrunner import run_tpu_batch
 
-        return run_tpu_batch(opts, batch=args.batch)
+        try:
+            return run_tpu_batch(opts, batch=args.batch)
+        finally:
+            logger.GLOBAL.flush()
 
-    return _run_oracle(opts)
+    try:
+        return _run_oracle(opts)
+    finally:
+        # findings from the last cases must reach durable sinks (sqlite/
+        # file) before the daemon drain thread dies with the process
+        logger.GLOBAL.flush()
 
 
 def _run_oracle(opts: dict) -> int:

@@ -1,0 +1,28 @@
+"""The hybrid dispatcher's host-pool worker, in a deliberately jax-free
+module: process-pool workers (spawn) import the function's defining module
+on unpickle, and in this image a bare ``import jax`` can BLOCK when the
+axon TPU relay is wedged — so the worker lives here, where the transitive
+imports are only the oracle engine and the watchdog (both pure Python).
+tests/test_hybrid.py pins the no-jax property.
+"""
+
+from __future__ import annotations
+
+
+def host_worker(args):
+    """One host-routed oracle case, a pure function of its args so results
+    are identical across thread and process pools."""
+    i, data, ts, host_rows, budget = args
+    from ..oracle.engine import Engine
+    from ..utils.watchdog import CaseTimeout, run_with_timeout
+
+    def case():
+        eng = Engine({"paths": ["direct"], "input": data, "seed": ts,
+                      "n": 1, "mutations": host_rows})
+        return eng.run_case(1)
+
+    try:
+        out, meta = run_with_timeout(case, budget)
+    except CaseTimeout:
+        return i, None, []
+    return i, out, meta

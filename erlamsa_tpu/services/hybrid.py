@@ -31,6 +31,7 @@ import numpy as np
 
 from ..ops.registry import DEVICE_CODES, HOST_CODES
 from ..utils.bytehelpers import binarish
+from .hostpool import host_worker as _host_worker
 
 
 def sample_traits(data: bytes) -> dict:
@@ -120,9 +121,25 @@ class HybridDispatcher:
         self.max_running_time = max_running_time
         self._appl_cache: np.ndarray | None = None
         self._appl_corpus: list | None = None
-        self._pool = cf.ThreadPoolExecutor(
-            max_workers=host_workers or min(8, (os.cpu_count() or 2))
+        workers = host_workers or min(8, (os.cpu_count() or 2))
+        # The oracle is pure Python, so a thread pool is GIL-bound — the
+        # reference gets REAL parallelism from Erlang processes. On
+        # multicore hosts use a spawn process pool (spawn, not fork: the
+        # parent may hold an initialized TPU backend, and the oracle path
+        # imports no jax so spawned workers stay accelerator-free).
+        # ERLAMSA_HOST_POOL=thread|process overrides.
+        kind = os.environ.get(
+            "ERLAMSA_HOST_POOL",
+            "process" if (os.cpu_count() or 1) > 1 else "thread",
         )
+        if kind == "process":
+            import multiprocessing as mp
+
+            self._pool: cf.Executor = cf.ProcessPoolExecutor(
+                max_workers=workers, mp_context=mp.get_context("spawn")
+            )
+        else:
+            self._pool = cf.ThreadPoolExecutor(max_workers=workers)
 
     def _applicability(self, seeds: list[bytes]) -> np.ndarray:
         """bool[B, H]: host row h applicable to sample b. Computed once per
@@ -185,32 +202,21 @@ class HybridDispatcher:
         feed the evolving host scores. A case exceeding max_running_time
         is abandoned (absent from the result dict), so the batch loop
         never stalls on one adversarial sample."""
-        from ..oracle.engine import Engine
-        from ..utils.watchdog import CaseTimeout, run_with_timeout
-
-        def one(item):
-            i, data = item
-            ts = (
+        def ts_for(i: int):
+            return (
                 (self.seed[0], self.seed[1] ^ case_idx,
                  self.seed[2] ^ (i + 1))
                 if isinstance(self.seed, tuple)
                 else (1, case_idx, i + 1)
             )
 
-            def case():
-                eng = Engine({"paths": ["direct"], "input": data, "seed": ts,
-                              "n": 1, "mutations": self.host_rows})
-                return eng.run_case(1)
-
-            try:
-                out, meta = run_with_timeout(case, self.max_running_time)
-            except CaseTimeout:
-                return i, None, []
-            return i, out, meta
-
+        jobs = [
+            (i, data, ts_for(i), self.host_rows, self.max_running_time)
+            for i, data in idx_seeds
+        ]
         results = {}
         metas = []
-        for i, out, meta in self._pool.map(one, idx_seeds):
+        for i, out, meta in self._pool.map(_host_worker, jobs):
             if out is None:
                 continue
             results[i] = out

@@ -42,7 +42,8 @@ class Logger:
 
     def configure(self, spec: dict):
         """spec like the -L options: {"stdout": level, "file": (path, level),
-        "csv": (path, level), "syslog": (host, port, level)}
+        "csv": (path, level), "syslog": (host, port, level),
+        "sqlite": (path, level)}
         (erlamsa_logger:build_logger, src/erlamsa_logger.erl:194-228)."""
         if "stdout" in spec:
             self.add_sink(spec["stdout"], lambda s: print(s, flush=True))
@@ -66,6 +67,9 @@ class Logger:
             self.add_sink(
                 level, lambda s: sock.sendto(b"<134>" + s.encode(), (host, port))
             )
+        if "sqlite" in spec:
+            path, level = spec["sqlite"]
+            self.add_sink(level, SqliteSink(path))
         if spec.get("no_io_logging"):
             self._log_data = False
 
@@ -83,6 +87,21 @@ class Logger:
                         write(line)
                     except Exception:
                         pass
+            self._q.task_done()
+
+    def flush(self, timeout: float = 5.0) -> None:
+        """Block until queued records have reached the sinks (bounded).
+        The drain thread is a daemon — without this, records logged just
+        before process exit (a finding from the last case, typically the
+        most interesting one) could be lost."""
+        if self._thread is None:
+            return
+        with self._q.all_tasks_done:
+            end = time.monotonic() + timeout
+            while self._q.unfinished_tasks:
+                left = end - time.monotonic()
+                if left <= 0 or not self._q.all_tasks_done.wait(left):
+                    break
 
     def log(self, level: str, fmt: str, *args):
         """Fire-and-forget (erlamsa_logger:log/3)."""
@@ -99,6 +118,69 @@ class Logger:
         payload = data[:MAX_LOG_DATA]
         shown = payload.hex() if render == "hex" else repr(payload)
         self.log(level, (fmt % tuple(args) if args else fmt) + " " + shown)
+
+
+class SqliteSink:
+    """Queryable log sink: the reference can log into an mnesia table and
+    query findings after the run (erlamsa_logger.erl:194-228 + the mnesia
+    sink wiring); here the durable, file-based analogue is sqlite. Every
+    row commits immediately — findings must survive the very crash they
+    describe — and the connection is lock-guarded because the drain thread
+    writes while CLI queries may read from elsewhere."""
+
+    def __init__(self, path: str):
+        import sqlite3
+
+        self._conn = sqlite3.connect(path, check_same_thread=False)
+        self._conn.execute(
+            "CREATE TABLE IF NOT EXISTS log ("
+            " id INTEGER PRIMARY KEY AUTOINCREMENT,"
+            " ts TEXT, level TEXT, message TEXT)"
+        )
+        self._conn.execute(
+            "CREATE INDEX IF NOT EXISTS log_level ON log(level)"
+        )
+        self._conn.commit()
+        self._lock = threading.Lock()
+
+    def __call__(self, line: str) -> None:
+        parts = line.split("\t", 2)
+        ts, level, msg = (parts if len(parts) == 3 else ("", "info", line))
+        with self._lock:
+            self._conn.execute(
+                "INSERT INTO log (ts, level, message) VALUES (?, ?, ?)",
+                (ts, level, msg),
+            )
+            self._conn.commit()
+
+
+def query_log(path: str, level: str | None = None, like: str | None = None,
+              limit: int | None = 1000) -> list[tuple[int, str, str, str]]:
+    """Read entries back from a SqliteSink database — usable after the
+    logged-about process is long gone (the restored mnesia capability).
+    level filters exactly; like is a substring match on the message;
+    limit=None returns everything."""
+    import sqlite3
+
+    conn = sqlite3.connect(path)
+    try:
+        q = "SELECT id, ts, level, message FROM log"
+        cond, params = [], []
+        if level is not None:
+            cond.append("level = ?")
+            params.append(level)
+        if like is not None:
+            cond.append("message LIKE ?")
+            params.append(f"%{like}%")
+        if cond:
+            q += " WHERE " + " AND ".join(cond)
+        q += " ORDER BY id"
+        if limit is not None:
+            q += " LIMIT ?"
+            params.append(limit)
+        return list(conn.execute(q, params))
+    finally:
+        conn.close()
 
 
 GLOBAL = Logger()

@@ -8,10 +8,16 @@ writer; network failure raises so the main loop can back off
     "-"                      stdout
     "template%n.ext"         per-case files (%n = case number)
     "tcp://host:port"        TCP client     "tcp://:port" listen
-    "udp://host:port"        UDP client
-    "http://url"             HTTP POST
+    "udp://host:port"        UDP client     "udp://:port" listen (reply
+                             to whoever sends a datagram first)
+    "http://url"             HTTP POST      "http://:port[,Content-Type]"
+                             serve fuzz as a 200 response per connection
     "exec://cmdline"         spawn target, feed stdin (erlexec analogue)
     "serial://dev:baud"      serial device (termios)
+    "can://iface:id"         SocketCAN 8-byte frames
+    "canisotp://iface:id"    SocketCAN with ISO-TP framing (iso_tpish)
+    "cansockd://host:port:iface:id"            cansockd daemon client
+    "cansockd_isotp://host:port:iface:sid:did" cansockd ISO-TP mode
 """
 
 from __future__ import annotations
@@ -95,6 +101,56 @@ def _tcp_listen_writer(port: int) -> Writer:
         conn, _addr = srv.accept()
         try:
             conn.sendall(data)
+        finally:
+            conn.close()
+
+    return write
+
+
+def _udp_listen_writer(port: int) -> Writer:
+    """UDP listen mode (erlamsa_out.erl udplisten_writer): bind once; each
+    case blocks for an incoming datagram, then sends the fuzzed case back
+    to that sender — the UDP analogue of serve-on-connect."""
+    sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    sock.bind(("0.0.0.0", port))
+
+    def write(case_idx: int, data: bytes, meta: list) -> None:
+        packet, addr = sock.recvfrom(65535)
+        logger.log("info", "udp message received [case %d] from %s:%d (%d bytes)",
+                   case_idx, addr[0], addr[1], len(packet))
+        sock.sendto(data, addr)
+
+    return write
+
+
+def _http_listen_writer(port: int, content_type: str) -> Writer:
+    """HTTP server mode (erlamsa_out.erl:424-445 make_http_server_reply +
+    streamlisten_writer wiring): serve each connecting client one fuzzed
+    case as a complete 200 response. The request itself is read best-effort
+    and logged — fuzzing clients often send junk; we answer regardless."""
+    srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    srv.bind(("0.0.0.0", port))
+    srv.listen(16)
+
+    def write(case_idx: int, data: bytes, meta: list) -> None:
+        conn, addr = srv.accept()
+        try:
+            conn.settimeout(5)
+            try:
+                req = conn.recv(65535)
+                logger.log("info",
+                           "http client connect from %s:%d [case %d], "
+                           "request %d bytes", addr[0], addr[1], case_idx,
+                           len(req))
+            except OSError:
+                pass  # reply anyway, like the reference
+            head = (
+                f"HTTP/1.1 200 OK\r\nContent-type: {content_type}\r\n"
+                f"Content-Length: {len(data)}\r\n\r\n"
+            ).encode()
+            conn.sendall(head + data)
         finally:
             conn.close()
 
@@ -242,10 +298,38 @@ def _serial_writer(dev: str, baud: int) -> Writer:
     return write
 
 
-def _can_writer(iface: str, can_id: int) -> Writer:
-    """SocketCAN output (the cansockd path, erlamsa_out.erl cansockd
-    writers): each fuzzed case streams as 8-byte CAN frames. Gated on
-    AF_CAN support and the interface existing."""
+def iso_tpish(data: bytes) -> bytes:
+    """ISO-TP-style framing of one fuzzed case (erlamsa_out.erl:493-521
+    iso_tpish): <7 bytes -> one single frame ``0x0|len``; otherwise a
+    first frame ``0x1|len:12`` carrying 6 bytes, then consecutive frames
+    ``0x2|idx:4`` of 7 bytes each. The index wraps at 16 via 4-bit
+    truncation — and, matching the reference's clause order exactly, a
+    trailing PARTIAL frame whose index has passed 15 resets to 0 rather
+    than wrapping mod 16."""
+    n = len(data)
+    if n < 7:
+        return bytes([n & 0x0F]) + data
+    out = bytearray([0x10 | ((n >> 8) & 0x0F), n & 0xFF])
+    out += data[:6]
+    idx, off = 0, 6
+    while off < n:
+        chunk = data[off : off + 7]
+        if len(chunk) < 7 and idx > 15:
+            idx = 0
+        out.append(0x20 | (idx & 0x0F))
+        out += chunk
+        idx += 1
+        off += 7
+    return bytes(out)
+
+
+def _can_writer(iface: str, can_id: int, isotp: bool = False) -> Writer:
+    """SocketCAN output: each fuzzed case streams as 8-byte CAN frames,
+    optionally ISO-TP framed first (canisotp://). The reference reaches
+    CAN through its cansockd TCP daemon (erlamsa_out.erl cansockd
+    writers); talking SocketCAN directly is this framework's native
+    equivalent — the daemon client forms exist too (_cansockd_writer).
+    Gated on AF_CAN support and the interface existing."""
     import struct
 
     if not hasattr(socket, "AF_CAN"):
@@ -259,14 +343,82 @@ def _can_writer(iface: str, can_id: int) -> Writer:
         can_id |= socket.CAN_EFF_FLAG
 
     def write(case_idx: int, data: bytes, meta: list) -> None:
+        payload = iso_tpish(data) if isotp else data
         try:
-            for off in range(0, len(data), 8):
-                chunk = data[off : off + 8]
+            for off in range(0, len(payload), 8):
+                chunk = payload[off : off + 8]
                 # '=' = native byte order, matching the kernel's can_frame
                 frame = struct.pack("=IB3x8s", can_id, len(chunk),
                                     chunk.ljust(8, b"\x00"))
                 sock.send(frame)
         except OSError as e:
+            raise CantConnect(str(e)) from e
+
+    return write
+
+
+def _hexstr(data: bytes, sep: str) -> str:
+    return sep.join(f"{b:02X}" for b in data) + (sep if sep and data else "")
+
+
+def _cansockd_writer(host: str, port: int, iface: str, can_id: str) -> Writer:
+    """cansockd daemon client (erlamsa_out.erl cansockd_writer /
+    make_cansockd_cmd): one persistent TCP connection; every case opens
+    with ``< open iface >`` and streams 8-byte chunks as
+    ``< send ID LEN HH HH ... >`` text commands."""
+    state: dict = {"sock": None}
+
+    def _sock() -> socket.socket:
+        if state["sock"] is None:
+            try:
+                state["sock"] = socket.create_connection((host, port), timeout=5)
+            except OSError as e:
+                raise CantConnect(str(e)) from e
+        return state["sock"]
+
+    def write(case_idx: int, data: bytes, meta: list) -> None:
+        cmds = [f"< open {iface} >"]
+        for off in range(0, len(data), 8):
+            chunk = data[off : off + 8]
+            cmds.append(f"< send {can_id} {len(chunk)} {_hexstr(chunk, ' ')}>")
+        try:
+            _sock().sendall("".join(cmds).encode())
+        except OSError as e:
+            state["sock"] = None
+            raise CantConnect(str(e)) from e
+
+    return write
+
+
+def _cansockd_isotp_writer(host: str, port: int, iface: str,
+                           sid: str, did: str) -> Writer:
+    """cansockd ISO-TP mode client (erlamsa_out.erl:560-576): the banner
+    switches the daemon into isotpmode with the source/destination ids,
+    then each case ships as one ``< sendpdu HEX >`` — the daemon does the
+    ISO-TP segmentation (for direct SocketCAN segmentation use
+    canisotp://)."""
+    state: dict = {"sock": None}
+    banner = (f"< open {iface} >< isotpmode >"
+              f"< isotpconf {sid} {did} 0 0 0 >")
+
+    def _sock() -> socket.socket:
+        if state["sock"] is None:
+            try:
+                s = socket.create_connection((host, port), timeout=5)
+                s.sendall(banner.encode())
+                state["sock"] = s
+            except OSError as e:
+                raise CantConnect(str(e)) from e
+        return state["sock"]
+
+    def write(case_idx: int, data: bytes, meta: list) -> None:
+        if not data:
+            return
+        cmd = f"< sendpdu {_hexstr(data, '')} >"
+        try:
+            _sock().sendall(cmd.encode())
+        except OSError as e:
+            state["sock"] = None
             raise CantConnect(str(e)) from e
 
     return write
@@ -300,14 +452,57 @@ def string_outputs(spec, monitor_notify=None) -> tuple[Writer | None, float]:
         host, _, port = spec[6:].rpartition(":")
         return _tls_writer(host or "127.0.0.1", int(port)), DEFAULT_MAX_RUNNING_TIME
     if spec.startswith("udp://"):
-        host, _, port = spec[6:].rpartition(":")
+        rest = spec[6:]
+        if rest.startswith(":"):
+            # only the explicit "udp://:port" form listens, mirroring tcp://
+            return _udp_listen_writer(int(rest[1:])), DEFAULT_MAX_RUNNING_TIME
+        host, _, port = rest.rpartition(":")
         return _udp_writer(host or "127.0.0.1", int(port)), DEFAULT_MAX_RUNNING_TIME
     if spec.startswith(("http://", "https://")):
+        # "http://:port[,Content-Type]" = server mode (reference
+        # erlamsa_out.erl http_writer empty-host clauses); anything with a
+        # host is a POST client
+        scheme, rest = spec.split("://", 1)
+        if rest.startswith(":"):
+            if scheme == "https":
+                # the reference's https server mode needs cert/key files
+                # that this spec-only seam cannot carry; refuse loudly
+                # rather than serve plaintext on a port named https
+                raise SystemExit(
+                    "https://:port server mode is not supported; use "
+                    "http://:port (plaintext) or terminate TLS in front"
+                )
+            port_s, _, ctype = rest[1:].partition(",")
+            return (
+                _http_listen_writer(
+                    int(port_s), ctype.strip() or "application/octet-stream"
+                ),
+                DEFAULT_MAX_RUNNING_TIME,
+            )
         return _http_writer(spec), DEFAULT_MAX_RUNNING_TIME
     if spec.startswith("exec://"):
         return _exec_writer(spec[7:], monitor_notify), DEFAULT_MAX_RUNNING_TIME
     if spec.startswith("ip://"):
         return _rawip_writer(spec[5:]), DEFAULT_MAX_RUNNING_TIME
+    if spec.startswith("cansockd://"):
+        host, port, iface, can_id = spec[11:].split(":", 3)
+        return (
+            _cansockd_writer(host or "127.0.0.1", int(port), iface, can_id),
+            DEFAULT_MAX_RUNNING_TIME,
+        )
+    if spec.startswith("cansockd_isotp://"):
+        host, port, iface, sid, did = spec[17:].split(":", 4)
+        return (
+            _cansockd_isotp_writer(host or "127.0.0.1", int(port), iface,
+                                   sid, did),
+            DEFAULT_MAX_RUNNING_TIME,
+        )
+    if spec.startswith("canisotp://"):
+        iface, _, can_id = spec[11:].partition(":")
+        return (
+            _can_writer(iface, int(can_id or "0", 0), isotp=True),
+            DEFAULT_MAX_RUNNING_TIME,
+        )
     if spec.startswith("can://"):
         iface, _, can_id = spec[6:].partition(":")
         return _can_writer(iface, int(can_id or "0", 0)), DEFAULT_MAX_RUNNING_TIME

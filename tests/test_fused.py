@@ -262,9 +262,12 @@ def test_fused_pipeline_runs():
 
 def test_device_sizer_detection_is_valid():
     """Device sizer finds are independently valid: the field value equals
-    the distance to the buffer end. (The device scan covers ALL offsets
-    at u8/u16/u32 widths — broader than the oracle's offset<=n/5 sampling,
-    narrower in width (no u64); neither is a subset of the other.)"""
+    the distance to the candidate's end offset, which sits within the
+    oracle's probed set (tail, the near-tail deltas, or a sampled
+    interior end). (The device scan covers ALL offsets at u8/u16/u32
+    widths for tail/near-tail — broader than the oracle's offset<=n/5
+    sampling, narrower in width (no u64); neither is a subset of the
+    other.)"""
     import struct
 
     from erlamsa_tpu.ops.sizer import detect_sizer
@@ -281,7 +284,7 @@ def test_device_sizer_detection_is_valid():
     for data in cases:
         batch = pack([data], capacity=L)
         keys = prng.sample_keys(prng.case_key(prng.base_key(1), 0), 1)
-        found, a, w, kind = jax.jit(jax.vmap(detect_sizer))(
+        found, a, w, kind, end = jax.jit(jax.vmap(detect_sizer))(
             keys, batch.data, batch.lens
         )
         has_field = data[:3] == b"HDR"
@@ -289,8 +292,72 @@ def test_device_sizer_detection_is_valid():
         if not has_field:
             continue
         dev_a, dev_w, dev_kind = int(a[0]), int(w[0]), int(kind[0])
+        dev_end = int(end[0])
+        # the pick may be any oracle-probed view — e.g. the low byte of a
+        # little-endian u16 tail field is itself a valid u8 near-tail
+        # (end = n-1) candidate, exactly as simple_u8len's x=1 clause
+        assert len(data) - dev_end in range(0, 9), (data, dev_end)
         fieldbytes = data[dev_a : dev_a + dev_w]
         endian = "little" if dev_kind in (2, 4) else "big"
         value = int.from_bytes(fieldbytes, endian)
-        assert value == len(data) - dev_a - dev_w, (data, dev_a, dev_w, value)
+        assert value == dev_end - dev_a - dev_w, (data, dev_a, dev_w, value)
         assert value > 2
+
+
+def test_composite_matches_standalone_applies():
+    """Pin the composite's bit-identity claim (ADVICE r3): for every
+    mutator whose round is a MOVEMENT kind (splice/swap/perm-bytes/
+    perm-lines), _apply_composite must equal running the standalone
+    reference applies in sequence. MASK kinds (snand/srnd) are excluded —
+    they are distribution-equivalent only (_mask_transform docstring)."""
+    import jax.numpy as jnp
+
+    from erlamsa_tpu.ops.fused import (
+        _PARAM_BRANCHES,
+        K_MASK,
+        K_NONE,
+        Tables,
+        _apply_composite,
+        _apply_perm_bytes,
+        _apply_perm_lines,
+        _apply_splice,
+        _apply_swap,
+    )
+
+    NS = 8  # samples per mutator
+    batch = pack([DOC * 3] * NS, capacity=L)
+
+    def gen_and_apply(code_idx):
+        def one(key, data, n):
+            t = Tables(key, data, n)
+            site_key = prng.sub(key, prng.TAG_SITE)
+            p = _PARAM_BRANCHES[code_idx](site_key, t)
+            comp, comp_n = _apply_composite(
+                site_key, p, data, n, t.line_starts, t.line_lens, t.nlines
+            )
+            seq, seq_n = _apply_splice(p, data, n)
+            seq, seq_n = _apply_swap(p, seq, seq_n)
+            seq, seq_n = _apply_perm_bytes(site_key, p, seq, seq_n)
+            seq, seq_n = _apply_perm_lines(
+                site_key, p, seq, seq_n, t.line_starts, t.line_lens, t.nlines
+            )
+            return p["kind"], comp, comp_n, seq, seq_n
+
+        return jax.jit(jax.vmap(one))
+
+    covered_kinds = set()
+    for idx, code in enumerate(DEVICE_CODES):
+        keys = prng.sample_keys(
+            prng.case_key(prng.base_key(idx + 1), 0), NS
+        )
+        kind, comp, comp_n, seq, seq_n = gen_and_apply(idx)(
+            keys, batch.data, batch.lens
+        )
+        kind = np.asarray(kind)
+        movement = (kind != K_MASK) & (kind != K_NONE)
+        covered_kinds.update(kind[movement].tolist())
+        sel = np.nonzero(movement)[0]
+        assert np.array_equal(np.asarray(comp)[sel], np.asarray(seq)[sel]), code
+        assert np.array_equal(np.asarray(comp_n)[sel], np.asarray(seq_n)[sel]), code
+    # the suite must actually have exercised every movement kind
+    assert covered_kinds == {1, 2, 3, 4}, covered_kinds

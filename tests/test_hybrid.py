@@ -178,3 +178,47 @@ def test_batchrunner_resume(tmp_path, monkeypatch, capsys):
     assert run_tpu_batch(dict(opts), batch=8) == 0
     _s, case3, _sc3, _hs3, _hsp3 = load_state(state)
     assert case3 == 3
+
+
+def test_host_pool_process_mode(monkeypatch):
+    """ERLAMSA_HOST_POOL=process must produce the same deterministic
+    results as the thread pool — the worker is a pure function of
+    (seed, case, index) either way."""
+    monkeypatch.setenv("ERLAMSA_HOST_POOL", "process")
+    from erlamsa_tpu.services.hybrid import HybridDispatcher
+
+    seeds = [b"json {\"a\": 123}" * 4, b"<tag>text 42</tag>" * 4]
+    d_proc = HybridDispatcher([("sgm", 5), ("js", 5), ("bf", 1)], (1, 2, 3))
+    try:
+        got_p = d_proc.fuzz_host(0, list(enumerate(seeds)))
+    finally:
+        d_proc.close()
+    monkeypatch.setenv("ERLAMSA_HOST_POOL", "thread")
+    d_thr = HybridDispatcher([("sgm", 5), ("js", 5), ("bf", 1)], (1, 2, 3))
+    try:
+        got_t = d_thr.fuzz_host(0, list(enumerate(seeds)))
+    finally:
+        d_thr.close()
+    assert got_p == got_t
+    assert set(got_p) == {0, 1}
+
+
+def test_hostpool_module_is_jax_free():
+    """Process-pool workers import hostpool's module tree on unpickle; a
+    bare `import jax` can block when the axon relay is wedged, so the
+    worker's transitive imports must never include jax."""
+    import os as _os
+    import subprocess
+    import sys as _sys
+
+    # strip PYTHONPATH: this image's axon sitecustomize imports jax into
+    # EVERY interpreter, which would mask what the module itself pulls in
+    env = {k: v for k, v in _os.environ.items() if k != "PYTHONPATH"}
+    repo = _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__)))
+    env["PYTHONPATH"] = repo
+    code = ("import erlamsa_tpu.services.hostpool, sys; "
+            "print('jax' in sys.modules)")
+    r = subprocess.run([_sys.executable, "-c", code], env=env,
+                       capture_output=True, text=True, timeout=60)
+    assert r.returncode == 0, r.stderr
+    assert r.stdout.strip() == "False"

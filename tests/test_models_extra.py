@@ -251,3 +251,80 @@ def test_exploit_parse_log():
     assert len(packets) == 2
     assert packets[0][0] == "c->s"
     assert packets[1] == ("s->c", b"200 OK")
+
+
+# ---- NHRP external module (the shipped real-protocol example) ------------
+
+
+def _nhrp_packet(body: bytes = b"\x01\x02target-address\x00\x00payload") -> bytes:
+    from erlamsa_tpu.services.external_nhrp import fix_checksum
+
+    head = bytes(range(4)) + bytes(range(0x10, 0x1C))  # prefix + 12B header
+    return fix_checksum(head + b"\x00\x00" + body)
+
+
+def test_nhrp_fix_checksum_verifies():
+    from erlamsa_tpu.services.external_nhrp import inet_checksum
+
+    pkt = _nhrp_packet()
+    # RFC 1071: summing a block that includes its own correct checksum
+    # yields 0 — over the reference's coverage (everything past the
+    # 4-byte prefix)
+    assert inet_checksum(pkt[4:]) == 0
+    # corrupt a body byte: verification must now fail
+    bad = pkt[:-1] + bytes([pkt[-1] ^ 0xFF])
+    assert inet_checksum(bad[4:]) != 0
+
+
+def test_nhrp_short_packet_passthrough():
+    from erlamsa_tpu.services.external_nhrp import fix_checksum
+
+    assert fix_checksum(b"short") == b"short"
+    assert fix_checksum(b"") == b""
+
+
+def test_nhrp_loads_through_external_hook():
+    ext = load_external("erlamsa_tpu.services.external_nhrp")
+    assert "post" in ext.capabilities and "fuzzer" in ext.capabilities
+    post = ext.post()
+    from erlamsa_tpu.services.external_nhrp import inet_checksum
+
+    pkt = _nhrp_packet()
+    mutated = pkt[:20] + b"XXXX" + pkt[24:]  # simulate a body mutation
+    assert inet_checksum(mutated[4:]) != 0
+    fixed = post(mutated)
+    assert inet_checksum(fixed[4:]) == 0
+    assert fixed[18:] == mutated[18:]  # body untouched by the fix
+
+
+def test_nhrp_gfcomms_session_protocol_shaped_fuzz():
+    """-e nhrp equivalent of a gfcomms run: the session fuzzer must keep
+    the 18-byte header intact, mutate the body across a session, and emit
+    packets whose checksum still verifies."""
+    import socket as pysock
+
+    from erlamsa_tpu.services.external import load_external
+    from erlamsa_tpu.services.external_nhrp import inet_checksum
+    from erlamsa_tpu.services.gfcomms import GfComms
+
+    ext = load_external("erlamsa_tpu.services.external_nhrp")
+    srv = GfComms(0, external_fuzzer=ext.fuzzer())
+    # port 0: grab the bound port after serve
+    srv.serve(block=False)
+    port = srv._srv.getsockname()[1]
+    try:
+        pkt = _nhrp_packet(b"A" * 64 + b" number 123 " + b"B" * 64)
+        replies = []
+        cli = pysock.create_connection(("127.0.0.1", port), timeout=5)
+        cli.settimeout(5)
+        for _ in range(5):
+            cli.sendall(pkt)
+            replies.append(cli.recv(65536))
+        cli.close()
+        assert any(r != pkt for r in replies), "no packet mutated in session"
+        for r in replies:
+            assert r[:16] == pkt[:16], "fixed header must survive"
+            if len(r) > 18:
+                assert inet_checksum(r[4:]) == 0, "checksum must verify"
+    finally:
+        srv.stop()

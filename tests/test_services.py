@@ -4,6 +4,7 @@ tests for these layers (SURVEY.md §4) — these are new coverage."""
 
 import base64
 import json
+import os
 import socket
 import threading
 import time
@@ -586,3 +587,223 @@ def test_serial_proxy_over_pty():
             pass
     assert got != b""
     assert got != b"serial fuzz 123\n"  # prob 1.0 mutates
+
+
+# ---- r4 writer additions: http/udp listen, ISO-TP, cansockd -------------
+
+
+def test_http_listen_writer_serves_case():
+    from urllib.request import urlopen
+
+    port = _free_port()
+    w, _ = string_outputs(f"http://:{port},text/plain")
+    done = []
+
+    def serve():
+        w(3, b"fuzzed-http-case", [])
+        done.append(True)
+
+    t = threading.Thread(target=serve)
+    t.start()
+    resp = urlopen(f"http://127.0.0.1:{port}/anything", timeout=5)
+    body = resp.read()
+    t.join(5)
+    assert body == b"fuzzed-http-case"
+    assert resp.headers["Content-Length"] == str(len(body))
+    assert resp.headers["Content-type"] == "text/plain"
+    assert done == [True]
+
+
+def test_http_listen_default_content_type():
+    from urllib.request import urlopen
+
+    port = _free_port()
+    w, _ = string_outputs(f"http://:{port}")
+    t = threading.Thread(target=w, args=(0, b"\x00\x01binary", []))
+    t.start()
+    resp = urlopen(f"http://127.0.0.1:{port}/", timeout=5)
+    body = resp.read()
+    t.join(5)
+    assert body == b"\x00\x01binary"
+    assert resp.headers["Content-type"] == "application/octet-stream"
+
+
+def test_udp_listen_writer_replies_to_sender():
+    port = _free_port()
+    w, _ = string_outputs(f"udp://:{port}")
+    t = threading.Thread(target=w, args=(1, b"fuzzed-udp-reply", []))
+    t.start()
+    cli = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    cli.settimeout(5)
+    cli.sendto(b"ping", ("127.0.0.1", port))
+    data, _addr = cli.recvfrom(65535)
+    t.join(5)
+    cli.close()
+    assert data == b"fuzzed-udp-reply"
+
+
+def test_iso_tpish_single_frame():
+    from erlamsa_tpu.services.out import iso_tpish
+
+    assert iso_tpish(b"abc") == b"\x03abc"
+    assert iso_tpish(b"") == b"\x00"
+    assert iso_tpish(b"123456") == b"\x06123456"
+
+
+def test_iso_tpish_multi_frame():
+    from erlamsa_tpu.services.out import iso_tpish
+
+    data = bytes(range(20))
+    out = iso_tpish(data)
+    # first frame: 0x1|len:12 over two bytes, then 6 payload bytes
+    assert out[0] == 0x10 and out[1] == 20
+    assert out[2:8] == data[:6]
+    # consecutive frames idx 0 and 1, 7 bytes each
+    assert out[8] == 0x20 and out[9:16] == data[6:13]
+    assert out[16] == 0x21 and out[17:24] == data[13:20]
+    # 12-bit length split for a >255-byte case
+    big = iso_tpish(bytes(300))
+    assert big[0] == 0x11 and big[1] == 300 - 256
+
+
+def test_iso_tpish_index_wrap_matches_reference():
+    from erlamsa_tpu.services.out import iso_tpish
+
+    # 17 FULL consecutive frames: the 17th has idx 16, which the reference
+    # encodes into 4 bits -> 0 (truncation), never resetting mid-stream
+    data = bytes(6 + 7 * 17)
+    out = iso_tpish(data)
+    frames = [out[2 + 6 + 8 * i] for i in range(17)]
+    assert frames[:16] == [0x20 | i for i in range(16)]
+    assert frames[16] == 0x20
+    # trailing PARTIAL frame after the wrap point: the reference's clause
+    # order RESETS the index to 0 (not idx mod 16) before the last frame
+    data = bytes(6 + 7 * 17 + 3)
+    out = iso_tpish(data)
+    assert out[-4] == 0x20  # idx 17 -> reset -> 0
+
+
+def test_cansockd_writer_command_stream():
+    port = _free_port()
+    srv = socket.socket()
+    srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    srv.bind(("127.0.0.1", port))
+    srv.listen(1)
+    got = []
+
+    def accept():
+        conn, _ = srv.accept()
+        conn.settimeout(5)
+        while True:
+            try:
+                chunk = conn.recv(65535)
+            except OSError:
+                break
+            if not chunk:
+                break
+            got.append(chunk)
+            if b"send" in b"".join(got):
+                break
+        conn.close()
+
+    t = threading.Thread(target=accept)
+    t.start()
+    w, _ = string_outputs(f"cansockd://127.0.0.1:{port}:vcan0:123")
+    w(0, bytes([0xAA] * 8 + [0xBB, 0xCC]), [])
+    t.join(5)
+    srv.close()
+    text = b"".join(got).decode()
+    assert text.startswith("< open vcan0 >")
+    assert "< send 123 8 AA AA AA AA AA AA AA AA >" in text
+    assert "< send 123 2 BB CC >" in text
+
+
+def test_cansockd_isotp_writer_banner_and_pdu():
+    port = _free_port()
+    srv = socket.socket()
+    srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    srv.bind(("127.0.0.1", port))
+    srv.listen(1)
+    got = []
+
+    def accept():
+        conn, _ = srv.accept()
+        conn.settimeout(5)
+        while b"sendpdu" not in b"".join(got):
+            try:
+                chunk = conn.recv(65535)
+            except OSError:
+                break
+            if not chunk:
+                break
+            got.append(chunk)
+        conn.close()
+
+    t = threading.Thread(target=accept)
+    t.start()
+    w, _ = string_outputs(f"cansockd_isotp://127.0.0.1:{port}:vcan0:7E0:7E8")
+    w(0, b"\xde\xad\xbe\xef", [])
+    w(1, b"", [])  # empty case: no command, like the reference
+    t.join(5)
+    srv.close()
+    text = b"".join(got).decode()
+    assert text.startswith("< open vcan0 >< isotpmode >"
+                           "< isotpconf 7E0 7E8 0 0 0 >")
+    assert "< sendpdu DEADBEEF >" in text
+
+
+# ---- r4: queryable findings store (sqlite sink) -------------------------
+
+
+def test_sqlite_sink_records_and_queries(tmp_path):
+    from erlamsa_tpu.services.logger import Logger, SqliteSink, query_log
+
+    db = str(tmp_path / "log.db")
+    lg = Logger()
+    lg.add_sink("finding", SqliteSink(db))
+    lg.log("finding", "exec target died with signal %d on case %d", 11, 3)
+    lg.log("info", "below the sink level, must not be stored")
+    lg.log("critical", "stored: critical outranks finding")
+    lg.flush()
+    rows = query_log(db)
+    levels = [r[2] for r in rows]
+    assert "finding" in levels and "critical" in levels
+    assert "info" not in levels
+    found = query_log(db, level="finding", like="signal 11")
+    assert len(found) == 1
+    assert "case 3" in found[0][3]
+
+
+def test_findings_survive_process_exit(tmp_path):
+    """The restored mnesia capability (erlamsa_logger.erl:194-228): a crash
+    finding recorded by one process is retrievable by another after the
+    first is gone — via the CLI's --list-findings."""
+    import subprocess
+    import sys as _sys
+
+    crash = tmp_path / "crash.sh"
+    crash.write_text("#!/bin/sh\nkill -SEGV $$\n")
+    crash.chmod(0o755)
+    db = tmp_path / "findings.db"
+
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    run = subprocess.run(
+        [_sys.executable, "-m", "erlamsa_tpu", "-s", "1,2,3", "-n", "2",
+         "-o", f"exec://{crash}", "-L", f"sqlite={db}"],
+        input=b"hello crash target 123\n", timeout=120, env=env,
+        cwd=str(tmp_path), capture_output=True,
+    )
+    assert run.returncode == 0, run.stderr.decode()
+
+    listing = subprocess.run(
+        [_sys.executable, "-m", "erlamsa_tpu", "--list-findings", str(db)],
+        timeout=60, env=env, cwd=str(tmp_path), capture_output=True,
+    )
+    assert listing.returncode == 0, listing.stderr.decode()
+    out_text = listing.stdout.decode()
+    assert "died with signal 11" in out_text
+    assert "finding(s)" in listing.stderr.decode()
