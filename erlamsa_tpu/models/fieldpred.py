@@ -122,7 +122,12 @@ def get_possible_simple_lens(r: ErlRand, data: bytes) -> list[tuple]:
 
     sublen = min(n // 5, SIZER_MAX_FIRST_BYTES)
     first_seq = np.arange(0, sublen + 1, dtype=np.int64)
-    var_b = [r.rand_range(sublen, n) for _ in range(sublen + 1)]
+    # sublen+1 consecutive rand_range(sublen, n) draws in one block:
+    # rand_range(l, r) with r > l is trunc(uniform()*(r-l)) + l
+    var_b = (
+        (r.uniform_block(sublen + 1) * (n - sublen)).astype(np.int64)
+        + sublen
+    ).tolist()
     targets, vals = _field_targets(data, sublen)
     deltas = (0, 1, 2, 4, 8)
     nvb = len(var_b)

@@ -8,9 +8,19 @@ inverse used after chunk-level mutation.
 Chunks are tuples:
     ("text", list[int]) | ("byte", list[int]) |
     ("delimited", quote:int, list[int], quote:int)
+
+The scan is run-jumped rather than per-byte (this was an oracle-wide
+hotspot: every host-routed case lexes its data at least once): texty and
+"6-texty-ahead" predicates are numpy masks computed once, and the byte /
+plain-text / inside-quote loops advance by whole runs via searchsorted
+jumps into precomputed boundary-position arrays. Only quote handling
+(rare) stays scalar. Chunk contents are unchanged (lists of ints), and
+tests lock the chunking against the per-byte semantics.
 """
 
 from __future__ import annotations
+
+import numpy as np
 
 MIN_TEXTY = 6
 
@@ -40,6 +50,28 @@ def lex(data: bytes) -> list[tuple]:
     chunks: list[tuple] = []
     i = 0
     n = len(data)
+    if n == 0:
+        return chunks
+
+    d = np.frombuffer(data, dtype=np.uint8)
+    tm = ((d >= 32) & (d <= 126)) | (d == 9) | (d == 10) | (d == 13)
+    # enough[i] == _texty_enough(data, i): a short all-texty tail counts,
+    # so the window slides over a 6-True pad
+    pad = np.concatenate([tm, np.ones(MIN_TEXTY, bool)])
+    c = np.concatenate([[0], np.cumsum(pad)])
+    enough = (c[MIN_TEXTY:] - c[:-MIN_TEXTY])[:n] == MIN_TEXTY
+    enough_pos = np.flatnonzero(enough)
+    isquote = (d == 0x22) | (d == 0x27)
+    # plain-text runs stop at a non-texty byte or a quote
+    textstop_pos = np.flatnonzero(~tm | isquote)
+    # inside quotes, scalar handling is needed at quotes, backslashes and
+    # non-texty bytes; everything between advances in one jump
+    special_pos = np.flatnonzero(~tm | isquote | (d == 0x5C))
+
+    def jump(positions: np.ndarray, frm: int) -> int:
+        k = np.searchsorted(positions, frm, side="left")
+        return int(positions[k]) if k < len(positions) else n
+
     raw: list[int] = []
 
     def flush_raw():
@@ -49,9 +81,11 @@ def lex(data: bytes) -> list[tuple]:
             raw = []
 
     while i < n:
-        if not _texty_enough(data, i):
-            raw.append(data[i])
-            i += 1
+        if not enough[i]:
+            # whole run of not-enough positions becomes byte chunk content
+            j = jump(enough_pos, i)
+            raw.extend(data[i:j])
+            i = j
             continue
         flush_raw()
         # text mode
@@ -66,12 +100,12 @@ def lex(data: bytes) -> list[tuple]:
                 after: list[int] = []
                 closed = False
                 while j < n:
-                    c = data[j]
-                    if c == quote:
+                    c_ = data[j]
+                    if c_ == quote:
                         closed = True
                         j += 1
                         break
-                    if c == 0x5C:  # backslash escape
+                    if c_ == 0x5C:  # backslash escape
                         if j + 1 >= n:
                             after.append(0x5C)
                             j += 1
@@ -84,9 +118,15 @@ def lex(data: bytes) -> list[tuple]:
                         after.append(0x5C)
                         j += 1
                         continue
-                    if texty(c):
-                        after.append(c)
-                        j += 1
+                    if tm[j]:
+                        # data[j] is texty and neither the delimiter nor a
+                        # backslash — but it may be the OTHER quote char
+                        # (itself in special_pos), which the scan simply
+                        # consumes; so always take data[j] and jump from
+                        # j+1 to the next special byte
+                        k = jump(special_pos, j + 1)
+                        after.extend(data[j:k])
+                        j = k
                         continue
                     break  # non-texty inside quotes: abandon delimited run
                 if closed:
@@ -99,12 +139,15 @@ def lex(data: bytes) -> list[tuple]:
                 # unterminated: quote + contents become text, resume scan
                 seen = seen + [quote] + after
                 i = j
-                if i < n and not texty(data[i]):
+                if i < n and not tm[i]:
                     break
                 continue
-            if texty(b):
-                seen.append(b)
-                i += 1
+            if tm[i]:
+                # run of plain texty bytes up to the next quote/non-texty;
+                # i itself is texty and not a quote, so the stop is > i
+                j = jump(textstop_pos, i)
+                seen.extend(data[i:j])
+                i = j
                 continue
             break
         if seen:
@@ -120,22 +163,34 @@ def unlex(chunks: list[tuple]) -> bytes:
         if c[0] == "delimited":
             _, l, body, rr = c
             out.append(l)
-            out.extend(_flatten(body))
+            _flatten_into(out, body)
             out.append(rr)
         else:
-            out.extend(_flatten(c[1]))
+            _flatten_into(out, c[1])
     return bytes(out)
+
+
+def _flatten_into(out: bytearray, x) -> None:
+    """Flatten nested int/str/bytes lists into out — int elements (the
+    overwhelming majority) append without a recursive call each."""
+    if isinstance(x, (bytes, bytearray)):
+        out.extend(x)
+        return
+    if isinstance(x, int):
+        out.append(x & 0xFF)
+        return
+    if isinstance(x, str):
+        out.extend(x.encode("latin-1", "replace"))
+        return
+    for e in x:
+        if isinstance(e, int):
+            out.append(e & 0xFF)
+        else:
+            _flatten_into(out, e)
 
 
 def _flatten(x) -> bytes:
     """Tolerate nested int/str/bytes lists produced by text mutators."""
-    if isinstance(x, (bytes, bytearray)):
-        return bytes(x)
-    if isinstance(x, int):
-        return bytes([x & 0xFF])
-    if isinstance(x, str):
-        return x.encode("latin-1", "replace")
     out = bytearray()
-    for e in x:
-        out.extend(_flatten(e))
+    _flatten_into(out, x)
     return bytes(out)

@@ -171,8 +171,11 @@ def main(argv=None) -> int:
         return 0
 
     if args.list_findings:
-        rows = logger.query_log(args.list_findings, level="finding",
-                                limit=None)
+        try:
+            rows = logger.query_log(args.list_findings, level="finding",
+                                    limit=None)
+        except (FileNotFoundError, ValueError) as e:
+            raise SystemExit(f"erlamsa-tpu: {e}")
         for _id, ts, _level, message in rows:
             print(f"{ts}\t{message}")
         print(f"# {len(rows)} finding(s)", file=sys.stderr)
@@ -186,7 +189,11 @@ def main(argv=None) -> int:
             elif part.startswith("file="):
                 spec["file"] = (part[5:], "debug")
             elif part.startswith("sqlite="):
-                spec["sqlite"] = (part[7:], "debug")
+                # findings-and-worse only: every row is an individually
+                # fsync'd commit (durability by design), so routing info/
+                # debug spam here would starve the drain thread and bloat
+                # the store; stream sinks carry the verbose levels
+                spec["sqlite"] = (part[7:], "finding")
         logger.GLOBAL.configure(spec)
 
     try:

@@ -77,6 +77,12 @@ class Logger:
         if self._thread is None:
             self._thread = threading.Thread(target=self._drain, daemon=True)
             self._thread.start()
+            # cover EVERY exit path (service modes return/raise from many
+            # places): queued records get a bounded chance to reach
+            # durable sinks before the daemon drain thread dies
+            import atexit
+
+            atexit.register(self.flush)
 
     def _drain(self):
         while True:
@@ -159,9 +165,14 @@ def query_log(path: str, level: str | None = None, like: str | None = None,
     """Read entries back from a SqliteSink database — usable after the
     logged-about process is long gone (the restored mnesia capability).
     level filters exactly; like is a substring match on the message;
-    limit=None returns everything."""
+    limit=None returns everything. Raises FileNotFoundError for a missing
+    path (sqlite3.connect would otherwise create a junk empty DB there)
+    and ValueError for a file that is not a findings store."""
+    import os as _os
     import sqlite3
 
+    if not _os.path.exists(path):
+        raise FileNotFoundError(f"no findings store at {path!r}")
     conn = sqlite3.connect(path)
     try:
         q = "SELECT id, ts, level, message FROM log"
@@ -178,7 +189,10 @@ def query_log(path: str, level: str | None = None, like: str | None = None,
         if limit is not None:
             q += " LIMIT ?"
             params.append(limit)
-        return list(conn.execute(q, params))
+        try:
+            return list(conn.execute(q, params))
+        except sqlite3.OperationalError as e:
+            raise ValueError(f"{path!r} is not a findings store: {e}") from e
     finally:
         conn.close()
 

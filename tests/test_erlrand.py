@@ -161,3 +161,38 @@ def test_seed_from_source(tmp_path):
         seed_from_source(str(short))
     with _pytest.raises(ValueError):
         seed_from_source(str(tmp_path / "missing.bin"))
+
+
+def test_uniform_block_matches_scalar_stream():
+    """uniform_block(k) must be bit-identical to k scalar uniform() calls
+    and leave the generator in the same state."""
+    from erlamsa_tpu.utils.erlrand import ErlRand
+
+    for seed in ((1, 2, 3), (1985, 10000, 3337), (7, 7, 7)):
+        for k in (1, 2, 5, 64, 257, 1000):
+            r1, r2 = ErlRand(seed), ErlRand(seed)
+            blk = r1.uniform_block(k)
+            ref = [r2.uniform() for _ in range(k)]
+            assert blk.tolist() == ref, (seed, k)
+            assert r1.getstate() == r2.getstate()
+    r = ErlRand((1, 2, 3))
+    assert r.uniform_block(0).size == 0
+    assert r.getstate() == ErlRand((1, 2, 3)).getstate()
+
+
+def test_random_block_matches_scalar_loop():
+    """random_block's vectorized path reproduces the reference's
+    back-to-front scalar loop byte-for-byte."""
+    from erlamsa_tpu.utils.erlrand import ErlRand
+
+    def scalar_block(r, n):
+        out = bytearray(n)
+        for i in range(n - 1, -1, -1):
+            out[i] = r.rand(256)
+        return bytes(out)
+
+    for seed in ((1, 2, 3), (42, 42, 42)):
+        for n in (0, 1, 7, 256, 1333):
+            r1, r2 = ErlRand(seed), ErlRand(seed)
+            assert r1.random_block(n) == scalar_block(r2, n), (seed, n)
+            assert r1.getstate() == r2.getstate()
