@@ -134,36 +134,57 @@ def get_possible_simple_lens(r: ErlRand, data: bytes) -> list[tuple]:
 
     # invert the scan: a clause matches range (a, b) at delta d iff
     # b == target[k, a] + d, so look the required b value up instead of
-    # comparing every (range, delta, clause) triple. var_b positions by
-    # value; matches keyed (range_index, d) -> first clause k
-    by_val: dict[int, list[int]] = {}
-    for j, y in enumerate(var_b):
-        by_val.setdefault(y, []).append(j)
-    hits: dict[tuple[int, int], int] = {}
-    for k in range(len(_COMBOS)):
-        trow = targets[k]
-        for a in range(sublen + 1):
-            t = int(trow[a])
-            if t < 0:
-                continue
-            for di, d in enumerate(deltas):
-                want_b = t + d  # then bb == t
-                if not (a < t and t > 0):
-                    continue
-                # the (a, n) block occupies range indices 0..sublen
-                if want_b == n:
-                    hits.setdefault((a, di), k)
-                # the (x, y) block: index sublen+1 + x*nvb + j
-                # (k ascends, so setdefault keeps the first clause)
-                for j in by_val.get(want_b, ()):
-                    hits.setdefault((sublen + 1 + a * nvb + j, di), k)
+    # comparing every (range, delta, clause) triple. Matches are keyed
+    # (range_index, d) -> FIRST clause k (min over k), computed with
+    # vectorized membership tests — no per-(k, a, d) Python loop.
+    K = len(_COMBOS)
+    A = sublen + 1
+    D = len(deltas)
+    T = targets  # [K, A]; -1 where impossible
+    valid = (T > 0) & (first_seq[None, :] < T)
+    k_col = np.arange(K, dtype=np.int64)[:, None]  # broadcast over a
+
+    # the (a, n) block occupies range indices 0..sublen
+    h_tail = np.full((A, D), K, np.int64)
+    # the (x, y) block: index sublen+1 + x*nvb + j
+    h_var = np.full((A * nvb, D), K, np.int64)
+    vb_arr = np.asarray(var_b, np.int64)
+    order = np.argsort(vb_arr, kind="stable")
+    sv = vb_arr[order]
+    for di, d in enumerate(deltas):
+        m = valid & (T == n - d)
+        if m.any():
+            ks, as_ = np.nonzero(m)  # k-ascending (row-major)
+            np.minimum.at(h_tail[:, di], as_, ks)
+        want = T + d
+        lo = np.searchsorted(sv, want.ravel()).reshape(K, A)
+        hi = np.searchsorted(sv, want.ravel(), side="right").reshape(K, A)
+        cnt = np.where(valid, hi - lo, 0).ravel()
+        total = int(cnt.sum())
+        if total == 0:
+            continue
+        ks = np.repeat(np.broadcast_to(k_col, (K, A)).ravel(), cnt)
+        as_ = np.repeat(np.broadcast_to(first_seq, (K, A)).ravel(), cnt)
+        starts = np.repeat(lo.ravel(), cnt)
+        offs = np.arange(total) - np.repeat(np.cumsum(cnt) - cnt, cnt)
+        js = order[starts + offs]
+        np.minimum.at(h_var[:, di], as_ * nvb + js, ks)
 
     def a_of(ridx: int) -> int:
         return ridx if ridx <= sublen else (ridx - sublen - 1) // nvb
 
+    # hit enumeration in ascending (range_index, d): tail indices
+    # (0..sublen) precede every var index, and argwhere is row-major
+    hit_items = [
+        (int(a_), int(di), int(h_tail[a_, di]))
+        for a_, di in np.argwhere(h_tail < K)
+    ] + [
+        (sublen + 1 + int(rid), int(di), int(h_var[rid, di]))
+        for rid, di in np.argwhere(h_var < K)
+    ]
+
     big_parts: dict[int, list[tuple]] = {}
-    for (ridx, di) in sorted(hits):
-        k = hits[(ridx, di)]
+    for ridx, _di, k in hit_items:
         size, endian = _COMBOS[k]
         a = a_of(ridx)
         bb = int(targets[k, a])

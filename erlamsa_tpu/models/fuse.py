@@ -128,18 +128,23 @@ def find_jump_points(r: ErlRand, a: bytes, b: bytes) -> tuple[int, int]:
         if len(ukb):
             has_b &= ukb[safe] == uka
         acc: list[tuple[np.ndarray, np.ndarray]] = []
+        # python ints up front: indexing numpy scalars inside the loop
+        # costs more than the loop body itself
+        sal, bal = sa_.tolist(), ba_.tolist()
+        sbl, bbl = sb_.tolist(), bb_.tolist()
+        hbl, pbl = has_b.tolist(), pos_b.tolist()
         # uka ascending == the per-node gb_trees ascending (node, ch) walk
-        for g in range(len(uka)):
-            s0, e0 = sa_[g], ba_[g]
+        for g in range(len(sal)):
+            s0, e0 = sal[g], bal[g]
             if s0 == e0:
                 # collapsed bucket: the reference pushes a degenerate
                 # node #([[]], []) unconditionally (erlamsa_fuse.erl:90-92)
                 acc.append((sent_a, empty))
                 continue
-            if not has_b[g]:
+            if not hbl[g]:
                 continue
-            gb_ = pos_b[g]
-            acc.append((soa[s0:e0][::-1], sob[sb_[gb_]:bb_[gb_]][::-1]))
+            gb_ = pbl[g]
+            acc.append((soa[s0:e0][::-1], sob[sbl[gb_]:bbl[gb_]][::-1]))
         if not acc:
             return _any_position_pair(r, a, b, nodes)
         # the reference insert(0)s every node: final order is reversed

@@ -182,6 +182,16 @@ def _flatten_into(out: bytearray, x) -> None:
     if isinstance(x, str):
         out.extend(x.encode("latin-1", "replace"))
         return
+    if isinstance(x, (list, tuple)):
+        # C fast path: a flat list of in-range ints (the overwhelming
+        # case) converts in one call. Guarded to sequences — a one-shot
+        # iterator would be partially consumed by a failed bytes() and
+        # the fallback loop below would drop its leading elements.
+        try:
+            out.extend(bytes(x))
+            return
+        except (TypeError, ValueError):
+            pass
     for e in x:
         if isinstance(e, int):
             out.append(e & 0xFF)

@@ -56,6 +56,9 @@ def sample_traits(data: bytes) -> dict:
         "looks_json": stripped[:1] in (b"{", b"[", b'"')
         or stripped[:1].isdigit(),
         "is_zip": data[:4] in (b"PK\x03\x04", b"PK\x05\x06"),
+        # gzip magic: the oracle's cp (compressed) pattern decompresses,
+        # mutates and recompresses these — device patterns cannot
+        "is_gz": data[:2] == b"\x1f\x8b",
         "has_uri": b"://" in data,
         "maybe_b64": maybe_b64,
         "size": len(data),
@@ -120,6 +123,7 @@ class HybridDispatcher:
         # abandoned and the device output stands in at merge time
         self.max_running_time = max_running_time
         self._appl_cache: np.ndarray | None = None
+        self._arch_cache: np.ndarray | None = None
         self._appl_corpus: list | None = None
         workers = host_workers or min(8, (os.cpu_count() or 2))
         # The oracle is pure Python, so a thread pool is GIL-bound — the
@@ -148,13 +152,19 @@ class HybridDispatcher:
         evolve."""
         if self._appl_cache is None or self._appl_corpus is not seeds:
             rows = []
+            arch = []
             for s in seeds:
                 traits = sample_traits(s)  # one scan per sample
                 rows.append([row_applicable(c, traits)
                              for c, _p in self.host_rows])
+                arch.append(traits["is_zip"] or traits["is_gz"])
             self._appl_cache = np.asarray(rows, bool).reshape(
                 len(seeds), len(self.host_rows)
             )
+            # archive/compressed containers: only the oracle's ar/cp
+            # PATTERNS can mutate inside these, so they weigh toward the
+            # host even though no single host MUTATOR claims them
+            self._arch_cache = np.asarray(arch, bool)
             self._appl_corpus = seeds
         return self._appl_cache
 
@@ -181,6 +191,12 @@ class HybridDispatcher:
             dm = np.asarray(device_scores, np.float64) @ self.device_pri
         else:
             dm = np.full(len(seeds), self.NEUTRAL_SCORE * self.device_pri.sum())
+        # zip/gzip containers: only the oracle's ar/cp PATTERNS mutate
+        # inside these. ar + cp carry weight 1 each against the device
+        # patterns' summed weight of 9 (src/erlamsa_patterns.erl:394-405),
+        # so scaling the bonus off dm routes a container sample hostward
+        # with at least the reference's 2/11 pattern probability
+        hm = hm + self._arch_cache * dm * (2.0 / 9.0)
         total = hm + dm
         draws = rng.random(len(seeds))
         probs = np.where(total > 0, hm / np.maximum(total, 1e-9), 0.0)

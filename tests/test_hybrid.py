@@ -222,3 +222,59 @@ def test_hostpool_module_is_jax_free():
                        capture_output=True, text=True, timeout=60)
     assert r.returncode == 0, r.stderr
     assert r.stdout.strip() == "False"
+
+
+def test_compressed_corpus_routes_to_host():
+    """gzip/zip container samples must reach the host pool (only the
+    oracle's ar/cp patterns can mutate inside them) at roughly the
+    reference's ar+cp pattern probability, even when no host MUTATOR
+    guard matches the compressed bytes."""
+    import gzip as gz
+
+    from erlamsa_tpu.services.hybrid import HybridDispatcher
+    from erlamsa_tpu.oracle.mutations import default_mutations
+
+    blob = gz.compress(b"inner payload 1234567890" * 8, mtime=0)
+    plain = bytes(range(256)) * 2  # binary, no host traits
+    seeds = [blob] * 64 + [plain] * 64
+    d = HybridDispatcher(list(default_mutations()), (4, 5, 6))
+    try:
+        routed = np.zeros(len(seeds))
+        for case in range(20):
+            routed += d.split(case, seeds)
+        gz_rate = routed[:64].mean() / 20
+        plain_rate = routed[64:].mean() / 20
+        # 2/11 ~ 0.18 from the ar/cp bonus alone; allow sampling slack
+        assert gz_rate > 0.10, gz_rate
+        assert gz_rate > plain_rate + 0.05, (gz_rate, plain_rate)
+    finally:
+        d.close()
+
+
+def test_host_routed_gzip_gets_cp_pattern_treatment():
+    """A host-routed gzip sample runs through the oracle's full pattern
+    set; with the cp pattern in play, outputs are frequently VALID gzip
+    re-compressions of a mutated payload."""
+    import gzip as gz
+    import zlib
+
+    from erlamsa_tpu.services.hybrid import HybridDispatcher
+    from erlamsa_tpu.oracle.mutations import default_mutations
+
+    blob = gz.compress(b"compressed body text 42 " * 16, mtime=0)
+    d = HybridDispatcher(list(default_mutations()), (1, 2, 3))
+    try:
+        ok = 0
+        for case in range(12):
+            res = d.fuzz_host(case, [(0, blob)])
+            out = res.get(0)
+            if not out:
+                continue
+            try:
+                gz.decompress(out)
+                ok += 1
+            except (OSError, EOFError, zlib.error):
+                pass
+        assert ok >= 2, f"only {ok}/12 outputs were valid gzip"
+    finally:
+        d.close()
