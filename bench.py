@@ -88,7 +88,12 @@ def _run_stage(jax, base, batch_n: int, seed_len: int, capacity: int,
             os.environ["ERLAMSA_PALLAS"] = pallas
         batch = pack(make_seeds(batch_n, seed_len), capacity=capacity)
         scores = init_scores(jax.random.fold_in(base, 999), batch_n)
-        step, _ = make_fuzzer(capacity, batch_n, engine=engine)
+        # every seed is exactly seed_len bytes: detection scans need only
+        # that prefix of the (4x growth slack) capacity
+        from erlamsa_tpu.ops.buffers import scan_bound
+
+        step, _ = make_fuzzer(capacity, batch_n, engine=engine,
+                              scan_len=scan_bound(seed_len, capacity))
 
         data, lens = batch.data, batch.lens
         _phase(f"stage B={batch_n} L={seed_len} cap={capacity}: inputs packed", t0)

@@ -42,6 +42,14 @@ def capacity_for(max_len: int, slack: float = 2.0) -> int:
     return CAPACITY_CLASSES[-1]
 
 
+def scan_bound(max_len: int, capacity: int) -> int:
+    """Static detection-scan bound for a batch whose longest sample is
+    max_len (fuzz_batch scan_len): lane-friendly multiple of 256, floored
+    at 256 so a degenerate all-empty batch never yields a width-0 view,
+    capped at the capacity."""
+    return max(256, min(capacity, -(-max_len // 256) * 256))
+
+
 def pack(seeds: Sequence[bytes], capacity: int | None = None) -> Batch:
     """Host -> device: pad/pack a list of byte strings."""
     if not seeds:

@@ -60,12 +60,20 @@ ENGINES = ("fused", "switch")
 
 
 def fuzz_sample(key, data, n, scores, pri, pat_pri, engine: str = "fused",
-                enable_sizer: bool = True, enable_csum: bool = True):
+                enable_sizer: bool = True, enable_csum: bool = True,
+                scan: jax.Array | None = None):
     """Mutate one sample end-to-end. vmapped by fuzz_batch.
 
     enable_sizer/enable_csum are TRACE-TIME switches: when the caller knows
     the sz/cs pattern priorities are zero (make_fuzzer does), the detection
     scans never enter the compiled program.
+
+    scan: optional PREFIX VIEW of data (data[:S] with S >= n for every
+    sample in the batch, caller-guaranteed). The sizer/csum detection
+    scans read only original bytes below n — padding is zero either way —
+    so running them on the short view is bit-identical while cutting
+    their cost by L/S (the applies still use the full capacity, which
+    mutations may grow into).
 
     NOTE: the two engines draw sp/lp permutations differently (fused caps
     the window), so (seed, case) reproducibility holds only within one
@@ -88,6 +96,7 @@ def fuzz_sample(key, data, n, scores, pri, pat_pri, engine: str = "fused",
     from .sizer import detect_sizer, rebuild_sizer, xor8_of_range
 
     pat, rounds, skip = pattern_plan(prng.sub(key, prng.TAG_PROB), n, pat_pri)
+    scan_data = data if scan is None else scan
 
     # sz: mutate only the blob behind a detected length field, then rewrite
     # the field with the blob's new length (vectorized sizer scan,
@@ -97,7 +106,7 @@ def fuzz_sample(key, data, n, scores, pri, pat_pri, engine: str = "fused",
     # rounds. Not found -> degenerates to an od-ish whole-buffer pass.
     if enable_sizer:
         found, field_a, field_w, field_kind, field_end = detect_sizer(
-            prng.sub(key, prng.TAG_LEN), data, n
+            prng.sub(key, prng.TAG_LEN), scan_data, n
         )
         use_sz = (pat == SZ) & found
         skip = jnp.where(use_sz, field_a + field_w, skip)
@@ -116,7 +125,7 @@ def fuzz_sample(key, data, n, scores, pri, pat_pri, engine: str = "fused",
         from .crc32 import crc32_of_range, detect_csum, write_crc32_be
 
         kx = prng.sub(key, prng.TAG_VAL)
-        cs_found, cs_a, pick_crc = detect_csum(kx, data, n)
+        cs_found, cs_a, pick_crc = detect_csum(kx, scan_data, n)
         cs_w = jnp.where(pick_crc, 4, 1)  # trailer width held out below
         use_cs = (pat == CS) & cs_found & ~use_sz
         skip = jnp.where(use_cs, cs_a, skip)
@@ -229,7 +238,7 @@ def _auto_slices(B: int, L: int) -> int:
 
 def fuzz_batch(keys, data, lens, scores, pri, pat_pri, engine: str = "fused",
                enable_sizer: bool = True, enable_csum: bool = True,
-               slices="auto"):
+               slices="auto", scan_len: int | None = None):
     """One device call: mutate a [B, L] batch.
 
     Args:
@@ -256,6 +265,12 @@ def fuzz_batch(keys, data, lens, scores, pri, pat_pri, engine: str = "fused",
         throughput only — under pjit the sort would become a cross-device
         gather, so the mesh path leaves it off.
 
+      scan_len: static prefix bound: caller guarantees every sample's
+        len <= scan_len <= L. The sizer/csum detection scans then run on
+        data[:, :scan_len] — bit-identical (both views are zero beyond
+        each sample's n) at 1/(L/scan_len) the scan cost. The applies
+        keep the full capacity, which mutations may grow into.
+
     Returns (data', lens', scores', FuzzMeta).
     """
     B = data.shape[0]
@@ -266,17 +281,23 @@ def fuzz_batch(keys, data, lens, scores, pri, pat_pri, engine: str = "fused",
     while s > 1 and B % s:
         s //= 2
 
-    def run(k, d, n, sc):
+    use_scan = (scan_len is not None and 0 < scan_len < data.shape[1])
+    scan = data[:, :scan_len] if use_scan else None
+
+    def run(k, d, n, sc, scn_d=None):
+        # scn_d=None flows through vmap as an empty pytree and
+        # fuzz_sample falls back to the full-width row
         out, n_out, scn, pat, log = jax.vmap(
-            lambda ki, di, ni, si: fuzz_sample(
+            lambda ki, di, ni, si, sdi: fuzz_sample(
                 ki, di, ni, si, pri, pat_pri, engine, enable_sizer,
-                enable_csum
-            )
-        )(k, d, n, sc)
+                enable_csum, scan=sdi
+            ),
+            in_axes=(0, 0, 0, 0, 0 if use_scan else None),
+        )(k, d, n, sc, scn_d)
         return out, n_out, scn, pat, log
 
     if s <= 1:
-        out, n_out, sc, pat, log = run(keys, data, lens, scores)
+        out, n_out, sc, pat, log = run(keys, data, lens, scores, scan)
         return out, n_out, sc, FuzzMeta(pat, log)
 
     # the sort key re-derives each sample's rounds draw exactly as
@@ -290,9 +311,11 @@ def fuzz_batch(keys, data, lens, scores, pri, pat_pri, engine: str = "fused",
     def part(x):
         return x[order].reshape((s, B // s) + x.shape[1:])
 
+    parts = (part(keys), part(data), part(lens), part(scores))
+    if use_scan:
+        parts = parts + (part(scan),)
     out, n_out, sc, pat, log = jax.lax.map(
-        lambda a: run(*a),
-        (part(keys), part(data), part(lens), part(scores)),
+        lambda a: run(*a), parts,
     )
 
     def unpart(x):
@@ -315,8 +338,12 @@ def make_class_fuzzer(mutator_pri=None, pattern_pri=None,
     in `indices`, so a sample's stream is a pure function of (seed, case,
     corpus index) no matter how the classes partition the batch.
 
-    step(base, case_idx, indices, data, lens, scores)
+    step(base, case_idx, indices, data, lens, scores, scan_len=None)
       -> (data', lens', scores', meta)
+
+    scan_len (static per call): the caller's bound on max sample length
+    in this batch — the batch runner knows each class's true max, so
+    detection scans run at data width instead of capacity width.
     """
     from .patterns import CS, NUM_PATTERNS, SZ
 
@@ -337,26 +364,30 @@ def make_class_fuzzer(mutator_pri=None, pattern_pri=None,
     enable_sizer = bool(pat_pri[SZ] > 0)
     enable_csum = bool(pat_pri[CS] > 0)
 
-    def step(base, case_idx, indices, data, lens, scores):
+    def step(base, case_idx, indices, data, lens, scores, scan_len=None):
         ckey = prng.case_key(base, case_idx)
         keys = jax.vmap(lambda i: jax.random.fold_in(ckey, i))(indices)
         return fuzz_batch(
             keys, data, lens, scores, jnp.asarray(pri), jnp.asarray(pat_pri),
             engine=engine, enable_sizer=enable_sizer, enable_csum=enable_csum,
-            slices=slices,
+            slices=slices, scan_len=scan_len,
         )
 
-    return jax.jit(step)
+    return jax.jit(step, static_argnames=("scan_len",))
 
 
 def make_fuzzer(capacity: int, batch: int, mutator_pri=None, pattern_pri=None,
-                engine: str = "fused", slices=DEFAULT_SLICES):
+                engine: str = "fused", slices=DEFAULT_SLICES,
+                scan_len: int | None = None):
     """Host convenience: returns (jitted_step, initial_state_fn).
 
     jitted_step(case_idx, data, lens, scores) -> (data', lens', scores', meta)
     with keys derived from (base_seed, case_idx, sample_idx) — the resume
     format is just (seed, case counter), like the reference's
     last_seed.txt + --skip (SURVEY.md §5.4).
+
+    scan_len: static bound on max sample length (see fuzz_batch) — set it
+    when the corpus's longest seed is far below capacity.
     """
     class_step = make_class_fuzzer(mutator_pri, pattern_pri, engine, slices)
     indices = jnp.arange(batch, dtype=jnp.int32)
@@ -368,6 +399,7 @@ def make_fuzzer(capacity: int, batch: int, mutator_pri=None, pattern_pri=None,
             )
         # identical keys to the class step with indices = arange(batch):
         # prng.sample_keys is exactly vmap(fold_in) over arange
-        return class_step(base, case_idx, indices, data, lens, scores)
+        return class_step(base, case_idx, indices, data, lens, scores,
+                          scan_len=scan_len)
 
     return step, init_scores

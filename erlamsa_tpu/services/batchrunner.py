@@ -73,9 +73,15 @@ def run_tpu_batch(opts: dict, batch: int = 1024) -> int:
             overflow_idx.append(i)
         else:
             class_indices.setdefault(cls, []).append(i)
+    # per-class static scan bound: the true max sample length lets
+    # detection scans run at data width instead of capacity width
+    # (fuzz_batch scan_len)
+    from ..ops.buffers import scan_bound
+
     class_batches = {
         cls: (np.asarray(idx, np.int32),
-              pack([corpus[i] for i in idx], capacity=cls))
+              pack([corpus[i] for i in idx], capacity=cls),
+              scan_bound(max(len(corpus[i]) for i in idx), cls))
         for cls, idx in sorted(class_indices.items())
     }
     overflow_set = set(overflow_idx)
@@ -207,9 +213,10 @@ def run_tpu_batch(opts: dict, batch: int = 1024) -> int:
                                  device_scores=np.asarray(scores_in))
         class_outputs = []
         scores_out = scores_in
-        for cls, (idx, packed) in class_batches.items():
+        for cls, (idx, packed, cls_scan) in class_batches.items():
             new_data, new_lens, new_cls_scores, _meta = step(
                 base, case, idx, packed.data, packed.lens, scores_out[idx],
+                scan_len=cls_scan,
             )
             class_outputs.append((idx, new_data, new_lens, new_cls_scores))
             scores_out = scores_out.at[idx].set(new_cls_scores)

@@ -344,3 +344,45 @@ def test_interior_sizer_detected_and_tail_preserved(state):
         if field == blob_len:
             rewritten += 1
     assert rewritten > 10
+
+
+def test_scan_len_bit_identical(state):
+    """scan_len is a pure cost optimization: detection reads only bytes
+    below each sample's n, and padding is zero in both views — outputs
+    must be bit-identical with and without the hint, across the sliced
+    and unsliced execution paths."""
+    from erlamsa_tpu.ops.patterns import DEFAULT_PATTERN_PRI_NP
+    from erlamsa_tpu.ops.registry import DEFAULT_DEVICE_PRI
+    import jax.numpy as jnp
+    import struct
+
+    base, scores = state
+    nb = 32
+    payload = b"SZPAYLOAD_" * 4
+    seeds = (
+        SEEDS[: nb // 2]
+        + [b"HD" + struct.pack(">H", len(payload)) + payload] * (nb // 2)
+    )
+    # capacity 4x the longest seed: the scan hint actually bites
+    cap = 4 * max(len(s) for s in seeds)
+    batch = pack(seeds, capacity=cap)
+    keys = prng.sample_keys(prng.case_key(base, 5), nb)
+    sc = scores[:nb]
+    pri = jnp.asarray(np.asarray(DEFAULT_DEVICE_PRI, np.int32))
+    pat_pri = jnp.asarray(DEFAULT_PATTERN_PRI_NP)
+    from erlamsa_tpu.ops.buffers import scan_bound
+
+    scan = scan_bound(max(len(s) for s in seeds), cap)
+
+    for slices in (0, "auto"):
+        ref = fuzz_batch(keys, batch.data, batch.lens, sc, pri, pat_pri,
+                         slices=slices)
+        got = fuzz_batch(keys, batch.data, batch.lens, sc, pri, pat_pri,
+                         slices=slices, scan_len=scan)
+        for name, a, b in zip(
+            ("data", "lens", "scores", "pattern", "applied"),
+            (*ref[:3], *ref[3]), (*got[:3], *got[3]),
+        ):
+            assert np.array_equal(np.asarray(a), np.asarray(b)), (
+                f"slices={slices}: {name} diverged with scan_len={scan}"
+            )
