@@ -42,114 +42,140 @@ def _char_suffixes(buf: bytes, sufs: list[int]) -> dict[int, list[int]]:
     return subs
 
 
-def _any_position_pair(r: ErlRand, buf_a: bytes, buf_b: bytes, nodes) -> tuple[int, int]:
-    """Pick a random node, then a random source and target suffix
-    (erlamsa_fuse.erl:72-77). rand_elem([]) yields the empty suffix without
-    a draw (erlamsa_rnd:rand_elem clause for []). Nodes hold offset arrays;
-    the empty-suffix marker is the offset len(buf) itself (same value the
-    marker mapped to), so tolist() keeps draw counts and results exact."""
-    froms, tos = r.rand_elem(nodes)
-    frm = r.rand_elem(list(map(int, froms))) if len(froms) else []
-    to = r.rand_elem(list(map(int, tos))) if len(tos) else []
-    frm = frm if isinstance(frm, int) else len(buf_a)
-    to = to if isinstance(to, int) else len(buf_b)
-    return frm, to
+# NOTE: the scalar _any_position_pair and the per-round dict/view bucket
+# builders were removed in r4 when find_jump_points went fully flat; the
+# scalar walk lives on as the pinned reference implementation inside
+# tests/test_fuse_vectorized.py (which also exercises _char_suffixes).
 
 
-def _round_buckets_flat(buf_arr: np.ndarray, n: int, parts):
-    """One round's bucketing for EVERY node at once, kept FLAT: returns
-    (uk, so1, starts, bounds) where uk is the ascending unique
-    node_id*256 + ch keys (the reference's per-node gb_trees ascending
-    walk), so1 holds every advanced offset (+1) in key-sorted walk order,
-    and bucket g is the view so1[starts[g]:bounds[g]][::-1] — the
-    reference's prepend order — with the fix_empty_list marker adjustment
-    already applied to starts. Returning views instead of a dict of
-    per-bucket copies is the difference between ~3 numpy slices per
-    bucket and a python build loop that dominated oracle profiles."""
-    sizes = np.fromiter((p.size for p in parts), np.int64, len(parts))
-    total = int(sizes.sum())
-    empty = np.asarray([], np.int64)
-    if total == 0:
-        return empty, empty, empty, empty
-    offs = np.concatenate(parts)
-    ids = np.repeat(np.arange(len(parts), dtype=np.int64), sizes)
-    m = offs < n
-    offs, ids = offs[m], ids[m]
+def _round_groups(buf_arr: np.ndarray, n: int, offs: np.ndarray,
+                  sizes: np.ndarray):
+    """Flat bucketing over the flat node state: returns
+    (uk, so1, starts, bounds, adj) in key-sorted coordinates, where
+    starts/bounds delimit groups PRE marker adjustment and adj[g] flags a
+    group whose first walked element is the exhausted-suffix marker (the
+    reference's fix_empty_list drops it at insert time,
+    erlamsa_fuse.erl:57-70)."""
+    empty = np.empty(0, np.int64)
     if offs.size == 0:
-        return empty, empty, empty, empty
-    keys = ids * 256 + buf_arr[offs].astype(np.int64)
+        return empty, empty, empty, empty, empty.astype(bool)
+    ids = np.repeat(np.arange(len(sizes), dtype=np.int64), sizes)
+    m = offs < n
+    offs2, ids = offs[m], ids[m]
+    if offs2.size == 0:
+        return empty, empty, empty, empty, empty.astype(bool)
+    keys = ids * 256 + buf_arr[offs2].astype(np.int64)
     order = np.argsort(keys, kind="stable")
     sk = keys[order]
-    so = offs[order]
+    so = offs2[order]
     new_grp = np.empty(len(sk), bool)
     new_grp[0] = True
     np.not_equal(sk[1:], sk[:-1], out=new_grp[1:])
     starts = np.flatnonzero(new_grp)
     uk = sk[starts]
     bounds = np.append(starts[1:], len(sk))
-    # fix_empty_list fires AT INSERT time: the exhausted suffix
-    # (offset n-1 -> marker n) is discarded iff it is the FIRST walked
-    # element of its bucket ([n] collapses to [], and later inserts start
-    # from the emptied bucket); a marker walked into a non-empty bucket
-    # is kept (erlamsa_fuse.erl:57-70)
-    starts = starts + (so[starts] == n - 1)
-    return uk, so + 1, starts, bounds
+    adj = so[starts] == n - 1
+    return uk, so + 1, starts, bounds, adj
 
 
 def find_jump_points(r: ErlRand, a: bytes, b: bytes) -> tuple[int, int]:
     """Walk shared-prefix refinements until the stop draw fires
     (erlamsa_fuse.erl:102-128). Returns byte offsets (from_a, to_b).
 
-    Vectorized over the reference walk (this was the oracle's #2 hotspot:
-    per-suffix dict prepends over every node every round). Each round is
-    ONE grouped argsort per side — node count no longer matters. Bucket
-    contents and refinement order reproduce the scalar walk element-for-
-    element; tests lock both the draw stream and the results."""
+    Fully flat over the reference walk (this was the oracle's #1 hotspot
+    twice over): the node list never materializes — the state between
+    rounds is four arrays (per-side concatenated offsets + per-node
+    sizes, in node order), and a round is one grouped argsort per side,
+    one searchsorted key intersection, and mask/reverse/insert array ops.
+    Node order (the reference's insert(0) reversal), within-bucket
+    prepend order, fix_empty_list marker drops, and the degenerate
+    #([[]], []) sentinel nodes all reproduce the scalar walk element for
+    element; tests lock both the draw stream and the results against a
+    scalar reference implementation."""
     na, nb = len(a), len(b)
     arr_a = np.frombuffer(a, dtype=np.uint8)
     arr_b = np.frombuffer(b, dtype=np.uint8)
-    # suffixes(X) excludes the empty suffix (erlamsa_fuse.erl:52-55)
-    nodes = [(np.arange(na, dtype=np.int64), np.arange(nb, dtype=np.int64))]
-    sent_a = np.asarray([na], np.int64)  # the degenerate node's [[]]
-    empty = np.asarray([], np.int64)
+    # suffixes(X) excludes the empty suffix (erlamsa_fuse.erl:52-55);
+    # node state: offsets concatenated in node order + per-node sizes
+    fa = np.arange(na, dtype=np.int64)
+    fa_sizes = np.asarray([na], np.int64)
+    fb = np.arange(nb, dtype=np.int64)
+    fb_sizes = np.asarray([nb], np.int64)
     fuel = SEARCH_FUEL
     while True:
-        if fuel < 0:
-            return _any_position_pair(r, a, b, nodes)
-        if r.rand(SEARCH_STOP_IP) == 0:
-            return _any_position_pair(r, a, b, nodes)
-        uka, soa, sa_, ba_ = _round_buckets_flat(arr_a, na, [f for f, _ in nodes])
-        ukb, sob, sb_, bb_ = _round_buckets_flat(arr_b, nb, [t for _, t in nodes])
-        # b-side lookup by key: searchsorted over ascending uniques
-        # replaces per-bucket dict inserts for the whole b side
+        if fuel < 0 or r.rand(SEARCH_STOP_IP) == 0:
+            return _pick_flat(r, a, b, fa, fa_sizes, fb, fb_sizes)
+        uka, soa1, sta, bda, adja = _round_groups(arr_a, na, fa, fa_sizes)
+        if uka.size == 0:
+            return _pick_flat(r, a, b, fa, fa_sizes, fb, fb_sizes)
+        ukb, sob1, stb, bdb, adjb = _round_groups(arr_b, nb, fb, fb_sizes)
         pos_b = np.searchsorted(ukb, uka)
-        safe = np.minimum(pos_b, max(len(ukb) - 1, 0))
-        has_b = (pos_b < len(ukb)) & (len(ukb) > 0)
-        if len(ukb):
-            has_b &= ukb[safe] == uka
-        acc: list[tuple[np.ndarray, np.ndarray]] = []
-        # python ints up front: indexing numpy scalars inside the loop
-        # costs more than the loop body itself
-        sal, bal = sa_.tolist(), ba_.tolist()
-        sbl, bbl = sb_.tolist(), bb_.tolist()
-        hbl, pbl = has_b.tolist(), pos_b.tolist()
-        # uka ascending == the per-node gb_trees ascending (node, ch) walk
-        for g in range(len(sal)):
-            s0, e0 = sal[g], bal[g]
-            if s0 == e0:
-                # collapsed bucket: the reference pushes a degenerate
-                # node #([[]], []) unconditionally (erlamsa_fuse.erl:90-92)
-                acc.append((sent_a, empty))
-                continue
-            if not hbl[g]:
-                continue
-            gb_ = pbl[g]
-            acc.append((soa[s0:e0][::-1], sob[sbl[gb_]:bbl[gb_]][::-1]))
-        if not acc:
-            return _any_position_pair(r, a, b, nodes)
-        # the reference insert(0)s every node: final order is reversed
-        nodes = acc[::-1]
-        fuel -= len(acc)
+        if ukb.size:
+            safe = np.minimum(pos_b, ukb.size - 1)
+            has_b = (pos_b < ukb.size) & (ukb[safe] == uka)
+        else:
+            has_b = np.zeros(len(uka), bool)
+        size_a = (bda - sta) - adja
+        # collapsed bucket: the reference pushes a degenerate node
+        # #([[]], []) unconditionally (erlamsa_fuse.erl:90-92)
+        collapsed = size_a == 0
+        kept = has_b & ~collapsed
+        live = kept | collapsed
+        if not live.any():
+            return _pick_flat(r, a, b, fa, fa_sizes, fb, fb_sizes)
+
+        # a side: drop markers and dead groups, splice a sentinel (the
+        # value na == the empty-suffix marker) where a group collapsed,
+        # then reverse — groups are contiguous, so one reversal yields
+        # both the insert(0) node order and the per-bucket prepend order
+        keep_elem = np.ones(len(soa1), bool)
+        keep_elem[sta[adja]] = False
+        dead = ~live
+        if dead.any():
+            delta = np.zeros(len(soa1) + 1, np.int64)
+            np.add.at(delta, sta[dead], 1)
+            np.add.at(delta, bda[dead], -1)
+            keep_elem &= np.cumsum(delta[:-1]) == 0
+        ea = soa1[keep_elem]
+        if collapsed.any():
+            csum_keep = np.concatenate([[0], np.cumsum(keep_elem)])
+            ea = np.insert(ea, csum_keep[sta[collapsed]], na)
+        fa = ea[::-1]
+        fa_sizes = np.where(collapsed, 1, size_a)[live][::-1]
+
+        # b side: elements of the groups matched by kept a-groups (key
+        # ascent is shared, so relative order already agrees), markers
+        # dropped; collapsed nodes contribute size-0 parts
+        bsel = pos_b[kept]
+        keep_b = np.zeros(len(sob1), bool)
+        if bsel.size:
+            delta = np.zeros(len(sob1) + 1, np.int64)
+            np.add.at(delta, stb[bsel] + adjb[bsel], 1)
+            np.add.at(delta, bdb[bsel], -1)
+            keep_b = np.cumsum(delta[:-1]) > 0
+        fb = sob1[keep_b][::-1]
+        szb = np.zeros(len(uka), np.int64)
+        szb[kept] = ((bdb - stb) - adjb)[bsel]
+        fb_sizes = szb[live][::-1]
+
+        fuel -= int(live.sum())
+
+
+def _pick_flat(r: ErlRand, buf_a: bytes, buf_b: bytes,
+               fa, fa_sizes, fb, fb_sizes) -> tuple[int, int]:
+    """_any_position_pair over the flat node state: same three draws
+    (node, from-suffix, to-suffix) in the same order."""
+    count = len(fa_sizes)
+    idx = r.uniform_n(count) - 1  # rand_elem over the node list
+    ba_ = np.concatenate([[0], np.cumsum(fa_sizes)])
+    bb_ = np.concatenate([[0], np.cumsum(fb_sizes)])
+    froms = fa[ba_[idx]:ba_[idx + 1]]
+    tos = fb[bb_[idx]:bb_[idx + 1]]
+    frm = r.rand_elem(list(map(int, froms))) if len(froms) else []
+    to = r.rand_elem(list(map(int, tos))) if len(tos) else []
+    frm = frm if isinstance(frm, int) else len(buf_a)
+    to = to if isinstance(to, int) else len(buf_b)
+    return frm, to
 
 
 def fuse(r: ErlRand, a: bytes, b: bytes) -> bytes:
