@@ -108,6 +108,22 @@ def main() -> None:
     run_stage("pallas2_small", B1, SL, CAP, IT, pallas="2")
     run_stage("switch_small", B1, SL, CAP, max(1, IT - 2), engine="switch")
 
+    # the honest product number on hardware: full mutator set end-to-end
+    # (device batches + host oracle pool), same stage bench.py reports
+    stage: dict = {"batch": B1, "seed_len": SL}
+    report["stages"]["full_set"] = stage
+    bank()
+    try:
+        full_sps, host_frac = bench._run_full_set_stage(B1, SL, 2, T0)
+        stage.update(status="ok", samples_per_sec=round(full_sps, 1),
+                     host_routed_frac=round(host_frac, 4))
+        log(f"full_set: {full_sps:,.0f} samples/sec "
+            f"({host_frac:.1%} host-routed)")
+    except Exception as e:  # noqa: BLE001
+        stage.update(status="error", error=f"{type(e).__name__}: {e}")
+        log(f"full_set: FAILED {type(e).__name__}: {e}")
+    bank()
+
     # profiler trace for the tuning story (big; gitignored) — reuses the
     # program+buffers the fused_full stage already compiled
     try:

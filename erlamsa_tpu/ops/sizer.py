@@ -104,7 +104,8 @@ def detect_sizer(key, data, n):
     kind = (flat // L).astype(jnp.int32)
     a = (flat % L).astype(jnp.int32)
     width = jnp.asarray((1, 2, 2, 4, 4), jnp.int32)[kind]
-    val = jnp.stack(vals)[kind, a]
+    # five scalar reads, not a [5, L] stack-then-gather
+    val = jnp.stack([v[a] for v in vals])[kind]
     end = jnp.minimum(val + a + width, n)
     return any_found, a, width, kind, end
 

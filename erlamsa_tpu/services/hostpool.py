@@ -9,6 +9,12 @@ tests/test_hybrid.py pins the no-jax property.
 from __future__ import annotations
 
 
+def warmup(_i):
+    """No-op used to force worker bootstrap while the parent holds a
+    known-safe environment (see HybridDispatcher.__init__)."""
+    return None
+
+
 def host_worker(args):
     """One host-routed oracle case, a pure function of its args so results
     are identical across thread and process pools."""

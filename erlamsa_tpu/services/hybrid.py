@@ -142,6 +142,24 @@ class HybridDispatcher:
             self._pool: cf.Executor = cf.ProcessPoolExecutor(
                 max_workers=workers, mp_context=mp.get_context("spawn")
             )
+            # force worker bootstrap NOW, under a known-safe env: this
+            # image's sitecustomize imports jax into every interpreter,
+            # and a bare jax import can block when the axon relay is
+            # wedged — workers must never inherit the parent's TPU env
+            from .hostpool import warmup
+
+            saved = {k: os.environ.get(k)
+                     for k in ("JAX_PLATFORMS", "PALLAS_AXON_POOL_IPS")}
+            os.environ["JAX_PLATFORMS"] = "cpu"
+            os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+            try:
+                list(self._pool.map(warmup, range(workers)))
+            finally:
+                for k, v in saved.items():
+                    if v is None:
+                        os.environ.pop(k, None)
+                    else:
+                        os.environ[k] = v
         else:
             self._pool = cf.ThreadPoolExecutor(max_workers=workers)
 
