@@ -120,7 +120,8 @@ def main() -> None:
         log(f"full_set: {full_sps:,.0f} samples/sec "
             f"({host_frac:.1%} host-routed)")
     except Exception as e:  # noqa: BLE001
-        stage.update(status="error", error=f"{type(e).__name__}: {e}")
+        stage.update(status="error", error=f"{type(e).__name__}: {e}",
+                     traceback=traceback.format_exc()[-2000:])
         log(f"full_set: FAILED {type(e).__name__}: {e}")
     bank()
 

@@ -154,6 +154,13 @@ class HybridDispatcher:
             os.environ.pop("PALLAS_AXON_POOL_IPS", None)
             try:
                 list(self._pool.map(warmup, range(workers)))
+            except Exception:
+                # a worker died during bootstrap (BrokenProcessPool, OOM,
+                # import failure): reap the executor rather than leak its
+                # workers, and degrade to the thread pool — slower but
+                # functional
+                self._pool.shutdown(wait=False)
+                self._pool = cf.ThreadPoolExecutor(max_workers=workers)
             finally:
                 for k, v in saved.items():
                     if v is None:
