@@ -1,0 +1,96 @@
+"""Append the r4 structured golden layer to the self-goldens.
+
+The existing goldens (r2) lock every mutator/pattern on generic inputs;
+this layer adds inputs chosen to drive the oracle paths that were
+vectorized in r4 — the fuse suffix walk (repetitive text), the strlex
+quote/escape scanner, fieldpred's interior sizers, and the ar/cp
+container patterns — so any future stream drift in those paths breaks a
+checked-in golden loudly, not just a differential test that lives next
+to the code it checks.
+
+APPEND-ONLY by design: existing blob bytes and manifest entries are
+preserved verbatim; new segments land at the end of the blob. Running it
+twice is a no-op (keys that already exist are skipped).
+
+Usage: python bin/gen_goldens.py
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+sys.path.insert(0, os.path.join(REPO, "tests"))
+
+GOLDEN_JSON = os.path.join(REPO, "tests", "goldens", "self_goldens.json")
+GOLDEN_BLOB = os.path.join(REPO, "tests", "goldens", "self_goldens.bin")
+
+SEEDS = ((11, 22, 33), (777, 13, 99))
+NEW_INPUTS = ("repeat", "quoted", "zipfile", "gzipped", "sized")
+# mutators whose implementations were touched (or whose guards key on the
+# new inputs) — locked per new input
+MUTAS = ("ft", "fn", "fo", "b64", "uri", "len", "sgm", "js", "tr2", "num",
+         "ab", "zip")
+PATTERNS = ("ar", "cp", "sz", "cs", "od", "bu")
+
+
+def main() -> None:
+    from erlamsa_tpu.oracle.engine import Engine, fuzz
+    from test_parity import INPUTS
+
+    with open(GOLDEN_JSON) as f:
+        manifest = json.load(f)
+    with open(GOLDEN_BLOB, "rb") as f:
+        blob = bytearray(f.read())
+
+    for name in NEW_INPUTS:
+        manifest["inputs"][name] = hashlib.sha256(INPUTS[name]).hexdigest()
+
+    def put(key: str, out: bytes) -> bool:
+        if key in manifest["goldens"]:
+            return False
+        manifest["goldens"][key] = {
+            "offset": len(blob), "size": len(out),
+            "sha256": hashlib.sha256(out).hexdigest(),
+        }
+        blob.extend(out)
+        return True
+
+    added = 0
+    for inp in NEW_INPUTS:
+        data = INPUTS[inp]
+        for seed in SEEDS:
+            s = "-".join(map(str, seed))
+            for m in MUTAS:
+                added += put(
+                    f"muta/{m}/{inp}/{s}",
+                    fuzz(data, seed=seed, mutations=[(m, 1)],
+                         patterns=[("od", 1)]),
+                )
+            for p in PATTERNS:
+                added += put(
+                    f"pattern/{p}/{inp}/{s}",
+                    fuzz(data, seed=seed, patterns=[(p, 1)]),
+                )
+        # full default-config three-case stream
+        seed = SEEDS[0]
+        s = "-".join(map(str, seed))
+        eng = Engine({"paths": ["direct"], "input": data, "seed": seed,
+                      "n": 3})
+        for i, out in enumerate(eng.run()):
+            added += put(f"default/{inp}/{s}/case{i + 1}", out)
+
+    with open(GOLDEN_BLOB, "wb") as f:
+        f.write(blob)
+    with open(GOLDEN_JSON, "w") as f:
+        json.dump(manifest, f, indent=1, sort_keys=True)
+    print(f"added {added} goldens "
+          f"({len(manifest['goldens'])} total, blob {len(blob)} bytes)")
+
+
+if __name__ == "__main__":
+    main()

@@ -153,13 +153,23 @@ class HybridDispatcher:
             os.environ["JAX_PLATFORMS"] = "cpu"
             os.environ.pop("PALLAS_AXON_POOL_IPS", None)
             try:
-                list(self._pool.map(warmup, range(workers)))
-            except Exception:
-                # a worker died during bootstrap (BrokenProcessPool, OOM,
-                # import failure): reap the executor rather than leak its
-                # workers, and degrade to the thread pool — slower but
-                # functional
-                self._pool.shutdown(wait=False)
+                # the timeout also covers a worker HANGING in bootstrap
+                # (e.g. a blocked import this env scrub didn't prevent):
+                # TimeoutError routes to the same degrade path
+                list(self._pool.map(warmup, range(workers), timeout=60))
+            except Exception as e:  # noqa: BLE001
+                # a worker died or hung during bootstrap: reap the
+                # executor rather than leak its workers, and degrade to
+                # the thread pool — slower (GIL-bound) but functional
+                from . import logger
+
+                logger.log(
+                    "warning",
+                    "host process pool failed during warmup (%s: %s); "
+                    "degrading to a GIL-bound thread pool",
+                    type(e).__name__, e,
+                )
+                self._pool.shutdown(wait=False, cancel_futures=True)
                 self._pool = cf.ThreadPoolExecutor(max_workers=workers)
             finally:
                 for k, v in saved.items():

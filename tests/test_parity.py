@@ -32,6 +32,39 @@ GOLDEN_JSON = os.path.join(HERE, "goldens", "self_goldens.json")
 GOLDEN_BLOB = os.path.join(HERE, "goldens", "self_goldens.bin")
 REFERENCE_DIR = os.path.join(HERE, "goldens", "reference")
 
+def _zip_input() -> bytes:
+    import io
+    import zipfile
+
+    buf = io.BytesIO()
+    with zipfile.ZipFile(buf, "w", zipfile.ZIP_DEFLATED) as z:
+        for name, content in (
+            ("member-a.txt", b"zip member alpha value=1001\n" * 4),
+            ("dir/member-b.bin", bytes(range(64))),
+        ):
+            # fixed timestamp: writestr(str, ...) embeds the wall clock
+            # and the golden INPUT must be byte-stable across runs
+            info = zipfile.ZipInfo(name, date_time=(1980, 1, 1, 0, 0, 0))
+            info.compress_type = zipfile.ZIP_DEFLATED
+            z.writestr(info, content)
+    return buf.getvalue()
+
+
+def _gzip_input() -> bytes:
+    import gzip
+
+    return gzip.compress(b"compressed body: count=4242 flag=on\n" * 6,
+                         mtime=0)
+
+
+def _sized_input() -> bytes:
+    import struct
+
+    payload = b"INTERIOR_SIZED_BLOB_" + bytes(range(48))
+    return (b"HD" + struct.pack(">H", len(payload)) + payload
+            + b"TRAILING_SUFFIX")
+
+
 INPUTS = {
     "text": b"Golden sample: value=12345 name=test <tag attr='x'>text body"
             b"</tag> [1,2,3] {\"k\": 42}\n" * 3,
@@ -39,6 +72,14 @@ INPUTS = {
     "lines": b"".join(
         b"line %03d with number %d\n" % (i, i * 7) for i in range(20)
     ),
+    # r4 structured layer: inputs exercising the vectorized oracle paths
+    # (fuse walk, strlex quoting, fieldpred interior sizers, containers)
+    "repeat": b"abcabcabcabc shared shared shared prefix prefix 789\n" * 8,
+    "quoted": b"key='val\\'ue' other=\"ab\\\"cd\" plain text 55 "
+              b"'unterminated trail\n" * 4,
+    "zipfile": _zip_input(),
+    "gzipped": _gzip_input(),
+    "sized": _sized_input(),
 }
 
 with open(GOLDEN_JSON) as f:
@@ -103,16 +144,18 @@ def test_self_golden(key):
 
 
 @pytest.mark.parametrize(
-    "seed_s", sorted({k.split("/")[2] for k in _MANIFEST["goldens"]
-                      if k.startswith("default/")})
+    "inp_seed", sorted({tuple(k.split("/")[1:3])
+                        for k in _MANIFEST["goldens"]
+                        if k.startswith("default/")})
 )
-def test_self_golden_default_stream(seed_s):
+def test_self_golden_default_stream(inp_seed):
+    inp, seed_s = inp_seed
     seed = tuple(map(int, seed_s.split("-")))
-    eng = Engine({"paths": ["direct"], "input": INPUTS["text"],
+    eng = Engine({"paths": ["direct"], "input": INPUTS[inp],
                   "seed": seed, "n": 3})
     outs = eng.run()
     for i, o in enumerate(outs):
-        assert o == _expected(f"default/text/{seed_s}/case{i + 1}")
+        assert o == _expected(f"default/{inp}/{seed_s}/case{i + 1}")
 
 
 def _reference_files():
