@@ -33,7 +33,20 @@ import numpy as np  # noqa: F401  (child uses it; import kept cheap)
 # env-overridable for smoke runs on weak hosts (CPU fallback)
 BATCH = int(os.environ.get("ERLAMSA_BENCH_BATCH", 2048))
 SEED_LEN = int(os.environ.get("ERLAMSA_BENCH_SEED_LEN", 4096))
-CAPACITY = int(os.environ.get("ERLAMSA_BENCH_CAPACITY", 16384))  # 4x growth slack
+# default capacity = the product's own policy (buffers.capacity_for, 2x
+# growth slack -> the 8192 class for 4KB seeds); the class table is
+# inlined because the bench PARENT must never import erlamsa_tpu/jax
+# (a bare jax import can hang under a wedged relay — see module
+# docstring); the child re-derives nothing, it receives the number
+_CLASSES = (256, 1024, 2048, 4096, 8192, 16384, 65536, 262144, 1_000_000)
+
+
+def _capacity_for(n: int, slack: float = 2.0) -> int:
+    want = max(1, int(n * slack))
+    return next((c for c in _CLASSES if c >= want), _CLASSES[-1])
+
+
+CAPACITY = int(os.environ.get("ERLAMSA_BENCH_CAPACITY", 0)) or _capacity_for(SEED_LEN)
 WARMUP = 2
 ITERS = int(os.environ.get("ERLAMSA_BENCH_ITERS", 10))
 REPO = os.path.dirname(os.path.abspath(__file__))
@@ -333,7 +346,12 @@ def parent_main() -> None:
     # fallback number should show the engine at its best on this host
     env.setdefault("ERLAMSA_BENCH_BATCH", "2048")
     env.setdefault("ERLAMSA_BENCH_SEED_LEN", "1024")
-    env.setdefault("ERLAMSA_BENCH_CAPACITY", "4096")
+    # capacity follows whatever seed length survived the setdefault (a
+    # user-supplied SEED_LEN must not pair with an undershooting cap)
+    env.setdefault(
+        "ERLAMSA_BENCH_CAPACITY",
+        str(_capacity_for(int(env["ERLAMSA_BENCH_SEED_LEN"]))),
+    )
     env.setdefault("ERLAMSA_BENCH_ITERS", "3")
     fb_result = os.path.join(REPO, f"bench_fb_result.{pid}.json")
     fb = _spawn(env, fb_result, None)

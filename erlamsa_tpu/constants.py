@@ -56,5 +56,9 @@ DEFAULT_CM_PORT = 51234
 
 # Default TPU batch capacity classes: sample buffers are padded to the
 # smallest class >= seed length * growth slack.  TPU-native choice: lane
-# dimension multiples of 128 keep layouts tight.
-CAPACITY_CLASSES = (256, 1024, 4096, 16384, 65536, 262144, ABSMAX_BINARY_BLOCK)
+# dimension multiples of 128 keep layouts tight. The 2048/8192 rungs
+# matter: common 1KB/4KB corpora at the default 2x slack land exactly
+# there — without them capacity_for jumps 4x and every O(L) pass pays
+# double (measured 1.7x e2e at 1KB seeds, PROFILE.md).
+CAPACITY_CLASSES = (256, 1024, 2048, 4096, 8192, 16384, 65536, 262144,
+                    ABSMAX_BINARY_BLOCK)

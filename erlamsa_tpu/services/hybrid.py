@@ -169,6 +169,15 @@ class HybridDispatcher:
                     "degrading to a GIL-bound thread pool",
                     type(e).__name__, e,
                 )
+                # kill the workers before discarding the executor: a
+                # worker HUNG in bootstrap is non-daemon and cannot be
+                # cancelled, and concurrent.futures' atexit hook would
+                # otherwise join it forever at interpreter exit
+                for p in getattr(self._pool, "_processes", {}).values():
+                    try:
+                        p.terminate()
+                    except Exception:  # noqa: BLE001 — already dead is fine
+                        pass
                 self._pool.shutdown(wait=False, cancel_futures=True)
                 self._pool = cf.ThreadPoolExecutor(max_workers=workers)
             finally:

@@ -43,9 +43,12 @@ def _zip_input() -> bytes:
             ("dir/member-b.bin", bytes(range(64))),
         ):
             # fixed timestamp: writestr(str, ...) embeds the wall clock
-            # and the golden INPUT must be byte-stable across runs
+            # and the golden INPUT must be byte-stable across runs;
+            # create_system pins the platform byte (0 on Windows, 3
+            # elsewhere by default) for the same reason
             info = zipfile.ZipInfo(name, date_time=(1980, 1, 1, 0, 0, 0))
             info.compress_type = zipfile.ZIP_DEFLATED
+            info.create_system = 3
             z.writestr(info, content)
     return buf.getvalue()
 
