@@ -68,7 +68,10 @@ def tokenize(data: bytes) -> list[tuple]:
     text_start = 0
     while i < n:
         if data[i] != 0x3C:  # <
-            i += 1
+            # jump straight to the next tag opener (C-level find); the
+            # skipped run is plain text emitted at the next boundary
+            nxt = data.find(b"<", i)
+            i = n if nxt < 0 else nxt
             continue
         if i > text_start:
             toks.append(("text", data[text_start:i]))
