@@ -103,6 +103,15 @@ class HybridDispatcher:
     NEUTRAL_SCORE = 6.0
     MIN_SCORE, MAX_SCORE = 2.0, 10.0
 
+    #: CONSTRAINT (ADVICE r4): with the process pool, __init__ mutates
+    #: process-global os.environ (JAX_PLATFORMS=cpu, PALLAS_AXON_POOL_IPS
+    #: removed) for the duration of worker warmup (<= 60s) so spawned
+    #: workers never inherit the parent's TPU env. Any OTHER thread
+    #: initializing jax in that window would silently land on the CPU
+    #: backend. Safe on the single-threaded batchrunner path; library
+    #: callers must construct HybridDispatcher before starting threads
+    #: that touch jax (or set ERLAMSA_HOST_POOL=thread).
+
     def __init__(self, selected: list[tuple[str, int]], seed,
                  host_workers: int | None = None,
                  max_running_time: float = 30.0):

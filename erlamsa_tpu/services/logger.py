@@ -137,7 +137,16 @@ class SqliteSink:
     def __init__(self, path: str):
         import sqlite3
 
-        self._conn = sqlite3.connect(path, check_same_thread=False)
+        # busy timeout + WAL: a concurrent --list-findings reader must not
+        # make the per-row durable commit raise 'database is locked' (the
+        # drain loop's blanket except would silently drop the finding row)
+        self._conn = sqlite3.connect(path, check_same_thread=False,
+                                     timeout=5.0)
+        self._op_err = sqlite3.OperationalError
+        try:
+            self._conn.execute("PRAGMA journal_mode=WAL")
+        except Exception:  # noqa: BLE001 — e.g. WAL unsupported on this fs
+            pass
         self._conn.execute(
             "CREATE TABLE IF NOT EXISTS log ("
             " id INTEGER PRIMARY KEY AUTOINCREMENT,"
@@ -153,11 +162,27 @@ class SqliteSink:
         parts = line.split("\t", 2)
         ts, level, msg = (parts if len(parts) == 3 else ("", "info", line))
         with self._lock:
-            self._conn.execute(
-                "INSERT INTO log (ts, level, message) VALUES (?, ?, ?)",
-                (ts, level, msg),
-            )
-            self._conn.commit()
+            for attempt in (0, 1):
+                try:
+                    self._conn.execute(
+                        "INSERT INTO log (ts, level, message) VALUES (?, ?, ?)",
+                        (ts, level, msg),
+                    )
+                    self._conn.commit()
+                    break
+                except self._op_err:
+                    # locked despite the busy timeout: roll the pending
+                    # INSERT back (a failed commit leaves it in the open
+                    # transaction — retrying without rollback would record
+                    # the row twice), then retry once before letting the
+                    # drain loop drop it
+                    try:
+                        self._conn.rollback()
+                    except self._op_err:
+                        pass
+                    if attempt:
+                        raise
+                    time.sleep(0.05)
 
 
 def query_log(path: str, level: str | None = None, like: str | None = None,

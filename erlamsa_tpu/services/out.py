@@ -12,6 +12,13 @@ writer; network failure raises so the main loop can back off
                              to whoever sends a datagram first)
     "http://url"             HTTP POST      "http://:port[,Content-Type]"
                              serve fuzz as a 200 response per connection
+
+    The bare ":port" listen forms bind 0.0.0.0 (all interfaces — fuzz
+    output is served to ANY client that connects, matching the
+    reference). To restrict the bind, use the ",listen" forms:
+    "tcp://127.0.0.1:port,listen", "udp://127.0.0.1:port,listen",
+    "http://127.0.0.1:port,listen[,Content-Type]".
+
     "exec://cmdline"         spawn target, feed stdin (erlexec analogue)
     "serial://dev:baud"      serial device (termios)
     "can://iface:id"         SocketCAN 8-byte frames
@@ -89,12 +96,14 @@ def _tls_writer(host: str, port: int) -> Writer:
     return write
 
 
-def _tcp_listen_writer(port: int) -> Writer:
+def _tcp_listen_writer(port: int, bind_host: str = "0.0.0.0") -> Writer:
     """Listen mode: serve each accepted connection one fuzzed case
-    (erlamsa_out.erl tcp listen path)."""
+    (erlamsa_out.erl tcp listen path). The bare "tcp://:port" spec binds
+    all interfaces like the reference; "tcp://host:port,listen" restricts
+    the bind (e.g. 127.0.0.1 keeps fuzz output off the network)."""
     srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
     srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
-    srv.bind(("0.0.0.0", port))
+    srv.bind((bind_host, port))
     srv.listen(16)
 
     def write(case_idx: int, data: bytes, meta: list) -> None:
@@ -107,13 +116,14 @@ def _tcp_listen_writer(port: int) -> Writer:
     return write
 
 
-def _udp_listen_writer(port: int) -> Writer:
+def _udp_listen_writer(port: int, bind_host: str = "0.0.0.0") -> Writer:
     """UDP listen mode (erlamsa_out.erl udplisten_writer): bind once; each
     case blocks for an incoming datagram, then sends the fuzzed case back
-    to that sender — the UDP analogue of serve-on-connect."""
+    to that sender — the UDP analogue of serve-on-connect. bind_host as in
+    _tcp_listen_writer ("udp://host:port,listen" restricts the bind)."""
     sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
     sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
-    sock.bind(("0.0.0.0", port))
+    sock.bind((bind_host, port))
 
     def write(case_idx: int, data: bytes, meta: list) -> None:
         packet, addr = sock.recvfrom(65535)
@@ -124,14 +134,15 @@ def _udp_listen_writer(port: int) -> Writer:
     return write
 
 
-def _http_listen_writer(port: int, content_type: str) -> Writer:
+def _http_listen_writer(port: int, content_type: str,
+                        bind_host: str = "0.0.0.0") -> Writer:
     """HTTP server mode (erlamsa_out.erl:424-445 make_http_server_reply +
     streamlisten_writer wiring): serve each connecting client one fuzzed
     case as a complete 200 response. The request itself is read best-effort
     and logged — fuzzing clients often send junk; we answer regardless."""
     srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
     srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
-    srv.bind(("0.0.0.0", port))
+    srv.bind((bind_host, port))
     srv.listen(16)
 
     def write(case_idx: int, data: bytes, meta: list) -> None:
@@ -444,6 +455,15 @@ def string_outputs(spec, monitor_notify=None) -> tuple[Writer | None, float]:
         return _stdout_writer, DEFAULT_MAX_RUNNING_TIME
     if spec.startswith("tcp://"):
         rest = spec[6:]
+        # "tcp://host:port,listen": listen bound to host (loopback keeps
+        # fuzz output off the network); bare "tcp://:port" binds 0.0.0.0
+        # like the reference
+        if rest.endswith(",listen"):
+            host, _, port = rest[: -len(",listen")].rpartition(":")
+            return (
+                _tcp_listen_writer(int(port), host or "0.0.0.0"),
+                DEFAULT_MAX_RUNNING_TIME,
+            )
         host, _, port = rest.rpartition(":")
         if host == "":
             return _tcp_listen_writer(int(port)), DEFAULT_MAX_RUNNING_TIME
@@ -453,6 +473,12 @@ def string_outputs(spec, monitor_notify=None) -> tuple[Writer | None, float]:
         return _tls_writer(host or "127.0.0.1", int(port)), DEFAULT_MAX_RUNNING_TIME
     if spec.startswith("udp://"):
         rest = spec[6:]
+        if rest.endswith(",listen"):  # bound listen form, same as tcp://
+            host, _, port = rest[: -len(",listen")].rpartition(":")
+            return (
+                _udp_listen_writer(int(port), host or "0.0.0.0"),
+                DEFAULT_MAX_RUNNING_TIME,
+            )
         if rest.startswith(":"):
             # only the explicit "udp://:port" form listens, mirroring tcp://
             return _udp_listen_writer(int(rest[1:])), DEFAULT_MAX_RUNNING_TIME
@@ -463,6 +489,24 @@ def string_outputs(spec, monitor_notify=None) -> tuple[Writer | None, float]:
         # erlamsa_out.erl http_writer empty-host clauses); anything with a
         # host is a POST client
         scheme, rest = spec.split("://", 1)
+        if (",listen" in rest) and scheme == "https":
+            raise SystemExit(
+                "https server mode is not supported; use "
+                "http://host:port,listen (plaintext) or terminate TLS in "
+                "front"
+            )
+        if (",listen" in rest) and scheme == "http":
+            # "http://host:port,listen[,CT]": server mode bound to host
+            hostport, _, ctype = rest.partition(",listen")
+            host, _, port_s = hostport.rpartition(":")
+            return (
+                _http_listen_writer(
+                    int(port_s),
+                    ctype.lstrip(",").strip() or "application/octet-stream",
+                    host or "0.0.0.0",
+                ),
+                DEFAULT_MAX_RUNNING_TIME,
+            )
         if rest.startswith(":"):
             if scheme == "https":
                 # the reference's https server mode needs cert/key files

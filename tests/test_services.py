@@ -809,3 +809,53 @@ def test_findings_survive_process_exit(tmp_path):
     out_text = listing.stdout.decode()
     assert "died with signal 11" in out_text
     assert "finding(s)" in listing.stderr.decode()
+
+
+def test_bench_capacity_classes_match_product():
+    """bench.py inlines the capacity-class table so the bench parent never
+    imports erlamsa_tpu/jax; this pin stops the copies drifting (a change
+    to CAPACITY_CLASSES would otherwise silently make the bench measure a
+    different capacity policy than the product ships)."""
+    import importlib.util
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    spec = importlib.util.spec_from_file_location(
+        "bench_under_test", os.path.join(repo, "bench.py")
+    )
+    bench = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(bench)
+
+    from erlamsa_tpu.constants import CAPACITY_CLASSES
+
+    assert bench._CLASSES == CAPACITY_CLASSES
+
+
+def test_listen_writers_bound_to_loopback():
+    """The ",listen" spec forms restrict the bind host (ADVICE r4: the
+    bare :port forms serve fuzz output on all interfaces)."""
+    port = _free_port()
+    w, _ = string_outputs(f"udp://127.0.0.1:{port},listen")
+    t = threading.Thread(target=w, args=(1, b"bound-udp", []), daemon=True)
+    t.start()
+    cli = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    cli.settimeout(5)
+    cli.sendto(b"ping", ("127.0.0.1", port))
+    data, _addr = cli.recvfrom(65535)
+    t.join(5)
+    cli.close()
+    assert data == b"bound-udp"
+
+    port2 = _free_port()
+    w2, _ = string_outputs(f"tcp://127.0.0.1:{port2},listen")
+    t2 = threading.Thread(target=w2, args=(1, b"bound-tcp", []), daemon=True)
+    t2.start()
+    c2 = socket.create_connection(("127.0.0.1", port2), timeout=5)
+    chunks = b""
+    while True:
+        b = c2.recv(4096)
+        if not b:
+            break
+        chunks += b
+    t2.join(5)
+    c2.close()
+    assert chunks == b"bound-tcp"
