@@ -1,0 +1,167 @@
+#!/usr/bin/env python
+"""Service-layer load measurement: BASELINE configs 4 and 5.
+
+Config 4 — FaaS: fire N concurrent HTTP fuzz requests at services/faas.py
+  (the reference's 10k-concurrent-request analogue of
+  /root/reference/src/erlamsa_fsupervisor.erl:59-86) and record req/s,
+  p50/p99 latency and — for the tpu backend — batcher fill efficiency.
+Config 5 — proxy: stream cases through a live tcp fuzzproxy at
+  -P 1.0,1.0 (/root/reference/src/erlamsa_fuzzproxy.erl:261-296) and
+  record forwarded cases/s.
+
+Run standalone (prints one JSON line) or from bench.py via run_all().
+N defaults to 10_000 requests / 2_000 proxy cases; ERLAMSA_LOAD_N and
+ERLAMSA_LOAD_CONC shrink it for smoke runs. Everything binds loopback.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import statistics
+import threading
+import time
+import urllib.request
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def faas_load(n_requests: int, concurrency: int, backend: str = "oracle",
+              payload: bytes = b"faas load sample value=123\n") -> dict:
+    """Start a FaaS server, fire n_requests with a bounded worker pool,
+    return {reqs_per_sec, p50_ms, p99_ms, errors, fill_efficiency?}."""
+    from erlamsa_tpu.services.faas import serve
+
+    port = _free_port()
+    srv = serve("127.0.0.1", port, {"seed": (1, 2, 3)}, backend=backend,
+                batch=64, block=False)
+    url = f"http://127.0.0.1:{port}/erlamsa/erlamsa_esi:fuzz"
+
+    lat: list[float] = []
+    lat_lock = threading.Lock()
+    errors = [0]
+    it = iter(range(n_requests))
+    it_lock = threading.Lock()
+
+    def worker():
+        while True:
+            with it_lock:
+                nxt = next(it, None)
+            if nxt is None:
+                return
+            t0 = time.monotonic()
+            try:
+                with urllib.request.urlopen(url, data=payload, timeout=90) as r:
+                    r.read()
+                    # empty bodies are legitimate fuzz results (e.g. a
+                    # line-delete emptying a one-line sample); an error is
+                    # a non-200 or a give-up reply
+                    ok = (r.status == 200
+                          and r.headers.get("erlamsa-status", "ok") != "error")
+            except Exception:  # noqa: BLE001 — any failure is an error count
+                ok = False
+            dt = time.monotonic() - t0
+            with lat_lock:
+                lat.append(dt)
+                if not ok:
+                    errors[0] += 1
+
+    threads = [threading.Thread(target=worker, daemon=True)
+               for _ in range(concurrency)]
+    t0 = time.monotonic()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall = time.monotonic() - t0
+
+    qs = statistics.quantiles(lat, n=100) if len(lat) >= 100 else sorted(lat)
+    out = {
+        "faas_requests": n_requests,
+        "faas_concurrency": concurrency,
+        "faas_reqs_per_sec": round(n_requests / wall, 1),
+        "faas_p50_ms": round(statistics.median(lat) * 1000, 2),
+        "faas_p99_ms": round((qs[98] if len(qs) >= 99 else max(lat)) * 1000, 2),
+        "faas_errors": errors[0],
+    }
+    batcher = getattr(srv.RequestHandlerClass, "batcher", None)
+    if batcher is not None and hasattr(batcher, "fill_efficiency"):
+        out["faas_fill_efficiency"] = round(batcher.fill_efficiency, 3)
+    srv.shutdown()
+    return out
+
+
+def proxy_stream(n_cases: int, payload: bytes = b"proxy stream case 42\n") -> dict:
+    """Live tcp fuzzproxy at -P 1.0,1.0: an echo upstream, one client
+    pumping n_cases request/response pairs through the proxy."""
+    from erlamsa_tpu.services.proxy import FuzzProxy
+
+    up_port = _free_port()
+    upstream = socket.socket()
+    upstream.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    upstream.bind(("127.0.0.1", up_port))
+    upstream.listen(8)
+
+    def echo_server():
+        while True:
+            try:
+                conn, _ = upstream.accept()
+            except OSError:
+                return
+            while True:
+                try:
+                    chunk = conn.recv(65536)
+                except OSError:
+                    break
+                if not chunk:
+                    break
+                try:
+                    conn.sendall(chunk)
+                except OSError:
+                    break
+            conn.close()
+
+    threading.Thread(target=echo_server, daemon=True).start()
+
+    l_port = _free_port()
+    proxy = FuzzProxy(f"tcp://{l_port}:127.0.0.1:{up_port}",
+                      probs="1.0,1.0", opts={"seed": (1, 2, 3)})
+    proxy.start(block=False)
+    time.sleep(0.3)
+
+    cli = socket.create_connection(("127.0.0.1", l_port), timeout=30)
+    cli.settimeout(30)
+    t0 = time.monotonic()
+    done = 0
+    for _ in range(n_cases):
+        cli.sendall(payload)
+        if not cli.recv(65536):
+            break
+        done += 1
+    wall = time.monotonic() - t0
+    cli.close()
+    upstream.close()
+    return {
+        "proxy_cases": done,
+        "proxy_cases_per_sec": round(done / wall, 1) if wall > 0 else 0.0,
+    }
+
+
+def run_all() -> dict:
+    n = int(os.environ.get("ERLAMSA_LOAD_N", 10_000))
+    conc = int(os.environ.get("ERLAMSA_LOAD_CONC", 200))
+    pn = int(os.environ.get("ERLAMSA_LOAD_PROXY_N", 2_000))
+    out = faas_load(n, conc)
+    out.update(proxy_stream(pn))
+    return out
+
+
+if __name__ == "__main__":
+    print(json.dumps(run_all()))

@@ -1,0 +1,137 @@
+"""Device ft/fn/fo: suffix-fusion as a context-matched span splice.
+
+Reference: the fuse mutators (src/erlamsa_mutations.erl:384-427 over
+src/erlamsa_fuse.erl) walk a generalized suffix structure of two buffers
+and jump from a random source suffix to a target suffix sharing a prefix
+— radamsa's "fuse". The oracle (models/fuse.py) keeps the exact
+suffix-walk and its AS183 draw order for parity work.
+
+The DEVICE re-expression replaces the structure walk with a vectorized
+context match: draw a jump-out point p and a context depth k (the walk
+deepens its shared prefix with prob 7/8 per round — a log-distributed
+depth draw mirrors that), then match every position j whose forward
+bytes agree with data[p:p+k] in one batch of shifted compares, and pick
+the jump-in point q uniformly among matches. One O(L) scan per round
+instead of a pointer structure — and the result is exactly a span splice
+the fused engine already pays for.
+
+In the batch pipeline each sample is its own block list, so all three
+variants fuse the sample with itself (the oracle's fn/fo reach
+neighbouring blocks; single-block ll reduces them to self-fusion too —
+oracle/mutations.py sed_fuse_next). Shapes:
+
+  ft  out = data[:p] ++ data[q:n]            (fuse_this: tail jump)
+  fn  out = data[:p] ++ data[q:q+l] ++ data[p:n]   (splice a matched span in)
+  fo  out = data[:p] ++ data[q:q+l] ++ data[p+d:n] (jump in AND skip ahead)
+
+Draws are shared verbatim by the fused param-gens (via Tables) and the
+standalone switch kernels.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from . import prng
+
+# static compare window == the max drawable depth: k = 1 + rand_log(3)
+# reaches at most 4, so deeper compare passes would always be masked
+MATCH_DEPTH = 4
+
+
+def fuse_scan(key, data, n):
+    """-> (p, q, ok): jump-out p, context-matched jump-in q.
+
+    k = 1 + rand_log(3) (log-distributed like the walk's geometric
+    deepening, capped at MATCH_DEPTH); q uniform over positions whose
+    k forward bytes equal data[p:p+k], excluding p itself (a p->p jump
+    is the identity). ok=False (no other occurrence) falls back to a
+    uniform q — the walk's terminal single-suffix node analogue."""
+    L = data.shape[0]
+    i = jnp.arange(L, dtype=jnp.int32)
+    kf = prng.sub(key, prng.TAG_FUSE)
+    p = prng.rand(prng.sub(kf, 1), jnp.maximum(n, 1))
+    k = jnp.minimum(
+        1 + prng.rand_log(prng.sub(kf, 2), 3), MATCH_DEPTH
+    ).astype(jnp.int32)
+
+    match = jnp.ones(L, bool)
+    for d in range(MATCH_DEPTH):
+        if d == 0:
+            a = data
+        else:
+            # static shift (== data[clip(i+d)]: bytes >= n are zero by the
+            # buffer invariant, so zero-pad equals the clip-gather) — a
+            # fusable slice where a gather would not fuse
+            a = jnp.concatenate([data[d:], jnp.zeros(d, data.dtype)])
+        probe = data[jnp.clip(p + d, 0, L - 1)]
+        match = match & ((d >= k) | (a == probe))
+    match = match & (i < n) & (i != p)
+
+    total = jnp.sum(match).astype(jnp.int32)
+    ok = total > 0
+    r = prng.rand(prng.sub(kf, 3), total)
+    cum = jnp.cumsum(match).astype(jnp.int32)
+    q_hit = jnp.argmax(match & (cum == r + 1)).astype(jnp.int32)
+    # fallback draw over [0, n) \ {p}: draw n-1 values and shift past p,
+    # so a no-match round still jumps somewhere else
+    q_rnd = prng.rand(prng.sub(kf, 4), jnp.maximum(n - 1, 1))
+    q_rnd = q_rnd + (q_rnd >= p).astype(jnp.int32)
+    return p, jnp.where(ok, q_hit, q_rnd), ok
+
+
+def draw_ft(key, n, p, q):
+    """-> (pos, drop, src_start, src_len, reps, delta)."""
+    return (
+        p, n - p, q, jnp.maximum(n - q, 1), jnp.int32(1),
+        prng.rand_delta(key),
+    )
+
+
+def draw_fn(key, n, p, q):
+    l = 1 + prng.rand(prng.sub(key, prng.TAG_LEN), jnp.maximum(n - q, 1))
+    return p, jnp.int32(0), q, l, jnp.int32(1), prng.rand_delta(key)
+
+
+def draw_fo(key, n, p, q):
+    l = 1 + prng.rand(prng.sub(key, prng.TAG_LEN), jnp.maximum(n - q, 1))
+    d = prng.erand(prng.sub(key, prng.TAG_AUX), jnp.maximum(n - p, 1))
+    return p, d, q, l, jnp.int32(1), prng.rand_delta(key)
+
+
+def span_splice(data, n, pos, drop, src_start, src_len, reps):
+    """out = data[:pos] ++ span-repeated ++ data[pos+drop:] (the fused
+    engine's SRC_SPAN splice, standalone for the switch engine)."""
+    L = data.shape[0]
+    i = jnp.arange(L, dtype=jnp.int32)
+    pos = jnp.clip(pos, 0, n)
+    drop = jnp.clip(drop, 0, n - pos)
+    rlen = jnp.clip(src_len * jnp.maximum(reps, 1), 0, L)
+    end_ins = pos + rlen
+    span_src = jnp.clip(
+        src_start + jnp.mod(i - pos, jnp.maximum(src_len, 1)), 0, L - 1
+    )
+    tail_src = jnp.clip(i - rlen + drop, 0, L - 1)
+    out = jnp.where(
+        i < pos,
+        data,
+        jnp.where(i < end_ins, data[span_src], data[tail_src]),
+    )
+    n_out = jnp.clip(n - drop + rlen, 0, L)
+    out = jnp.where(i < n_out, out, jnp.uint8(0))
+    return out, n_out
+
+
+def _fuse_kernel(draw):
+    def kernel(key, data, n):
+        p, q, _ok = fuse_scan(key, data, n)
+        pos, drop, s, sl, reps, delta = draw(key, n, p, q)
+        out, n_out = span_splice(data, n, pos, drop, s, sl, reps)
+        return out, n_out, delta
+
+    return kernel
+
+
+fuse_this = _fuse_kernel(draw_ft)
+fuse_next = _fuse_kernel(draw_fn)
+fuse_old = _fuse_kernel(draw_fo)

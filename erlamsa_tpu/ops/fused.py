@@ -1,8 +1,8 @@
-"""Fused mutation engine: one parametric apply instead of 25 kernels.
+"""Fused mutation engine: one parametric apply instead of 31 kernels.
 
 Why: under vmap, ``lax.switch`` over per-sample mutator choices executes
-EVERY branch and selects — the naive pipeline pays for all 25 kernels on
-every sample every round (~1200 O(L) passes per sample per case). The TPU-
+EVERY branch and selects — the naive pipeline pays for all 31 kernels on
+every sample every round (~1500 O(L) passes per sample per case). The TPU-
 first observation is that almost every mutator is a *decision* (a handful
 of scalars) followed by one of four *applications*:
 
@@ -49,6 +49,12 @@ from .utf8_mutators import _FUNNY_LENS, _FUNNY_TABLE
 PERM_WINDOW = 256  # byte-permute window cap (radamsa uses 20)
 PERM_LINES = 64  # line-permute window cap
 
+# scratch row length: must hold the num render (_SCRATCH=24) and one
+# payload-table row (ops/payloads.py PAY_W); payloads longer than a row
+# repeat via the literal reps field instead of a wider scratch
+SCRATCH = 48
+assert SCRATCH >= _SCRATCH
+
 _NUM_IDX = DEVICE_CODES.index("num")
 
 # application kinds
@@ -76,14 +82,21 @@ def _zeros():
     p = {f: jnp.int32(0) for f in Params.FIELDS}
     p["kind"] = jnp.int32(K_NONE)
     p["delta"] = jnp.int32(-1)
-    p["scratch"] = jnp.zeros(_SCRATCH, jnp.uint8)
+    p["scratch"] = jnp.zeros(SCRATCH, jnp.uint8)
     return p
 
 
 class Tables:
-    """Shared per-round precomputation (a few O(L) passes)."""
+    """Shared per-round precomputation (a few O(L) passes).
 
-    def __init__(self, key, data, n):
+    enable_len / enable_fuse are TRACE-TIME switches (the pipeline
+    builder knows the static priority vector): when off, the keyed sizer
+    scan / fuse context-match scan are skipped and the corresponding
+    param-gen branches read zeros — they are unreachable anyway because
+    the mutator's priority is 0."""
+
+    def __init__(self, key, data, n, enable_len: bool = True,
+                 enable_fuse: bool = True):
         L = data.shape[0]
         i = jnp.arange(L, dtype=jnp.int32)
         valid = i < n
@@ -98,6 +111,30 @@ class Tables:
         # widenable bytes (for uw)
         self.widenable = ((data & jnp.uint8(0x3F)) == data) & valid
         self.key = key
+        # keyed per-round scans for the r5 mutators (len / ft fn fo):
+        # computed ONCE here so their param-gen switch branches stay
+        # scalar (the lax.switch executes every branch per sample).
+        # The static candidate masks also feed the P_SIZERQ predicate
+        # (self.sizer_any), so the scan is paid once per round total.
+        if enable_len:
+            from .sizer import detect_sizer, sizer_candidates
+
+            cands = sizer_candidates(data, n)
+            self.sizer_any = jnp.any(cands[0])
+            self.sizer = detect_sizer(key, data, n, candidates=cands)
+        else:
+            z = jnp.int32(0)
+            # constant False: with len's priority 0 its applicability is
+            # irrelevant, so the predicate scan is skipped entirely
+            self.sizer_any = jnp.zeros((), bool)
+            self.sizer = (jnp.zeros((), bool), z, z, z, z)
+        if enable_fuse:
+            from .fuse_mutators import fuse_scan
+
+            self.fuse_p, self.fuse_q, self.fuse_ok = fuse_scan(key, data, n)
+        else:
+            self.fuse_p = self.fuse_q = jnp.int32(0)
+            self.fuse_ok = jnp.zeros((), bool)
 
 
 # --- per-mutator parameter generators ------------------------------------
@@ -301,7 +338,9 @@ def _pg_num(key, t):
     p["drop"] = b_end - a_ext
     p["src"] = jnp.int32(SRC_LIT)
     p["lit_len"] = repl_len
-    p["scratch"] = repl[:_SCRATCH]
+    p["scratch"] = jnp.zeros(SCRATCH, jnp.uint8).at[:_SCRATCH].set(
+        repl[:_SCRATCH]
+    )
     # delta placeholder: sed_num scores the MUTATED data's binarish-ness;
     # fused_mutate_step recomputes it post-apply for the num mutator
     p["delta"] = jnp.int32(2)
@@ -458,6 +497,88 @@ def _pg_none(key, t):
     return _zeros()
 
 
+# --- r5 structured mutators as splices ------------------------------------
+# Draw logic lives in payload_mutators / lenfield / fuse_mutators and is
+# shared with the switch-engine kernels; here it only fills a Params row.
+
+
+def _payload_pg(draw):
+    def pg(key, t):
+        from .payload_mutators import _table
+
+        p = _zeros()
+        tab, _lens = _table()
+        pos, drop, row, lit_len, reps, delta = draw(key, t.n)
+        p["kind"] = jnp.int32(K_SPLICE)
+        p["pos"] = pos
+        p["drop"] = drop
+        p["src"] = jnp.int32(SRC_LIT)
+        p["lit_len"] = lit_len
+        p["reps"] = reps
+        p["scratch"] = jax.lax.dynamic_update_slice(
+            p["scratch"], tab[row][:SCRATCH], (0,)
+        )
+        p["delta"] = delta
+        return p
+
+    return pg
+
+
+def _pg_ab(key, t):
+    from .payload_mutators import draw_ab
+
+    return _payload_pg(draw_ab)(key, t)
+
+
+def _pg_ad(key, t):
+    from .payload_mutators import draw_ad
+
+    return _payload_pg(draw_ad)(key, t)
+
+
+def _pg_len(key, t):
+    from .lenfield import draw_len
+
+    p = _zeros()
+    pos, drop, lit, lit_len, reps, delta = draw_len(key, t.n, t.sizer)
+    p["kind"] = jnp.int32(K_SPLICE)
+    p["pos"] = pos
+    p["drop"] = drop
+    p["src"] = jnp.int32(SRC_LIT)
+    p["lit_len"] = lit_len
+    p["reps"] = reps
+    p["scratch"] = jax.lax.dynamic_update_slice(
+        p["scratch"], lit[:SCRATCH], (0,)
+    )
+    p["delta"] = delta
+    return p
+
+
+def _fuse_pg(draw_name):
+    def pg(key, t):
+        from . import fuse_mutators as fm
+
+        p = _zeros()
+        draw = getattr(fm, draw_name)
+        pos, drop, s, sl, reps, delta = draw(key, t.n, t.fuse_p, t.fuse_q)
+        p["kind"] = jnp.int32(K_SPLICE)
+        p["pos"] = pos
+        p["drop"] = drop
+        p["src"] = jnp.int32(SRC_SPAN)
+        p["src_start"] = s
+        p["src_len"] = sl
+        p["reps"] = reps
+        p["delta"] = delta
+        return p
+
+    return pg
+
+
+_pg_ft = _fuse_pg("draw_ft")
+_pg_fn = _fuse_pg("draw_fn")
+_pg_fo = _fuse_pg("draw_fo")
+
+
 # order MUST match registry.DEVICE_CODES
 _PARAM_GENS = {
     "uw": _pg_utf8_widen,
@@ -484,6 +605,12 @@ _PARAM_GENS = {
     "lp": _pg_line_perm,
     "lis": _pg_line_ins,
     "lrs": _pg_line_replace,
+    "ab": _pg_ab,
+    "ad": _pg_ad,
+    "len": _pg_len,
+    "ft": _pg_ft,
+    "fn": _pg_fn,
+    "fo": _pg_fo,
     "nil": _pg_none,
 }
 
@@ -499,11 +626,15 @@ def _splice_geometry(p, n, L):
     agree on these."""
     pos = jnp.clip(p["pos"], 0, n)
     drop = jnp.clip(p["drop"], 0, n - pos)
+    # literals repeat too (r5, for the payload-table mutators): reps=0
+    # from _zeros() means 1 — every pre-r5 SRC_LIT program is unchanged
     rlen = jnp.select(
         [p["src"] == SRC_SPAN, p["src"] == SRC_LIT],
-        [p["src_len"] * p["reps"], p["lit_len"]],
+        [p["src_len"] * p["reps"],
+         p["lit_len"] * jnp.maximum(p["reps"], 1)],
         0,
     )
+    rlen = jnp.clip(rlen, 0, L)
     n_out = jnp.clip(n - drop + rlen, 0, L)
     return pos, drop, rlen, n_out
 
@@ -518,7 +649,9 @@ def _apply_splice(p, data, n):
     src_span = p["src_start"] + jnp.mod(
         i - pos, jnp.maximum(p["src_len"], 1)
     )
-    lit_idx = jnp.clip(i - pos, 0, _SCRATCH - 1)
+    lit_idx = jnp.clip(
+        jnp.mod(i - pos, jnp.maximum(p["lit_len"], 1)), 0, SCRATCH - 1
+    )
     repl_byte = jnp.where(
         p["src"] == SRC_LIT,
         p["scratch"][lit_idx],
@@ -635,7 +768,9 @@ def _composite_src(key, p, data, n, starts, lens, nlines):
     use_lit = (
         (kind == K_SPLICE) & (p["src"] == SRC_LIT) & (i >= pos) & (i < end_ins)
     )
-    lit_idx = jnp.clip(i - pos, 0, _SCRATCH - 1)
+    lit_idx = jnp.clip(
+        jnp.mod(i - pos, jnp.maximum(p["lit_len"], 1)), 0, SCRATCH - 1
+    )
 
     # swap: exchange adjacent spans [a1, a1+l1) and [a1+l1, a1+l1+l2)
     a1, l1, l2 = p["a1"], p["l1"], p["l2"]
@@ -756,13 +891,20 @@ def _apply_composite(key, p, data, n, starts, lens, nlines):
 # --- fused scheduler step -------------------------------------------------
 
 
-def fused_mutate_step(key, data, n, scores, pri):
+def fused_mutate_step(key, data, n, scores, pri,
+                      enable_len: bool = True, enable_fuse: bool = True):
     """Drop-in replacement for scheduler.mutate_step with ~8 O(L) passes.
     Selection and score accounting are shared with the switch engine
-    (scheduler.weighted_pick / adjust_scores)."""
-    applied, any_app, pos, pos_of = weighted_pick(key, data, n, scores, pri)
+    (scheduler.weighted_pick / adjust_scores). enable_len / enable_fuse:
+    trace-time switches skipping the keyed sizer / fuse scans when the
+    corresponding mutators are disabled (see Tables)."""
+    t = Tables(key, data, n, enable_len=enable_len, enable_fuse=enable_fuse)
+    from .registry import predicates
 
-    t = Tables(key, data, n)
+    applied, any_app, pos, pos_of = weighted_pick(
+        key, data, n, scores, pri,
+        preds=predicates(data, n, sizer_any=t.sizer_any),
+    )
     site_key = prng.sub(key, prng.TAG_SITE)
     # Tables is a host object, not a pytree: close each branch over it
     branches = tuple(

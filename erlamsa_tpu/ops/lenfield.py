@@ -1,0 +1,103 @@
+"""Device len: length-field mutation over the sizer detector.
+
+Reference: the length-predict mutator (src/erlamsa_mutations.erl:1107-1143
+via erlamsa_field_predict) finds a plausible length field and then draws
+one of 7 edits: zero the field, saturate it, expand the enclosed blob
+with random data, drop the blob (rewriting the field), or write a random
+length. The oracle keeps the reference's randomized rescan
+(models/fieldpred.py); the DEVICE path reuses ops/sizer.detect_sizer —
+the vectorized one-pass field scan already built for the sz pattern —
+and expresses every variant as ONE splice:
+
+  t=0  field <- 0         splice [a, a+w) with zero literal
+  t=1  field <- all-ones  splice [a, a+w) with 0xFF literal
+  t=2  expand blob        insert random literal bytes at the blob end
+  t=3  drop blob          splice [a, end) with the new-length field bytes
+  t>3  field <- random    splice [a, a+w) with the new-length field bytes
+
+Deviations (device divergence class): the random new length draws 31
+uniform bits doubled (the reference draws size-of-field bits then
+doubles, capped at ABSMAX_BINARY_BLOCK — same cap here); blob expansion
+inserts an 8-byte random literal tiled 1 + rand_log(8) times (the
+reference splices an uncapped random block; device capacity clips both).
+
+The draw is shared verbatim by the fused param-gen and the standalone
+switch kernel.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..constants import ABSMAX_BINARY_BLOCK
+from . import prng
+from .sizer import KIND_U16LE, KIND_U32LE, detect_sizer
+
+LIT_W = 8  # expand-fill literal: 8 random bytes, tiled via the reps field
+
+
+def field_bytes(value, width, kind):
+    """[4] uint8: the length field's byte image (endianness per kind)."""
+    k = jnp.arange(4, dtype=jnp.int32)
+    is_le = (kind == KIND_U16LE) | (kind == KIND_U32LE)
+    shift = jnp.where(is_le, k * 8, (width - 1 - k) * 8)
+    return (
+        jnp.right_shift(value.astype(jnp.int32), jnp.clip(shift, 0, 31)) & 0xFF
+    ).astype(jnp.uint8)
+
+
+def draw_len(key, n, sizer):
+    """-> (pos, drop, lit[LIT_W], lit_len, reps, delta). sizer is
+    detect_sizer's (found, a, width, kind, end). Blob expansion tiles an
+    8-byte random literal via reps (period-8 randomness — documented
+    device deviation, the reference splices an uncapped random block)."""
+    found, a, width, kind, end = sizer
+    t = prng.rand(prng.sub(key, prng.TAG_MASK), 7)
+
+    raw = jax.random.bits(prng.sub(key, prng.TAG_VAL), (), jnp.uint32)
+    new_len = jnp.minimum(
+        ((raw >> 2).astype(jnp.int32) * 2) & 0x7FFFFFFF,
+        ABSMAX_BINARY_BLOCK,
+    )
+    fb = jnp.select(
+        [t == 0, t == 1],
+        [jnp.zeros(4, jnp.uint8), jnp.full(4, 0xFF, jnp.uint8)],
+        field_bytes(new_len, width, kind),
+    )
+    # 8 fill bytes from 2 raw words, tiled via reps (period-8 randomness)
+    fill_words = jax.random.bits(prng.sub(key, prng.TAG_AUX), (2,), jnp.uint32)
+    shifts = jnp.arange(0, 32, 8, dtype=jnp.uint32)
+    rand_fill = jnp.concatenate([
+        ((fill_words[0] >> shifts) & 0xFF).astype(jnp.uint8),
+        ((fill_words[1] >> shifts) & 0xFF).astype(jnp.uint8),
+    ])
+
+    expand = t == 2
+    lit = jnp.where(expand, rand_fill, jnp.zeros(LIT_W, jnp.uint8).at[:4].set(fb))
+    pos = jnp.where(expand, end, a).astype(jnp.int32)
+    drop = jnp.select(
+        [expand, t == 3], [jnp.int32(0), end - a], width
+    ).astype(jnp.int32)
+    lit_len = jnp.where(expand, LIT_W, width).astype(jnp.int32)
+    reps = jnp.where(
+        expand, 1 + prng.rand_log(prng.sub(key, prng.TAG_LEN), 8), 1
+    ).astype(jnp.int32)
+
+    # no detected field: emit a no-op program, report a failed try
+    pos = jnp.where(found, pos, 0)
+    drop = jnp.where(found, drop, 0)
+    lit_len = jnp.where(found, lit_len, 0)
+    reps = jnp.where(found, reps, 0)
+    delta = jnp.where(found, 1, -1).astype(jnp.int32)  # reference: 1 / -2
+    return pos, drop, lit, lit_len, reps, delta
+
+
+def length_mutate(key, data, n):
+    """Switch-engine kernel."""
+    from .payload_mutators import lit_splice
+
+    sizer = detect_sizer(key, data, n)
+    pos, drop, lit, lit_len, reps, delta = draw_len(key, n, sizer)
+    out, n_out = lit_splice(data, n, pos, drop, lit, lit_len, reps)
+    return out, n_out, delta

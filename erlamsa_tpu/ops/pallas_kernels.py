@@ -143,18 +143,19 @@ def pallas_randmask(seeds, params, data):
 
 # --- whole-round kernel ----------------------------------------------------
 #
-# One pallas_call per scheduler round covering three of the four fused
-# applies (ops/fused.py): SPLICE, SWAP and MASK are computed from the
-# original row and selected by `kind` (only one apply is ever active per
-# round, so select == the jnp engine's identity-chain), then an in-place
-# Fisher-Yates pass handles PERM_BYTES under pl.when. The sample row stays
+# One pallas_call per scheduler round covering the fused applies
+# (ops/fused.py): SPLICE, SWAP, MASK and (since r5, in vector-register
+# form) the PERM_BYTES Fisher-Yates are computed from the original row
+# and selected by `kind` (only one apply is ever active per round, so
+# select == the jnp engine's identity-chain). The sample row stays
 # in VMEM across all of it — the jnp engine pays ~4 HBM round-trips per
 # round for the same work. PERM_LINES stays in jnp outside (it needs the
 # per-round line table; `lp` is a single default-priority mutator).
 #
 # Primitive discipline (TPU Mosaic has no arbitrary vector gather):
-# everything is rolls by traced scalars, iota masks, and scalar ref
-# accesses. Traced-shift rolls go through _roll -> pltpu.roll, which
+# everything is rolls by traced scalars, iota masks, and one-hot
+# reductions — no dynamic scalar VMEM reads/writes remain (r5).
+# Traced-shift rolls go through _roll -> pltpu.roll, which
 # lowers to Mosaic's dynamic-rotate (jnp.roll with a traced shift would
 # lower via concat + dynamic_slice, which Mosaic may reject); shifts are
 # reduced mod L so they are always non-negative. The splice's
@@ -206,9 +207,13 @@ def _round_logic(bits, params_ref, lit_ref, data_ref, out_ref):
     pos_c = jnp.clip(pos, 0, n)
     drop_c = jnp.clip(drop, 0, n - pos_c)
     span_total = src_len * reps
+    # literals repeat too (r5 payload mutators): reps==0 means 1, so every
+    # pre-r5 program is unchanged (same rule as fused._splice_geometry)
+    lit_total = lit_len * jnp.maximum(reps, 1)
     rlen = jnp.where(
-        src == SRC_SPAN, span_total, jnp.where(src == SRC_LIT, lit_len, 0)
+        src == SRC_SPAN, span_total, jnp.where(src == SRC_LIT, lit_total, 0)
     )
+    rlen = jnp.clip(rlen, 0, L)
     sl_c = jnp.maximum(src_len, 1)
     o = i - pos_c
     # repeated-span source: conditional rolls by src_len * 2^k, LSB-first
@@ -217,12 +222,15 @@ def _round_logic(bits, params_ref, lit_ref, data_ref, out_ref):
     for k in range(max(1, (L - 1).bit_length())):
         bitk = (odiv >> k) & 1
         cur = jnp.where(bitk == 1, _roll(cur, sl_c << k), cur)
-    # place the <=_SCRATCH (24) literal bytes at their splice offsets via static
-    # scalar broadcasts (no sub-tile slice store, no gather)
+    # place the <=SCRATCH (48) literal bytes at their splice offsets via
+    # static scalar broadcasts (no sub-tile slice store, no gather);
+    # repetition folds into the offset via the lit_len modulus
     S = lit_ref.shape[-1]
+    ll_c = jnp.maximum(lit_len, 1)
+    omod = jnp.where(o >= 0, o % ll_c, -1)
     lit_rolled = jnp.zeros((1, L), jnp.uint8)
     for k in range(min(S, L)):
-        lit_rolled = jnp.where(o == k, lit_ref[0, k], lit_rolled)
+        lit_rolled = jnp.where(omod == k, lit_ref[0, k], lit_rolled)
     repl = jnp.where(src == SRC_LIT, lit_rolled, cur)
     tail = _roll(d, rlen - drop_c)
     end_ins = pos_c + rlen
@@ -260,30 +268,41 @@ def _round_logic(bits, params_ref, lit_ref, data_ref, out_ref):
                   jnp.where(kind == K_MASK, mk, d)),
     )
 
-    # ---- PERM_BYTES: in-place Fisher-Yates over [ps, ps+plen) ----
+    # ---- PERM_BYTES: Fisher-Yates over [ps, ps+span), VECTOR form ----
+    # The window rides a [W] register tile and swaps are one-hot selects:
+    # no dynamic scalar VMEM reads/writes (the named Mosaic risk). Same
+    # bits draws, same swap sequence — streams unchanged. Gated by
+    # pl.when and bounded by the traced span, so non-sp rounds pay
+    # nothing. The sp draw guarantees ps + span <= n, so the circular
+    # rolls never wrap inside the permuted region.
     @pl.when(kind == K_PERM_BYTES)
     def _fisher_yates():
-        span = jnp.clip(plen, 0, _FY_CAP)
+        Wf = min(_FY_CAP, L)
+        wiota = jax.lax.broadcasted_iota(jnp.int32, (1, Wf), 1)[0]
+        span = jnp.clip(plen, 0, Wf)
+        win0 = _roll(d, -ps)[0, :Wf]
+        vrow = bits[3][:Wf]
 
-        def body(t, carry):
+        def _fy_body(t, win):
             j = span - 1 - t
+            r = (
+                jnp.sum(jnp.where(wiota == j, vrow, 0)).astype(jnp.uint32)
+                % jnp.maximum(j + 1, 1).astype(jnp.uint32)
+            ).astype(jnp.int32)
+            vj = jnp.sum(jnp.where(wiota == j, win, 0)).astype(jnp.uint8)
+            vr = jnp.sum(jnp.where(wiota == r, win, 0)).astype(jnp.uint8)
+            swapped = jnp.where(
+                wiota == j, vr, jnp.where(wiota == r, vj, win)
+            )
+            return jnp.where(j > 0, swapped, win)
 
-            @pl.when(j > 0)
-            def _swap_one():
-                r = (
-                    bits[3, jnp.clip(j, 0, L - 1)]
-                    % (j + 1).astype(jnp.uint32)
-                ).astype(jnp.int32)
-                aj = jnp.clip(ps + j, 0, L - 1)
-                ar = jnp.clip(ps + r, 0, L - 1)
-                vj = out_ref[0, aj]
-                vr = out_ref[0, ar]
-                out_ref[0, aj] = vr
-                out_ref[0, ar] = vj
-
-            return carry
-
-        jax.lax.fori_loop(0, _FY_CAP - 1, body, 0)
+        win_f = jax.lax.fori_loop(
+            0, jnp.maximum(span - 1, 0), _fy_body, win0
+        )
+        win_l = jnp.concatenate([win_f, jnp.zeros(L - Wf, jnp.uint8)]) \
+            if L > Wf else win_f
+        fy_back = _roll(win_l.reshape(1, L), ps)
+        out_ref[...] = jnp.where((i >= ps) & (i < ps + span), fy_back, d)
 
 
 def _round_kernel_hw(seed_ref, params_ref, lit_ref, data_ref, out_ref):

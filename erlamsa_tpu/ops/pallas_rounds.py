@@ -3,8 +3,9 @@
 This is the final residency step past ``fused_round_single``
 (ops/pallas_kernels.py, which fuses one round's applies): here the
 scheduler's weighted pick, the applicability predicates, the per-round
-tables (line spans, digit runs, widenable/binarish scans) and ALL 25
-device param generators run INSIDE one pallas_call, so a sample's bytes
+tables (line spans, digit runs, widenable/binarish scans, sizer
+candidates, fuse jump pairs) and ALL 31 device param generators run
+INSIDE one pallas_call, so a sample's bytes
 enter VMEM once, take every mutation round there, and leave once. Per-
 round HBM traffic is zero on hardware (random bits come from the TPU
 PRNG; the portable build passes precomputed threefry bits as operands and
@@ -16,9 +17,11 @@ rounds — no max-over-batch lane masking (ops/pipeline.py pays
 max(rounds) across the vmap batch).
 
 Primitive discipline follows pallas_kernels.py: rolls by traced scalars,
-iota masks, cumulative scans, scalar ref reads/writes (Fisher-Yates, the
-number parser), one-hot sums instead of vector gathers. PERM_LINES is new
-here: up to 64 whole-line segments move via 64 static conditional rolls.
+iota masks, cumulative scans, one-hot sums instead of vector gathers or
+dynamic scalar VMEM access (r5: Fisher-Yates swaps ride a register-tile
+window, the number parser reads a rolled digit window, byte probes are
+one-hot reductions). PERM_LINES is new here: up to 64 whole-line
+segments move via 64 static conditional rolls.
 
 Determinism: reproducible for a fixed (seed, case, sample); bitstreams
 diverge from the jnp engines (documented divergence class — raw-bits
@@ -36,10 +39,12 @@ lowering without a chip to iterate against, per the pallas guide's
 constraints: no 1D iota (2D-derived index vectors), no int64 anywhere
 (the num path runs on int32-pair scalar math, _p_* helpers), no vector
 gathers or dynamic table slices (one-hot sums), traced-shift rolls via
-pltpu.roll, first-index reductions instead of 1D argmax. Remaining
-hardware risks: dynamic scalar VMEM reads/writes (Fisher-Yates swaps,
-byte probes) and the [65, L] line-window reduction. Validation on a live
-chip still pending — bin/tpu_evidence.py stage pallas2_small banks the
+pltpu.roll, first-index reductions instead of 1D argmax, and — since r5
+— no dynamic scalar VMEM reads/writes anywhere (Fisher-Yates went
+vector-register one-hot, the number parser reads a rolled window, the
+dash scan and applied-log store are row ops). Remaining hardware risk:
+the [65, L] line-window reduction shape. Validation on a live chip
+still pending — bin/tpu_evidence.py stage pallas2_small banks the
 compile/run outcome the first healthy relay window.
 """
 
@@ -55,8 +60,10 @@ try:  # pallas TPU backend is optional off-TPU
 except ImportError:  # pragma: no cover
     pltpu = None
 
-from ..constants import MAX_BURST_MUTATIONS, MAX_SCORE, MIN_SCORE
-from . import prng
+from ..constants import ABSMAX_BINARY_BLOCK, MAX_BURST_MUTATIONS, MAX_SCORE, MIN_SCORE
+from . import payloads, prng
+from .fuse_mutators import MATCH_DEPTH
+from .payload_mutators import _AAA_COUNTS
 from .fused import (
     K_MASK,
     K_NONE,
@@ -65,6 +72,7 @@ from .fused import (
     K_SPLICE,
     K_SWAP,
     PERM_WINDOW,
+    SCRATCH,
     SRC_LIT,
     SRC_SPAN,
 )
@@ -78,9 +86,11 @@ from .pallas_kernels import _roll
 from .registry import DEVICE_CODES, DEVICE_MUTATORS, NUM_DEVICE_MUTATORS
 from .registry import (
     P_HAS_DIGIT,
+    P_N4,
     P_NEVER,
     P_NONEMPTY,
     P_PAIR,
+    P_SIZERQ,
     P_TEXT,
     P_TEXT_2L,
     P_TEXT_3L,
@@ -110,13 +120,31 @@ assert tuple(_FUSED_PGS) == DEVICE_CODES, (
 _SB_POS = M  # primary position / which-run / which-line
 _SB_VAL = M + 1  # value / donor row / repeat magnitude
 _SB_LEN = M + 2  # span length / count
-_SB_AUX = M + 3  # secondary line (donor for lis/lrs)
+_SB_AUX = M + 3  # secondary line (donor for lis/lrs) / fo skip-ahead
 _SB_DELTA = M + 4  # rand_delta sign bit
 _SB_MASKOP = M + 5
 _SB_PROB = M + 6
 _SB_LOG2 = M + 7  # rand_log second draw
 _SB_NUM = M + 8  # ..+17: the textual-number mutator's draws
+# r5 structured-mutator slots (slots within one generator are distinct;
+# cross-generator sharing is harmless — only the applied row is used)
+_SB_PAYV = M + 18  # ab/ad variant draw
+_SB_PAYROW = M + 19  # payload-table row draw
+_SB_PAYREP = M + 20  # ab repeat count / aaas length-class draw
+_SB_PAYAUX = M + 21  # aaas fallback / traversal reps / ad shell row
+_SB_LENT = M + 22  # len variant t
+_SB_LENV = M + 23  # len random new-length bits
+_SB_LENPICK = M + 24  # len candidate pick
+_SB_LENR = M + 25  # len expand reps (rand_log b1; b2 = _SB_LOG2)
+_SB_LENF0 = M + 26  # len expand fill bytes 0-3
+_SB_LENF1 = M + 27  # len expand fill bytes 4-7
+_SB_FUSEP = M + 28  # fuse jump-out p
+_SB_FUSEDEP = M + 29  # fuse depth (rand_log b1; b2 = _SB_LOG2)
+_SB_FUSEPICK = M + 30  # fuse jump-in pick
+_SB_FUSEFB = M + 31  # fuse fallback q
+_SB_FUSELEN = M + 32  # fn/fo spliced span length
 _SB_ROW_LEN = 64
+assert _SB_FUSELEN < _SB_ROW_LEN, "scalar-draw row overflow"
 
 # vector-bit rows in the per-round [6, L] uint32 block
 _VB_MASK0, _VB_MASK1, _VB_MASK2, _VB_FY, _VB_WIDE, _VB_LPERM = range(6)
@@ -386,6 +414,79 @@ def _round(sref, log_ref, tables, r, n, scores, pri_vec, sb, vb):
         e = jnp.where(k == nlines - 1, n, start_of(k + 1))
         return s, jnp.maximum(e - s, 0)
 
+    # ---- length-field candidates (len mutator + pred P_SIZERQ) ----
+    # tail/near-tail static-mask subset of ops/sizer.detect_sizer: the jnp
+    # engines add keyed interior probes; level-2 bitstreams diverge by
+    # design (module docstring). Forward bytes via rolls (circular: a
+    # candidate whose field straddles n is rejected by the i + w <= n
+    # guard, so wrap reads cannot fabricate one within data).
+    b1v, b2v, b3v = _roll(di, -1), _roll(di, -2), _roll(di, -3)
+    sz_vals = (
+        di,
+        di * 256 + b1v,
+        b1v * 256 + di,
+        ((di * 256 + b1v) * 256 + b2v) * 256 + b3v,
+        ((b3v * 256 + b2v) * 256 + b1v) * 256 + di,
+    )
+    sz_widths = (1, 2, 2, 4, 4)
+    sz_cands = []
+    for vv, ww in zip(sz_vals, sz_widths):
+        dlt = n - (vv + i + ww)
+        if ww == 1:
+            near = (dlt >= 0) & (dlt <= 8)
+        else:
+            near = (dlt == 0) | (dlt == 1) | (dlt == 2) | (dlt == 4) | (dlt == 8)
+        sz_cands.append((vv > 2) & near & (i + ww <= n) & valid)
+    sizer_any = jnp.bool_(False)
+    for ck in sz_cands:
+        sizer_any = sizer_any | jnp.any(ck)
+
+    # uniform pick among all candidates (flat cumsum order, one draw)
+    sz_total = jnp.int32(0)
+    for ck in sz_cands:
+        sz_total = sz_total + jnp.sum(ck.astype(jnp.int32))
+    r_sz = _krand(sb[_SB_LENPICK], sz_total)
+    running = jnp.int32(0)
+    len_found = jnp.bool_(False)
+    len_a = jnp.int32(0)
+    len_w = jnp.int32(1)
+    len_kind = jnp.int32(0)
+    len_val = jnp.int32(0)
+    for kk, (ck, vv, ww) in enumerate(zip(sz_cands, sz_vals, sz_widths)):
+        cum_k = jnp.cumsum(ck.astype(jnp.int32), axis=1) + running
+        hit = ck & (cum_k == r_sz + 1)
+        anyh = jnp.any(hit)
+        len_a = jnp.where(anyh, _first_idx(hit, i, 0), len_a)
+        len_w = jnp.where(anyh, ww, len_w)
+        len_kind = jnp.where(anyh, kk, len_kind)
+        len_val = jnp.where(anyh, jnp.sum(jnp.where(hit, vv, 0)), len_val)
+        len_found = len_found | anyh
+        running = running + jnp.sum(ck.astype(jnp.int32))
+    len_end = jnp.minimum(len_val + len_a + len_w, n)
+
+    # ---- fuse jump pair (ft fn fo): context match scan ----
+    # (ops/fuse_mutators.fuse_scan in kernel form; scalar probe bytes via
+    # one-hot sums, not dynamic VMEM reads)
+    p_f = _krand(sb[_SB_FUSEP], n)
+    k_f = jnp.minimum(
+        1 + _krand_log(sb[_SB_FUSEDEP], sb[_SB_LOG2], 3), MATCH_DEPTH
+    ).astype(jnp.int32)
+    match_f = jnp.ones((1, L), bool)
+    for dd in range(MATCH_DEPTH):
+        fwd = _roll(di, -dd)
+        probe_idx = jnp.clip(p_f + dd, 0, L - 1)
+        b_probe = jnp.sum(jnp.where(i == probe_idx, di, 0)).astype(jnp.int32)
+        match_f = match_f & ((dd >= k_f) | (fwd == b_probe))
+    match_f = match_f & (i < n) & (i != p_f)
+    tot_f = jnp.sum(match_f.astype(jnp.int32)).astype(jnp.int32)
+    r_f = _krand(sb[_SB_FUSEPICK], tot_f)
+    cum_f = jnp.cumsum(match_f.astype(jnp.int32), axis=1)
+    q_hit = _first_idx(match_f & (cum_f == r_f + 1), i, 0)
+    # fallback over [0, n) \ {p_f} (fuse_mutators.fuse_scan rule)
+    q_fb = _krand(sb[_SB_FUSEFB], jnp.maximum(n - 1, 1))
+    q_fb = q_fb + (q_fb >= p_f).astype(jnp.int32)
+    q_f = jnp.where(tot_f > 0, q_hit, q_fb)
+
     # ---- applicability + weighted pick (scheduler.weighted_pick) ----
     preds = {
         P_NONEMPTY: nonempty,
@@ -396,6 +497,8 @@ def _round(sref, log_ref, tables, r, n, scores, pri_vec, sb, vb):
         P_TEXT_3L: text & (nlines >= 3),
         P_WIDENABLE: jnp.any(widenable) & nonempty,
         P_NEVER: jnp.bool_(False),
+        P_SIZERQ: sizer_any,
+        P_N4: n >= 4,
     }
     applicable = jnp.stack([preds[m.pred] for m in DEVICE_MUTATORS]) & (
         pri_vec > 0
@@ -426,7 +529,11 @@ def _round(sref, log_ref, tables, r, n, scores, pri_vec, sb, vb):
         return s, ln
 
     pos_u = _krand(sb[_SB_POS], n)  # shared single-position draw
-    b_at = sref[0, jnp.clip(pos_u, 0, L - 1)].astype(jnp.int32)
+    # scalar byte probe via one-hot sum (no dynamic scalar VMEM read —
+    # the docstring's named Mosaic risk)
+    b_at = jnp.sum(jnp.where(i == jnp.clip(pos_u, 0, L - 1), di, 0)).astype(
+        jnp.int32
+    )
     s_sp, l_sp = span_draw()
 
     z = jnp.int32(0)
@@ -476,10 +583,12 @@ def _round(sref, log_ref, tables, r, n, scores, pri_vec, sb, vb):
     # first position holding the max key == argmax (2D reduction form)
     mx_uw = jnp.max(wide_keys)
     pos_uw = _first_idx(wide_keys == mx_uw, i, 0)
-    b_uw = sref[0, jnp.clip(pos_uw, 0, L - 1)]
+    b_uw = jnp.sum(
+        jnp.where(i == jnp.clip(pos_uw, 0, L - 1), di, 0)
+    ).astype(jnp.uint8)
     setp("uw", kind=K_SPLICE, pos=pos_uw, drop=1, src=SRC_LIT, lit_len=2,
          delta=delta_c)
-    funny_t, funny_l, itbl_hi, itbl_lo = tables
+    funny_t, funny_l, itbl_hi, itbl_lo, pay_t, pay_l = tables
     n_funny = funny_t.shape[0]
     row_ui = _krand(sb[_SB_VAL], n_funny)
     # row select via one-hot sums over static columns (no dynamic sublane
@@ -506,18 +615,26 @@ def _round(sref, log_ref, tables, r, n, scores, pri_vec, sb, vb):
     a_num = _first_idx(digit_starts & (csum == target + 1), i, 0)
     b_end = _first_idx((i >= a_num) & ~is_digit, i, n)
 
-    def dash_cond(c):
-        idx = a_num - 1 - c
-        return (idx >= 0) & (sref[0, jnp.clip(idx, 0, L - 1)] == 45)
-
-    dash_count = jax.lax.while_loop(dash_cond, lambda c: c + 1, jnp.int32(0))
+    # dash run immediately before a_num, vectorized (the historical
+    # while_loop probed one scalar VMEM byte per step — the docstring's
+    # named Mosaic risk). Roll the dash mask so original index a_num-1-c
+    # lands at lane L-1-c; the run length is then the all-true suffix,
+    # found via the last False lane. Lanes outside the valid window
+    # (c >= a_num) read wrapped bytes but are forced False.
+    dash_roll = _roll(((di == 45) & (i < a_num)).astype(jnp.int32),
+                      L - a_num)
+    last_false = jnp.max(jnp.where(dash_roll == 0, i, -1))
+    dash_count = jnp.maximum(L - 1 - last_false, 0).astype(jnp.int32)
     neg_in = dash_count > 0
     a_ext = a_num - dash_count
 
+    # digit window via one roll: parse reads become STATIC lane indices
+    # (wrapped bytes beyond b_end are never taken)
+    num_win = _roll(di, -a_num)  # num_win[0, k] = d[a_num + k]
+
     def parse_body(k, vp):
-        idx = jnp.clip(a_num + k, 0, L - 1)
         take = (a_num + k < b_end) & (k < _MAX_PARSE_DIGITS)
-        dig = sref[0, idx].astype(jnp.int32) - 48
+        dig = jnp.sum(jnp.where(i == k, num_win, 0)).astype(jnp.int32) - 48
         nv = _p_mul10_add(vp, dig)
         return _p_sel(take, nv, vp)
 
@@ -570,6 +687,86 @@ def _round(sref, log_ref, tables, r, n, scores, pri_vec, sb, vb):
          src_start=ds_lis, src_len=dl_lis, reps=1, delta=1)
     setp("lrs", kind=K_SPLICE, pos=ts_lis, drop=tl_lis, src=SRC_SPAN,
          src_start=ds_lis, src_len=dl_lis, reps=1, delta=1)
+
+    # ---- r5 structured mutators (ab ad len ft fn fo) ----
+    # payload-row length lookup helper (one-hot sum, no dynamic slice)
+    n_pay = pay_l.shape[-1]
+    pay_iota = jax.lax.broadcasted_iota(jnp.int32, pay_l.shape, 1)
+
+    def pay_len_of(row):
+        return jnp.sum(jnp.where(pay_iota == row, pay_l, 0)).astype(jnp.int32)
+
+    # ab (payload_mutators.draw_ab shape)
+    v_ab = _krand(sb[_SB_PAYV], 5)
+    silly_row = payloads.SILLY0 + _krand(sb[_SB_PAYROW], payloads.N_SILLY)
+    silly_reps = _krand(sb[_SB_PAYREP], 20) + 1
+    t_aaa = _krand(sb[_SB_PAYREP], 11)
+    aaa_tab = jnp.int32(0)
+    for idx, cnt in enumerate(_AAA_COUNTS):
+        aaa_tab = jnp.where(t_aaa == idx, cnt, aaa_tab)
+    aaa_reps = jnp.where(t_aaa < 10, aaa_tab, _krand(sb[_SB_PAYAUX], 1024))
+    trav_row = payloads.TRAV0 + _krand(sb[_SB_PAYROW], 2)
+    trav_reps = _kerand(sb[_SB_PAYAUX], 10)
+    row_ab = jnp.where(
+        v_ab <= 1, silly_row,
+        jnp.where(v_ab == 2, payloads.AAA_ROW,
+                  jnp.where(v_ab == 3, trav_row, payloads.NULL_ROW)),
+    ).astype(jnp.int32)
+    reps_ab = jnp.where(
+        v_ab <= 1, silly_reps,
+        jnp.where(v_ab == 2, aaa_reps,
+                  jnp.where(v_ab == 3, trav_reps, 1)),
+    ).astype(jnp.int32)
+    ll_ab = pay_len_of(row_ab)
+    setp("ab", kind=K_SPLICE, pos=jnp.where(v_ab == 4, n, pos_u),
+         drop=jnp.where(v_ab == 1, ll_ab * reps_ab, 0), src=SRC_LIT,
+         lit_len=ll_ab, reps=reps_ab, delta=delta_c)
+
+    # ad (payload_mutators.draw_ad shape)
+    v_ad = _krand(sb[_SB_PAYV], 4)
+    row_ad = jnp.where(
+        v_ad < 3,
+        payloads.DELIM0 + _krand(sb[_SB_PAYROW], payloads.N_DELIM),
+        payloads.SHELL0 + _krand(sb[_SB_PAYAUX], payloads.N_SHELL),
+    ).astype(jnp.int32)
+    ll_ad = pay_len_of(row_ad)
+    setp("ad", kind=K_SPLICE, pos=pos_u, drop=0, src=SRC_LIT,
+         lit_len=ll_ad, reps=1, delta=delta_c)
+
+    # len (lenfield.draw_len shape over the in-kernel candidate pick)
+    t_len = _krand(sb[_SB_LENT], 7)
+    new_len = jnp.minimum(
+        ((sb[_SB_LENV] >> 2).astype(jnp.int32) * 2) & 0x7FFFFFFF,
+        ABSMAX_BINARY_BLOCK,
+    )
+    len_expand = t_len == 2
+    # field-byte image computed in the lit section below (needs len_w/kind)
+    _LEN_FILL_W = 8  # expand fill: 8 bytes from 2 scalar slots, tiled
+    setp("len",
+         kind=jnp.where(len_found, K_SPLICE, K_NONE),
+         pos=jnp.where(len_expand, len_end, len_a),
+         drop=jnp.where(
+             len_expand, 0, jnp.where(t_len == 3, len_end - len_a, len_w)
+         ),
+         src=SRC_LIT,
+         lit_len=jnp.where(len_expand, _LEN_FILL_W, len_w),
+         reps=jnp.where(
+             len_expand,
+             1 + _krand_log(sb[_SB_LENR], sb[_SB_LOG2], 8),
+             1,
+         ),
+         delta=jnp.where(len_found, 1, -1))
+
+    # ft fn fo (fuse_mutators draw shapes over the in-kernel jump pair)
+    sl_fuse = jnp.maximum(n - q_f, 1)
+    setp("ft", kind=K_SPLICE, pos=p_f, drop=n - p_f, src=SRC_SPAN,
+         src_start=q_f, src_len=sl_fuse, reps=1, delta=delta_c)
+    l_fuse = 1 + _krand(sb[_SB_FUSELEN], jnp.maximum(n - q_f, 1))
+    setp("fn", kind=K_SPLICE, pos=p_f, drop=0, src=SRC_SPAN,
+         src_start=q_f, src_len=l_fuse, reps=1, delta=delta_c)
+    d_fo = _kerand(sb[_SB_AUX], jnp.maximum(n - p_f, 1))
+    setp("fo", kind=K_SPLICE, pos=p_f, drop=d_fo, src=SRC_SPAN,
+         src_start=q_f, src_len=l_fuse, reps=1, delta=delta_c)
     # "nil": all-zero row (K_NONE) already
 
     # select the applied row (+ gate to no-op when nothing applicable)
@@ -585,9 +782,9 @@ def _round(sref, log_ref, tables, r, n, scores, pri_vec, sb, vb):
     mask_op, mask_prob = sel("mask_op"), sel("mask_prob")
     delta_sel = sel("delta")
 
-    # literal bytes for the applied splice (byte ops / uw / ui / num) as a
-    # python list of _SCRATCH (24) traced SCALARS — no vector gather, no
-    # 1D scratch
+    # literal bytes for the applied splice (byte ops / uw / ui / num /
+    # payload rows / len field image) as a python list of SCRATCH (48)
+    # traced SCALARS — no vector gather, no 1D scratch
     is_bi = applied == _IDX["bi"]
     byte0 = jnp.select(
         [applied == _IDX["bei"], applied == _IDX["bed"],
@@ -596,12 +793,18 @@ def _round(sref, log_ref, tables, r, n, scores, pri_vec, sb, vb):
         nb_rand,  # bi's inserted byte is the same rand_byte draw
     ).astype(jnp.uint8)
     z8 = jnp.uint8(0)
-    at_pos = d[0, jnp.clip(pos_u, 0, L - 1)]
+    at_pos = b_at.astype(jnp.uint8)  # same probe as the byte ops
     is_num = applied == _IDX["num"]
     is_ui = applied == _IDX["ui"]
     is_uw = applied == _IDX["uw"]
+    is_pay = (applied == _IDX["ab"]) | (applied == _IDX["ad"])
+    is_len_m = applied == _IDX["len"]
+    row_pay = jnp.where(applied == _IDX["ab"], row_ab, row_ad)
+    pay_rows_col = jax.lax.broadcasted_iota(jnp.int32, (n_pay, 1), 0)
+    pay_row_hit = pay_rows_col == row_pay
+    is_le_len = (len_kind == 2) | (len_kind == 4)  # u16le / u32le
     lit = []
-    for k in range(_SCRATCH):
+    for k in range(SCRATCH):
         byte_k = byte0 if k == 0 else (
             jnp.where(is_bi, at_pos, z8) if k == 1 else z8
         )
@@ -609,9 +812,38 @@ def _round(sref, log_ref, tables, r, n, scores, pri_vec, sb, vb):
             (b_uw | jnp.uint8(0x80)) if k == 1 else z8
         )
         ui_k = seq_ui[k] if k < 4 else z8
+        num_k = num_digits[k] if k < _SCRATCH else z8
+        pay_k = jnp.sum(
+            jnp.where(pay_row_hit, pay_t[:, k : k + 1].astype(jnp.int32), 0)
+        ).astype(jnp.uint8)
+        if k < 4:  # field image: zeros / saturate / new-length bytes
+            shift = jnp.where(is_le_len, k * 8, (len_w - 1 - k) * 8)
+            fb_k = jnp.where(
+                t_len == 0, 0,
+                jnp.where(
+                    t_len == 1, 0xFF,
+                    jnp.right_shift(new_len, jnp.clip(shift, 0, 31)) & 0xFF,
+                ),
+            ).astype(jnp.uint8)
+        else:
+            fb_k = z8
+        if k < 8:  # expand fill: 8 random bytes from 2 scalar slots
+            src_slot = sb[_SB_LENF0] if k < 4 else sb[_SB_LENF1]
+            fill_k = ((src_slot >> ((k % 4) * 8)) & 0xFF).astype(jnp.uint8)
+        else:
+            fill_k = z8
+        len_k = jnp.where(len_expand, fill_k, fb_k)
         lit.append(jnp.where(
-            is_num, num_digits[k],
-            jnp.where(is_ui, ui_k, jnp.where(is_uw, uw_k, byte_k)),
+            is_num, num_k,
+            jnp.where(
+                is_ui, ui_k,
+                jnp.where(
+                    is_uw, uw_k,
+                    jnp.where(
+                        is_pay, pay_k, jnp.where(is_len_m, len_k, byte_k)
+                    ),
+                ),
+            ),
         ).astype(jnp.uint8))
 
     # ---- applies (pallas_kernels._round_logic discipline) ----
@@ -619,8 +851,9 @@ def _round(sref, log_ref, tables, r, n, scores, pri_vec, sb, vb):
     drop_c = jnp.clip(drop, 0, n - pos_c)
     rlen = jnp.where(
         src == SRC_SPAN, src_len * reps,
-        jnp.where(src == SRC_LIT, lit_len, 0),
+        jnp.where(src == SRC_LIT, lit_len * jnp.maximum(reps, 1), 0),
     )
+    rlen = jnp.clip(rlen, 0, L)
     sl_c = jnp.maximum(src_len, 1)
     o = i - pos_c
     cur = _roll(d, pos_c - src_start)
@@ -628,9 +861,12 @@ def _round(sref, log_ref, tables, r, n, scores, pri_vec, sb, vb):
     for k in range(max(1, (L - 1).bit_length())):
         bitk = (odiv >> k) & 1
         cur = jnp.where(bitk == 1, _roll(cur, sl_c << k), cur)
+    # repeated literal: offset modulo lit_len (reps==0 -> 1, pre-r5 rule)
+    ll_c = jnp.maximum(lit_len, 1)
+    omod = jnp.where(o >= 0, o % ll_c, -1)
     lit_at = jnp.zeros((1, L), jnp.uint8)
-    for k in range(_SCRATCH):
-        lit_at = jnp.where(o == k, lit[k], lit_at)
+    for k in range(SCRATCH):
+        lit_at = jnp.where(omod == k, lit[k], lit_at)
     repl = jnp.where(src == SRC_LIT, lit_at, cur)
     tail = _roll(d, rlen - drop_c)
     n_sp = jnp.clip(n - drop_c + rlen, 0, L)
@@ -669,30 +905,40 @@ def _round(sref, log_ref, tables, r, n, scores, pri_vec, sb, vb):
     n1 = jnp.where(kind == K_SPLICE, n_sp, n)
     sref[...] = out
 
-    # PERM_BYTES: in-place Fisher-Yates over [ps, ps+plen), bits row _VB_FY
+    # PERM_BYTES: Fisher-Yates over [ps, ps+span) in VECTOR form — the
+    # window rides a [Wp] register tile and swaps are one-hot selects, so
+    # the historical dynamic scalar VMEM reads/writes (the docstring's
+    # named Mosaic risk) are gone. Same vb draws, same swap sequence,
+    # same values: interpret-mode streams are unchanged. Gated by pl.when
+    # and bounded by the traced span, so non-sp rounds (30 of 31
+    # mutators) pay nothing. The sp setp guarantees ps + span <= n, so
+    # the circular rolls never wrap inside the permuted region.
     @pl.when(kind == K_PERM_BYTES)
     def _fy():
-        span = jnp.clip(plen, 0, min(PERM_WINDOW, L))
+        Wp = min(PERM_WINDOW, L)
+        wi = _arange1d(Wp)
+        span = jnp.clip(plen, 0, Wp)
+        win0 = _roll(d, -ps)[0, :Wp]  # win0[k] = d[ps + k]
+        vrow = vb[_VB_FY][:Wp].astype(jnp.uint32)
 
-        def body(t, carry):
+        def _fy_body(t, win):
             j = span - 1 - t
+            rr = (
+                jnp.sum(jnp.where(wi == j, vrow, 0)).astype(jnp.uint32)
+                % jnp.maximum(j + 1, 1).astype(jnp.uint32)
+            ).astype(jnp.int32)
+            vj = jnp.sum(jnp.where(wi == j, win, 0)).astype(jnp.uint8)
+            vr = jnp.sum(jnp.where(wi == rr, win, 0)).astype(jnp.uint8)
+            swapped = jnp.where(wi == j, vr, jnp.where(wi == rr, vj, win))
+            return jnp.where(j > 0, swapped, win)
 
-            @pl.when(j > 0)
-            def _swap_one():
-                rr = (
-                    vb[_VB_FY, jnp.clip(j, 0, L - 1)]
-                    % (j + 1).astype(jnp.uint32)
-                ).astype(jnp.int32)
-                aj = jnp.clip(ps + j, 0, L - 1)
-                ar = jnp.clip(ps + rr, 0, L - 1)
-                vj = sref[0, aj]
-                vr = sref[0, ar]
-                sref[0, aj] = vr
-                sref[0, ar] = vj
-
-            return carry
-
-        jax.lax.fori_loop(0, min(PERM_WINDOW, L) - 1, body, 0)
+        win_f = jax.lax.fori_loop(
+            0, jnp.maximum(span - 1, 0), _fy_body, win0
+        )
+        win_l = jnp.concatenate([win_f, jnp.zeros(L - Wp, jnp.uint8)]) \
+            if L > Wp else win_f
+        fy_back = _roll(win_l.reshape(1, L), ps)
+        sref[...] = jnp.where((i >= ps) & (i < ps + span), fy_back, d)
 
     # ---- score update (scheduler.adjust_scores) ----
     bin2 = _binarish(sref, n1)
@@ -706,7 +952,11 @@ def _round(sref, log_ref, tables, r, n, scores, pri_vec, sb, vb):
         scores + deltas, int(MIN_SCORE), int(MAX_SCORE)
     ).astype(jnp.int32)
 
-    log_ref[0, r] = jnp.where(any_app, applied, -1)
+    # row-select store (not a dynamic scalar VMEM write): R_MAX is 16
+    r_iota = jax.lax.broadcasted_iota(jnp.int32, (1, log_ref.shape[-1]), 1)
+    log_ref[...] = jnp.where(
+        r_iota == r, jnp.where(any_app, applied, -1), log_ref[...]
+    )
     return n1, scores1
 
 
@@ -877,9 +1127,10 @@ def _render_scalars(v):
 
 
 def _run(meta_ref, pri_ref, sc_ref, funny_ref, flens_ref, itblh_ref,
-         itbll_ref, data_ref, out_ref, nout_ref, scout_ref, log_ref, sref,
-         get_bits):
-    tables = (funny_ref[...], flens_ref[...], itblh_ref[...], itbll_ref[...])
+         itbll_ref, payt_ref, payl_ref, data_ref, out_ref, nout_ref,
+         scout_ref, log_ref, sref, get_bits):
+    tables = (funny_ref[...], flens_ref[...], itblh_ref[...], itbll_ref[...],
+              payt_ref[...], payl_ref[...])
     sref[...] = data_ref[...]
     log_ref[...] = jnp.full((1, R_MAX), -1, jnp.int32)
     n0 = meta_ref[0, 0]
@@ -900,16 +1151,18 @@ def _run(meta_ref, pri_ref, sc_ref, funny_ref, flens_ref, itblh_ref,
 
 
 def _kernel_portable(meta_ref, pri_ref, sc_ref, funny_ref, flens_ref,
-                     itblh_ref, itbll_ref, sbits_ref, vbits_ref, data_ref,
-                     out_ref, nout_ref, scout_ref, log_ref, sref):
+                     itblh_ref, itbll_ref, payt_ref, payl_ref, sbits_ref,
+                     vbits_ref, data_ref, out_ref, nout_ref, scout_ref,
+                     log_ref, sref):
     _run(meta_ref, pri_ref, sc_ref, funny_ref, flens_ref, itblh_ref,
-         itbll_ref, data_ref, out_ref, nout_ref, scout_ref, log_ref, sref,
+         itbll_ref, payt_ref, payl_ref, data_ref, out_ref, nout_ref,
+         scout_ref, log_ref, sref,
          get_bits=lambda r: (sbits_ref[r], vbits_ref[r]))
 
 
 def _kernel_hw(seed_ref, meta_ref, pri_ref, sc_ref, funny_ref, flens_ref,
-               itblh_ref, itbll_ref, data_ref, out_ref, nout_ref, scout_ref,
-               log_ref, sref):  # pragma: no cover - TPU
+               itblh_ref, itbll_ref, payt_ref, payl_ref, data_ref, out_ref,
+               nout_ref, scout_ref, log_ref, sref):  # pragma: no cover - TPU
     pltpu.prng_seed(seed_ref[0, 0], seed_ref[0, 1])
     L = data_ref.shape[-1]
 
@@ -919,8 +1172,8 @@ def _kernel_hw(seed_ref, meta_ref, pri_ref, sc_ref, funny_ref, flens_ref,
         return sb, vb
 
     _run(meta_ref, pri_ref, sc_ref, funny_ref, flens_ref, itblh_ref,
-         itbll_ref, data_ref, out_ref, nout_ref, scout_ref, log_ref, sref,
-         get_bits)
+         itbll_ref, payt_ref, payl_ref, data_ref, out_ref, nout_ref,
+         scout_ref, log_ref, sref, get_bits)
 
 
 def case_rounds_single(key, data_row, n, scores, pri, rounds):
@@ -947,6 +1200,8 @@ def case_rounds_single(key, data_row, n, scores, pri, rounds):
     int_lo = jnp.asarray(
         (_itbl64 & 0xFFFFFFFF).astype(np.uint32).astype(np.int32)
     ).reshape(1, -1)
+    pay_t = jnp.asarray(payloads.TABLE)
+    pay_l = jnp.asarray(payloads.LENS, jnp.int32).reshape(1, -1)
     out_shape = (
         jax.ShapeDtypeStruct((1, L), jnp.uint8),
         jax.ShapeDtypeStruct((1, 1), jnp.int32),
@@ -964,7 +1219,8 @@ def case_rounds_single(key, data_row, n, scores, pri, rounds):
         ).reshape(1, 2)
         out, nout, sc, log = pl.pallas_call(
             _kernel_hw, out_shape=out_shape, scratch_shapes=scratch
-        )(seed, meta, pri2, sc2, funny_t, funny_l, int_hi, int_lo, data2)
+        )(seed, meta, pri2, sc2, funny_t, funny_l, int_hi, int_lo,
+          pay_t, pay_l, data2)
     else:
         sbits = jax.random.bits(
             prng.sub(key, prng.TAG_SITE), (R_MAX, _SB_ROW_LEN), jnp.uint32
@@ -975,5 +1231,6 @@ def case_rounds_single(key, data_row, n, scores, pri, rounds):
         out, nout, sc, log = pl.pallas_call(
             _kernel_portable, out_shape=out_shape, scratch_shapes=scratch,
             interpret=True,
-        )(meta, pri2, sc2, funny_t, funny_l, int_hi, int_lo, sbits, vbits, data2)
+        )(meta, pri2, sc2, funny_t, funny_l, int_hi, int_lo, pay_t, pay_l,
+          sbits, vbits, data2)
     return out[0], nout[0, 0], sc[0], log[0]

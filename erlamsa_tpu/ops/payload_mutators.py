@@ -1,0 +1,133 @@
+"""Device ab/ad: ASCII payload injection as a table-row splice.
+
+Reference: the ascii_bad / ascii_delimeter mutators
+(src/erlamsa_mutations.erl:430-651) lex the input into string chunks and
+splice badness payloads (format strings, traversal runs, 'a' floods,
+NULs, delimiters, shell-inject wrappers) into a text chunk. The oracle
+(oracle/textmutas.py) keeps that chunk-accurate path for host-routed and
+parity work.
+
+The DEVICE re-expression drops the lexer: for a sample the applicability
+predicate already classifies as text (registry P_TEXT — the same
+samples the hybrid used to route hostward for ab/ad), the payload lands
+at a uniform byte position. The payload itself is one row of the packed
+table in ops/payloads.py repeated ``reps`` times — exactly the splice
+engine's literal-with-reps form, so ab/ad cost the same one gather as
+every other splice mutator.
+
+Documented deviations from the oracle (divergence class: device engines,
+see ops/pipeline.py fuzz_sample NOTE): insert_badness repeats ONE silly
+string rand(20)+1 times where the reference concatenates rand(20)+1
+independent draws; traversal runs are period-3 ("/../../..") where the
+reference appends a trailing separator; payloads land at byte (not
+chunk-local) positions; ad's delimiter-drop arm (drop_delimeter) stays
+host-side.
+
+Draw layout (all scalar, shared verbatim by the fused param-gen and the
+standalone switch kernel so both engines emit the same streams):
+  ab: variant = rand(5) over {insert_badness, replace_badness,
+      insert_aaas, insert_traversal, insert_null}
+  ad: variant = rand(4): 3x delimiter insert, 1x shell-inject
+      (erlamsa_mutations.erl:625-644's 3/4-1/4 split)
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from . import payloads, prng
+
+# interesting 'aaaa...' lengths (erlamsa_mutations.erl:486-501)
+_AAA_COUNTS = (127, 128, 255, 256, 16383, 16384, 32767, 32768, 65535, 65536)
+
+
+def _table():
+    return jnp.asarray(payloads.TABLE), jnp.asarray(payloads.LENS)
+
+
+def draw_ab(key, n):
+    """-> (pos, drop, row, lit_len, reps, delta): the ab edit program."""
+    _tab, lens = _table()
+    kt = prng.sub(key, prng.TAG_TABLE)
+    v = prng.rand(prng.sub(key, prng.TAG_MASK), 5)
+    pos_ins = prng.rand(prng.sub(key, prng.TAG_POS), jnp.maximum(n, 1))
+
+    silly_row = payloads.SILLY0 + prng.rand(prng.sub(kt, 1), payloads.N_SILLY)
+    silly_reps = prng.rand(prng.sub(key, prng.TAG_LEN), 20) + 1
+
+    t = prng.rand(prng.sub(kt, 2), 11)
+    aaa_reps = jnp.where(
+        t < 10,
+        jnp.asarray(_AAA_COUNTS, jnp.int32)[jnp.clip(t, 0, 9)],
+        prng.rand(prng.sub(kt, 3), 1024),
+    )
+
+    # row/aux subkeys shared across variants: exactly one variant is used
+    trav_row = payloads.TRAV0 + prng.rand(prng.sub(kt, 1), 2)
+    trav_reps = prng.erand(prng.sub(kt, 3), 10)
+
+    row = jnp.select(
+        [v <= 1, v == 2, v == 3],
+        [silly_row, jnp.int32(payloads.AAA_ROW), trav_row],
+        jnp.int32(payloads.NULL_ROW),
+    ).astype(jnp.int32)
+    reps = jnp.select(
+        [v <= 1, v == 2, v == 3],
+        [silly_reps, aaa_reps, trav_reps],
+        jnp.int32(1),
+    ).astype(jnp.int32)
+    lit_len = lens[row]
+    pos = jnp.where(v == 4, n, pos_ins).astype(jnp.int32)  # NUL appends
+    # replace_badness overwrites in place; everything else inserts
+    drop = jnp.where(v == 1, lit_len * reps, 0).astype(jnp.int32)
+    return pos, drop, row, lit_len, reps, prng.rand_delta(key)
+
+
+def draw_ad(key, n):
+    """-> (pos, drop, row, lit_len, reps, delta): the ad edit program."""
+    _tab, lens = _table()
+    kt = prng.sub(key, prng.TAG_TABLE)
+    v = prng.rand(prng.sub(key, prng.TAG_MASK), 4)
+    delim_row = payloads.DELIM0 + prng.rand(prng.sub(kt, 1), payloads.N_DELIM)
+    shell_row = payloads.SHELL0 + prng.rand(prng.sub(kt, 2), payloads.N_SHELL)
+    row = jnp.where(v < 3, delim_row, shell_row).astype(jnp.int32)
+    pos = prng.rand(prng.sub(key, prng.TAG_POS), jnp.maximum(n, 1))
+    return pos, jnp.int32(0), row, lens[row], jnp.int32(1), prng.rand_delta(key)
+
+
+def lit_splice(data, n, pos, drop, lit, lit_len, reps):
+    """out = data[:pos] ++ lit-repeated ++ data[pos+drop:] (the fused
+    engine's SRC_LIT-with-reps splice, standalone for the switch engine).
+    lit is a [W] row; the replacement is lit[:lit_len] tiled reps times."""
+    L = data.shape[0]
+    i = jnp.arange(L, dtype=jnp.int32)
+    pos = jnp.clip(pos, 0, n)
+    drop = jnp.clip(drop, 0, n - pos)
+    rlen = jnp.clip(lit_len * jnp.maximum(reps, 1), 0, L)
+    end_ins = pos + rlen
+    lit_idx = jnp.clip(
+        jnp.mod(i - pos, jnp.maximum(lit_len, 1)), 0, lit.shape[0] - 1
+    )
+    tail_src = jnp.clip(i - rlen + drop, 0, L - 1)
+    out = jnp.where(
+        i < pos,
+        data,
+        jnp.where(i < end_ins, lit[lit_idx], data[tail_src]),
+    )
+    n_out = jnp.clip(n - drop + rlen, 0, L)
+    out = jnp.where(i < n_out, out, jnp.uint8(0))
+    return out, n_out
+
+
+def _payload_kernel(draw):
+    def kernel(key, data, n):
+        tab, _lens = _table()
+        pos, drop, row, lit_len, reps, delta = draw(key, n)
+        out, n_out = lit_splice(data, n, pos, drop, tab[row], lit_len, reps)
+        return out, n_out, delta
+
+    return kernel
+
+
+ascii_bad = _payload_kernel(draw_ab)
+ascii_delim = _payload_kernel(draw_ad)

@@ -1,0 +1,88 @@
+"""Static payload tables for the DEVICE ab/ad mutators.
+
+The reference's ascii mutators (src/erlamsa_mutations.erl:430-651) build
+their injection payloads from small string tables: silly format-strings,
+path-traversal runs, 'a' floods, delimiters and shell-inject wrappers
+around a reverse-connect endpoint. On device those draws become one row
+pick from a packed uint8 table plus a repeat count — the splice engine
+(ops/fused.py) overlays ``TABLE[row]`` repeated ``reps`` times at the
+insertion point, so the whole payload family costs one gather.
+
+The table is numpy at module scope (module import must not touch the JAX
+backend — registry.py precedent); engines convert at trace time. Rows
+longer than ``PAY_W`` truncate (none of the static payloads do; only an
+adversarially long --ssrf host could, documented).
+
+configure(host, port) rebuilds the shell-inject block for a non-default
+reverse-connect endpoint (the oracle's Ctx.ssrf_ep). It must run BEFORE
+the fuzzer is built: jit captures the table as a compile-time constant,
+so the CLI calls it right after flag parsing (services/cli.py).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..utils.tables import DELIMETERS, REV_CONNECTS, SHELL_INJECTS, SILLY_STRINGS
+
+PAY_W = 48  # row width == ops/fused.py SCRATCH (payloads ride the scratch slot)
+
+# default reverse-connect endpoint: oracle Ctx defaults
+# (oracle/mutations.py Ctx.__init__)
+_DEFAULT_EP = ("localhost", 51234)
+
+
+def _pack(strings: list[str]) -> tuple[np.ndarray, np.ndarray]:
+    tab = np.zeros((len(strings), PAY_W), np.uint8)
+    lens = np.zeros(len(strings), np.int32)
+    for r, s in enumerate(strings):
+        b = s.encode("latin-1", "replace")[:PAY_W]
+        tab[r, : len(b)] = np.frombuffer(b, np.uint8)
+        lens[r] = len(b)
+    return tab, lens
+
+
+def _build(ep: tuple[str, int]):
+    host, port = ep
+    shell = [
+        inj.format(rev.format(host=host, port=port))
+        for inj in SHELL_INJECTS
+        for rev in REV_CONNECTS
+    ]
+    rows = (
+        list(SILLY_STRINGS)  # [SILLY0, SILLY0+N_SILLY)
+        + ["a"]  # AAA_ROW ('a' floods, reps carries the count)
+        + ["/..", "\\.."]  # TRAV0..TRAV0+1 (period-3 traversal runs)
+        + ["\x00"]  # NULL_ROW
+        + list(DELIMETERS)  # [DELIM0, DELIM0+N_DELIM)
+        + shell  # [SHELL0, SHELL0+N_SHELL)
+    )
+    return _pack(rows)
+
+
+# row-range layout (stable: draws index off these)
+SILLY0, N_SILLY = 0, len(SILLY_STRINGS)
+AAA_ROW = SILLY0 + N_SILLY
+TRAV0 = AAA_ROW + 1
+NULL_ROW = TRAV0 + 2
+DELIM0 = NULL_ROW + 1
+N_DELIM = len(DELIMETERS)
+SHELL0 = DELIM0 + N_DELIM
+N_SHELL = len(SHELL_INJECTS) * len(REV_CONNECTS)
+
+TABLE, LENS = _build(_DEFAULT_EP)
+_current_ep = _DEFAULT_EP
+
+
+def configure(host: str, port: int) -> None:
+    """Rebuild the shell-inject rows for a custom reverse-connect endpoint.
+    Call before building fuzzers (jit bakes the table in)."""
+    global TABLE, LENS, _current_ep
+    if (host, port) == _current_ep:
+        return
+    TABLE, LENS = _build((host, port))
+    _current_ep = (host, port)
+
+
+def current_ep() -> tuple[str, int]:
+    return _current_ep

@@ -61,12 +61,16 @@ ENGINES = ("fused", "switch")
 
 def fuzz_sample(key, data, n, scores, pri, pat_pri, engine: str = "fused",
                 enable_sizer: bool = True, enable_csum: bool = True,
-                scan: jax.Array | None = None):
+                scan: jax.Array | None = None,
+                enable_len: bool = True, enable_fuse: bool = True):
     """Mutate one sample end-to-end. vmapped by fuzz_batch.
 
     enable_sizer/enable_csum are TRACE-TIME switches: when the caller knows
     the sz/cs pattern priorities are zero (make_fuzzer does), the detection
-    scans never enter the compiled program.
+    scans never enter the compiled program. enable_len/enable_fuse do the
+    same for the fused engine's per-round keyed sizer / fuse-pair scans
+    (ops/fused.py Tables) when the len / ft fn fo mutator priorities are
+    zero.
 
     scan: optional PREFIX VIEW of data (data[:S] with S >= n for every
     sample in the batch, caller-guaranteed). The sizer/csum detection
@@ -85,13 +89,34 @@ def fuzz_sample(key, data, n, scores, pri, pat_pri, engine: str = "fused",
     (seed, case) replay of pre-r3 archives reproduces structure but not
     the exact mask bytes; re-archive under the current engine for
     bit-exact replay.
+
+    ENGINE VERSION NOTE (r5): the device registry grew from 25 to 31
+    mutators (ab ad len ft fn fo moved on-device), which changes EVERY
+    weighted pick, and weighted_pick's per-mutator draws moved from M
+    key-splits to one raw-bits block (scheduler.weighted_pick). Pre-r5
+    archives do not replay bit-exactly under any engine; the checkpoint
+    engine stamp (services/checkpoint.py) rejects cross-version resume.
     """
     if engine not in ENGINES:
         raise ValueError(f"engine must be one of {ENGINES}, got {engine!r}")
     if engine == "fused":
-        from .fused import fused_mutate_step as step_fn
+        from .fused import fused_mutate_step
+
+        def step_fn(k, d, nn, sc, pr):
+            return fused_mutate_step(
+                k, d, nn, sc, pr,
+                enable_len=enable_len, enable_fuse=enable_fuse,
+            )
     else:
-        step_fn = mutate_step
+        from .registry import predicates
+
+        def step_fn(k, d, nn, sc, pr):
+            # with len disabled its applicability is masked by pri=0, so
+            # skip the O(L) sizer-candidate scan the predicate would run
+            sz = None if enable_len else jnp.zeros((), bool)
+            return mutate_step(
+                k, d, nn, sc, pr, preds=predicates(d, nn, sizer_any=sz)
+            )
     from .patterns import CS, SZ
     from .sizer import detect_sizer, rebuild_sizer, xor8_of_range
 
@@ -179,11 +204,18 @@ def fuzz_sample(key, data, n, scores, pri, pat_pri, engine: str = "fused",
 
     out, n_out = _splice_prefix(data, work, skip, wn)
     if enable_sizer:
+        L = data.shape[0]
+        # reserve room for the held-out tail: a blob grown to capacity
+        # (r5's ab 'a'-floods reach it routinely) must not evict the
+        # re-attached suffix — truncate the blob instead (the sz
+        # contract: original bytes past the blob survive byte-for-byte)
+        n_out = jnp.where(
+            use_sz, jnp.minimum(n_out, L - sz_tail), n_out
+        )
         # field value = the blob length that actually fit (splice may have
         # truncated growth at capacity), not the pre-truncation wn
         blob_len = jnp.maximum(n_out - skip, 0)
         # interior sizer: re-attach the original bytes past the blob's end
-        L = data.shape[0]
         i = jnp.arange(L, dtype=jnp.int32)
         tail_src = data[jnp.clip(i - n_out + field_end, 0, L - 1)]
         in_tail = use_sz & (i >= n_out) & (i < n_out + sz_tail)
@@ -238,7 +270,8 @@ def _auto_slices(B: int, L: int) -> int:
 
 def fuzz_batch(keys, data, lens, scores, pri, pat_pri, engine: str = "fused",
                enable_sizer: bool = True, enable_csum: bool = True,
-               slices="auto", scan_len: int | None = None):
+               slices="auto", scan_len: int | None = None,
+               enable_len: bool = True, enable_fuse: bool = True):
     """One device call: mutate a [B, L] batch.
 
     Args:
@@ -290,7 +323,8 @@ def fuzz_batch(keys, data, lens, scores, pri, pat_pri, engine: str = "fused",
         out, n_out, scn, pat, log = jax.vmap(
             lambda ki, di, ni, si, sdi: fuzz_sample(
                 ki, di, ni, si, pri, pat_pri, engine, enable_sizer,
-                enable_csum, scan=sdi
+                enable_csum, scan=sdi,
+                enable_len=enable_len, enable_fuse=enable_fuse,
             ),
             in_axes=(0, 0, 0, 0, 0 if use_scan else None),
         )(k, d, n, sc, scn_d)
@@ -363,6 +397,16 @@ def make_class_fuzzer(mutator_pri=None, pattern_pri=None,
         raise ValueError(f"engine must be one of {ENGINES}, got {engine!r}")
     enable_sizer = bool(pat_pri[SZ] > 0)
     enable_csum = bool(pat_pri[CS] > 0)
+    # static mutator-priority knowledge: skip the fused engine's per-round
+    # keyed scans when their mutators can never be picked
+    from .registry import code_index
+
+    enable_len = bool(pri[code_index("len")] > 0)
+    enable_fuse = bool(
+        pri[code_index("ft")] > 0
+        or pri[code_index("fn")] > 0
+        or pri[code_index("fo")] > 0
+    )
 
     def step(base, case_idx, indices, data, lens, scores, scan_len=None):
         ckey = prng.case_key(base, case_idx)
@@ -371,6 +415,7 @@ def make_class_fuzzer(mutator_pri=None, pattern_pri=None,
             keys, data, lens, scores, jnp.asarray(pri), jnp.asarray(pat_pri),
             engine=engine, enable_sizer=enable_sizer, enable_csum=enable_csum,
             slices=slices, scan_len=scan_len,
+            enable_len=enable_len, enable_fuse=enable_fuse,
         )
 
     return jax.jit(step, static_argnames=("scan_len",))

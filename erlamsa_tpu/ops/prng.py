@@ -30,6 +30,8 @@ TAG_PERM = 0x07
 TAG_AUX = 0x08
 TAG_SITE = 0x09
 TAG_ROUNDS = 0x0A
+TAG_FUSE = 0x0B  # device fuse jump-pair scan (ops/fuse_mutators.py)
+TAG_TABLE = 0x0C  # payload-table row draws (ops/payload_mutators.py)
 
 
 def base_key(seed: tuple[int, int, int] | int) -> jax.Array:

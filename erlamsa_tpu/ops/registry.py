@@ -21,8 +21,11 @@ from typing import Callable, NamedTuple
 import jax.numpy as jnp
 
 from . import byte_mutators as bm
+from . import fuse_mutators as fm
+from . import lenfield as lf
 from . import line_mutators as lm
 from . import num_mutators as nm
+from . import payload_mutators as pm
 from . import seq_mutators as sm
 from . import utf8_mutators as um
 
@@ -43,8 +46,10 @@ P_TEXT_2L = 4  # text with >= 2 lines
 P_TEXT_3L = 5  # text with >= 3 lines
 P_WIDENABLE = 6  # a byte < 0x40 present
 P_NEVER = 7  # never applicable (nil debug mutator)
+P_SIZERQ = 8  # a tail/near-tail length-field candidate exists (len)
+P_N4 = 9  # n >= 4 (fuse context match needs a few bytes)
 
-NUM_PREDS = 8
+NUM_PREDS = 10
 
 
 def _nomutation(key, data, n):
@@ -80,6 +85,15 @@ DEVICE_MUTATORS: tuple[DeviceMutator, ...] = (
     DeviceMutator("lp", lm.line_perm, 1, P_TEXT_3L),
     DeviceMutator("lis", lm.line_ins, 1, P_TEXT),
     DeviceMutator("lrs", lm.line_replace, 1, P_TEXT),
+    # r5: formerly host-routed mutators re-expressed as device splices
+    # (payload-table injection, sizer-field edit, context-matched fusion)
+    # — the hybrid's host tail shrank from 16 to 10 codes
+    DeviceMutator("ab", pm.ascii_bad, 1, P_TEXT),
+    DeviceMutator("ad", pm.ascii_delim, 1, P_TEXT),
+    DeviceMutator("len", lf.length_mutate, 2, P_SIZERQ),
+    DeviceMutator("ft", fm.fuse_this, 2, P_N4),
+    DeviceMutator("fn", fm.fuse_next, 1, P_N4),
+    DeviceMutator("fo", fm.fuse_old, 2, P_N4),
     DeviceMutator("nil", _nomutation, 0, P_NEVER),
 )
 
@@ -88,11 +102,13 @@ NUM_DEVICE_MUTATORS = len(DEVICE_MUTATORS)
 DEFAULT_DEVICE_PRI = tuple(m.default_pri for m in DEVICE_MUTATORS)
 
 # host-engine mutators with their reference default priorities
-# (src/erlamsa_mutations.erl:1291-1331)
+# (src/erlamsa_mutations.erl:1291-1331). ab/ad/len/ft/fn/fo moved to the
+# device registry in r5; the oracle still implements them (exact
+# chunk-lexed / suffix-walk semantics) for parity mode and host routing
+# of container samples.
 HOST_CODES: dict[str, int] = {
-    "sgm": 10, "js": 3, "ab": 1, "ad": 1, "tr2": 1, "td": 1, "ts1": 2,
-    "tr": 2, "ts2": 2, "ft": 2, "fn": 1, "fo": 2, "len": 2, "b64": 7,
-    "uri": 1, "zip": 1,
+    "sgm": 10, "js": 3, "tr2": 1, "td": 1, "ts1": 2,
+    "tr": 2, "ts2": 2, "b64": 7, "uri": 1, "zip": 1,
 }
 
 ALL_CODES = DEVICE_CODES + tuple(HOST_CODES)
@@ -102,8 +118,15 @@ def code_index(code: str) -> int:
     return DEVICE_CODES.index(code)
 
 
-def predicates(data, n):
-    """bool[NUM_PREDS] applicability table for one sample."""
+def predicates(data, n, sizer_any=None):
+    """bool[NUM_PREDS] applicability table for one sample.
+
+    sizer_any: optional precomputed "a tail/near-tail length-field
+    candidate exists" bool (the fused engine shares the scan with its
+    per-round detect_sizer; when omitted it is computed here via
+    ops.sizer.sizer_candidates — keyed interior probes can't live in a
+    predicate, so a purely-interior sizer is missed, a conservative
+    documented narrowing)."""
     L = data.shape[0]
     i = jnp.arange(L, dtype=jnp.int32)
     valid = i < n
@@ -116,6 +139,12 @@ def predicates(data, n):
     # line count: newline-terminated segments plus an unterminated tail
     last = data[jnp.clip(n - 1, 0, L - 1)]
     nlines = nl_count + jnp.where(nonempty & (last != 10), 1, 0)
+
+    if sizer_any is None:
+        from .sizer import sizer_candidates
+
+        sizer_any = jnp.any(sizer_candidates(data, n)[0])
+
     return jnp.stack(
         [
             nonempty,
@@ -126,6 +155,8 @@ def predicates(data, n):
             text & (nlines >= 3),
             widenable & nonempty,
             jnp.zeros((), bool),
+            sizer_any,
+            n >= 4,
         ]
     )
 

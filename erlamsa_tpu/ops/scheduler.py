@@ -45,23 +45,29 @@ def init_scores(key: jax.Array, batch: int) -> jax.Array:
     return jnp.maximum(r, int(MIN_SCORE))
 
 
-def weighted_pick(key, data, n, scores, pri):
+def weighted_pick(key, data, n, scores, pri, preds=None):
     """The mux selection: applicability table, weighted-permutation draw,
     first applicable in descending order. Shared by both engines.
+
+    preds: optional precomputed registry.predicates table (the fused
+    engine shares scan work with its per-round Tables).
 
     Returns (applied, any_app, pos, pos_of): chosen registry index, whether
     anything was applicable, its position in the try order, and the inverse
     permutation (for tried-before score accounting)."""
     M = NUM_DEVICE_MUTATORS
-    preds = predicates(data, n)  # bool[NUM_PREDS]
+    if preds is None:
+        preds = predicates(data, n)  # bool[NUM_PREDS]
     applicable = preds[jnp.asarray(PRED_INDEX_NP)] & (pri > 0)
 
-    # weighted permutation: r_m = rand(score_m * pri_m), sorted desc
-    kweights = jax.random.split(prng.sub(key, prng.TAG_PERM), M)
-    bounds = jnp.maximum(scores * pri, 1)
-    draws = jax.vmap(lambda k, b: jax.random.randint(k, (), 0, b, dtype=jnp.int32))(
-        kweights, bounds
-    )
+    # weighted permutation: r_m = rand(score_m * pri_m), sorted desc.
+    # One threefry call for all M draws (bits % bound, bias < 1e-7 at
+    # bound <= 100) instead of M key-splits + M randints — the split
+    # chain dominated the pick at M=31 (ENGINE VERSION NOTE r5 in
+    # ops/pipeline.py: selection streams changed).
+    bits = jax.random.bits(prng.sub(key, prng.TAG_PERM), (M,), jnp.uint32)
+    bounds = jnp.maximum(scores * pri, 1).astype(jnp.uint32)
+    draws = (bits % bounds).astype(jnp.int32)
     order = jnp.argsort(-draws, stable=True).astype(jnp.int32)
 
     app_in_order = applicable[order]
@@ -84,7 +90,7 @@ def adjust_scores(scores, applied, any_app, pos, pos_of, delta):
     )
 
 
-def mutate_step(key, data, n, scores, pri):
+def mutate_step(key, data, n, scores, pri, preds=None):
     """One mutation event on one sample (the per-kernel "switch" engine).
 
     Args:
@@ -92,11 +98,14 @@ def mutate_step(key, data, n, scores, pri):
       data: uint8[L]; n: int32 length.
       scores: int32[M] self-adjusting scores.
       pri: int32[M] user priorities (0 disables a mutator).
+      preds: optional precomputed registry.predicates table.
 
     Returns: (data', n', scores', applied int32) — applied is the registry
     index, or -1 when nothing was applicable.
     """
-    applied, any_app, pos, pos_of = weighted_pick(key, data, n, scores, pri)
+    applied, any_app, pos, pos_of = weighted_pick(
+        key, data, n, scores, pri, preds=preds
+    )
 
     new_data, new_n, delta = jax.lax.switch(
         applied, _KERNELS, prng.sub(key, prng.TAG_SITE), data, n

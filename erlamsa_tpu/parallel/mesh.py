@@ -112,3 +112,29 @@ def place_batch(mesh: Mesh, data, lens, scores):
         jax.device_put(lens, lens_sharding(mesh)),
         jax.device_put(scores, scores_sharding(mesh)),
     )
+
+
+def pad_batch(mesh: Mesh, data, lens, scores):
+    """Pad an UNEVEN batch (B not divisible by the data axis) with zero
+    rows up to the next multiple, so the canonical shardings apply.
+
+    Padding rows carry n=0: every mutator predicate fails on them, the
+    scheduler picks nothing, and the rows pass through untouched — so a
+    padded run's first B rows are bit-identical to the unpadded stream
+    (each sample's keys derive from its own index, never from B). Returns
+    (data, lens, scores, B_orig); slice [:B_orig] after the step.
+    """
+    ddim = mesh.shape["data"]
+    B = data.shape[0]
+    pad = (-B) % ddim
+    if pad:
+        data = np.concatenate(
+            [np.asarray(data),
+             np.zeros((pad,) + data.shape[1:], np.asarray(data).dtype)]
+        )
+        lens = np.concatenate([np.asarray(lens), np.zeros(pad, np.int32)])
+        scores = np.concatenate(
+            [np.asarray(scores),
+             np.zeros((pad,) + scores.shape[1:], np.int32)]
+        )
+    return (*place_batch(mesh, data, lens, scores), B)

@@ -101,7 +101,15 @@ class TpuBatcher:
         self._max_running_time = max_running_time
         self._overflow = None  # built lazily on the first oversized request
         self._overflow_lock = threading.Lock()
+        # load metrics (BASELINE config 4): fill efficiency = served /
+        # (flushes * batch) — how full the device batches actually ran
+        self.flushes = 0
+        self.served = 0
         supervise("tpu-batcher-flusher", self._flusher)
+
+    @property
+    def fill_efficiency(self) -> float:
+        return self.served / (self.flushes * self.batch) if self.flushes else 0.0
 
     def _flusher(self):
         import numpy as np
@@ -128,6 +136,8 @@ class TpuBatcher:
                     self._scores,
                 )
                 self._case += 1
+                self.flushes += 1
+                self.served += len(reqs)
                 results = unpack(Batch(data, lens))
                 for r, res in zip(reqs, results):
                     r.result = res

@@ -16,6 +16,19 @@ import os
 import numpy as np
 
 
+def _engine_stamp(engine: str = "fused") -> np.ndarray:
+    """(engine id string) the saved stream is only replayable under: the
+    engine name, the ERLAMSA_PALLAS level, and the device-registry size
+    (engines draw differently, and a registry growth like the r5
+    ab/ad/len/ft/fn/fo move changes every weighted pick). engine comes
+    from the caller — the batch runner always builds the fused engine
+    today, so the default reflects the only shipping configuration."""
+    from ..ops.registry import NUM_DEVICE_MUTATORS
+
+    pallas = os.environ.get("ERLAMSA_PALLAS", "0")
+    return np.asarray(f"{engine}/pallas{pallas}/M{NUM_DEVICE_MUTATORS}", "U32")
+
+
 def save_state(path: str, seed, case_idx: int, scores,
                host_scores: dict | None = None,
                host_scores_post: dict | None = None) -> None:
@@ -35,6 +48,7 @@ def save_state(path: str, seed, case_idx: int, scores,
             f,
             seed=np.asarray(seed, np.int64),
             case_idx=np.asarray(case_idx, np.int64),
+            engine=_engine_stamp(),
             scores=np.asarray(scores, np.int32),
             host_codes=np.asarray(sorted(hs), "U8"),
             host_values=np.asarray([hs[k] for k in sorted(hs)], np.float64),
@@ -62,10 +76,15 @@ def save_state(path: str, seed, case_idx: int, scores,
 def load_state(path: str):
     """-> (seed tuple, case_idx, scores ndarray, host_scores dict,
     host_scores_post dict), or None when the file is unreadable/corrupt
-    (callers start fresh). Older files without the post state fall back
-    to the pre state."""
+    OR was written under a different engine/pallas-level/registry (the
+    stream is only reproducible per-engine — callers start fresh).
+    Older files without the post state fall back to the pre state."""
     try:
         with np.load(path) as z:
+            # a stampless file is by definition pre-r5: its stream ran the
+            # 25-mutator registry and cannot resume bit-faithfully either
+            if "engine" not in z or str(z["engine"]) != str(_engine_stamp()):
+                return None
             seed = tuple(int(x) for x in z["seed"])
             case_idx = int(z["case_idx"])
             scores = z["scores"].copy()

@@ -1,9 +1,11 @@
 """Hybrid dispatcher: full-mutator-set fuzzing with device batches.
 
-The device engine covers 24 closed-form mutators; the structured tail
-(sgm js ab ad tree* ft fn fo len b64 uri zip) runs in the oracle. The
-reference's mux draws one mutator per event from the whole weighted set —
-the hybrid dispatcher reproduces that split at the *sample* level:
+The device engine covers 30 closed-form mutators (r5 moved ab ad len
+ft fn fo onto the device as payload-table / sizer-field / context-match
+splices); the structured tail (sgm js tree* b64 uri zip) runs in the
+oracle. The reference's mux draws one mutator per event from the whole
+weighted set — the hybrid dispatcher reproduces that split at the
+*sample* level:
 
   1. per sample, estimate which registry rows are applicable (cheap host
      heuristics mirroring the mutators' own guards),
@@ -53,6 +55,10 @@ def sample_traits(data: bytes) -> dict:
         # a '<' immediately followed by a name/bang/slash — the shape the
         # SGML tokenizer actually turns into a tag, unlike a bare 0x3C byte
         "has_tag": re.search(rb"<[A-Za-z!/?]", data[:4096]) is not None,
+        # tree mutators walk bracket/paren/brace/quote structure
+        # (models/treeops.py): without any opener the oracle draw fails,
+        # so plain text must not weigh toward the host for them
+        "has_tree": re.search(rb"[(\[{<\"']", data[:4096]) is not None,
         "looks_json": stripped[:1] in (b"{", b"[", b'"')
         or stripped[:1].isdigit(),
         "is_zip": data[:4] in (b"PK\x03\x04", b"PK\x05\x06"),
@@ -78,10 +84,11 @@ def row_applicable(code: str, traits: dict) -> bool:
         return traits["has_uri"]
     if code == "b64":
         return traits["maybe_b64"]
-    if code in ("tr2", "td", "ts1", "ts2", "tr", "ab", "ad"):
-        return not traits["is_bin"]
-    if code == "len":
-        return traits["size"] > 10
+    if code in ("tr2", "td", "ts1", "ts2", "tr"):
+        # r5: require actual bracket/quote structure, mirroring the tree
+        # walkers' own no-opener failure — "not binary" alone routed every
+        # text sample hostward for 8 priority points of tree mass
+        return (not traits["is_bin"]) and traits["has_tree"]
     return True
 
 

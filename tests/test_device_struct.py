@@ -1,0 +1,306 @@
+"""Device ab/ad/len/ft/fn/fo: the r5 structured-mutator device moves.
+
+Pins the new paths three ways:
+- draw-level properties (payload rows land where drawn, len edits the
+  detected field, fuse jump-in shares the jump-out's forward context),
+- switch-kernel vs fused param-gen agreement (shared draw functions),
+- end-to-end: the fused engine actually produces payload injections /
+  field edits over a corpus where the mutator is forced.
+
+Reference semantics being re-expressed: ascii mutators
+src/erlamsa_mutations.erl:430-651, length predict :1107-1143, fuse
+:384-427 (documented device deviations listed in each ops module).
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from erlamsa_tpu.ops import payloads, prng
+from erlamsa_tpu.ops.fuse_mutators import (
+    MATCH_DEPTH,
+    fuse_next,
+    fuse_old,
+    fuse_scan,
+    fuse_this,
+)
+from erlamsa_tpu.ops.lenfield import draw_len, field_bytes, length_mutate
+from erlamsa_tpu.ops.payload_mutators import ascii_bad, ascii_delim, draw_ab, draw_ad
+from erlamsa_tpu.ops.registry import DEVICE_CODES, code_index
+from erlamsa_tpu.ops.sizer import detect_sizer
+
+L = 256
+
+
+def _row(data: bytes) -> tuple[jnp.ndarray, jnp.ndarray]:
+    buf = np.zeros(L, np.uint8)
+    buf[: len(data)] = np.frombuffer(data, np.uint8)
+    return jnp.asarray(buf), jnp.int32(len(data))
+
+
+def _keys(n=64, salt=0):
+    return [jax.random.fold_in(jax.random.key(salt), k) for k in range(n)]
+
+
+# --- payload tables -------------------------------------------------------
+
+
+def test_payload_table_layout():
+    assert payloads.TABLE.shape[1] == payloads.PAY_W
+    assert payloads.TABLE.shape[0] == payloads.SHELL0 + payloads.N_SHELL
+    # every row's recorded length matches its content
+    for r in range(payloads.TABLE.shape[0]):
+        ln = int(payloads.LENS[r])
+        assert ln > 0
+        assert not payloads.TABLE[r, ln:].any()
+    assert bytes(payloads.TABLE[payloads.AAA_ROW, :1]) == b"a"
+    assert bytes(payloads.TABLE[payloads.NULL_ROW, :1]) == b"\x00"
+    assert bytes(payloads.TABLE[payloads.TRAV0, :3]) == b"/.."
+
+
+def test_payload_configure_rebuilds_shell_rows():
+    before = payloads.TABLE[payloads.SHELL0].copy()
+    try:
+        payloads.configure("10.9.8.7", 4242)
+        row = bytes(
+            payloads.TABLE[payloads.SHELL0][: int(payloads.LENS[payloads.SHELL0])]
+        )
+        assert b"10.9.8.7" in row
+    finally:
+        payloads.configure(*payloads._DEFAULT_EP)
+    assert np.array_equal(payloads.TABLE[payloads.SHELL0], before)
+
+
+# --- ab / ad --------------------------------------------------------------
+
+
+def test_ab_inserts_known_payload():
+    data, n = _row(b"The quick brown fox jumps over the lazy dog again")
+    payload_seen = 0
+    grew_cases = 0
+    for key in _keys(64):
+        out, n2, delta = jax.jit(ascii_bad)(key, data, n)
+        out_b = bytes(np.asarray(out)[: int(n2)])
+        assert int(delta) in (-1, 1)
+        pos, drop, row, lit_len, reps, _ = draw_ab(key, n)
+        row_b = bytes(payloads.TABLE[int(row)][: int(lit_len)])
+        if row_b and row_b in out_b:
+            payload_seen += 1
+        if int(n2) != int(n):
+            grew_cases += 1
+    # payloads are drawn from the table, so most outputs contain the row
+    assert payload_seen >= 48
+    assert grew_cases >= 32
+
+
+def test_ab_null_append_variant():
+    data, n = _row(b"plain ascii words")
+    found = False
+    for key in _keys(128):
+        pos, drop, row, lit_len, reps, _ = draw_ab(key, n)
+        if int(row) == payloads.NULL_ROW:
+            out, n2, _ = ascii_bad(key, data, n)
+            out_b = bytes(np.asarray(out)[: int(n2)])
+            assert out_b.endswith(b"\x00")  # insert_null appends
+            found = True
+            break
+    assert found
+
+
+def test_ad_inserts_delimiter_or_shell():
+    data, n = _row(b"field1:field2|field3;tail")
+    hits = 0
+    for key in _keys(64):
+        pos, drop, row, lit_len, reps, _ = draw_ad(key, n)
+        out, n2, _ = ascii_delim(key, data, n)
+        out_b = bytes(np.asarray(out)[: int(n2)])
+        row_b = bytes(payloads.TABLE[int(row)][: int(lit_len)])
+        assert int(n2) == int(n) + int(lit_len)  # pure insert
+        if row_b in out_b:
+            hits += 1
+        if int(row) >= payloads.SHELL0:
+            assert int(lit_len) > 3  # shell injects carry the endpoint
+    assert hits >= 56
+
+
+def test_ab_aaas_flood_capped_by_capacity():
+    data, n = _row(b"short text with letters")
+    seen_flood = False
+    for key in _keys(256):
+        pos, drop, row, lit_len, reps, _ = draw_ab(key, n)
+        if int(row) == payloads.AAA_ROW and int(reps) >= L:
+            out, n2, _ = ascii_bad(key, data, n)
+            assert int(n2) == L  # clipped at capacity, not overflowed
+            out_b = bytes(np.asarray(out))
+            assert out_b.count(b"a") >= L - int(n)
+            seen_flood = True
+            break
+    assert seen_flood
+
+
+# --- len ------------------------------------------------------------------
+
+
+def _sized_buffer() -> tuple[jnp.ndarray, jnp.int32, int, int]:
+    """header + u16be length field + blob whose length it records."""
+    blob = bytes(range(65, 65 + 60))
+    buf = b"HD" + len(blob).to_bytes(2, "big") + blob
+    data, n = _row(buf)
+    return data, n, 2, len(blob)
+
+
+def test_len_edits_detected_field():
+    data, n, field_a, _bl = _sized_buffer()
+    changed = 0
+    for key in _keys(64):
+        out, n2, delta = jax.jit(length_mutate)(key, data, n)
+        assert int(delta) == 1  # a candidate always exists here
+        if bytes(np.asarray(out)[: int(n2)]) != bytes(np.asarray(data)[: int(n)]):
+            changed += 1
+    assert changed >= 56
+
+
+def test_len_variants_cover_zero_saturate_and_drop():
+    data, n, field_a, blob_len = _sized_buffer()
+    sizer = detect_sizer(jax.random.key(7), data, n)
+    saw = set()
+    for key in _keys(128):
+        pos, drop, lit, lit_len, reps, delta = draw_len(key, n, sizer)
+        t_kind = (int(pos), int(drop), int(lit_len), int(reps))
+        out, n2 = __import__(
+            "erlamsa_tpu.ops.payload_mutators", fromlist=["lit_splice"]
+        ).lit_splice(data, n, pos, drop, lit, lit_len, reps)
+        out_b = np.asarray(out)
+        if int(drop) > 4:  # drop-blob variant: output shrinks
+            saw.add("drop")
+            assert int(n2) < int(n)
+        elif int(drop) == 0 and int(reps) >= 1 and int(lit_len) > 4:
+            saw.add("expand")
+            assert int(n2) > int(n)
+        elif (out_b[: int(n2)] == 0xFF).sum() >= 2:
+            saw.add("saturate")
+        elif int(lit_len) <= 4:
+            saw.add("field")
+        del t_kind
+    assert {"drop", "expand", "field"} <= saw
+
+
+def test_len_no_candidate_is_failed_try():
+    data, n = _row(b"\x01\x01\x01\x01")  # all values <= 2: no candidate
+    out, n2, delta = length_mutate(jax.random.key(3), data, n)
+    assert int(delta) == -1
+    assert bytes(np.asarray(out)) == bytes(np.asarray(data))
+    assert int(n2) == int(n)
+
+
+def test_field_bytes_endianness():
+    v = jnp.int32(0x0102)
+    be = np.asarray(field_bytes(v, jnp.int32(2), jnp.int32(1)))  # u16be
+    le = np.asarray(field_bytes(v, jnp.int32(2), jnp.int32(2)))  # u16le
+    assert tuple(be[:2]) == (1, 2)
+    assert tuple(le[:2]) == (2, 1)
+
+
+# --- ft / fn / fo ---------------------------------------------------------
+
+
+def test_fuse_scan_matches_context():
+    pattern = b"abcdef-XY-abcdef-ZW-abcdef tail words abcdef"
+    data, n = _row(pattern)
+    matched = 0
+    for key in _keys(64):
+        p, q, ok = fuse_scan(key, data, n)
+        p, q, ok = int(p), int(q), bool(ok)
+        assert q != p
+        if ok:
+            # q's forward context equals p's for at least 1 byte
+            buf = np.asarray(data)
+            if buf[q] == buf[p]:
+                matched += 1
+    assert matched >= 32  # repeated 'abcdef' gives the scan real matches
+
+
+def test_fuse_kernels_produce_self_splices():
+    data, n = _row(b"0123456789" * 12)
+    for kernel in (fuse_this, fuse_next, fuse_old):
+        changed = 0
+        for key in _keys(32):
+            out, n2, delta = jax.jit(kernel)(key, data, n)
+            out_b = np.asarray(out)[: int(n2)]
+            # every output byte must exist in the source alphabet
+            assert set(out_b.tolist()) <= set(np.asarray(data).tolist())
+            if int(n2) != int(n) or bytes(out_b) != bytes(
+                np.asarray(data)[: int(n)]
+            ):
+                changed += 1
+        assert changed >= 16, kernel
+
+
+def test_fuse_ft_is_prefix_plus_suffix():
+    data, n = _row(b"ABCD-ABCD-ABCD-ABCD-ABCD!")
+    for key in _keys(16):
+        p, q, ok = fuse_scan(key, data, n)
+        out, n2, _ = fuse_this(key, data, n)
+        p, q = int(p), int(q)
+        exp = bytes(np.asarray(data)[:p]) + bytes(np.asarray(data)[q : int(n)])
+        got = bytes(np.asarray(out)[: int(n2)])
+        assert got == exp[:L]
+
+
+# --- registry / engines ---------------------------------------------------
+
+
+def test_new_codes_registered_on_device():
+    for c in ("ab", "ad", "len", "ft", "fn", "fo"):
+        assert c in DEVICE_CODES
+    from erlamsa_tpu.ops.registry import HOST_CODES
+
+    assert not (set(HOST_CODES) & {"ab", "ad", "len", "ft", "fn", "fo"})
+
+
+def test_fused_engine_emits_payloads_end_to_end():
+    """Force ab-only priority: every mutated text sample gains a payload."""
+    from erlamsa_tpu.ops import pipeline, scheduler
+    from erlamsa_tpu.ops.registry import NUM_DEVICE_MUTATORS
+
+    pri = np.zeros(NUM_DEVICE_MUTATORS, np.int32)
+    pri[code_index("ab")] = 1
+    B = 16
+    seed = b"some honest ascii corpus line with words in it"
+    data = np.zeros((B, L), np.uint8)
+    data[:, : len(seed)] = np.frombuffer(seed, np.uint8)
+    lens = np.full(B, len(seed), np.int32)
+    step = pipeline.make_fuzzer(L, B, mutator_pri=pri)[0]
+    base = prng.base_key((9, 9, 9))
+    sc = scheduler.init_scores(prng.case_key(base, 0), B)
+    out, n_out, _sc, meta = step(base, 0, jnp.asarray(data), jnp.asarray(lens), sc)
+    out = np.asarray(out)
+    n_out = np.asarray(n_out)
+    applied = np.asarray(meta.applied)
+    assert (applied == code_index("ab")).any()
+    changed = sum(
+        bytes(out[b][: n_out[b]]) != bytes(data[b][: lens[b]])
+        for b in range(B)
+    )
+    assert changed >= B // 2
+
+
+def test_switch_engine_runs_new_kernels():
+    from erlamsa_tpu.ops.scheduler import mutate_step
+    from erlamsa_tpu.ops.registry import NUM_DEVICE_MUTATORS
+
+    data, n = _row(b"switch engine sample with digits 123 and (tree)")
+    pri = np.zeros(NUM_DEVICE_MUTATORS, np.int32)
+    for c in ("ab", "ad", "len", "ft", "fn", "fo"):
+        pri[code_index(c)] = 5
+    sc = jnp.full(NUM_DEVICE_MUTATORS, 6, jnp.int32)
+    applied_set = set()
+    d, nn = data, n
+    for key in _keys(48, salt=5):
+        d, nn, sc, applied = jax.jit(mutate_step)(
+            key, d, nn, sc, jnp.asarray(pri)
+        )
+        applied_set.add(int(applied))
+    codes = {DEVICE_CODES[a] for a in applied_set if a >= 0}
+    assert codes & {"ab", "ad", "ft", "fn", "fo"}

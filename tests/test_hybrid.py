@@ -10,9 +10,9 @@ SELECTED = dict(default_mutations())
 
 
 def test_host_mass_heuristics():
-    # plain binary: tree/ascii/sgml/js guards all fail
-    assert host_applicable_mass(bytes(range(256)), SELECTED) <= \
-        SELECTED["len"] + SELECTED["ft"] + SELECTED["fn"] + SELECTED["fo"]
+    # plain binary: tree/sgml/js guards all fail, and the r5 device moves
+    # (ab ad len ft fn fo) left no always-applicable host row at all
+    assert host_applicable_mass(bytes(range(200, 256)), SELECTED) == 0
     # XML-ish data unlocks sgm (pri 10)
     xml_mass = host_applicable_mass(b"<a><b>text</b></a>", SELECTED)
     assert xml_mass >= SELECTED["sgm"]
@@ -22,6 +22,18 @@ def test_host_mass_heuristics():
     # URI unlocks uri
     assert host_applicable_mass(b"see http://x.com/ ok", SELECTED) >= \
         host_applicable_mass(b"see nothing here ok", SELECTED)
+
+
+def test_tree_guard_needs_structure():
+    # r5: plain text without bracket/quote openers must not weigh toward
+    # the host for the tree mutators (their walkers would find no node)
+    flat = b"just words and newlines\nno structure at all\n"
+    structured = b"call(arg1, [a, b]) {body} 'quoted'\n"
+    flat_mass = host_applicable_mass(flat, SELECTED)
+    tree_mass = sum(SELECTED[c] for c in ("tr2", "td", "ts1", "ts2", "tr"))
+    assert host_applicable_mass(structured, SELECTED) >= \
+        flat_mass + tree_mass
+    assert flat_mass == 0  # nothing else applies to flat prose either
 
 
 def test_split_deterministic_and_reasonable():

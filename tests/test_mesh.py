@@ -127,3 +127,89 @@ def test_place_batch_roundtrip():
     np.testing.assert_array_equal(np.asarray(sdata), np.asarray(data))
     np.testing.assert_array_equal(np.asarray(slens), np.asarray(lens))
     np.testing.assert_array_equal(np.asarray(sscores), np.asarray(scores))
+
+
+def test_uneven_batch_pads_and_matches_single_device():
+    """B=20 on an 8-wide data axis (VERDICT r4 item 5): pad_batch rows are
+    inert and the first B sharded rows equal the unpadded single-device
+    stream."""
+    from erlamsa_tpu.parallel.mesh import pad_batch
+
+    _require_devices(8)
+    B = 20
+    base, data, lens, scores = _example_batch(batch=B)
+
+    ref_out, ref_n, ref_sc, _ = _single_device_reference(
+        base, 0, data, lens, scores
+    )
+
+    mesh = make_mesh(jax.devices()[:8], data=8, seq=1)
+    sdata, slens, sscores, b_orig = pad_batch(mesh, data, lens, scores)
+    assert b_orig == B and sdata.shape[0] == 24
+    step = make_sharded_fuzzer(mesh, sdata.shape[0])
+    out, n_out, sc, _ = step(base, 0, sdata, slens, sscores)
+    jax.block_until_ready(out)
+
+    np.testing.assert_array_equal(np.asarray(out)[:B], np.asarray(ref_out))
+    np.testing.assert_array_equal(np.asarray(n_out)[:B], np.asarray(ref_n))
+    np.testing.assert_array_equal(np.asarray(sc)[:B], np.asarray(ref_sc))
+    # padding rows stayed inert
+    np.testing.assert_array_equal(np.asarray(n_out)[B:], np.zeros(4))
+    assert not np.asarray(out)[B:].any()
+
+
+def test_carried_scores_sequence_matches_single_device():
+    """Sequence mode across 3 cases: the evolving per-sample scheduler
+    scores carried under the mesh must match the single-device carry
+    (VERDICT r4 item 5)."""
+    _require_devices(8)
+    base, data0, lens0, scores0 = _example_batch()
+    mesh = make_mesh(jax.devices()[:8], data=8, seq=1)
+    step = make_sharded_fuzzer(mesh, BATCH)
+
+    r_data, r_lens, r_sc = data0, lens0, scores0
+    s_data, s_lens, s_sc = place_batch(mesh, data0, lens0, scores0)
+    for case in range(3):
+        r_out, r_n, r_sc, _ = _single_device_reference(
+            base, case, r_data, r_lens, r_sc
+        )
+        r_data, r_lens = r_out, r_n
+
+        s_out, s_n, s_sc, _ = step(base, case, s_data, s_lens, s_sc)
+        s_data, s_lens = s_out, s_n
+
+        np.testing.assert_array_equal(np.asarray(s_out), np.asarray(r_out))
+        np.testing.assert_array_equal(np.asarray(s_n), np.asarray(r_n))
+        np.testing.assert_array_equal(np.asarray(s_sc), np.asarray(r_sc))
+
+
+def test_interior_sizer_input_on_seq_axis():
+    """A corpus of length-field samples (incl. interior sizers) sharded
+    with seq=2 must produce the identical bytes as one device — the sz
+    holdout/re-attach path crosses the seq dimension (VERDICT r4 item 5)."""
+    _require_devices(8)
+    blob = bytes(range(64, 64 + 50))
+    tail = b"TRAILER-BYTES-PAST-BLOB"
+    # u16be length field at offset 2 recording an INTERIOR blob end
+    sized = b"HD" + len(blob).to_bytes(2, "big") + blob + tail
+    seeds = [sized] * (BATCH // 2) + [
+        b"plain sample %03d with number 777\n" % i for i in range(BATCH // 2)
+    ]
+    from erlamsa_tpu.ops.buffers import pack
+
+    b = pack(seeds, capacity=CAPACITY)
+    base = prng.base_key((4, 5, 6))
+    scores = init_scores(jax.random.fold_in(base, 999), BATCH)
+
+    ref_out, ref_n, ref_sc, _ = _single_device_reference(
+        base, 2, b.data, b.lens, scores
+    )
+    mesh = make_mesh(jax.devices()[:8], data=4, seq=2)
+    step = make_sharded_fuzzer(mesh, BATCH)
+    sdata, slens, sscores = place_batch(mesh, b.data, b.lens, scores)
+    out, n_out, sc, _ = step(base, 2, sdata, slens, sscores)
+    jax.block_until_ready(out)
+
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(ref_out))
+    np.testing.assert_array_equal(np.asarray(n_out), np.asarray(ref_n))
+    np.testing.assert_array_equal(np.asarray(sc), np.asarray(ref_sc))

@@ -295,8 +295,13 @@ def test_tpu_batcher_oversized_request_takes_oracle_escape():
     out = b.fuzz(big, {"seed": (1, 2, 3)}, timeout=120)
     # full-fidelity oracle output, not a 256-byte truncation
     assert out != b"" and len(out) > 256
+    # a fitting request rides the DEVICE batch (served counter moves; the
+    # byte content itself may legitimately be empty — e.g. a line-delete
+    # on a single-line sample — so the mechanism is what's asserted)
+    before = b.served
     small = b.fuzz(b"fits fine 123", {"seed": (1, 2, 3)}, timeout=120)
-    assert small != b""
+    assert isinstance(small, bytes)
+    assert b.served == before + 1
 
 
 # ---- proxy --------------------------------------------------------------
@@ -859,3 +864,38 @@ def test_listen_writers_bound_to_loopback():
     t2.join(5)
     c2.close()
     assert chunks == b"bound-tcp"
+
+
+def test_batcher_meets_latency_deadline_under_load():
+    """BASELINE config 4 support (VERDICT r4 item 4): under sustained
+    concurrent load the oracle batcher must answer every request well
+    inside the service budget, and the load harness publishes latency
+    percentiles + batcher fill efficiency for the bench record."""
+    import sys as _sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    if os.path.join(repo, "bin") not in _sys.path:
+        _sys.path.insert(0, os.path.join(repo, "bin"))
+    import load_bench
+
+    out = load_bench.faas_load(n_requests=120, concurrency=24)
+    assert out["faas_errors"] == 0
+    assert out["faas_reqs_per_sec"] > 1
+    # per-request latency must sit far inside the 90s request timeout /
+    # 30s per-case budget even with 24 requests in flight on one core
+    assert out["faas_p99_ms"] < 15_000, out
+
+
+def test_proxy_stream_harness():
+    """BASELINE config 5 support: the live-proxy stream harness pushes
+    cases through a tcp fuzzproxy at -P 1.0,1.0 and reports cases/s."""
+    import sys as _sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    if os.path.join(repo, "bin") not in _sys.path:
+        _sys.path.insert(0, os.path.join(repo, "bin"))
+    import load_bench
+
+    out = load_bench.proxy_stream(n_cases=60)
+    assert out["proxy_cases"] == 60
+    assert out["proxy_cases_per_sec"] > 1
