@@ -236,6 +236,30 @@ def child_main() -> None:
         _write_result(line)
     except Exception as e:  # noqa: BLE001 — device number still stands
         _phase(f"full-set stage FAILED: {type(e).__name__}: {e}", t0)
+
+    # service-layer stage (BASELINE configs 4/5): FaaS concurrency +
+    # live-proxy stream via bin/load_bench.py. Modest defaults keep the
+    # TPU bench window short; ERLAMSA_LOAD_N=10000 runs the full config-4
+    # load. ERLAMSA_BENCH_SERVICES=0 skips.
+    if os.environ.get("ERLAMSA_BENCH_SERVICES", "1") != "0":
+        try:
+            sys.path.insert(0, os.path.join(REPO, "bin"))
+            import load_bench
+
+            os.environ.setdefault("ERLAMSA_LOAD_N", "2000")
+            os.environ.setdefault("ERLAMSA_LOAD_CONC", "100")
+            os.environ.setdefault("ERLAMSA_LOAD_PROXY_N", "1000")
+            svc = load_bench.run_all()
+            record.update(svc)
+            _phase(
+                f"service stage: faas {svc['faas_reqs_per_sec']} req/s "
+                f"(p99 {svc['faas_p99_ms']} ms), proxy "
+                f"{svc['proxy_cases_per_sec']} cases/s", t0,
+            )
+            line = json.dumps(record)
+            _write_result(line)
+        except Exception as e:  # noqa: BLE001 — earlier numbers stand
+            _phase(f"service stage FAILED: {type(e).__name__}: {e}", t0)
     print(line)
 
 

@@ -95,6 +95,7 @@ def faas_load(n_requests: int, concurrency: int, backend: str = "oracle",
     if batcher is not None and hasattr(batcher, "fill_efficiency"):
         out["faas_fill_efficiency"] = round(batcher.fill_efficiency, 3)
     srv.shutdown()
+    srv.server_close()  # release the listening socket, not just the loop
     return out
 
 
@@ -137,19 +138,45 @@ def proxy_stream(n_cases: int, payload: bytes = b"proxy stream case 42\n") -> di
     time.sleep(0.3)
 
     cli = socket.create_connection(("127.0.0.1", l_port), timeout=30)
-    cli.settimeout(30)
     t0 = time.monotonic()
     done = 0
+    dropped = 0
+    closed = False
     for _ in range(n_cases):
         cli.sendall(payload)
-        if not cli.recv(65536):
+        # one reply per case; a mutation may legitimately EMPTY the
+        # forwarded packet (nothing reaches the echo upstream), so a
+        # timed-out case counts as dropped rather than hanging the run
+        cli.settimeout(5)
+        try:
+            first = cli.recv(65536)
+        except socket.timeout:
+            dropped += 1
+            continue
+        if not first:
+            closed = True
             break
         done += 1
+        # a fuzz-resized response may arrive segmented: drain leftovers
+        # so they are not miscounted as the NEXT case's reply
+        cli.settimeout(0.01)
+        while True:
+            try:
+                extra = cli.recv(65536)
+            except socket.timeout:
+                break
+            if not extra:
+                closed = True
+                break
+        if closed:
+            break
     wall = time.monotonic() - t0
     cli.close()
+    proxy.stop()
     upstream.close()
     return {
         "proxy_cases": done,
+        "proxy_dropped": dropped,
         "proxy_cases_per_sec": round(done / wall, 1) if wall > 0 else 0.0,
     }
 

@@ -16,7 +16,9 @@ adversarially long --ssrf host could, documented).
 configure(host, port) rebuilds the shell-inject block for a non-default
 reverse-connect endpoint (the oracle's Ctx.ssrf_ep). It must run BEFORE
 the fuzzer is built: jit captures the table as a compile-time constant,
-so the CLI calls it right after flag parsing (services/cli.py).
+so the batch runner calls it from the same opts the oracle Ctx reads
+(services/batchrunner.py run_tpu_batch; library callers building
+fuzzers directly do the same).
 """
 
 from __future__ import annotations

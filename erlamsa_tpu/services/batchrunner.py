@@ -44,11 +44,18 @@ def run_tpu_batch(opts: dict, batch: int = 1024) -> int:
     import jax
 
     from ..constants import CAPACITY_CLASSES
-    from ..ops import prng
+    from ..ops import payloads, prng
     from ..ops.buffers import Batch, capacity_for, pack, unpack
     from ..ops.pipeline import make_class_fuzzer
     from ..ops.registry import DEVICE_CODES
     from ..ops.scheduler import init_scores
+
+    # bake the reverse-connect endpoint into the device ab/ad payload
+    # table BEFORE any fuzzer is built (jit captures it as a constant) —
+    # same source of truth as the oracle Ctx (oracle/engine.py)
+    payloads.configure(
+        opts.get("ssrf_host", "localhost"), opts.get("ssrf_port", 51234)
+    )
 
     seeds = _load_corpus(opts.get("paths", ["-"]), opts.get("recursive", False),
                          direct=opts.get("corpus"))

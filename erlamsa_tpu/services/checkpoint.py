@@ -31,7 +31,8 @@ def _engine_stamp(engine: str = "fused") -> np.ndarray:
 
 def save_state(path: str, seed, case_idx: int, scores,
                host_scores: dict | None = None,
-               host_scores_post: dict | None = None) -> None:
+               host_scores_post: dict | None = None,
+               engine: str = "fused") -> None:
     """Atomic write (tmp + rename): a kill mid-save — the very interruption
     checkpoints exist for — must never corrupt the previous checkpoint.
     host_scores: the hybrid routing scores the resumed case's split must
@@ -48,7 +49,7 @@ def save_state(path: str, seed, case_idx: int, scores,
             f,
             seed=np.asarray(seed, np.int64),
             case_idx=np.asarray(case_idx, np.int64),
-            engine=_engine_stamp(),
+            engine=_engine_stamp(engine),
             scores=np.asarray(scores, np.int32),
             host_codes=np.asarray(sorted(hs), "U8"),
             host_values=np.asarray([hs[k] for k in sorted(hs)], np.float64),
@@ -73,7 +74,7 @@ def save_state(path: str, seed, case_idx: int, scores,
         pass
 
 
-def load_state(path: str):
+def load_state(path: str, engine: str = "fused"):
     """-> (seed tuple, case_idx, scores ndarray, host_scores dict,
     host_scores_post dict), or None when the file is unreadable/corrupt
     OR was written under a different engine/pallas-level/registry (the
@@ -83,7 +84,9 @@ def load_state(path: str):
         with np.load(path) as z:
             # a stampless file is by definition pre-r5: its stream ran the
             # 25-mutator registry and cannot resume bit-faithfully either
-            if "engine" not in z or str(z["engine"]) != str(_engine_stamp()):
+            if "engine" not in z or str(z["engine"]) != str(
+                _engine_stamp(engine)
+            ):
                 return None
             seed = tuple(int(x) for x in z["seed"])
             case_idx = int(z["case_idx"])

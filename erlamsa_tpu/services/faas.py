@@ -14,6 +14,15 @@ import binascii
 import json
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
+
+class _FaasServer(ThreadingHTTPServer):
+    """HTTPServer's default accept backlog is 5 — concurrent load (the
+    reference serves 10k simultaneous fsupervisor requests) overflows it
+    and the kernel RESETS connections. A deep listen queue plus the
+    batcher's own queueing is the capacity model here."""
+
+    request_queue_size = 1024
+
 from ..utils.erlrand import parse_seed
 from . import logger
 from .batcher import make_batcher
@@ -205,7 +214,7 @@ def serve(host: str, port: int, opts: dict, backend: str = "oracle",
             ),
         },
     )
-    srv = ThreadingHTTPServer((host, port), handler)
+    srv = _FaasServer((host, port), handler)
     logger.log("info", "faas listening on %s:%d (backend=%s)", host, port, backend)
     print(f"# faas listening on {host}:{port} backend={backend} "
           f"admin-token={handler.cmanager.admin_token}", flush=True)
