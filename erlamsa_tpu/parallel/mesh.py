@@ -31,6 +31,16 @@ from ..ops.pipeline import FuzzMeta, fuzz_batch
 from ..ops.registry import DEFAULT_DEVICE_PRI
 
 
+def default_pris() -> tuple[jnp.ndarray, jnp.ndarray]:
+    """(mutator_pri, pattern_pri) as device arrays — the one conversion
+    of the default tables, shared by entry(), the dry run and
+    make_sharded_fuzzer so they can never silently diverge."""
+    return (
+        jnp.asarray(np.asarray(DEFAULT_DEVICE_PRI, np.int32)),
+        jnp.asarray(np.asarray(DEFAULT_PATTERN_PRI_NP, np.int32)),
+    )
+
+
 def make_mesh(devices=None, data: int | None = None, seq: int = 1) -> Mesh:
     """Build a (data, seq) mesh over the given (or all) devices."""
     devices = devices if devices is not None else jax.devices()
@@ -64,17 +74,14 @@ def make_sharded_fuzzer(mesh: Mesh, batch: int, mutator_pri=None, pattern_pri=No
     """Jitted multi-device fuzz step: keys/data/lens/scores sharded over the
     data axis, priorities replicated. Returns step(base, case_idx, data,
     lens, scores)."""
-    pri = jnp.asarray(
-        np.asarray(
-            mutator_pri if mutator_pri is not None else DEFAULT_DEVICE_PRI,
-            np.int32,
-        )
+    d_pri, d_pat = default_pris()
+    pri = (
+        jnp.asarray(np.asarray(mutator_pri, np.int32))
+        if mutator_pri is not None else d_pri
     )
-    pat_pri = jnp.asarray(
-        np.asarray(
-            pattern_pri if pattern_pri is not None else DEFAULT_PATTERN_PRI_NP,
-            np.int32,
-        )
+    pat_pri = (
+        jnp.asarray(np.asarray(pattern_pri, np.int32))
+        if pattern_pri is not None else d_pat
     )
 
     dsh = batch_sharding(mesh)
