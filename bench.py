@@ -186,6 +186,7 @@ def child_main() -> None:
 
     _phase(f"jax imported, backend={jax.default_backend()}", t0)
     from erlamsa_tpu.ops import prng
+    from erlamsa_tpu.ops.registry import NUM_DEVICE_MUTATORS
 
     base = prng.base_key((1, 2, 3))
     stages = [(BATCH, SEED_LEN, CAPACITY, ITERS)]
@@ -211,6 +212,10 @@ def child_main() -> None:
             "seed_len": seed_len,
             "batch": batch_n,
             "capacity": capacity,
+            # r5 grew the device registry 25 -> 31 (ab/ad/len/ft/fn/fo);
+            # cross-round comparisons of `value` must account for the
+            # wider per-round mutator coverage
+            "device_mutators": NUM_DEVICE_MUTATORS,
         }
         if pallas_lvl:
             record["pallas"] = pallas_lvl

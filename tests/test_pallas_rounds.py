@@ -263,3 +263,66 @@ def test_pipeline_integration_pallas2(monkeypatch):
     applied = np.asarray(m1.applied)
     assert applied.shape == (B, R_MAX)
     assert ((applied >= -1) & (applied < M)).all()
+
+
+# ---- r5 structured mutators in the whole-case kernel ---------------------
+
+
+def test_ab_injects_payload_bytes():
+    from erlamsa_tpu.ops.registry import DEVICE_CODES as _DC
+
+    changed = 0
+    for s in range(12):
+        out, log = _run_one("ab", TEXT, seed=1000 + s)
+        assert log[0] == _DC.index("ab")
+        if out != TEXT:
+            changed += 1
+        # splice output: only printable source bytes plus payload bytes
+        # (payload tables are latin-1 strings and NULs)
+    assert changed >= 10
+
+
+def test_ad_pure_insert_grows_by_row_length():
+    from erlamsa_tpu.ops import payloads
+
+    grow_ok = 0
+    for s in range(12):
+        out, log = _run_one("ad", TEXT, seed=2000 + s)
+        growth = len(out) - len(TEXT)
+        # ad inserts exactly one table row (delimiter or shell inject)
+        if 0 < growth <= payloads.PAY_W:
+            grow_ok += 1
+    assert grow_ok >= 10
+
+
+def test_len_edits_sized_buffer():
+    blob = bytes(range(65, 65 + 40))
+    sized = b"HD" + len(blob).to_bytes(2, "big") + blob
+    changed = 0
+    for s in range(12):
+        out, log = _run_one("len", sized, seed=3000 + s)
+        if log[0] >= 0 and out != sized:
+            changed += 1
+    assert changed >= 8
+
+
+def test_len_without_candidate_never_applies():
+    # all bytes <= 2: P_SIZERQ is false, so the scheduler can't pick len
+    out, log = _run_one("len", b"\x01\x02\x01\x02\x01", seed=7)
+    assert log[0] == -1
+    assert out == b"\x01\x02\x01\x02\x01"
+
+
+def test_fuse_kernels_splice_within_alphabet():
+    from erlamsa_tpu.ops.registry import DEVICE_CODES as _DC
+
+    src = b"ABCD-ABCD-ABCD-ABCD!xyz" * 3
+    for code in ("ft", "fn", "fo"):
+        changed = 0
+        for s in range(10):
+            out, log = _run_one(code, src, seed=4000 + s)
+            assert log[0] == _DC.index(code)
+            assert set(out) <= set(src)  # pure self-splice
+            if out != src:
+                changed += 1
+        assert changed >= 5, code
