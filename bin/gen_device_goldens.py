@@ -1,0 +1,101 @@
+#!/usr/bin/env python
+"""Regenerate tests/goldens/device_goldens.json — digests of the FUSED
+device engine's output for fixed (seed, case, corpus) points.
+
+Where the oracle self-goldens (bin/gen_goldens.py) lock the sequential
+parity engine, these lock the DEVICE stream: the (seed, case) archive
+format (services/checkpoint.py, last_seed.txt) promises that replaying a
+case under the same engine version reproduces the bytes. An accidental
+stream change (a draw reordered, a table row shifted) breaks every
+archived repro silently — this file makes it a test failure instead.
+
+Intentional stream changes (a new registry row, a draw-scheme change)
+regenerate via this script and MUST add an ENGINE VERSION NOTE to
+ops/pipeline.py fuzz_sample's docstring (r3 and r5 precedents).
+
+Run from the repo root: python bin/gen_device_goldens.py
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+OUT = os.path.join(REPO, "tests", "goldens", "device_goldens.json")
+
+
+def _standalone_env() -> None:
+    """CPU-safe env for a bare `python bin/gen_device_goldens.py` run.
+    NOT executed on import: the golden test exec's this module inside
+    pytest, whose process env must not be mutated."""
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+    if REPO not in sys.path:
+        sys.path.insert(0, REPO)
+
+
+def corpus(kind: str, batch: int) -> list[bytes]:
+    if kind == "text":
+        return [
+            b"golden text sample %04d value=12345 (tree) [x]\nsecond line\n"
+            % i
+            for i in range(batch)
+        ]
+    if kind == "sized":
+        blob = bytes(range(33, 33 + 60))
+        return [b"HD" + len(blob).to_bytes(2, "big") + blob] * batch
+    return [bytes((i * 7 + j * 13) % 251 for j in range(300))
+            for i in range(batch)]
+
+
+def digest_points():
+    import jax
+
+    from erlamsa_tpu.ops import prng
+    from erlamsa_tpu.ops.buffers import pack
+    from erlamsa_tpu.ops.pipeline import make_fuzzer
+    from erlamsa_tpu.ops.scheduler import init_scores
+
+    import numpy as np
+
+    points = {}
+    B, CAP = 16, 512
+    step, _ = make_fuzzer(CAP, B)  # one compile serves all three kinds
+    base = prng.base_key((11, 22, 33))
+    for kind in ("text", "sized", "binary"):
+        seeds = corpus(kind, B)
+        b = pack(seeds, capacity=CAP)
+        scores = init_scores(jax.random.fold_in(base, 999), B)
+        data, lens = b.data, b.lens
+        for case in range(3):  # sequence mode: scores carry
+            data, lens, scores, _ = step(base, case, data, lens, scores)
+            h = hashlib.md5()
+            h.update(np.asarray(data).tobytes())
+            h.update(np.asarray(lens).tobytes())
+            h.update(np.asarray(scores).tobytes())
+            points[f"{kind}/case{case}"] = h.hexdigest()
+    return points
+
+
+def main() -> None:
+    points = digest_points()
+    from erlamsa_tpu.ops.registry import NUM_DEVICE_MUTATORS
+
+    doc = {
+        "engine": f"fused/M{NUM_DEVICE_MUTATORS}",
+        "note": "see bin/gen_device_goldens.py; regenerate on INTENTIONAL "
+                "stream changes only, with an ENGINE VERSION NOTE",
+        "points": points,
+    }
+    with open(OUT, "w") as f:
+        json.dump(doc, f, indent=1, sort_keys=True)
+        f.write("\n")
+    print(f"wrote {OUT}: {len(points)} points")
+
+
+if __name__ == "__main__":
+    _standalone_env()
+    main()

@@ -386,3 +386,31 @@ def test_scan_len_bit_identical(state):
             assert np.array_equal(np.asarray(a), np.asarray(b)), (
                 f"slices={slices}: {name} diverged with scan_len={scan}"
             )
+
+
+def test_device_stream_goldens():
+    """The fused engine's (seed, case) streams are LOCKED: an accidental
+    draw/table/order change breaks every archived repro silently — this
+    digest check makes it a test failure instead. Intentional changes
+    regenerate via bin/gen_device_goldens.py + an ENGINE VERSION NOTE
+    (fuzz_sample docstring, r3/r5 precedents)."""
+    import importlib.util
+    import json
+    import os as _os
+
+    repo = _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__)))
+    with open(_os.path.join(repo, "tests", "goldens",
+                            "device_goldens.json")) as f:
+        doc = json.load(f)
+    from erlamsa_tpu.ops.registry import NUM_DEVICE_MUTATORS
+
+    assert doc["engine"] == f"fused/M{NUM_DEVICE_MUTATORS}", (
+        "registry size changed: regenerate device goldens + version note"
+    )
+    spec = importlib.util.spec_from_file_location(
+        "gen_device_goldens", _os.path.join(repo, "bin",
+                                            "gen_device_goldens.py")
+    )
+    gen = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(gen)
+    assert gen.digest_points() == doc["points"]
