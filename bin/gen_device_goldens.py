@@ -80,8 +80,70 @@ def digest_points():
     return points
 
 
+def pallas2_digest_points():
+    """Digests for the ERLAMSA_PALLAS=2 interpret-mode stream (the
+    flagship whole-case kernel; its hardware stream differs by design —
+    TPU PRNG — but the interpret stream is what CI locks). Smaller
+    shapes than the fused points: the interpret kernel is slow."""
+    import jax
+
+    from erlamsa_tpu.ops import prng
+    from erlamsa_tpu.ops.buffers import pack
+    from erlamsa_tpu.ops.pipeline import make_fuzzer
+    from erlamsa_tpu.ops.scheduler import init_scores
+
+    import numpy as np
+
+    assert os.environ.get("ERLAMSA_PALLAS") == "2", (
+        "run in a subprocess with ERLAMSA_PALLAS=2 (trace-time switch)"
+    )
+    points = {}
+    B, CAP = 8, 256
+    step, _ = make_fuzzer(CAP, B)
+    base = prng.base_key((11, 22, 33))
+    for kind in ("text", "sized"):
+        seeds = corpus(kind, B)
+        b = pack(seeds, capacity=CAP)
+        scores = init_scores(jax.random.fold_in(base, 999), B)
+        data, lens = b.data, b.lens
+        for case in range(2):
+            data, lens, scores, _ = step(base, case, data, lens, scores)
+            h = hashlib.md5()
+            h.update(np.asarray(data).tobytes())
+            h.update(np.asarray(lens).tobytes())
+            h.update(np.asarray(scores).tobytes())
+            points[f"{kind}/case{case}"] = h.hexdigest()
+    return points
+
+
+def _pallas2_subprocess() -> dict:
+    """Compute pallas2 points in a child so ERLAMSA_PALLAS=2 (a
+    trace-time env switch) never touches the calling process."""
+    import subprocess
+
+    env = dict(os.environ)
+    env["ERLAMSA_PALLAS"] = "2"
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    code = (
+        "import json, importlib.util; "
+        f"spec = importlib.util.spec_from_file_location('g', {__file__!r}); "
+        "g = importlib.util.module_from_spec(spec); "
+        "spec.loader.exec_module(g); "
+        "print(json.dumps(g.pallas2_digest_points()))"
+    )
+    out = subprocess.run(
+        [sys.executable, "-c", code], env=env, cwd=REPO,
+        capture_output=True, timeout=600, text=True,
+    )
+    assert out.returncode == 0, out.stderr[-2000:]
+    return json.loads(out.stdout.strip().splitlines()[-1])
+
+
 def main() -> None:
     points = digest_points()
+    pallas2 = _pallas2_subprocess()
     from erlamsa_tpu.ops.registry import NUM_DEVICE_MUTATORS
 
     doc = {
@@ -89,11 +151,12 @@ def main() -> None:
         "note": "see bin/gen_device_goldens.py; regenerate on INTENTIONAL "
                 "stream changes only, with an ENGINE VERSION NOTE",
         "points": points,
+        "pallas2_points": pallas2,
     }
     with open(OUT, "w") as f:
         json.dump(doc, f, indent=1, sort_keys=True)
         f.write("\n")
-    print(f"wrote {OUT}: {len(points)} points")
+    print(f"wrote {OUT}: {len(points)} fused + {len(pallas2)} pallas2 points")
 
 
 if __name__ == "__main__":

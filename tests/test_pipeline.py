@@ -414,3 +414,7 @@ def test_device_stream_goldens():
     gen = importlib.util.module_from_spec(spec)
     spec.loader.exec_module(gen)
     assert gen.digest_points() == doc["points"]
+    # the flagship whole-case kernel's interpret stream, locked via a
+    # subprocess (ERLAMSA_PALLAS=2 is a trace-time env switch that must
+    # not leak into this pytest process)
+    assert gen._pallas2_subprocess() == doc["pallas2_points"]
