@@ -37,6 +37,10 @@ SPLICE_SWAP_CODES = [
     "bd", "bei", "bed", "bf", "bi", "ber", "br", "sd", "sr",
     "uw", "ui", "num",
     "ld", "lds", "lr2", "lri", "lr", "ls", "lis", "lrs",
+    # r5 structured mutators: payload-table / sizer-field / fusion
+    # splices (incl. the repeated-literal form) must stay bit-identical
+    # between the jnp composite and the level-1 kernel
+    "ab", "ad", "len", "ft", "fn", "fo",
 ]
 
 
@@ -139,3 +143,24 @@ def test_kernel_fisher_yates_direct():
     assert np.array_equal(out[48:], data[48:])
     assert sorted(out[16:48]) == sorted(data[16:48])
     assert not np.array_equal(out[16:48], data[16:48])
+
+
+def test_kernel_repeated_literal_tiling_direct():
+    """SRC_LIT with reps > 1 (the r5 payload form): lit[:lit_len] tiled
+    reps times at pos, bit-identical to the modular expectation."""
+    L = 64
+    data = np.arange(L, dtype=np.uint8)
+    n = 20
+    lit = np.zeros(48, np.uint8)
+    lit[:3] = (250, 251, 252)
+    p = _params(kind=K_SPLICE, pos=5, drop=0, src=2, lit_len=3, reps=4, n=n)
+    key = prng.base_key((4, 4, 4))
+    out = np.asarray(fused_round_single(
+        key, p, jnp.asarray(lit), jnp.asarray(data)
+    ))
+    expect = np.concatenate([
+        data[:5], np.tile(lit[:3], 4), data[5:n],
+    ])
+    n_out = len(expect)
+    assert np.array_equal(out[:n_out], expect)
+    assert not out[n_out:].any()
