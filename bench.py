@@ -168,6 +168,58 @@ def _run_full_set_stage(batch_n: int, seed_len: int, cases: int, t0: float):
     return warm_sps, host_frac
 
 
+def _run_corpus_stage(batch_n: int, seed_len: int, cases: int, t0: float):
+    """Feedback-driven corpus engine over a MIXED-LENGTH seed set: store
+    dedup -> energy schedule -> power-of-two length buckets -> device
+    batches, the `--corpus DIR --feedback` CLI path (corpus/runner.py).
+    The mixed lengths are the point: the r5 full-set stage padded every
+    sample to one capacity class, and bucketing is the claw-back for the
+    872 -> 550 samples/s slide recorded in BENCH_r05.json.
+
+    Returns (warm_samples_per_sec, per-bucket padded-waste dict,
+    novel-hash count). Warm = first case (trace+compile) dropped via the
+    runner's per-case finish timestamps; needs cases >= 2."""
+    import shutil
+    import tempfile
+
+    from erlamsa_tpu.corpus.runner import run_corpus_batch
+
+    # mixed-length corpus: the same text/binary mix as make_seeds, cut to
+    # a spread of sizes (seed_len down to seed_len/16) so buckets form
+    base_seeds = make_seeds(batch_n, seed_len)
+    lengths = [max(64, seed_len >> k) for k in (0, 1, 2, 3, 4)]
+    seeds = [s[: lengths[i % len(lengths)]] for i, s in enumerate(base_seeds)]
+
+    stats: dict = {}
+    tmpdir = tempfile.mkdtemp(prefix="erlamsa_corpus_bench_")
+    try:
+        opts = {
+            "corpus_dir": tmpdir,
+            "corpus": seeds,
+            "feedback": True,
+            "seed": (1, 2, 3),
+            "n": max(2, cases),
+            "output": os.devnull,
+            "_stats": stats,
+        }
+        rc = run_corpus_batch(opts, batch=batch_n)
+    finally:
+        shutil.rmtree(tmpdir, ignore_errors=True)
+    if rc != 0 or len(stats.get("finish_times", [])) < 2:
+        raise RuntimeError(f"corpus stage failed rc={rc} stats={stats}")
+    ft = stats["finish_times"]
+    warm_sps = batch_n * (len(ft) - 1) / (ft[-1] - ft[0])
+    waste = {
+        str(cap): round(b["padded_bytes_wasted"] / max(b["rows"], 1), 1)
+        for cap, b in sorted(stats["buckets"].items())
+    }
+    _phase(
+        f"corpus stage: {warm_sps:,.0f} samples/s warm, "
+        f"buckets={list(waste)} padded-waste/sample={waste}", t0,
+    )
+    return warm_sps, waste, stats.get("new_hashes", 0)
+
+
 def child_main() -> None:
     """The measured run. Writes its JSON record to $ERLAMSA_BENCH_RESULT
     (and stdout); phase timings go to stderr.
@@ -241,6 +293,22 @@ def child_main() -> None:
         _write_result(line)
     except Exception as e:  # noqa: BLE001 — device number still stands
         _phase(f"full-set stage FAILED: {type(e).__name__}: {e}", t0)
+
+    # corpus-mode stage: the feedback engine on a mixed-length seed set,
+    # with per-bucket padded-bytes-wasted so the bucketing win over the
+    # full-set number is measurable. ERLAMSA_BENCH_CORPUS=0 skips.
+    if os.environ.get("ERLAMSA_BENCH_CORPUS", "1") != "0":
+        try:
+            corpus_sps, waste, novel = _run_corpus_stage(
+                BATCH, SEED_LEN, max(2, ITERS // 3), t0
+            )
+            record["corpus_samples_per_sec"] = round(corpus_sps, 1)
+            record["corpus_padded_waste_per_sample"] = waste
+            record["corpus_novel_hashes"] = novel
+            line = json.dumps(record)
+            _write_result(line)
+        except Exception as e:  # noqa: BLE001 — earlier numbers stand
+            _phase(f"corpus stage FAILED: {type(e).__name__}: {e}", t0)
 
     # service-layer stage (BASELINE configs 4/5): FaaS concurrency +
     # live-proxy stream via bin/load_bench.py. Modest defaults keep the

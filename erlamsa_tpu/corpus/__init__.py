@@ -1,0 +1,31 @@
+"""Feedback-driven corpus engine.
+
+The reference erlamsa is a pure open-loop mutator: monitors and the proxy
+*detect* interesting outcomes but nothing feeds them back into seed
+selection, so every batch re-mutates a static corpus with uniform
+probability. This package closes the loop (SURVEY.md §7 / ROADMAP north
+star) with four pieces:
+
+  store.py      content-hash-deduped persistent seed corpus with
+                per-seed metadata (origin, energy, hit counts,
+                discovered-by), JSON-backed like services/cmanager.py
+  energy.py     AFL-style per-seed energy scheduling with deterministic
+                weighted sampling (counter-keyed like ops/prng.py, so a
+                fixed -s seed replays bit-identically)
+  assembler.py  power-of-two length-bucketed batch assembly bounding
+                padding waste and jit recompiles; emits the uint8[B, L]
+                + length vectors the device engine consumes
+  feedback.py   thread-safe event bus monitors/proxy/faas publish onto
+                and the store consumes to promote/demote seeds
+  runner.py     the feedback-driven batch loop riding the TPU engine
+                (the only module here that imports jax)
+
+Everything except runner.py is deliberately jax-free so monitors, the
+proxy and spawned host-pool workers can publish events without touching
+an accelerator backend (see services/hostpool.py for why that matters in
+this image).
+"""
+
+from .feedback import Event, FeedbackBus
+
+__all__ = ["Event", "FeedbackBus"]

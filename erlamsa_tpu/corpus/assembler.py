@@ -1,0 +1,116 @@
+"""Bucketed batch assembly: scheduled seeds -> padded device batches.
+
+The round-5 bench recorded the full-set slide 872 -> 550 samples/s
+(BENCH_r05.json): one oversized capacity class drags every sample in the
+batch to its padded width. This module groups a scheduled seed list into
+power-of-two LENGTH buckets so each sample pays only the padding of its
+own size class, and pads each bucket's row count up to a power of two so
+the jitted step sees a bounded set of (B, L) shapes — recompiles stay
+O(log^2) over the whole run instead of O(cases).
+
+Emits plain numpy uint8[B, L] + int32[B] length vectors — exactly what
+ops/buffers.Batch holds and services/batchrunner.py's step consumes —
+without importing jax, so assembly can run on publisher threads and in
+tests with no accelerator backend.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Sequence
+
+import numpy as np
+
+from ..constants import CAPACITY_CLASSES
+
+#: smallest bucket: below this, padding waste is noise and smaller
+#: shapes would only multiply compiled programs (lane width, ops/buffers
+#: scan_bound floor)
+MIN_BUCKET = 256
+
+#: smallest padded row count per bucket — thinner batches pay more
+#: per-dispatch overhead than the padding costs
+MIN_ROWS = 8
+
+#: mutation growth slack, matching ops/buffers.capacity_for
+GROWTH_SLACK = 2.0
+
+
+def _next_pow2(n: int) -> int:
+    return 1 << max(0, (int(n) - 1)).bit_length()
+
+
+def bucket_capacity(length: int, slack: float = GROWTH_SLACK,
+                    device_max: int | None = None) -> int:
+    """Power-of-two capacity for a seed of `length` bytes with mutation
+    growth slack, floored at MIN_BUCKET and capped at the largest device
+    capacity class (bigger seeds overflow to the host oracle, like the
+    batch runner's capacity classes)."""
+    cap_max = device_max or CAPACITY_CLASSES[-1]
+    want = max(1, int(length * slack))
+    return min(max(MIN_BUCKET, _next_pow2(want)), cap_max)
+
+
+class Bucket(NamedTuple):
+    """One padded device batch of same-size-class samples."""
+
+    capacity: int  # L: power-of-two byte width
+    slots: np.ndarray  # int32[rows]: positions in the scheduled list
+    data: np.ndarray  # uint8[rows_padded, capacity]
+    lens: np.ndarray  # int32[rows_padded]
+    rows: int  # real sample count (<= rows_padded)
+    padded_bytes_wasted: int  # sum(capacity - len) over REAL rows
+
+    @property
+    def rows_padded(self) -> int:
+        return self.data.shape[0]
+
+    @property
+    def pad_rows(self) -> int:
+        return self.rows_padded - self.rows
+
+
+def assemble(samples: Sequence[bytes], slack: float = GROWTH_SLACK,
+             device_max: int | None = None,
+             pad_rows_pow2: bool = True) -> list[Bucket]:
+    """Group a scheduled sample list into capacity buckets.
+
+    Every input position lands in exactly one bucket (Bucket.slots);
+    within a bucket, schedule order is preserved. Row padding repeats
+    real rows cyclically — pad outputs are discarded by the consumer, so
+    their content only has to be shape-valid. Buckets come back sorted
+    by capacity (smallest first) for a stable compile order.
+    """
+    groups: dict[int, list[int]] = {}
+    for pos, s in enumerate(samples):
+        cap = bucket_capacity(len(s), slack, device_max)
+        groups.setdefault(cap, []).append(pos)
+
+    buckets = []
+    for cap, positions in sorted(groups.items()):
+        rows = len(positions)
+        rows_padded = (
+            max(MIN_ROWS, _next_pow2(rows)) if pad_rows_pow2 else rows
+        )
+        data = np.zeros((rows_padded, cap), np.uint8)
+        lens = np.zeros(rows_padded, np.int32)
+        wasted = 0
+        for r in range(rows_padded):
+            s = samples[positions[r % rows]]
+            # oversized samples (beyond the device cap) are truncated to
+            # capacity rather than dropped — the scheduler picked them,
+            # and a truncated mutation beats an empty slot; the runner
+            # logs the overflow count
+            n = min(len(s), cap)
+            data[r, :n] = np.frombuffer(s[:n], np.uint8)
+            lens[r] = n
+            if r < rows:
+                wasted += cap - n
+        buckets.append(Bucket(
+            capacity=cap,
+            slots=np.asarray(positions, np.int32),
+            data=data,
+            lens=lens,
+            rows=rows,
+            padded_bytes_wasted=wasted,
+        ))
+    return buckets

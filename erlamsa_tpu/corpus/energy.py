@@ -1,0 +1,69 @@
+"""Energy scheduling: deterministic weighted seed selection.
+
+AFL-style semantics: each seed carries an energy raised by feedback
+events (new output hash, monitor-reported crash, proxy desync — see
+feedback.EVENT_GAIN) and its effective weight decays with the number of
+times it has already been scheduled, so fresh high-signal seeds get
+fuzzed hard and exhausted ones fade without ever reaching zero.
+
+Selection is counter-keyed like the device PRNG (ops/prng.py): the draw
+for case c seeds a fresh generator from (run seed, c, TAG_SCHED), never
+an evolving stream — so schedules replay bit-identically at a fixed -s
+seed, resume at any case without replaying earlier draws, and shard
+cleanly across workers. TAG_SCHED lives in the ops/prng.py tag registry;
+the copy here keeps this module jax-free (tests pin the two equal).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .store import CorpusStore
+
+#: mirrors ops.prng.TAG_SCHED — jax-free copy, equality test-pinned
+#: (tests/test_corpus.py::test_sched_tag_matches_prng_registry)
+TAG_SCHED = 0x0D
+
+
+def seed_weights(energies: list[float], hits: list[int]) -> np.ndarray:
+    """float64[N] sampling weights: energy decayed by sqrt of prior
+    schedule count. Strictly positive — every seed stays reachable."""
+    e = np.asarray(energies, np.float64)
+    h = np.asarray(hits, np.float64)
+    return np.maximum(e, 1e-9) / np.sqrt(1.0 + h)
+
+
+class EnergyScheduler:
+    """Per-case weighted seed selection over a CorpusStore."""
+
+    def __init__(self, store: CorpusStore, seed):
+        self.store = store
+        self.seed_ints = (
+            [int(x) for x in seed] if isinstance(seed, (tuple, list))
+            else [int(seed)]
+        )
+
+    def _rng(self, case_idx: int) -> np.random.Generator:
+        # counter-keyed, same construction as HybridDispatcher.split: the
+        # integer seed values, NOT Python's salted hash, so the schedule
+        # reproduces across processes and after resume
+        return np.random.default_rng([*self.seed_ints, case_idx, TAG_SCHED])
+
+    def schedule(self, case_idx: int, batch: int,
+                 record: bool = True) -> list[str]:
+        """Draw `batch` seed ids (with replacement) for one case,
+        weighted by current energy state. Deterministic in
+        (run seed, case_idx, energy state at call time)."""
+        ids = self.store.ids()
+        if not ids:
+            raise ValueError("empty corpus store")
+        en = self.store.energies()
+        w = seed_weights(*zip(*[en[s] for s in ids]))
+        picks = self._rng(case_idx).choice(len(ids), size=batch, p=w / w.sum())
+        chosen = [ids[i] for i in picks]
+        if record:
+            counts: dict[str, int] = {}
+            for sid in chosen:
+                counts[sid] = counts.get(sid, 0) + 1
+            self.store.record_scheduled(counts)
+        return chosen

@@ -1,0 +1,89 @@
+"""Feedback bus: the channel from detectors to the seed store.
+
+Monitors (services/monitors.py), the fuzzing proxy (services/proxy.py)
+and the FaaS /manage endpoint (services/faas.py) publish events here;
+the corpus runner drains the bus at case boundaries and folds the events
+into seed energies (store.apply_event). Publishing is always cheap and
+never blocks — when nothing consumes the bus (stateless runs, the
+default) events age out of a bounded deque.
+
+Deliberately jax-free: publishers include spawned host-pool workers and
+monitor threads that must never trigger an accelerator backend import
+(see services/hostpool.py).
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import deque
+from typing import NamedTuple
+
+
+class Event(NamedTuple):
+    """One observed outcome.
+
+    kind: what happened (see EVENT_GAIN for the known kinds).
+    seed_id: the store id of the seed that provoked it, when the
+        publisher knows it; None means "whatever was in flight" and the
+        consumer credits the seeds scheduled in the current case.
+    source: publisher tag, e.g. "monitor:exec" or "proxy:c->s".
+    detail: free-form context for logs/stats.
+    """
+
+    kind: str
+    seed_id: str | None = None
+    source: str = ""
+    detail: str = ""
+
+
+#: energy delta per event kind (store.apply_event). Crashes dominate,
+#: protocol desyncs and connect-backs rank above plain liveness drops,
+#: and novel output hashes give the small per-case exploration signal.
+EVENT_GAIN = {
+    "crash": 8.0,
+    "connback": 4.0,
+    "desync": 4.0,
+    "drop": 2.0,
+    "finding": 2.0,
+    "new_hash": 0.5,
+}
+
+
+class FeedbackBus:
+    """Bounded thread-safe publish/drain queue."""
+
+    def __init__(self, maxlen: int = 4096):
+        self._events: deque[Event] = deque(maxlen=maxlen)
+        self._lock = threading.Lock()
+        self.published = 0
+        self.dropped = 0  # aged out of the bounded deque before a drain
+
+    def publish(self, kind: str, seed_id: str | None = None,
+                source: str = "", detail: str = "") -> None:
+        ev = Event(kind, seed_id, source, detail)
+        with self._lock:
+            if len(self._events) == self._events.maxlen:
+                self.dropped += 1
+            self._events.append(ev)
+            self.published += 1
+
+    def drain(self) -> list[Event]:
+        """All pending events, oldest first; the bus is left empty."""
+        with self._lock:
+            evs = list(self._events)
+            self._events.clear()
+        return evs
+
+    def pending(self) -> int:
+        with self._lock:
+            return len(self._events)
+
+
+#: process-global bus: detectors publish here without any wiring; only a
+#: feedback-mode run ever drains it
+GLOBAL = FeedbackBus()
+
+
+def publish(kind: str, seed_id: str | None = None,
+            source: str = "", detail: str = "") -> None:
+    GLOBAL.publish(kind, seed_id, source, detail)

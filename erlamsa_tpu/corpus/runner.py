@@ -1,0 +1,252 @@
+"""Feedback-driven batch loop: store -> energy schedule -> buckets ->
+device mutation -> feedback, the closed-loop counterpart of
+services/batchrunner.py's open-loop path.
+
+Per case:
+  1. the energy scheduler draws `batch` seeds (weighted, counter-keyed)
+  2. the assembler groups them into power-of-two length buckets
+  3. each bucket rides one jitted fuzz_batch call (device mutator set)
+  4. outputs are hashed: a never-seen output hash bumps the source
+     seed's energy (the cheap novelty signal standing in for coverage)
+  5. the feedback bus is drained; monitor/proxy events promote the
+     seeds that were in flight
+  6. energies are checkpointed alongside the scheduler scores so a
+     resumed run schedules identically
+
+Determinism contract (the -s replay guarantee): every schedule draw is
+keyed on (seed, case, TAG_SCHED), device keys on (seed, case, slot), and
+energies evolve only from deterministic inputs applied at case
+boundaries — so at a fixed seed, two runs produce byte-identical
+schedules and outputs. External bus events are inherently timing-
+dependent; they are folded in at the same case boundary, so replay
+holds whenever the event stream is (e.g. absent, or injected at fixed
+cases as the tests do).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import sys
+import time
+
+import numpy as np
+
+from ..services import logger, metrics, out
+from . import feedback as fb
+from .assembler import assemble
+from .energy import EnergyScheduler
+from .store import CorpusStore
+
+
+def _out_hash(data: bytes) -> bytes:
+    return hashlib.sha1(data).digest()[:12]
+
+
+def run_corpus_batch(opts: dict, batch: int = 1024) -> int:
+    """The --corpus DIR --feedback entry point."""
+    import jax
+
+    from ..constants import CAPACITY_CLASSES
+    from ..oracle.mutations import default_mutations
+    from ..ops import prng
+    from ..ops.buffers import Batch, scan_bound, unpack
+    from ..ops.pipeline import make_class_fuzzer
+    from ..ops.registry import DEVICE_CODES
+    from ..ops.scheduler import init_scores
+    from ..services.checkpoint import (load_corpus_energies, load_state,
+                                       save_state)
+
+    store = CorpusStore(opts["corpus_dir"])
+    direct = opts.get("corpus")
+    if direct is not None:
+        # in-process callers (bench corpus stage, tests) hand seeds over
+        # directly instead of staging files
+        for s in direct:
+            store.add(s, origin="direct")
+    else:
+        paths = opts.get("paths") or []
+        paths = [p for p in paths if p != "-"]
+        if paths:
+            from ..oracle.gen import _expand_paths
+
+            expanded = (_expand_paths(paths) if opts.get("recursive")
+                        else paths)
+            new, dup, skipped = store.add_paths(expanded)
+            print(f"# corpus: {new} new, {dup} duplicate, "
+                  f"{skipped} skipped -> {len(store)} seeds in store",
+                  file=sys.stderr)
+    if len(store) == 0:
+        print("no corpus (store empty and no readable seeds)",
+              file=sys.stderr)
+        return 1
+
+    selected = dict(opts.get("mutations") or default_mutations())
+    pri = [max(selected.get(code, 0), 0) for code in DEVICE_CODES]
+    if not any(pri):
+        print("none of the selected mutations runs on the TPU backend; "
+              f"device set: {','.join(DEVICE_CODES)}", file=sys.stderr)
+        return 1
+
+    device_max = int(opts.get("device_capacity_max", CAPACITY_CLASSES[-1]))
+    sched = EnergyScheduler(store, opts["seed"])
+    step = make_class_fuzzer(mutator_pri=pri)
+    base = prng.base_key(opts["seed"])
+    scores = init_scores(jax.random.fold_in(base, 999), batch)
+    bus = opts.get("feedback_bus", fb.GLOBAL)
+    consume_feedback = bool(opts.get("feedback"))
+
+    n_cases = opts.get("n", 1)
+    start_case = 0
+    ckpt_every = max(1, int(opts.get("checkpoint_every", 1)))
+    state_path = opts.get("state_path")
+    if state_path:
+        import os as _os
+
+        from ..ops.registry import NUM_DEVICE_MUTATORS
+
+        if _os.path.exists(state_path):
+            st = load_state(state_path)
+            if st is None:
+                print("# checkpoint unreadable, starting fresh",
+                      file=sys.stderr)
+            else:
+                ck_seed, ck_case, ck_scores, _hs, _hsp = st
+                if (ck_seed != tuple(opts["seed"])
+                        or ck_scores.shape != (batch, NUM_DEVICE_MUTATORS)):
+                    print("# checkpoint mismatch (seed/shape), starting "
+                          "fresh", file=sys.stderr)
+                else:
+                    import jax.numpy as jnp
+
+                    start_case = ck_case
+                    scores = jnp.asarray(ck_scores)
+                    energies = load_corpus_energies(state_path)
+                    if energies:
+                        store.restore_energies(energies)
+                    print(f"# resumed at case {start_case} "
+                          f"({len(energies or {})} seed energies restored)",
+                          file=sys.stderr)
+        if start_case >= n_cases:
+            print(f"# run already complete ({start_case}/{n_cases} cases)",
+                  file=sys.stderr)
+            return 0
+
+    writer, _mt = out.string_outputs(opts.get("output", "-"))
+    stats = opts.get("_stats")  # caller-owned dict for measured numbers
+    seen_hashes: set[bytes] = set()
+    bucket_stats: dict[int, dict] = {}
+    truncated = 0
+    total = 0
+    new_hashes = 0
+    t0 = time.perf_counter()
+
+    for case in range(start_case, n_cases):
+        ids = sched.schedule(case, batch)
+        samples = [store.get(sid) for sid in ids]
+        truncated += sum(len(s) > device_max for s in samples)
+        buckets = assemble(samples, device_max=device_max)
+
+        results: dict[int, bytes] = {}
+        # np.array (copy): jax gives back read-only views, and the
+        # per-bucket scatter below writes in place
+        scores_np = np.array(scores)
+        case_bytes = 0
+        t_dev = time.perf_counter()
+        for b in buckets:
+            # keys derive from the SLOT position (0..batch-1) so a
+            # sample's stream is a pure function of (seed, case, slot)
+            # no matter how the buckets partition the batch; pad rows get
+            # out-of-range indices — their outputs are discarded
+            idx = np.concatenate([
+                b.slots, batch + np.arange(b.pad_rows, dtype=np.int32)
+            ]).astype(np.int32)
+            sc_in = scores_np[b.slots[np.arange(b.rows_padded) % b.rows]]
+            new_data, new_lens, new_sc, meta = step(
+                base, case, idx, b.data, b.lens, sc_in,
+                scan_len=scan_bound(int(b.lens[:b.rows].max()), b.capacity),
+            )
+            outs = unpack(Batch(new_data[:b.rows], new_lens[:b.rows]))
+            scores_np[b.slots] = np.asarray(new_sc)[:b.rows]
+            for j, slot in enumerate(b.slots):
+                results[int(slot)] = outs[j]
+            # per-mutator applied counters (registry rows, device side)
+            applied = np.asarray(meta.applied)[:b.rows].ravel()
+            applied = applied[applied >= 0]
+            if applied.size:
+                counts = np.bincount(applied, minlength=len(DEVICE_CODES))
+                for mi in np.nonzero(counts)[0]:
+                    metrics.GLOBAL.record_mutator(
+                        DEVICE_CODES[mi], applied=True, n=int(counts[mi])
+                    )
+            bs = bucket_stats.setdefault(
+                b.capacity,
+                {"batches": 0, "rows": 0, "pad_rows": 0,
+                 "padded_bytes_wasted": 0},
+            )
+            bs["batches"] += 1
+            bs["rows"] += b.rows
+            bs["pad_rows"] += b.pad_rows
+            bs["padded_bytes_wasted"] += b.padded_bytes_wasted
+            metrics.GLOBAL.record_bucket(
+                b.capacity, b.rows, b.pad_rows, b.padded_bytes_wasted
+            )
+        dev_s = time.perf_counter() - t_dev
+        scores = scores_np
+
+        # novelty feedback: a never-seen output hash is the cheap
+        # stand-in for new coverage — the source seed earns energy
+        for slot in range(batch):
+            payload = results.get(slot, b"")
+            case_bytes += len(payload)
+            h = _out_hash(payload)
+            if h not in seen_hashes:
+                seen_hashes.add(h)
+                new_hashes += 1
+                store.apply_event(fb.Event("new_hash", ids[slot]))
+            if writer is not None:
+                writer(case * batch + slot, payload, [])
+            else:
+                sys.stdout.buffer.write(payload)
+        total += len(results)
+        metrics.GLOBAL.record_batch(len(results), case_bytes, dev_s)
+
+        # external feedback (monitors/proxy/faas) folds in at the case
+        # boundary; anonymous events credit this case's seeds
+        if consume_feedback:
+            credit = sorted(set(ids))
+            for ev in bus.drain():
+                store.apply_event(ev, credit=credit)
+                logger.log("decision", "corpus: %s event from %s -> "
+                           "energy feedback", ev.kind, ev.source or "?")
+
+        if stats is not None:
+            stats.setdefault("finish_times", []).append(time.perf_counter())
+            stats.setdefault("schedules", []).append(list(ids))
+        if state_path and ((case + 1 - start_case) % ckpt_every == 0
+                           or case + 1 == n_cases):
+            save_state(state_path, opts["seed"], case + 1, scores,
+                       corpus_energies=store.energies())
+            store.save()
+
+    store.save()
+    dt = time.perf_counter() - t0
+    if truncated:
+        print(f"# {truncated} scheduled samples exceeded the device "
+              f"budget ({device_max}B) and were truncated", file=sys.stderr)
+    if stats is not None:
+        stats.update(total=total, dt=dt, batch=batch,
+                     buckets=bucket_stats, new_hashes=new_hashes,
+                     store_stats=store.stats())
+    logger.log("info", "corpus backend: %d samples in %.2fs "
+               "(%.0f samples/s), %d novel output hashes",
+               total, dt, total / max(dt, 1e-9), new_hashes)
+    waste = sum(b["padded_bytes_wasted"] for b in bucket_stats.values())
+    rows = sum(b["rows"] for b in bucket_stats.values())
+    print(
+        f"# {total} samples, {dt:.2f}s, {total / max(dt, 1e-9):.0f} "
+        f"samples/s, {new_hashes} novel hashes, "
+        f"{len(bucket_stats)} buckets, "
+        f"{waste / max(rows, 1):.0f} padded bytes wasted/sample",
+        file=sys.stderr,
+    )
+    return 0

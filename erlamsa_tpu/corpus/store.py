@@ -1,0 +1,237 @@
+"""Persistent seed store: content-hash-deduped corpus with metadata.
+
+Layout under the --corpus directory:
+
+    <root>/corpus.json      metadata: per-seed origin, energy, hit
+                            counts, discovered-by, event tallies
+    <root>/seeds/<sha256>   one file per unique seed, named by its
+                            content hash — dedup is the filename
+
+JSON-backed like services/cmanager.py's mnesia stand-in: a thread lock
+guards the in-memory state and every save is an atomic tmp+rename, so
+concurrent writers (monitor threads publishing through apply_event,
+the runner's case loop) never corrupt the store and a crash mid-save
+leaves the previous snapshot intact. Seed files are immutable once
+written (content-addressed), so cross-process sharing of a corpus
+directory is safe too: the worst race is two writers creating the same
+file with identical bytes.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import threading
+
+from ..services import logger
+from .feedback import EVENT_GAIN, Event
+
+STORE_VERSION = 1
+
+INIT_ENERGY = 1.0
+MIN_ENERGY = 0.25
+MAX_ENERGY = 64.0
+
+
+def seed_id_for(data: bytes) -> str:
+    """Content hash = identity; the store's dedup key and filename."""
+    return hashlib.sha256(data).hexdigest()
+
+
+class CorpusStore:
+    """Deduped seed corpus with per-seed scheduling metadata."""
+
+    def __init__(self, root: str, create: bool = True):
+        self.root = root
+        self.seeds_dir = os.path.join(root, "seeds")
+        self.meta_path = os.path.join(root, "corpus.json")
+        if create:
+            os.makedirs(self.seeds_dir, exist_ok=True)
+        self._lock = threading.Lock()
+        self._meta: dict[str, dict] = {}
+        self._next_idx = 0
+        self._cache: dict[str, bytes] = {}
+        self._load()
+
+    # --- persistence (cmanager.py idiom: atomic, best-effort) ------------
+
+    def _load(self):
+        if not os.path.exists(self.meta_path):
+            return
+        try:
+            with open(self.meta_path) as f:
+                st = json.load(f)
+            self._meta = dict(st.get("seeds", {}))
+            self._next_idx = max(
+                (m.get("idx", 0) + 1 for m in self._meta.values()), default=0
+            )
+        except (OSError, ValueError) as e:
+            logger.log("warning", "corpus store %s unreadable (%s); "
+                       "starting empty", self.meta_path, e)
+
+    def _save_locked(self):
+        """Caller holds self._lock. Atomic: a kill mid-save must never
+        corrupt the previous snapshot (checkpoint.py contract)."""
+        tmp = self.meta_path + ".tmp"
+        try:
+            with open(tmp, "w") as f:
+                json.dump({"version": STORE_VERSION, "seeds": self._meta}, f)
+            os.replace(tmp, self.meta_path)
+        except OSError:
+            pass  # persistence is best-effort; the live store stays valid
+
+    def save(self):
+        with self._lock:
+            self._save_locked()
+
+    # --- seed CRUD -------------------------------------------------------
+
+    def add(self, data: bytes, origin: str = "import",
+            discovered_by: str | None = None) -> tuple[str | None, bool]:
+        """Dedup-add one seed. Returns (seed_id, newly_added); empty data
+        is rejected with (None, False) — a zero-byte seed can never be
+        mutated into anything and would poison batch assembly."""
+        if not data:
+            return None, False
+        sid = seed_id_for(data)
+        with self._lock:
+            if sid in self._meta:
+                return sid, False
+            path = os.path.join(self.seeds_dir, sid)
+            if not os.path.exists(path):
+                tmp = path + ".tmp"
+                with open(tmp, "wb") as f:
+                    f.write(data)
+                os.replace(tmp, path)
+            self._meta[sid] = {
+                "idx": self._next_idx,
+                "len": len(data),
+                "origin": origin,
+                "discovered_by": discovered_by,
+                "energy": INIT_ENERGY,
+                "hits": 0,
+                "events": {},
+            }
+            self._next_idx += 1
+            self._cache[sid] = data
+            self._save_locked()
+        return sid, True
+
+    def add_paths(self, paths: list[str]) -> tuple[int, int, int]:
+        """Import seed files; unreadable/empty files are skipped with a
+        logged warning instead of aborting the run (the _load_corpus
+        contract). Returns (new, dup, skipped)."""
+        new = dup = skipped = 0
+        for p in paths:
+            try:
+                with open(p, "rb") as f:
+                    data = f.read()
+            except OSError as e:
+                logger.log("warning", "corpus: skipping unreadable seed "
+                           "%s: %s", p, e)
+                skipped += 1
+                continue
+            if not data:
+                logger.log("warning", "corpus: skipping empty seed %s", p)
+                skipped += 1
+                continue
+            _sid, added = self.add(data, origin=os.path.basename(p))
+            if added:
+                new += 1
+            else:
+                dup += 1
+        return new, dup, skipped
+
+    def get(self, seed_id: str) -> bytes:
+        data = self._cache.get(seed_id)
+        if data is None:
+            with open(os.path.join(self.seeds_dir, seed_id), "rb") as f:
+                data = f.read()
+            self._cache[seed_id] = data
+        return data
+
+    def ids(self) -> list[str]:
+        """Seed ids in insertion order — THE deterministic ordering every
+        scheduler draw indexes into (energy.EnergyScheduler)."""
+        with self._lock:
+            return sorted(self._meta, key=lambda s: self._meta[s]["idx"])
+
+    def __len__(self) -> int:
+        return len(self._meta)
+
+    def __contains__(self, seed_id: str) -> bool:
+        return seed_id in self._meta
+
+    def meta(self, seed_id: str) -> dict:
+        with self._lock:
+            return dict(self._meta[seed_id])
+
+    def seed_paths(self) -> list[str]:
+        """Seed file paths in insertion order (the oracle engine path
+        reads files; the store IS files)."""
+        return [os.path.join(self.seeds_dir, s) for s in self.ids()]
+
+    # --- energy bookkeeping ---------------------------------------------
+
+    def bump(self, seed_id: str, delta: float, kind: str | None = None):
+        with self._lock:
+            m = self._meta.get(seed_id)
+            if m is None:
+                return
+            m["energy"] = min(MAX_ENERGY,
+                              max(MIN_ENERGY, m["energy"] + delta))
+            if kind:
+                m["events"][kind] = m["events"].get(kind, 0) + 1
+
+    def apply_event(self, ev: Event, credit: list[str] | None = None):
+        """Fold one feedback event into seed energies. Events naming a
+        seed bump it directly; anonymous events (a monitor can rarely say
+        WHICH input crashed the target) split the gain evenly over the
+        `credit` set — the seeds scheduled in the case that was in flight,
+        the same attribution AFL makes."""
+        gain = EVENT_GAIN.get(ev.kind, 1.0)
+        if ev.seed_id is not None and ev.seed_id in self._meta:
+            self.bump(ev.seed_id, gain, ev.kind)
+        elif credit:
+            share = gain / len(credit)
+            for sid in credit:
+                self.bump(sid, share, ev.kind)
+
+    def record_scheduled(self, counts: dict[str, int]):
+        """hits += n per seed: the scheduler's energy-spend record that
+        decays a seed's effective weight over time (energy.seed_weights)."""
+        with self._lock:
+            for sid, n in counts.items():
+                m = self._meta.get(sid)
+                if m is not None:
+                    m["hits"] += n
+
+    def energies(self) -> dict[str, tuple[float, int]]:
+        """{seed_id: (energy, hits)} — the checkpointable schedule state."""
+        with self._lock:
+            return {s: (m["energy"], m["hits"])
+                    for s, m in self._meta.items()}
+
+    def restore_energies(self, mapping: dict[str, tuple[float, int]]):
+        """Resume path (services/checkpoint.py): restored energies make a
+        resumed run schedule exactly like the uninterrupted one."""
+        with self._lock:
+            for sid, (energy, hits) in mapping.items():
+                m = self._meta.get(sid)
+                if m is not None:
+                    m["energy"] = float(energy)
+                    m["hits"] = int(hits)
+
+    def stats(self) -> dict:
+        with self._lock:
+            events: dict[str, int] = {}
+            for m in self._meta.values():
+                for k, n in m["events"].items():
+                    events[k] = events.get(k, 0) + n
+            return {
+                "seeds": len(self._meta),
+                "bytes": sum(m["len"] for m in self._meta.values()),
+                "total_hits": sum(m["hits"] for m in self._meta.values()),
+                "events": events,
+            }

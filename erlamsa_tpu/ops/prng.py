@@ -32,6 +32,8 @@ TAG_SITE = 0x09
 TAG_ROUNDS = 0x0A
 TAG_FUSE = 0x0B  # device fuse jump-pair scan (ops/fuse_mutators.py)
 TAG_TABLE = 0x0C  # payload-table row draws (ops/payload_mutators.py)
+TAG_SCHED = 0x0D  # corpus energy-schedule draws (corpus/energy.py keeps a
+#                   jax-free copy; tests pin the two equal)
 
 
 def base_key(seed: tuple[int, int, int] | int) -> jax.Array:

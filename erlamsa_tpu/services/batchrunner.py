@@ -23,20 +23,48 @@ from . import logger, out
 
 
 def _load_corpus(paths: list[str], recursive: bool,
-                 direct: list[bytes] | None = None) -> list[bytes]:
+                 direct: list[bytes] | None = None,
+                 store_dir: str | None = None) -> list[bytes]:
     from ..oracle.gen import _expand_paths
 
-    if direct is not None:
+    if direct is not None and store_dir is None:
         # in-process callers (bench full-set stage, tests) hand the corpus
         # over directly instead of staging files
         return list(direct)
+    if store_dir is not None:
+        # --corpus: dedup everything through the persistent store and run
+        # over the deduped seed set, in store insertion order
+        from ..corpus.store import CorpusStore
+
+        store = CorpusStore(store_dir)
+        for s in direct or []:
+            store.add(s, origin="direct")
+        in_paths = [p for p in paths if p != "-"]
+        if in_paths:
+            new, dup, skipped = store.add_paths(
+                _expand_paths(in_paths) if recursive else in_paths
+            )
+            print(f"# corpus: {new} new, {dup} duplicate, {skipped} "
+                  f"skipped -> {len(store)} seeds in store", file=sys.stderr)
+        return [store.get(sid) for sid in store.ids()]
     if paths in ([], ["-"]):
         data = sys.stdin.buffer.read()
         return [data]
     seeds = []
     for p in _expand_paths(paths) if recursive else paths:
-        with open(p, "rb") as f:
-            seeds.append(f.read())
+        # a mid-run raise on one bad file would abandon the whole batch:
+        # skip unreadable/empty seeds with a logged warning instead
+        try:
+            with open(p, "rb") as f:
+                data = f.read()
+        except OSError as e:
+            logger.log("warning", "corpus: skipping unreadable seed %s: %s",
+                       p, e)
+            continue
+        if not data:
+            logger.log("warning", "corpus: skipping empty seed %s", p)
+            continue
+        seeds.append(data)
     return seeds
 
 
@@ -58,7 +86,8 @@ def run_tpu_batch(opts: dict, batch: int = 1024) -> int:
     )
 
     seeds = _load_corpus(opts.get("paths", ["-"]), opts.get("recursive", False),
-                         direct=opts.get("corpus"))
+                         direct=opts.get("corpus"),
+                         store_dir=opts.get("corpus_dir"))
     if not seeds:
         print("no corpus", file=sys.stderr)
         return 1

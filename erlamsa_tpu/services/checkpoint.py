@@ -32,7 +32,8 @@ def _engine_stamp(engine: str = "fused") -> np.ndarray:
 def save_state(path: str, seed, case_idx: int, scores,
                host_scores: dict | None = None,
                host_scores_post: dict | None = None,
-               engine: str = "fused") -> None:
+               engine: str = "fused",
+               corpus_energies: dict | None = None) -> None:
     """Atomic write (tmp + rename): a kill mid-save — the very interruption
     checkpoints exist for — must never corrupt the previous checkpoint.
     host_scores: the hybrid routing scores the resumed case's split must
@@ -40,24 +41,41 @@ def save_state(path: str, seed, case_idx: int, scores,
     host_scores_post: the same scores WITH the just-finished case's
     outcomes folded in — the state every later split builds on. Saving
     both is what makes an interrupted run route identically to an
-    uninterrupted one."""
+    uninterrupted one.
+    corpus_energies: {seed_id: (energy, hits)} from the corpus store
+    (corpus/store.py) — the feedback-mode schedule state; restoring it
+    makes a resumed run draw identical schedules."""
     tmp = path + ".tmp"
     hs = host_scores or {}
     hsp = host_scores_post if host_scores_post is not None else hs
-    with open(tmp, "wb") as f:
-        np.savez(
-            f,
-            seed=np.asarray(seed, np.int64),
-            case_idx=np.asarray(case_idx, np.int64),
-            engine=_engine_stamp(engine),
-            scores=np.asarray(scores, np.int32),
-            host_codes=np.asarray(sorted(hs), "U8"),
-            host_values=np.asarray([hs[k] for k in sorted(hs)], np.float64),
-            host_codes_post=np.asarray(sorted(hsp), "U8"),
-            host_values_post=np.asarray(
-                [hsp[k] for k in sorted(hsp)], np.float64
+    fields = dict(
+        seed=np.asarray(seed, np.int64),
+        case_idx=np.asarray(case_idx, np.int64),
+        engine=_engine_stamp(engine),
+        scores=np.asarray(scores, np.int32),
+        host_codes=np.asarray(sorted(hs), "U8"),
+        host_values=np.asarray([hs[k] for k in sorted(hs)], np.float64),
+        host_codes_post=np.asarray(sorted(hsp), "U8"),
+        host_values_post=np.asarray(
+            [hsp[k] for k in sorted(hsp)], np.float64
+        ),
+    )
+    if corpus_energies is not None:
+        # only feedback-mode runs carry corpus state; stateless
+        # checkpoints stay field-free so load_corpus_energies can tell
+        # "no corpus" (None) from "corpus with zero seeds" ({})
+        ce_ids = sorted(corpus_energies)
+        fields.update(
+            corpus_ids=np.asarray(ce_ids, "U64"),
+            corpus_energy=np.asarray(
+                [float(corpus_energies[s][0]) for s in ce_ids], np.float64
+            ),
+            corpus_hits=np.asarray(
+                [int(corpus_energies[s][1]) for s in ce_ids], np.int64
             ),
         )
+    with open(tmp, "wb") as f:
+        np.savez(f, **fields)
         # data must be durable BEFORE the rename publishes it, or a crash
         # right after os.replace leaves a truncated checkpoint and the run
         # silently restarts from case 0
@@ -105,5 +123,27 @@ def load_state(path: str, engine: str = "fused"):
                                     z["host_values_post"])
                 }
         return seed, case_idx, scores, host_scores, host_post
+    except Exception:
+        return None
+
+
+def load_corpus_energies(path: str, engine: str = "fused") -> dict | None:
+    """-> {seed_id: (energy, hits)} from a feedback-mode checkpoint, or
+    None when the file is unreadable, stamped for a different engine, or
+    predates the corpus fields. Kept separate from load_state so its
+    5-tuple contract (and every existing caller) stays untouched."""
+    try:
+        with np.load(path) as z:
+            if "engine" not in z or str(z["engine"]) != str(
+                _engine_stamp(engine)
+            ):
+                return None
+            if "corpus_ids" not in z:
+                return None
+            return {
+                str(s): (float(e), int(h))
+                for s, e, h in zip(z["corpus_ids"], z["corpus_energy"],
+                                   z["corpus_hits"])
+            }
     except Exception:
         return None

@@ -152,6 +152,15 @@ def build_parser() -> argparse.ArgumentParser:
                    help="python module with capabilities()")
     p.add_argument("-d", "--debug", action="store_true",
                    help="start the periodic profiler")
+    p.add_argument("--corpus", default=None, metavar="DIR",
+                   help="persistent seed store directory: input seeds are "
+                        "content-hash-deduped into DIR and runs draw from "
+                        "the store (corpus/store.py)")
+    p.add_argument("--feedback", action="store_true",
+                   help="feedback-driven corpus engine (requires --corpus): "
+                        "AFL-style energy scheduling over the store, "
+                        "length-bucketed device batches, monitor/proxy "
+                        "events promote seeds")
     p.add_argument("--backend", choices=["oracle", "tpu"], default="oracle",
                    help="oracle = sequential parity engine; tpu = batched device engine")
     p.add_argument("--batch", type=int, default=1024, help="TPU batch size")
@@ -232,6 +241,8 @@ def main(argv=None) -> int:
            if args.device_capacity_max is not None else {}),
         "workers": args.workers,
         "workers_same_seed": args.workers_same_seed,
+        "corpus_dir": args.corpus,
+        "feedback": args.feedback,
         "output": args.output,
         "verbose": args.verbose,
         "meta_path": args.meta,
@@ -296,6 +307,18 @@ def main(argv=None) -> int:
 
         start_monitors(args.monitor)
 
+    if args.feedback:
+        # the feedback loop IS the batched device engine: energy-scheduled
+        # store draws, bucketed batches, bus events promoting seeds
+        if not args.corpus:
+            raise SystemExit("erlamsa-tpu: --feedback requires --corpus DIR")
+        from ..corpus.runner import run_corpus_batch
+
+        try:
+            return run_corpus_batch(opts, batch=args.batch)
+        finally:
+            logger.GLOBAL.flush()
+
     if args.backend == "tpu":
         from .batchrunner import run_tpu_batch
 
@@ -303,6 +326,27 @@ def main(argv=None) -> int:
             return run_tpu_batch(opts, batch=args.batch)
         finally:
             logger.GLOBAL.flush()
+
+    if args.corpus:
+        # stateless oracle path with a store: dedup the inputs into DIR
+        # and run over the store's seed files (the store IS files)
+        from ..corpus.store import CorpusStore
+
+        store = CorpusStore(args.corpus)
+        in_paths = [p for p in opts["paths"] if p != "-"]
+        if in_paths:
+            from ..oracle.gen import _expand_paths
+
+            expanded = (_expand_paths(in_paths) if args.recursive
+                        else in_paths)
+            new, dup, skipped = store.add_paths(expanded)
+            print(f"# corpus: {new} new, {dup} duplicate, {skipped} "
+                  f"skipped -> {len(store)} seeds", file=sys.stderr)
+        if len(store) == 0:
+            raise SystemExit("erlamsa-tpu: --corpus store is empty and no "
+                             "readable seeds were given")
+        opts["paths"] = store.seed_paths()
+        opts["recursive"] = False
 
     try:
         return _run_oracle(opts)

@@ -180,6 +180,30 @@ class _Handler(BaseHTTPRequestHandler):
                     ts = cm.list_tokens(admin)
                     resp = {"status": "ok" if ts is not None else "denied",
                             "tokens": ts or []}
+                elif op == "stats":
+                    # throughput + per-mutator applied/failed + bucket
+                    # stats; counters aren't secrets, so no admin gate
+                    from . import metrics
+
+                    resp = {"status": "ok", "stats": metrics.GLOBAL.snapshot()}
+                elif op == "event":
+                    # external harnesses report outcomes (crash observed,
+                    # target hung) back through the HTTP API; a feedback-
+                    # mode run folds them into seed energies
+                    from ..corpus import feedback
+
+                    kind = req.get("kind")
+                    sid = req.get("seed_id")
+                    if isinstance(kind, str) and kind:
+                        feedback.publish(
+                            kind,
+                            seed_id=sid if isinstance(sid, str) else None,
+                            source="faas",
+                            detail=str(req.get("detail", ""))[:200],
+                        )
+                        resp = {"status": "ok"}
+                    else:
+                        resp = {"status": "badop"}
                 else:
                     resp = {"status": "badop"}
                 self._reply(200, json.dumps(resp).encode(), session,

@@ -303,7 +303,10 @@ class HybridDispatcher:
         return results
 
     def apply_outcomes(self, metas) -> None:
-        """Fold observed used/failed outcomes into the host scores."""
+        """Fold observed used/failed outcomes into the host scores (and
+        the global per-mutator applied/failed counters)."""
+        from . import metrics
+
         for meta in metas:
             for entry in meta:
                 if not (isinstance(entry, tuple) and len(entry) == 2):
@@ -311,8 +314,10 @@ class HybridDispatcher:
                 tag, val = entry
                 if tag == "used":
                     self._bump(val, +1.0)
+                    metrics.GLOBAL.record_mutator(val, applied=True)
                 elif tag == "failed":
                     self._bump(val, -1.0)
+                    metrics.GLOBAL.record_mutator(val, applied=False)
 
     def close(self):
         self._pool.shutdown(wait=False)

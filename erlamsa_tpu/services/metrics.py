@@ -23,6 +23,13 @@ class Counters:
         self.bytes_out = 0
         self.batches = 0
         self.device_time = 0.0
+        # per-mutator applied/failed tallies, keyed by registry code:
+        # device counts come from FuzzMeta.applied (corpus/runner.py),
+        # host counts from the oracle's used/failed metas
+        # (hybrid.apply_outcomes)
+        self.mutators: dict[str, list[int]] = {}
+        # per-capacity-bucket assembly stats (corpus/assembler.py)
+        self.buckets: dict[int, dict[str, int]] = {}
         self.t0 = time.perf_counter()
 
     def record_batch(self, n_samples: int, n_bytes: int, device_seconds: float):
@@ -31,6 +38,24 @@ class Counters:
             self.bytes_out += n_bytes
             self.batches += 1
             self.device_time += device_seconds
+
+    def record_mutator(self, code: str, applied: bool = True, n: int = 1):
+        with self._lock:
+            entry = self.mutators.setdefault(code, [0, 0])
+            entry[0 if applied else 1] += n
+
+    def record_bucket(self, capacity: int, rows: int, pad_rows: int,
+                      padded_bytes_wasted: int):
+        with self._lock:
+            b = self.buckets.setdefault(
+                capacity,
+                {"batches": 0, "rows": 0, "pad_rows": 0,
+                 "padded_bytes_wasted": 0},
+            )
+            b["batches"] += 1
+            b["rows"] += rows
+            b["pad_rows"] += pad_rows
+            b["padded_bytes_wasted"] += padded_bytes_wasted
 
     def snapshot(self) -> dict:
         with self._lock:
@@ -45,6 +70,12 @@ class Counters:
                 "device_samples_per_sec": round(
                     self.samples / self.device_time, 1
                 ) if self.device_time else 0.0,
+                "mutators": {
+                    code: {"applied": a, "failed": f}
+                    for code, (a, f) in sorted(self.mutators.items())
+                },
+                "buckets": {cap: dict(b)
+                            for cap, b in sorted(self.buckets.items())},
             }
 
 

@@ -34,6 +34,7 @@ import threading
 import time
 
 from ..constants import DEFAULT_CM_PORT
+from ..corpus import feedback
 from . import logger
 
 # shared monitor config, the reference's global_config ets analogue
@@ -98,6 +99,8 @@ class ConnectMonitor(Monitor):
             else:
                 logger.log("finding", "connect-back from %s:%d (%d bytes)",
                            addr[0], addr[1], len(data))
+            feedback.publish("connback", source="monitor:cm",
+                             detail=f"from {addr[0]}")
             _run_after(self.params)
 
 
@@ -127,6 +130,8 @@ class NetworkProbeMonitor(Monitor):
                         ok = True
             except OSError as e:
                 logger.log("finding", "probe: %s:%d unreachable (%s)", host, port, e)
+                feedback.publish("drop", source="monitor:probe",
+                                 detail=f"{host}:{port}")
                 _run_after(self.params)
             if ok:
                 logger.log("debug", "probe: %s:%d alive", host, port)
@@ -155,6 +160,9 @@ class ExecMonitor(Monitor):
                 level = "finding" if rc < 0 else "warning"
                 logger.log(level, "exec target exited rc=%d; tail: %r",
                            rc, out[-500:] if out else b"")
+                # signal exits are crashes; plain nonzero rc a finding
+                feedback.publish("crash" if rc < 0 else "finding",
+                                 source="monitor:exec", detail=f"rc={rc}")
                 _run_after(self.params)
             time.sleep(float(self.params.get("delay", 5.0)))
 
@@ -184,6 +192,7 @@ class R2Monitor(Monitor):
                     proc.stdin.flush()
                     dump = proc.stdout.read()
                     logger.log("finding", "r2 crash dump: %r", dump[:1000])
+                    feedback.publish("crash", source="monitor:r2")
                     _run_after(self.params)
             except (OSError, ValueError):
                 pass
@@ -219,6 +228,7 @@ class LogcatMonitor(Monitor):
                 if len(crash_lines) > 20:
                     logger.log("finding", "logcat crash: %r",
                                b"".join(crash_lines)[:2000])
+                    feedback.publish("crash", source="monitor:lc")
                     _run_after(self.params)
                     crash_lines = []
         proc.kill()
@@ -244,6 +254,8 @@ class LxiMonitor(Monitor):
                     if not (lo <= v <= hi):
                         logger.log("finding",
                                    "lxi measurement %g outside [%g, %g]", v, lo, hi)
+                        feedback.publish("finding", source="monitor:lxi",
+                                         detail=f"{v}")
                         _run_after(self.params)
             except (OSError, ValueError) as e:
                 logger.log("warning", "lxi probe failed: %s", e)
@@ -358,6 +370,7 @@ class CdbMonitor(Monitor):
             attempts = self.ATTEMPTS
             logger.log("finding", "cdb monitor detected event (crash?): %r",
                        crash[:1000])
+            feedback.publish("crash", source="monitor:cdb")
             bt = self._call(b"k\r\n")
             logger.log("finding", "cdb monitor backtrace: %r",
                        (bt or b"")[:2000])

@@ -26,6 +26,7 @@ from __future__ import annotations
 import socket
 import threading
 
+from ..corpus import feedback
 from ..utils.erlrand import gen_urandom_seed
 from . import logger
 from .batcher import make_batcher
@@ -121,6 +122,10 @@ class FuzzProxy:
         must flow through the framer (its reassembly buffer owns partial
         frames), with the coin gating only whether DATA payloads mutate."""
         gate = npacket > self.bypass and self._coin.random() < prob
+        if gate:
+            # per-connection fuzz tally: an abnormal close AFTER a fuzzed
+            # packet reads as a desync, not a routine drop (_pump)
+            conn_state["fuzzed"] = conn_state.get("fuzzed", 0) + 1
         if self.proto == "http2":
             from ..models.http2 import Http2FuzzState, fuzz_http2
 
@@ -167,8 +172,14 @@ class FuzzProxy:
                 out = self._fuzz_maybe(data, pcs, n, direction, conn_state)
                 pcs = raise_prob(pcs, self.ascent)
                 dst.sendall(out)
-        except OSError:
-            pass
+        except OSError as e:
+            # abnormal close (reset/refused mid-stream): a desync when
+            # fuzzed traffic flowed on this connection, else a drop —
+            # feedback-mode runs promote whatever seeds were in flight
+            kind = "desync" if conn_state.get("fuzzed") else "drop"
+            feedback.publish(kind, source=f"proxy:{direction}",
+                             detail=str(e)[:100])
+            logger.log("finding", "proxy %s (%s): %s", kind, direction, e)
         finally:
             # propagate the half-close: stop writing to dst, but leave the
             # opposite pump (dst -> src) alive to deliver the response

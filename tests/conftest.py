@@ -24,3 +24,14 @@ if "xla_force_host_platform_device_count" not in _flags:
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+
+
+def pytest_configure(config):
+    # no pytest.ini/pyproject config exists (adding one could shift
+    # pytest's rootdir detection), so the marker the tier-1 command
+    # deselects (-m 'not slow') is registered here
+    config.addinivalue_line(
+        "markers",
+        "slow: compiles the device engine or runs >5s; excluded from the "
+        "tier-1 gate (scripts/tier1.sh)",
+    )

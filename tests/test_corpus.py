@@ -1,0 +1,361 @@
+"""Corpus subsystem tests: store dedup, energy determinism, bucket
+assembly invariants, feedback bus, checkpoint/resume energies, and the
+(slow-marked) end-to-end feedback runner. The reference has no corpus
+engine at all — this is new coverage for erlamsa_tpu/corpus/."""
+
+import json
+import os
+import socket
+import urllib.request
+
+import numpy as np
+import pytest
+
+from erlamsa_tpu.corpus import feedback as fb
+from erlamsa_tpu.corpus.assembler import (MIN_BUCKET, MIN_ROWS, Bucket,
+                                          assemble, bucket_capacity)
+from erlamsa_tpu.corpus.energy import TAG_SCHED, EnergyScheduler, seed_weights
+from erlamsa_tpu.corpus.feedback import EVENT_GAIN, Event, FeedbackBus
+from erlamsa_tpu.corpus.store import (INIT_ENERGY, MAX_ENERGY, MIN_ENERGY,
+                                      CorpusStore, seed_id_for)
+from erlamsa_tpu.services.checkpoint import (load_corpus_energies,
+                                             load_state, save_state)
+
+
+# ---- store --------------------------------------------------------------
+
+
+def test_store_dedup_idempotent(tmp_path):
+    st = CorpusStore(str(tmp_path))
+    sid1, new1 = st.add(b"hello world", origin="t1")
+    sid2, new2 = st.add(b"hello world", origin="t2")
+    assert new1 and not new2 and sid1 == sid2 == seed_id_for(b"hello world")
+    assert len(st) == 1
+    # empty seeds are rejected, not stored
+    assert st.add(b"") == (None, False)
+    # a fresh store over the same directory sees the same state
+    st2 = CorpusStore(str(tmp_path))
+    assert len(st2) == 1 and st2.get(sid1) == b"hello world"
+    # re-adding into the reloaded store is still a dup
+    assert st2.add(b"hello world")[1] is False
+
+
+def test_store_insertion_order_survives_reload(tmp_path):
+    st = CorpusStore(str(tmp_path))
+    sids = [st.add(bytes([i]) * 10)[0] for i in range(5)]
+    assert st.ids() == sids
+    assert CorpusStore(str(tmp_path)).ids() == sids
+
+
+def test_store_add_paths_skips_bad_files(tmp_path):
+    good = tmp_path / "good.bin"
+    good.write_bytes(b"seed data")
+    empty = tmp_path / "empty.bin"
+    empty.write_bytes(b"")
+    st = CorpusStore(str(tmp_path / "store"))
+    new, dup, skipped = st.add_paths(
+        [str(good), str(empty), str(tmp_path / "missing.bin")]
+    )
+    assert (new, dup, skipped) == (1, 0, 2)
+    assert len(st) == 1
+
+
+def test_store_energy_bounds_and_events(tmp_path):
+    st = CorpusStore(str(tmp_path))
+    sid, _ = st.add(b"seed")
+    st.bump(sid, 1e9)
+    assert st.meta(sid)["energy"] == MAX_ENERGY
+    st.bump(sid, -1e9)
+    assert st.meta(sid)["energy"] == MIN_ENERGY
+    st.apply_event(Event("crash", sid))
+    assert st.meta(sid)["events"] == {"crash": 1}
+    assert st.meta(sid)["energy"] == MIN_ENERGY + EVENT_GAIN["crash"]
+
+
+def test_store_anonymous_event_splits_credit(tmp_path):
+    st = CorpusStore(str(tmp_path))
+    a, _ = st.add(b"aaaa")
+    b, _ = st.add(b"bbbb")
+    st.apply_event(Event("crash", None, "monitor:exec"), credit=[a, b])
+    ea = st.meta(a)["energy"]
+    eb = st.meta(b)["energy"]
+    assert ea == eb == INIT_ENERGY + EVENT_GAIN["crash"] / 2
+
+
+# ---- energy scheduling --------------------------------------------------
+
+
+def test_sched_tag_matches_prng_registry():
+    # energy.py keeps a jax-free copy of the tag; it must stay in
+    # lockstep with the ops/prng.py registry
+    from erlamsa_tpu.ops import prng
+
+    assert TAG_SCHED == prng.TAG_SCHED
+
+
+def test_seed_weights_positive_and_decaying():
+    w = seed_weights([1.0, 1.0, 0.0], [0, 9, 0])
+    assert (w > 0).all()
+    assert w[1] == pytest.approx(w[0] / np.sqrt(10.0))
+
+
+def test_schedule_deterministic_at_fixed_seed(tmp_path):
+    st = CorpusStore(str(tmp_path))
+    for i in range(8):
+        st.add(bytes([65 + i]) * (10 + i))
+    s1 = EnergyScheduler(st, (11, 22, 33)).schedule(3, 64, record=False)
+    s2 = EnergyScheduler(st, (11, 22, 33)).schedule(3, 64, record=False)
+    assert s1 == s2
+    # a different case index draws a different schedule
+    assert s1 != EnergyScheduler(st, (11, 22, 33)).schedule(4, 64,
+                                                            record=False)
+    # and a different seed too
+    assert s1 != EnergyScheduler(st, (11, 22, 34)).schedule(3, 64,
+                                                            record=False)
+
+
+def test_feedback_raises_schedule_density(tmp_path):
+    st = CorpusStore(str(tmp_path))
+    sids = [st.add(bytes([65 + i]) * 16)[0] for i in range(4)]
+    sched = EnergyScheduler(st, (1, 2, 3))
+    before = sched.schedule(0, 256, record=False).count(sids[2])
+    st.apply_event(Event("crash", sids[2]))
+    after = sched.schedule(0, 256, record=False).count(sids[2])
+    assert after > before
+
+
+def test_schedule_hits_decay(tmp_path):
+    st = CorpusStore(str(tmp_path))
+    sids = [st.add(bytes([65 + i]) * 16)[0] for i in range(2)]
+    sched = EnergyScheduler(st, (1, 2, 3))
+    st.record_scheduled({sids[0]: 100})
+    picks = sched.schedule(0, 200, record=False)
+    # the heavily-hit seed fades but never disappears
+    assert 0 < picks.count(sids[0]) < picks.count(sids[1])
+
+
+# ---- bucket assembly ----------------------------------------------------
+
+
+def test_bucket_capacity_pow2_bounds():
+    assert bucket_capacity(1) == MIN_BUCKET
+    assert bucket_capacity(100) == 256  # 100*2 -> 256
+    assert bucket_capacity(300) == 1024  # 300*2 -> 1024
+    assert bucket_capacity(10**9, device_max=65536) == 65536
+    cap = bucket_capacity(3000)
+    assert cap & (cap - 1) == 0  # power of two
+
+
+def test_assemble_shape_invariants():
+    samples = [b"a" * 50, b"b" * 300, b"c" * 2000, b"d" * 60, b"e" * 600]
+    buckets = assemble(samples)
+    # every position lands in exactly one bucket
+    slots = sorted(s for b in buckets for s in b.slots)
+    assert slots == list(range(len(samples)))
+    caps = [b.capacity for b in buckets]
+    assert caps == sorted(caps)  # stable compile order
+    for b in buckets:
+        assert isinstance(b, Bucket)
+        assert b.capacity & (b.capacity - 1) == 0
+        assert b.rows_padded & (b.rows_padded - 1) == 0
+        assert b.rows_padded >= max(b.rows, MIN_ROWS)
+        assert b.data.shape == (b.rows_padded, b.capacity)
+        assert b.data.dtype == np.uint8 and b.lens.dtype == np.int32
+        assert (b.lens <= b.capacity).all() and (b.lens > 0).all()
+        # real rows hold the scheduled bytes, padding is zero beyond len
+        for r, pos in enumerate(b.slots):
+            n = int(b.lens[r])
+            assert b.data[r, :n].tobytes() == samples[pos][:n]
+            assert not b.data[r, n:].any()
+        assert b.padded_bytes_wasted == sum(
+            b.capacity - len(samples[p]) for p in b.slots
+        )
+
+
+def test_assemble_truncates_oversized_to_device_max():
+    big = b"x" * 5000
+    (b,) = assemble([big], device_max=1024)
+    assert b.capacity == 1024 and b.lens[0] == 1024
+    assert b.padded_bytes_wasted == 0
+
+
+def test_assemble_unpadded_rows():
+    buckets = assemble([b"q" * 10] * 3, pad_rows_pow2=False)
+    assert buckets[0].rows == buckets[0].rows_padded == 3
+
+
+# ---- feedback bus -------------------------------------------------------
+
+
+def test_feedback_bus_publish_drain_bounded():
+    bus = FeedbackBus(maxlen=4)
+    for i in range(6):
+        bus.publish("crash", source=f"s{i}")
+    assert bus.published == 6 and bus.dropped == 2
+    evs = bus.drain()
+    assert len(evs) == 4 and evs[0].source == "s2"
+    assert bus.pending() == 0 and bus.drain() == []
+
+
+# ---- checkpoint energies ------------------------------------------------
+
+
+def test_checkpoint_corpus_energies_roundtrip(tmp_path):
+    p = str(tmp_path / "state.npz")
+    scores = np.zeros((4, 31), np.int32)
+    energies = {seed_id_for(b"a"): (3.5, 7), seed_id_for(b"b"): (1.0, 0)}
+    save_state(p, (1, 2, 3), 5, scores, corpus_energies=energies)
+    # the 5-tuple load_state contract is untouched
+    seed, case, sc, hs, hsp = load_state(p)
+    assert seed == (1, 2, 3) and case == 5 and hs == {}
+    assert load_corpus_energies(p) == energies
+    # a checkpoint without corpus state yields None, not {}
+    save_state(p, (1, 2, 3), 5, scores)
+    assert load_corpus_energies(p) is None
+
+
+def test_resume_restores_identical_schedule(tmp_path):
+    """The resume contract: restoring checkpointed energies into a fresh
+    store reproduces the interrupted run's schedule exactly."""
+    seeds = [bytes([65 + i]) * (16 + i) for i in range(6)]
+
+    def fresh(root):
+        st = CorpusStore(root)
+        for s in seeds:
+            st.add(s)
+        return st
+
+    st1 = fresh(str(tmp_path / "run1"))
+    sched1 = EnergyScheduler(st1, (9, 8, 7))
+    sched1.schedule(0, 32)  # records hits
+    st1.apply_event(Event("desync", st1.ids()[3]))
+    expect = sched1.schedule(1, 32, record=False)
+
+    p = str(tmp_path / "state.npz")
+    save_state(p, (9, 8, 7), 1, np.zeros((4, 31), np.int32),
+               corpus_energies=st1.energies())
+
+    st2 = fresh(str(tmp_path / "run2"))
+    st2.restore_energies(load_corpus_energies(p))
+    assert st2.energies() == st1.energies()
+    assert EnergyScheduler(st2, (9, 8, 7)).schedule(1, 32,
+                                                    record=False) == expect
+
+
+# ---- metrics ------------------------------------------------------------
+
+
+def test_metrics_mutator_and_bucket_counters():
+    from erlamsa_tpu.services.metrics import Counters
+
+    c = Counters()
+    c.record_mutator("bd", applied=True, n=3)
+    c.record_mutator("bd", applied=False)
+    c.record_mutator("sgm")
+    c.record_bucket(1024, rows=12, pad_rows=4, padded_bytes_wasted=3784)
+    c.record_bucket(1024, rows=8, pad_rows=0, padded_bytes_wasted=100)
+    snap = c.snapshot()
+    assert snap["mutators"]["bd"] == {"applied": 3, "failed": 1}
+    assert snap["mutators"]["sgm"] == {"applied": 1, "failed": 0}
+    assert snap["buckets"][1024] == {
+        "batches": 2, "rows": 20, "pad_rows": 4,
+        "padded_bytes_wasted": 3884,
+    }
+
+
+# ---- faas stats/event ops ----------------------------------------------
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+@pytest.fixture(scope="module")
+def faas_server():
+    from erlamsa_tpu.services.faas import serve
+
+    port = _free_port()
+    srv = serve("127.0.0.1", port, {"workers": 2, "seed": (1, 2, 3)},
+                backend="oracle", block=False)
+    yield port
+    srv.shutdown()
+
+
+def _manage(port, payload):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/erlamsa/erlamsa_esi:manage",
+        data=json.dumps(payload).encode(),
+    )
+    return json.loads(urllib.request.urlopen(req, timeout=30).read())
+
+
+def test_faas_manage_stats(faas_server):
+    resp = _manage(faas_server, {"op": "stats"})
+    assert resp["status"] == "ok"
+    assert "mutators" in resp["stats"] and "samples" in resp["stats"]
+
+
+def test_faas_manage_event_publishes(faas_server):
+    fb.GLOBAL.drain()  # isolate from other tests' publishers
+    resp = _manage(faas_server, {"op": "event", "kind": "crash",
+                                 "detail": "target died"})
+    assert resp["status"] == "ok"
+    evs = fb.GLOBAL.drain()
+    assert [(e.kind, e.source) for e in evs] == [("crash", "faas")]
+    # kind is mandatory
+    assert _manage(faas_server, {"op": "event"})["status"] == "badop"
+
+
+# ---- end-to-end runner (compiles the device engine: slow) ---------------
+
+
+@pytest.mark.slow
+def test_runner_two_runs_bit_identical(tmp_path):
+    """Acceptance: two runs at the same -s seed produce byte-identical
+    schedules and outputs, and bus events raise seed energy."""
+    from erlamsa_tpu.corpus.runner import run_corpus_batch
+
+    seeds = [bytes([65 + i]) * (40 * (i + 1)) for i in range(6)]
+
+    def run(root, outdir, bus):
+        stats = {}
+        opts = {"corpus_dir": root, "corpus": seeds, "feedback": True,
+                "feedback_bus": bus, "seed": (1, 2, 3), "n": 2,
+                "output": os.path.join(outdir, "out-%n.bin"),
+                "_stats": stats}
+        assert run_corpus_batch(opts, batch=8) == 0
+        outs = [open(os.path.join(outdir, f"out-{i}.bin"), "rb").read()
+                for i in range(16)]
+        return stats, outs
+
+    os.makedirs(tmp_path / "o1")
+    os.makedirs(tmp_path / "o2")
+    st1, outs1 = run(str(tmp_path / "r1"), str(tmp_path / "o1"),
+                     FeedbackBus())
+    st2, outs2 = run(str(tmp_path / "r2"), str(tmp_path / "o2"),
+                     FeedbackBus())
+    assert st1["schedules"] == st2["schedules"]
+    assert outs1 == outs2
+    assert st1["new_hashes"] > 0
+    assert st1["buckets"]  # bucketed, with waste accounting
+    for b in st1["buckets"].values():
+        assert b["padded_bytes_wasted"] >= 0
+
+    # a stub-monitor crash event raises energy of the in-flight seeds
+    bus = FeedbackBus()
+    bus.publish("crash", source="monitor:stub")
+    st3 = CorpusStore(str(tmp_path / "r3"))
+    stats3 = {}
+    opts = {"corpus_dir": str(tmp_path / "r3"), "corpus": seeds,
+            "feedback": True, "feedback_bus": bus, "seed": (1, 2, 3),
+            "n": 1, "output": os.devnull, "_stats": stats3}
+    assert run_corpus_batch(opts, batch=8) == 0
+    st3 = CorpusStore(str(tmp_path / "r3"))
+    crashed = [s for s in st3.ids()
+               if st3.meta(s)["events"].get("crash")]
+    assert crashed
+    assert any(st3.meta(s)["energy"] > INIT_ENERGY for s in crashed)
