@@ -168,13 +168,19 @@ def _run_full_set_stage(batch_n: int, seed_len: int, cases: int, t0: float):
     return warm_sps, host_frac
 
 
-def _run_corpus_stage(batch_n: int, seed_len: int, cases: int, t0: float):
+def _run_corpus_stage(batch_n: int, seed_len: int, cases: int, t0: float,
+                      pipeline: str = "async"):
     """Feedback-driven corpus engine over a MIXED-LENGTH seed set: store
     dedup -> energy schedule -> power-of-two length buckets -> device
     batches, the `--corpus DIR --feedback` CLI path (corpus/runner.py).
     The mixed lengths are the point: the r5 full-set stage padded every
     sample to one capacity class, and bucketing is the claw-back for the
     872 -> 550 samples/s slide recorded in BENCH_r05.json.
+
+    `pipeline` selects the runner's execution pipeline (async = the r6
+    double-buffered overlap path, sync = the serialized baseline); at the
+    fixed (1,2,3) seed both produce byte-identical outputs, so the
+    async/sync throughput ratio isolates the overlap win.
 
     Returns (warm_samples_per_sec, per-bucket padded-waste dict,
     novel-hash count). Warm = first case (trace+compile) dropped via the
@@ -201,6 +207,7 @@ def _run_corpus_stage(batch_n: int, seed_len: int, cases: int, t0: float):
             "n": max(2, cases),
             "output": os.devnull,
             "_stats": stats,
+            "pipeline": pipeline,
         }
         rc = run_corpus_batch(opts, batch=batch_n)
     finally:
@@ -214,7 +221,7 @@ def _run_corpus_stage(batch_n: int, seed_len: int, cases: int, t0: float):
         for cap, b in sorted(stats["buckets"].items())
     }
     _phase(
-        f"corpus stage: {warm_sps:,.0f} samples/s warm, "
+        f"corpus stage ({pipeline}): {warm_sps:,.0f} samples/s warm, "
         f"buckets={list(waste)} padded-waste/sample={waste}", t0,
     )
     return warm_sps, waste, stats.get("new_hashes", 0)
@@ -296,17 +303,36 @@ def child_main() -> None:
 
     # corpus-mode stage: the feedback engine on a mixed-length seed set,
     # with per-bucket padded-bytes-wasted so the bucketing win over the
-    # full-set number is measurable. ERLAMSA_BENCH_CORPUS=0 skips.
+    # full-set number is measurable. The async (pipelined) run is the
+    # headline corpus number; a sync run of the same shape follows so the
+    # record carries the measured overlap speedup (byte-identical outputs
+    # at the fixed bench seed). ERLAMSA_BENCH_CORPUS=0 skips everything,
+    # ERLAMSA_BENCH_SYNC=0 skips just the sync comparison leg.
     if os.environ.get("ERLAMSA_BENCH_CORPUS", "1") != "0":
         try:
             corpus_sps, waste, novel = _run_corpus_stage(
-                BATCH, SEED_LEN, max(2, ITERS // 3), t0
+                BATCH, SEED_LEN, max(2, ITERS // 3), t0, pipeline="async"
             )
             record["corpus_samples_per_sec"] = round(corpus_sps, 1)
             record["corpus_padded_waste_per_sample"] = waste
             record["corpus_novel_hashes"] = novel
             line = json.dumps(record)
             _write_result(line)
+            if os.environ.get("ERLAMSA_BENCH_SYNC", "1") != "0":
+                sync_sps, _, _ = _run_corpus_stage(
+                    BATCH, SEED_LEN, max(2, ITERS // 3), t0, pipeline="sync"
+                )
+                record["corpus_sync_samples_per_sec"] = round(sync_sps, 1)
+                record["corpus_pipeline_speedup"] = round(
+                    corpus_sps / sync_sps, 3
+                ) if sync_sps else 0.0
+                from erlamsa_tpu.services import metrics as _metrics
+
+                record["pipeline_overlap"] = _metrics.GLOBAL.snapshot()[
+                    "pipeline"
+                ]
+                line = json.dumps(record)
+                _write_result(line)
         except Exception as e:  # noqa: BLE001 — earlier numbers stand
             _phase(f"corpus stage FAILED: {type(e).__name__}: {e}", t0)
 

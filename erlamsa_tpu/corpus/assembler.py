@@ -69,48 +69,78 @@ class Bucket(NamedTuple):
         return self.rows_padded - self.rows
 
 
-def assemble(samples: Sequence[bytes], slack: float = GROWTH_SLACK,
-             device_max: int | None = None,
-             pad_rows_pow2: bool = True) -> list[Bucket]:
-    """Group a scheduled sample list into capacity buckets.
+class BucketPlan(NamedTuple):
+    """A bucket's membership before the padded panel is built — the cheap
+    half of assembly. plan_buckets + materialize split the work so a
+    pipelined runner can build bucket N+1's panel while bucket N computes
+    on device; assemble() composes them for the one-shot callers."""
 
-    Every input position lands in exactly one bucket (Bucket.slots);
-    within a bucket, schedule order is preserved. Row padding repeats
-    real rows cyclically — pad outputs are discarded by the consumer, so
-    their content only has to be shape-valid. Buckets come back sorted
-    by capacity (smallest first) for a stable compile order.
+    capacity: int  # L: power-of-two byte width
+    slots: np.ndarray  # int32[rows]: positions in the scheduled list
+    rows_padded: int
+
+
+def plan_buckets(samples: Sequence[bytes], slack: float = GROWTH_SLACK,
+                 device_max: int | None = None,
+                 pad_rows_pow2: bool = True) -> list[BucketPlan]:
+    """Group sample positions into capacity buckets (no data copied).
+
+    Every input position lands in exactly one bucket (slots); within a
+    bucket, schedule order is preserved. Plans come back sorted by
+    capacity (smallest first) for a stable compile order.
     """
     groups: dict[int, list[int]] = {}
     for pos, s in enumerate(samples):
         cap = bucket_capacity(len(s), slack, device_max)
         groups.setdefault(cap, []).append(pos)
-
-    buckets = []
-    for cap, positions in sorted(groups.items()):
-        rows = len(positions)
-        rows_padded = (
-            max(MIN_ROWS, _next_pow2(rows)) if pad_rows_pow2 else rows
-        )
-        data = np.zeros((rows_padded, cap), np.uint8)
-        lens = np.zeros(rows_padded, np.int32)
-        wasted = 0
-        for r in range(rows_padded):
-            s = samples[positions[r % rows]]
-            # oversized samples (beyond the device cap) are truncated to
-            # capacity rather than dropped — the scheduler picked them,
-            # and a truncated mutation beats an empty slot; the runner
-            # logs the overflow count
-            n = min(len(s), cap)
-            data[r, :n] = np.frombuffer(s[:n], np.uint8)
-            lens[r] = n
-            if r < rows:
-                wasted += cap - n
-        buckets.append(Bucket(
+    return [
+        BucketPlan(
             capacity=cap,
             slots=np.asarray(positions, np.int32),
-            data=data,
-            lens=lens,
-            rows=rows,
-            padded_bytes_wasted=wasted,
-        ))
-    return buckets
+            rows_padded=(max(MIN_ROWS, _next_pow2(len(positions)))
+                         if pad_rows_pow2 else len(positions)),
+        )
+        for cap, positions in sorted(groups.items())
+    ]
+
+
+def materialize(plan: BucketPlan, samples: Sequence[bytes]) -> Bucket:
+    """Build one plan's padded device panel (the expensive half)."""
+    cap = plan.capacity
+    rows = len(plan.slots)
+    data = np.zeros((plan.rows_padded, cap), np.uint8)
+    lens = np.zeros(plan.rows_padded, np.int32)
+    wasted = 0
+    for r in range(plan.rows_padded):
+        s = samples[plan.slots[r % rows]]
+        # oversized samples (beyond the device cap) are truncated to
+        # capacity rather than dropped — the scheduler picked them,
+        # and a truncated mutation beats an empty slot; the runner
+        # logs the overflow count
+        n = min(len(s), cap)
+        data[r, :n] = np.frombuffer(s[:n], np.uint8)
+        lens[r] = n
+        if r < rows:
+            wasted += cap - n
+    return Bucket(
+        capacity=cap,
+        slots=plan.slots,
+        data=data,
+        lens=lens,
+        rows=rows,
+        padded_bytes_wasted=wasted,
+    )
+
+
+def assemble(samples: Sequence[bytes], slack: float = GROWTH_SLACK,
+             device_max: int | None = None,
+             pad_rows_pow2: bool = True) -> list[Bucket]:
+    """Group a scheduled sample list into padded capacity buckets.
+
+    Row padding repeats real rows cyclically — pad outputs are discarded
+    by the consumer, so their content only has to be shape-valid.
+    """
+    return [
+        materialize(p, samples)
+        for p in plan_buckets(samples, slack, device_max, pad_rows_pow2)
+    ]

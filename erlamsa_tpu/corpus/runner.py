@@ -13,48 +13,134 @@ Per case:
   6. energies are checkpointed alongside the scheduler scores so a
      resumed run schedules identically
 
+Execution pipelines (--pipeline, default async):
+
+  sync   the serialized baseline: every bucket's outputs are forced to
+         host before the next bucket dispatches, and hashing/writing
+         happen inline between cases.
+  async  double-buffered: bucket steps dispatch without blocking (JAX
+         async dispatch), the score table stays DEVICE-resident across
+         buckets (gather/scatter on device — no host round-trip per
+         bucket), bucket N+1's panel is assembled on the host while
+         bucket N computes, and a drain worker thread forces completed
+         futures, hashes outputs and writes results while the main
+         thread dispatches the next case.
+
 Determinism contract (the -s replay guarantee): every schedule draw is
 keyed on (seed, case, TAG_SCHED), device keys on (seed, case, slot), and
 energies evolve only from deterministic inputs applied at case
 boundaries — so at a fixed seed, two runs produce byte-identical
-schedules and outputs. External bus events are inherently timing-
-dependent; they are folded in at the same case boundary, so replay
-holds whenever the event stream is (e.g. absent, or injected at fixed
-cases as the tests do).
+schedules and outputs, and sync/async produce byte-identical streams
+(the pipeline moves WHEN work happens, never WHAT is computed: hash
+events apply in the same bucket-dispatch + slot order, and the drain
+worker signals "events applied" before the next schedule draws).
+External bus events are inherently timing-dependent; they are folded in
+at the same case boundary, so replay holds whenever the event stream is
+(e.g. absent, or injected at fixed cases as the tests do).
 """
 
 from __future__ import annotations
 
 import hashlib
+import queue
 import sys
+import threading
 import time
 
 import numpy as np
 
 from ..services import logger, metrics, out
 from . import feedback as fb
-from .assembler import assemble
+from .assembler import materialize, plan_buckets
 from .energy import EnergyScheduler
 from .store import CorpusStore
+
+PIPELINES = ("sync", "async")
 
 
 def _out_hash(data: bytes) -> bytes:
     return hashlib.sha1(data).digest()[:12]
 
 
+class _DrainWorker:
+    """Orders completed cases behind the device: one thread consuming
+    submitted cases FIFO, so hashing/writing of case N overlaps the main
+    thread's schedule/assemble/dispatch of case N+1.
+
+    The first exception raised by the process callback is captured and
+    re-raised in the MAIN thread (from wait_done/close) — a dead drain
+    must fail the run, not silently stop consuming."""
+
+    def __init__(self, process, start_case: int):
+        self._process = process
+        self._q: queue.Queue = queue.Queue()
+        self._cv = threading.Condition()
+        self._done_case = start_case - 1
+        self.error: BaseException | None = None
+        self._t = threading.Thread(target=self._run, name="corpus-drain",
+                                   daemon=True)
+        self._t.start()
+
+    def submit(self, item):
+        metrics.GLOBAL.record_drain_backlog(self._q.qsize() + 1)
+        self._q.put(item)
+
+    def mark_done(self, case: int):
+        """Called by the process callback once the case's energy events
+        are applied — the point after which the next schedule may draw."""
+        with self._cv:
+            self._done_case = case
+            self._cv.notify_all()
+
+    def wait_done(self, case: int):
+        """Block until `case`'s events are applied (or the worker died)."""
+        with self._cv:
+            while self._done_case < case and self.error is None:
+                self._cv.wait()
+        if self.error is not None:
+            raise self.error
+
+    def _run(self):
+        while True:
+            item = self._q.get()
+            if item is None:
+                return
+            try:
+                self._process(item)
+            except BaseException as e:  # noqa: BLE001 — surfaced to main
+                with self._cv:
+                    self.error = e
+                    self._cv.notify_all()
+                return
+
+    def close(self, join: bool = True):
+        self._q.put(None)
+        if join:
+            self._t.join()
+        if self.error is not None:
+            raise self.error
+
+
 def run_corpus_batch(opts: dict, batch: int = 1024) -> int:
     """The --corpus DIR --feedback entry point."""
     import jax
+    import jax.numpy as jnp
 
     from ..constants import CAPACITY_CLASSES
     from ..oracle.mutations import default_mutations
     from ..ops import prng
     from ..ops.buffers import Batch, scan_bound, unpack
-    from ..ops.pipeline import make_class_fuzzer
+    from ..ops.pipeline import make_class_fuzzer, step_async
     from ..ops.registry import DEVICE_CODES
     from ..ops.scheduler import init_scores
     from ..services.checkpoint import (load_corpus_energies, load_state,
                                        save_state)
+
+    pipeline = str(opts.get("pipeline") or "async")
+    if pipeline not in PIPELINES:
+        raise ValueError(f"pipeline must be one of {PIPELINES}, "
+                         f"got {pipeline!r}")
+    use_async = pipeline == "async"
 
     store = CorpusStore(opts["corpus_dir"])
     direct = opts.get("corpus")
@@ -89,7 +175,10 @@ def run_corpus_batch(opts: dict, batch: int = 1024) -> int:
 
     device_max = int(opts.get("device_capacity_max", CAPACITY_CLASSES[-1]))
     sched = EnergyScheduler(store, opts["seed"])
-    step = make_class_fuzzer(mutator_pri=pri)
+    # async: donate the bucket panel + gathered score rows (fresh buffers
+    # every step) so the compiled program writes outputs in place
+    step = make_class_fuzzer(mutator_pri=pri,
+                             donate="auto" if use_async else False)
     base = prng.base_key(opts["seed"])
     scores = init_scores(jax.random.fold_in(base, 999), batch)
     bus = opts.get("feedback_bus", fb.GLOBAL)
@@ -116,8 +205,6 @@ def run_corpus_batch(opts: dict, batch: int = 1024) -> int:
                     print("# checkpoint mismatch (seed/shape), starting "
                           "fresh", file=sys.stderr)
                 else:
-                    import jax.numpy as jnp
-
                     start_case = ck_case
                     scores = jnp.asarray(ck_scores)
                     energies = load_corpus_energies(state_path)
@@ -135,24 +222,39 @@ def run_corpus_batch(opts: dict, batch: int = 1024) -> int:
     stats = opts.get("_stats")  # caller-owned dict for measured numbers
     seen_hashes: set[bytes] = set()
     bucket_stats: dict[int, dict] = {}
-    truncated = 0
-    total = 0
-    new_hashes = 0
-    t0 = time.perf_counter()
+    # tallies the drain worker owns in async mode (main reads after join)
+    tallies = {"truncated": 0, "total": 0, "new_hashes": 0}
 
-    for case in range(start_case, n_cases):
+    # sync mode keeps the score table host-resident. One conversion for
+    # the whole run — per bucket only that bucket's ROWS are gathered and
+    # scattered, never the full [batch, M] table (the pre-r6 path copied
+    # the entire table every case).
+    if not use_async:
+        scores = np.array(scores)
+
+    def dispatch_case(case, scores_in):
+        """Schedule, assemble and dispatch every bucket of one case.
+
+        async: steps dispatch without blocking, scores gather/scatter on
+        device, and each bucket's panel is materialized WHILE the
+        previous bucket's step runs (JAX async dispatch returns before
+        the compute finishes). sync: each bucket is forced to host
+        before the next dispatch — the serialized baseline.
+        Returns (ids, launched, scores_out)."""
+        t_s = time.perf_counter()
         ids = sched.schedule(case, batch)
         samples = [store.get(sid) for sid in ids]
-        truncated += sum(len(s) > device_max for s in samples)
-        buckets = assemble(samples, device_max=device_max)
+        plans = plan_buckets(samples, device_max=device_max)
+        metrics.GLOBAL.record_stage("schedule", time.perf_counter() - t_s)
+        tallies["truncated"] += sum(len(s) > device_max for s in samples)
 
-        results: dict[int, bytes] = {}
-        # np.array (copy): jax gives back read-only views, and the
-        # per-bucket scatter below writes in place
-        scores_np = np.array(scores)
-        case_bytes = 0
-        t_dev = time.perf_counter()
-        for b in buckets:
+        launched = []
+        scores_out = scores_in
+        assemble_s = dispatch_s = 0.0
+        for plan in plans:
+            t_a = time.perf_counter()
+            b = materialize(plan, samples)
+            t_d = time.perf_counter()
             # keys derive from the SLOT position (0..batch-1) so a
             # sample's stream is a pure function of (seed, case, slot)
             # no matter how the buckets partition the batch; pad rows get
@@ -160,17 +262,55 @@ def run_corpus_batch(opts: dict, batch: int = 1024) -> int:
             idx = np.concatenate([
                 b.slots, batch + np.arange(b.pad_rows, dtype=np.int32)
             ]).astype(np.int32)
-            sc_in = scores_np[b.slots[np.arange(b.rows_padded) % b.rows]]
-            new_data, new_lens, new_sc, meta = step(
-                base, case, idx, b.data, b.lens, sc_in,
+            gather = b.slots[np.arange(b.rows_padded) % b.rows]
+            sc_in = (jnp.take(scores_out, gather, axis=0) if use_async
+                     else scores_out[gather])
+            fut = step_async(
+                step, base, case, idx, b.data, b.lens, sc_in,
                 scan_len=scan_bound(int(b.lens[:b.rows].max()), b.capacity),
             )
+            if use_async:
+                scores_out = scores_out.at[jnp.asarray(b.slots)].set(
+                    fut.scores[:b.rows]
+                )
+            else:
+                scores_out[b.slots] = np.asarray(fut.scores)[:b.rows]
+            launched.append((b, fut))
+            t_e = time.perf_counter()
+            assemble_s += t_d - t_a
+            dispatch_s += t_e - t_d
+        metrics.GLOBAL.record_stage("assemble", assemble_s)
+        metrics.GLOBAL.record_stage("dispatch", dispatch_s)
+        return ids, launched, scores_out, dispatch_s
+
+    class _CaseWork:
+        __slots__ = ("case", "ids", "launched", "scores", "dispatch_s")
+
+        def __init__(self, case, ids, launched, scores, dispatch_s):
+            self.case = case
+            self.ids = ids
+            self.launched = launched
+            self.scores = scores
+            self.dispatch_s = dispatch_s
+
+    drain: _DrainWorker | None = None
+
+    def process_case(work: _CaseWork):
+        """Force one case's futures to host, then the order-dependent
+        tail: hashing (bucket dispatch order is fixed, slot walk is
+        0..batch-1 — identical in sync and async), energy events, bus
+        drain, writes and checkpointing. Runs inline in sync mode, on
+        the drain worker in async mode."""
+        case, ids, launched = work.case, work.ids, work.launched
+        results: dict[int, bytes] = {}
+        t_w = time.perf_counter()
+        for b, fut in launched:
+            new_data, new_lens, _new_sc, meta = fut.result()
             outs = unpack(Batch(new_data[:b.rows], new_lens[:b.rows]))
-            scores_np[b.slots] = np.asarray(new_sc)[:b.rows]
             for j, slot in enumerate(b.slots):
                 results[int(slot)] = outs[j]
             # per-mutator applied counters (registry rows, device side)
-            applied = np.asarray(meta.applied)[:b.rows].ravel()
+            applied = meta.applied[:b.rows].ravel()
             applied = applied[applied >= 0]
             if applied.size:
                 counts = np.bincount(applied, minlength=len(DEVICE_CODES))
@@ -190,25 +330,25 @@ def run_corpus_batch(opts: dict, batch: int = 1024) -> int:
             metrics.GLOBAL.record_bucket(
                 b.capacity, b.rows, b.pad_rows, b.padded_bytes_wasted
             )
-        dev_s = time.perf_counter() - t_dev
-        scores = scores_np
+        drain_wait_s = time.perf_counter() - t_w
+        metrics.GLOBAL.record_stage("drain_wait", drain_wait_s)
 
         # novelty feedback: a never-seen output hash is the cheap
         # stand-in for new coverage — the source seed earns energy
+        t_h = time.perf_counter()
+        case_bytes = 0
         for slot in range(batch):
             payload = results.get(slot, b"")
             case_bytes += len(payload)
             h = _out_hash(payload)
             if h not in seen_hashes:
                 seen_hashes.add(h)
-                new_hashes += 1
+                tallies["new_hashes"] += 1
                 store.apply_event(fb.Event("new_hash", ids[slot]))
-            if writer is not None:
-                writer(case * batch + slot, payload, [])
-            else:
-                sys.stdout.buffer.write(payload)
-        total += len(results)
-        metrics.GLOBAL.record_batch(len(results), case_bytes, dev_s)
+        tallies["total"] += len(results)
+        metrics.GLOBAL.record_stage("hash", time.perf_counter() - t_h)
+        metrics.GLOBAL.record_batch(len(results), case_bytes,
+                                    work.dispatch_s + drain_wait_s)
 
         # external feedback (monitors/proxy/faas) folds in at the case
         # boundary; anonymous events credit this case's seeds
@@ -219,32 +359,85 @@ def run_corpus_batch(opts: dict, batch: int = 1024) -> int:
                 logger.log("decision", "corpus: %s event from %s -> "
                            "energy feedback", ev.kind, ev.source or "?")
 
+        ckpt = state_path and ((case + 1 - start_case) % ckpt_every == 0
+                               or case + 1 == n_cases)
+        if not ckpt and drain is not None:
+            # energies are final for this case and no checkpoint pins
+            # this case's store state: unblock the next schedule NOW so
+            # writes below overlap the next case's dispatch
+            drain.mark_done(case)
+
+        def write_outputs():
+            t_o = time.perf_counter()
+            for slot in range(batch):
+                payload = results.get(slot, b"")
+                if writer is not None:
+                    writer(case * batch + slot, payload, [])
+                else:
+                    sys.stdout.buffer.write(payload)
+            metrics.GLOBAL.record_stage("write", time.perf_counter() - t_o)
+
+        write_outputs()
         if stats is not None:
             stats.setdefault("finish_times", []).append(time.perf_counter())
-            stats.setdefault("schedules", []).append(list(ids))
-        if state_path and ((case + 1 - start_case) % ckpt_every == 0
-                           or case + 1 == n_cases):
-            save_state(state_path, opts["seed"], case + 1, scores,
+        if ckpt:
+            # writes land BEFORE the checkpoint marks the case done (a
+            # resumed run must not skip a case whose outputs never hit
+            # disk), and the checkpoint lands before the next schedule
+            # records its hits (else resume would double-count them)
+            save_state(state_path, opts["seed"], case + 1,
+                       np.asarray(work.scores),
                        corpus_energies=store.energies())
             store.save()
+            if drain is not None:
+                drain.mark_done(case)
+
+    if use_async:
+        drain = _DrainWorker(process_case, start_case)
+
+    t0 = time.perf_counter()
+    try:
+        for case in range(start_case, n_cases):
+            if drain is not None and case > start_case:
+                # the -s contract's one serialization point: case N's
+                # energy events must land before schedule N+1 draws
+                drain.wait_done(case - 1)
+            ids, launched, scores, dispatch_s = dispatch_case(case, scores)
+            if stats is not None:
+                stats.setdefault("schedules", []).append(list(ids))
+            work = _CaseWork(case, ids, launched, scores, dispatch_s)
+            if drain is not None:
+                drain.submit(work)
+            else:
+                process_case(work)
+        if drain is not None:
+            drain.close()
+            drain = None
+    finally:
+        if drain is not None:
+            drain.close(join=False)
 
     store.save()
     dt = time.perf_counter() - t0
-    if truncated:
-        print(f"# {truncated} scheduled samples exceeded the device "
-              f"budget ({device_max}B) and were truncated", file=sys.stderr)
+    metrics.GLOBAL.record_pipeline_wall(dt)
+    total = tallies["total"]
+    new_hashes = tallies["new_hashes"]
+    if tallies["truncated"]:
+        print(f"# {tallies['truncated']} scheduled samples exceeded the "
+              f"device budget ({device_max}B) and were truncated",
+              file=sys.stderr)
     if stats is not None:
         stats.update(total=total, dt=dt, batch=batch,
                      buckets=bucket_stats, new_hashes=new_hashes,
-                     store_stats=store.stats())
-    logger.log("info", "corpus backend: %d samples in %.2fs "
+                     pipeline=pipeline, store_stats=store.stats())
+    logger.log("info", "corpus backend (%s pipeline): %d samples in %.2fs "
                "(%.0f samples/s), %d novel output hashes",
-               total, dt, total / max(dt, 1e-9), new_hashes)
+               pipeline, total, dt, total / max(dt, 1e-9), new_hashes)
     waste = sum(b["padded_bytes_wasted"] for b in bucket_stats.values())
     rows = sum(b["rows"] for b in bucket_stats.values())
     print(
         f"# {total} samples, {dt:.2f}s, {total / max(dt, 1e-9):.0f} "
-        f"samples/s, {new_hashes} novel hashes, "
+        f"samples/s ({pipeline} pipeline), {new_hashes} novel hashes, "
         f"{len(bucket_stats)} buckets, "
         f"{waste / max(rows, 1):.0f} padded bytes wasted/sample",
         file=sys.stderr,

@@ -38,6 +38,53 @@ class FuzzMeta(NamedTuple):
     applied: jax.Array  # int32[B, MAX_BURST_MUTATIONS]
 
 
+class StepFuture(NamedTuple):
+    """Handle to an in-flight device step.
+
+    JAX dispatch is already asynchronous: the arrays inside are device
+    futures, and holding a StepFuture costs nothing. The value of naming
+    it is the contract — nothing in here blocks until ``block()`` /
+    ``result()``, so a caller can dispatch bucket N+1 (or assemble it on
+    the host) while bucket N computes, then hand the future to a drain
+    worker that forces completion off the critical path."""
+
+    data: jax.Array  # uint8[B, L]
+    lens: jax.Array  # int32[B]
+    scores: jax.Array  # int32[B, M]
+    meta: FuzzMeta
+
+    def block(self) -> "StepFuture":
+        """Wait for the device step to finish (outputs stay on device)."""
+        jax.block_until_ready((self.data, self.lens, self.scores, self.meta))
+        return self
+
+    def ready(self) -> bool:
+        """True when the device step has completed (never blocks)."""
+        try:
+            return bool(self.data.is_ready())
+        except AttributeError:  # non-jax leaves (already host numpy)
+            return True
+
+    def result(self):
+        """Force completion and return host copies:
+        (data, lens, scores, meta) as numpy arrays / FuzzMeta-of-numpy."""
+        return (
+            np.asarray(self.data), np.asarray(self.lens),
+            np.asarray(self.scores),
+            FuzzMeta(np.asarray(self.meta.pattern),
+                     np.asarray(self.meta.applied)),
+        )
+
+
+def step_async(step, *args, **kwargs) -> StepFuture:
+    """Non-blocking step call: dispatch and wrap the outputs in a
+    StepFuture instead of synchronizing. Works with any step built by
+    make_fuzzer / make_class_fuzzer (they all return
+    (data, lens, scores, meta))."""
+    data, lens, scores, meta = step(*args, **kwargs)
+    return StepFuture(data, lens, scores, meta)
+
+
 def _shift_left(data, n, s):
     """Drop the first s bytes (suffix to offset 0)."""
     L = data.shape[0]
@@ -364,8 +411,18 @@ def fuzz_batch(keys, data, lens, scores, pri, pat_pri, engine: str = "fused",
 DEFAULT_SLICES = "auto"  # footprint-sized sub-batches (see _auto_slices)
 
 
+def resolve_donate(donate) -> bool:
+    """"auto" -> donate on accelerators only: XLA implements input-output
+    buffer aliasing on TPU/GPU, while the CPU backend ignores it with a
+    per-call warning — not worth the log spam for zero win."""
+    if donate == "auto":
+        return jax.default_backend() != "cpu"
+    return bool(donate)
+
+
 def make_class_fuzzer(mutator_pri=None, pattern_pri=None,
-                      engine: str = "fused", slices=DEFAULT_SLICES):
+                      engine: str = "fused", slices=DEFAULT_SLICES,
+                      donate=False):
     """Capacity-class step (SURVEY.md §5.7): one jitted function reused
     across class batches — XLA retraces per (B, L) shape, compiling one
     program per class. Keys derive from the ORIGINAL corpus index passed
@@ -378,6 +435,13 @@ def make_class_fuzzer(mutator_pri=None, pattern_pri=None,
     scan_len (static per call): the caller's bound on max sample length
     in this batch — the batch runner knows each class's true max, so
     detection scans run at data width instead of capacity width.
+
+    donate (False | True | "auto"): donate the data and scores buffers to
+    the compiled step (jit donate_argnums) so XLA writes outputs in place
+    instead of allocating fresh [B, L] panels per call. Only safe when the
+    caller never reuses an input after the call — true for the corpus
+    runner (fresh bucket panels, fresh score gathers every step), NOT for
+    loops that replay the same packed batch (the bench kernel stage).
     """
     from .patterns import CS, NUM_PATTERNS, SZ
 
@@ -418,12 +482,16 @@ def make_class_fuzzer(mutator_pri=None, pattern_pri=None,
             enable_len=enable_len, enable_fuse=enable_fuse,
         )
 
-    return jax.jit(step, static_argnames=("scan_len",))
+    # donate data (3) and scores (5): the two [B, *] buffers with
+    # same-shaped outputs. lens/indices are tiny; base/case are scalars.
+    donate_argnums = (3, 5) if resolve_donate(donate) else ()
+    return jax.jit(step, static_argnames=("scan_len",),
+                   donate_argnums=donate_argnums)
 
 
 def make_fuzzer(capacity: int, batch: int, mutator_pri=None, pattern_pri=None,
                 engine: str = "fused", slices=DEFAULT_SLICES,
-                scan_len: int | None = None):
+                scan_len: int | None = None, donate=False):
     """Host convenience: returns (jitted_step, initial_state_fn).
 
     jitted_step(case_idx, data, lens, scores) -> (data', lens', scores', meta)
@@ -433,8 +501,13 @@ def make_fuzzer(capacity: int, batch: int, mutator_pri=None, pattern_pri=None,
 
     scan_len: static bound on max sample length (see fuzz_batch) — set it
     when the corpus's longest seed is far below capacity.
+
+    donate: buffer donation for callers that never reuse inputs (see
+    make_class_fuzzer) — the request batcher qualifies (fresh pack per
+    flush, scores chained forward), a fixed-corpus replay loop does not.
     """
-    class_step = make_class_fuzzer(mutator_pri, pattern_pri, engine, slices)
+    class_step = make_class_fuzzer(mutator_pri, pattern_pri, engine, slices,
+                                   donate=donate)
     indices = jnp.arange(batch, dtype=jnp.int32)
 
     def step(base, case_idx, data, lens, scores):

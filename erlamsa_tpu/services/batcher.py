@@ -17,6 +17,7 @@ import time
 from dataclasses import dataclass, field
 
 from ..utils.erlrand import gen_urandom_seed
+from . import metrics
 from .supervisor import supervise
 
 
@@ -26,6 +27,36 @@ class _Req:
     opts: dict
     done: threading.Event = field(default_factory=threading.Event)
     result: bytes = b""
+    # enqueue timestamp: the flush deadline anchors on when the QUEUE
+    # became non-empty, not on when a flusher loop happened to pick the
+    # request up — a request that aged while a batch was in flight
+    # flushes immediately instead of waiting another full tick
+    t_enq: float = field(default_factory=time.monotonic)
+
+
+def collect_batch(q: "queue.Queue[_Req]", first: _Req, batch: int,
+                  deadline: float) -> list[_Req]:
+    """Gather up to `batch` requests ending at `deadline` (monotonic).
+
+    Everything already queued is swept without waiting — so when the
+    deadline has ALREADY passed (requests aged while the previous batch
+    held the device), the whole backlog flushes immediately as a partial
+    batch instead of trickling out one request per tick."""
+    reqs = [first]
+    while len(reqs) < batch:
+        try:
+            reqs.append(q.get_nowait())
+            continue
+        except queue.Empty:
+            pass
+        remaining = deadline - time.monotonic()
+        if remaining <= 0:
+            break
+        try:
+            reqs.append(q.get(timeout=remaining))
+        except queue.Empty:
+            break
+    return reqs
 
 
 class OracleBatcher:
@@ -77,13 +108,26 @@ class OracleBatcher:
 
 class TpuBatcher:
     """Accumulate requests; flush as one padded device batch when the batch
-    fills or max_latency_ms passes. Requests larger than the device
+    fills or the flush deadline passes. Requests larger than the device
     capacity take the oracle escape (same overflow rule as the batch
-    runner's capacity classes) instead of being truncated."""
+    runner's capacity classes) instead of being truncated.
+
+    Double-buffered (r6): the flusher DISPATCHES a batch (JAX async
+    dispatch — non-blocking) and immediately returns to collecting the
+    next one, so request queuing and host packing overlap device compute;
+    a drain thread forces completed batches and answers their clients. Up
+    to `inflight` batches ride the device queue at once (2 = classic
+    double buffering; 1 degenerates to the old serialized flusher).
+
+    The flush deadline is ADAPTIVE: while a batch is in flight the device
+    can't serve a new one anyway, so waiting about one device-step time
+    (EWMA-tracked) to fill the next batch costs no extra latency and
+    raises fill efficiency; the configured max_latency_ms stays the hard
+    cap so an idle service still answers a lone request promptly."""
 
     def __init__(self, batch: int = 256, capacity: int = 16384,
                  max_latency_ms: float = 20.0, seed=None,
-                 max_running_time: float = 30.0):
+                 max_running_time: float = 30.0, inflight: int = 2):
         import jax
 
         from ..ops import prng
@@ -94,9 +138,13 @@ class TpuBatcher:
         self.capacity = capacity
         self.max_latency = max_latency_ms / 1000.0
         self._q: queue.Queue[_Req] = queue.Queue()
-        self._step, _ = make_fuzzer(capacity, batch)
+        # fresh pack per flush + scores chained forward: donation-safe
+        self._step, _ = make_fuzzer(capacity, batch, donate="auto")
         self._base = prng.base_key(seed or gen_urandom_seed())
-        self._scores = init_scores(jax.random.fold_in(self._base, 999), batch)
+        self._init_scores = lambda: init_scores(
+            jax.random.fold_in(self._base, 999), batch
+        )
+        self._scores = self._init_scores()
         self._case = 0
         self._max_running_time = max_running_time
         self._overflow = None  # built lazily on the first oversized request
@@ -105,32 +153,54 @@ class TpuBatcher:
         # (flushes * batch) — how full the device batches actually ran
         self.flushes = 0
         self.served = 0
+        # bounded in-flight pipeline: the semaphore holds one permit per
+        # device slot, acquired before a batch is dispatched and released
+        # only after the drain has FORCED its results — so at most
+        # `inflight` batches ever sit in the device queue (releasing on
+        # hand-off instead would let the flusher stack batches behind a
+        # slow force and multiply tail latency)
+        self._inflight: queue.Queue = queue.Queue()
+        self._slots = threading.Semaphore(max(1, inflight))
+        self._step_ewma = 0.0  # EWMA of device step seconds (drain-side)
+        self._scores_dirty = threading.Event()  # drain saw a device error
         supervise("tpu-batcher-flusher", self._flusher)
+        supervise("tpu-batcher-drain", self._drain)
 
     @property
     def fill_efficiency(self) -> float:
         return self.served / (self.flushes * self.batch) if self.flushes else 0.0
 
-    def _flusher(self):
-        import numpy as np
+    def _deadline_s(self) -> float:
+        """Adaptive collect budget: ~half a device step (clipped to the
+        configured cap) once the step time is known; the full cap while
+        cold (no measurement yet)."""
+        if self._step_ewma <= 0.0:
+            return self.max_latency
+        return min(self.max_latency, max(self._step_ewma * 0.5, 1e-3))
 
-        from ..ops.buffers import Batch, pack, unpack
+    def _flusher(self):
+        from ..ops.buffers import pack
 
         while True:
-            reqs: list[_Req] = [self._q.get()]
-            deadline = time.monotonic() + self.max_latency
-            while len(reqs) < self.batch:
-                remaining = deadline - time.monotonic()
-                if remaining <= 0:
-                    break
-                try:
-                    reqs.append(self._q.get(timeout=remaining))
-                except queue.Empty:
-                    break
+            first = self._q.get()
+            # wait for a device slot BEFORE collecting: while the
+            # pipeline is saturated, flushing sooner couldn't be served
+            # sooner, and arrivals that queue up during the wait get
+            # swept into one fuller batch the moment a slot frees
+            self._slots.acquire()
+            reqs = collect_batch(
+                self._q, first, self.batch, first.t_enq + self._deadline_s()
+            )
             try:
+                if self._scores_dirty.is_set():
+                    # the drain hit a device error: the chained scores
+                    # future is poisoned — restart the chain
+                    self._scores = self._init_scores()
+                    self._scores_dirty.clear()
                 seeds = [r.data for r in reqs]
                 pad = [b"\x00"] * (self.batch - len(seeds))
                 packed = pack(seeds + pad, capacity=self.capacity)
+                t0 = time.monotonic()
                 data, lens, self._scores, _meta = self._step(
                     self._base, self._case, packed.data, packed.lens,
                     self._scores,
@@ -138,18 +208,41 @@ class TpuBatcher:
                 self._case += 1
                 self.flushes += 1
                 self.served += len(reqs)
-                results = unpack(Batch(data, lens))
-                for r, res in zip(reqs, results):
-                    r.result = res
-                    r.done.set()
             except BaseException:
-                # a device error mid-batch must not strand the collected
-                # requests until their client timeout: answer empty (the
+                # a dispatch error must not strand the collected requests
+                # until their client timeout: answer empty (the
                 # fsupervisor give-up convention) before the supervisor
                 # restarts this loop
                 for r in reqs:
                     r.done.set()
+                self._slots.release()
                 raise
+            metrics.GLOBAL.record_drain_backlog(self._inflight.qsize() + 1)
+            self._inflight.put((reqs, data, lens, t0))
+
+    def _drain(self):
+        import numpy as np
+
+        from ..ops.buffers import Batch, unpack
+
+        while True:
+            reqs, data, lens, t0 = self._inflight.get()
+            try:
+                results = unpack(Batch(np.asarray(data), np.asarray(lens)))
+            except BaseException:
+                for r in reqs:
+                    r.done.set()
+                self._scores_dirty.set()
+                self._slots.release()
+                raise
+            dt = time.monotonic() - t0
+            self._step_ewma = (dt if self._step_ewma <= 0.0
+                               else 0.3 * dt + 0.7 * self._step_ewma)
+            metrics.GLOBAL.record_stage("batcher_drain", dt)
+            for r, res in zip(reqs, results):
+                r.result = res
+                r.done.set()
+            self._slots.release()
 
     def fuzz(self, data: bytes, opts: dict, timeout: float = 90.0) -> bytes:
         if len(data) > self.capacity:
@@ -178,6 +271,7 @@ def make_batcher(backend: str, **kw):
     if backend == "tpu":
         return TpuBatcher(**{k: v for k, v in kw.items()
                              if k in ("batch", "capacity", "max_latency_ms",
-                                      "seed", "max_running_time")})
+                                      "seed", "max_running_time",
+                                      "inflight")})
     return OracleBatcher(workers=kw.get("workers", 10),
                          max_running_time=kw.get("max_running_time", 30.0))

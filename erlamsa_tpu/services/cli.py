@@ -164,6 +164,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--backend", choices=["oracle", "tpu"], default="oracle",
                    help="oracle = sequential parity engine; tpu = batched device engine")
     p.add_argument("--batch", type=int, default=1024, help="TPU batch size")
+    p.add_argument("--pipeline", choices=["sync", "async"], default="async",
+                   help="corpus execution pipeline: async (default) "
+                        "overlaps host assembly, device mutation and "
+                        "output drain; sync is the serialized baseline. "
+                        "Outputs are byte-identical at a fixed -s")
     p.add_argument("--state", default=None,
                    help="checkpoint file (.npz) for stop/resume of batch runs")
     p.add_argument("--node", default=None, help="join a parent node host:port")
@@ -243,6 +248,7 @@ def main(argv=None) -> int:
         "workers_same_seed": args.workers_same_seed,
         "corpus_dir": args.corpus,
         "feedback": args.feedback,
+        "pipeline": args.pipeline,
         "output": args.output,
         "verbose": args.verbose,
         "meta_path": args.meta,

@@ -231,6 +231,9 @@ def serve(host: str, port: int, opts: dict, backend: str = "oracle",
                 backend, batch=batch, workers=opts.get("workers", 10),
                 seed=opts.get("seed"),
                 max_running_time=service_budget(opts),
+                **{k: opts[k] for k in
+                   ("capacity", "max_latency_ms", "inflight")
+                   if opts.get(k) is not None},
             ),
             "cmanager": CloudManager(
                 auth_required=auth_required,

@@ -30,6 +30,13 @@ class Counters:
         self.mutators: dict[str, list[int]] = {}
         # per-capacity-bucket assembly stats (corpus/assembler.py)
         self.buckets: dict[int, dict[str, int]] = {}
+        # pipeline overlap accounting (corpus/runner.py, services/batcher):
+        # per-stage wall seconds keyed by stage name; when stages run on
+        # overlapping threads, sum(stages) > pipeline_wall_s measures the
+        # overlap won (ratio 1.0 = fully serialized)
+        self.stages: dict[str, float] = {}
+        self.pipeline_wall = 0.0
+        self.drain_backlog_peak = 0
         self.t0 = time.perf_counter()
 
     def record_batch(self, n_samples: int, n_bytes: int, device_seconds: float):
@@ -57,10 +64,48 @@ class Counters:
             b["pad_rows"] += pad_rows
             b["padded_bytes_wasted"] += padded_bytes_wasted
 
+    def record_stage(self, name: str, seconds: float):
+        """Accumulate wall time for one pipeline stage (schedule, assemble,
+        dispatch, drain_wait, hash, write, ...)."""
+        with self._lock:
+            self.stages[name] = self.stages.get(name, 0.0) + seconds
+
+    def record_pipeline_wall(self, seconds: float):
+        """Wall time a pipelined segment actually took end to end — the
+        denominator of the overlap ratio."""
+        with self._lock:
+            self.pipeline_wall += seconds
+
+    def record_drain_backlog(self, depth: int):
+        """High-water mark of cases queued behind the drain worker."""
+        with self._lock:
+            if depth > self.drain_backlog_peak:
+                self.drain_backlog_peak = depth
+
     def snapshot(self) -> dict:
         with self._lock:
             wall = time.perf_counter() - self.t0
+            # overlap_ratio: sum of per-stage wall over true pipeline wall.
+            # 1.0 = serialized; >1 = host stages ran while the device (or
+            # another host stage) was busy. device_idle_frac: fraction of
+            # the pipelined wall with no device step in flight (dispatch +
+            # drain_wait bound device-busy time from above).
+            stage_sum = sum(self.stages.values())
+            dev_busy = (self.stages.get("dispatch", 0.0)
+                        + self.stages.get("drain_wait", 0.0))
+            pipeline = {
+                "stages": {k: round(v, 3)
+                           for k, v in sorted(self.stages.items())},
+                "wall_s": round(self.pipeline_wall, 3),
+                "overlap_ratio": round(stage_sum / self.pipeline_wall, 3)
+                if self.pipeline_wall else 0.0,
+                "device_idle_frac": round(
+                    max(0.0, 1.0 - dev_busy / self.pipeline_wall), 3
+                ) if self.pipeline_wall else 0.0,
+                "drain_backlog_peak": self.drain_backlog_peak,
+            }
             return {
+                "pipeline": pipeline,
                 "samples": self.samples,
                 "batches": self.batches,
                 "bytes_out": self.bytes_out,

@@ -3,11 +3,57 @@
 # Fast tests only (-m 'not slow'); slow-marked tests (device-engine
 # compiles, end-to-end corpus runs) live behind `pytest -m slow`.
 # Run from the repo root: scripts/tier1.sh
+#
+# scripts/tier1.sh --bench-smoke additionally runs one tiny pipelined
+# corpus batch (async pipeline, 2 cases) after the tests — a cheap
+# end-to-end check that the double-buffered runner dispatches, drains
+# and reports throughput without needing the full bench.py harness.
 set -o pipefail
+
+bench_smoke=0
+if [ "${1:-}" = "--bench-smoke" ]; then
+  bench_smoke=1
+  shift
+fi
+
 rm -f /tmp/_t1.log
 timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
   -m 'not slow' --continue-on-collection-errors \
   -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log
 rc=${PIPESTATUS[0]}
 echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c)
+
+if [ $rc -eq 0 ] && [ $bench_smoke -eq 1 ]; then
+  echo "== bench smoke: tiny pipelined corpus batch =="
+  timeout -k 10 600 env JAX_PLATFORMS=cpu python - <<'EOF'
+import os, shutil, sys, tempfile
+
+from erlamsa_tpu.corpus.runner import run_corpus_batch
+
+stats = {}
+tmpdir = tempfile.mkdtemp(prefix="tier1_bench_smoke_")
+try:
+    rc = run_corpus_batch(
+        {
+            "corpus_dir": tmpdir,
+            "corpus": [bytes([65 + i]) * (40 * (i + 1)) for i in range(6)],
+            "feedback": True,
+            "seed": (1, 2, 3),
+            "n": 2,
+            "output": os.devnull,
+            "_stats": stats,
+            "pipeline": "async",
+        },
+        batch=8,
+    )
+finally:
+    shutil.rmtree(tmpdir, ignore_errors=True)
+ok = rc == 0 and stats.get("pipeline") == "async" and stats.get("total", 0) > 0
+print(f"BENCH_SMOKE={'ok' if ok else 'FAIL'} "
+      f"total={stats.get('total')} pipeline={stats.get('pipeline')}")
+sys.exit(0 if ok else 1)
+EOF
+  rc=$?
+fi
+
 exit $rc

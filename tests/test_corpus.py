@@ -359,3 +359,124 @@ def test_runner_two_runs_bit_identical(tmp_path):
                if st3.meta(s)["events"].get("crash")]
     assert crashed
     assert any(st3.meta(s)["energy"] > INIT_ENERGY for s in crashed)
+
+
+# ---- execution pipeline (r6) --------------------------------------------
+
+
+def test_plan_materialize_composition_matches_assemble():
+    from erlamsa_tpu.corpus.assembler import materialize, plan_buckets
+
+    samples = [b"a" * 40, b"b" * 900, b"c" * 40, b"d" * 5000, b"e" * 41]
+    whole = assemble(samples)
+    plans = plan_buckets(samples)
+    split = [materialize(p, samples) for p in plans]
+    assert len(whole) == len(split)
+    for w, s in zip(whole, split):
+        assert w.capacity == s.capacity
+        assert np.array_equal(w.slots, s.slots)
+        assert np.array_equal(w.data, s.data)
+        assert np.array_equal(w.lens, s.lens)
+        assert w.rows == s.rows
+        assert w.padded_bytes_wasted == s.padded_bytes_wasted
+    # plans carry no panels: cheap to build eagerly for a whole case
+    assert all(p.rows_padded >= len(p.slots) for p in plans)
+
+
+def test_runner_rejects_unknown_pipeline(tmp_path):
+    from erlamsa_tpu.corpus.runner import run_corpus_batch
+
+    with pytest.raises(ValueError, match="pipeline"):
+        run_corpus_batch({"pipeline": "turbo",
+                          "corpus_dir": str(tmp_path)}, batch=4)
+
+
+def test_drain_worker_error_propagates():
+    """A dead drain worker must fail the run from the MAIN thread: both
+    wait_done (mid-run) and close (end of run) re-raise its exception."""
+    from erlamsa_tpu.corpus.runner import _DrainWorker
+
+    def boom(item):
+        raise RuntimeError("drain died")
+
+    w = _DrainWorker(boom, start_case=0)
+    w.submit("case0")
+    with pytest.raises(RuntimeError, match="drain died"):
+        w.wait_done(0)
+    with pytest.raises(RuntimeError, match="drain died"):
+        w.close()
+
+
+def test_drain_worker_fifo_and_barrier():
+    from erlamsa_tpu.corpus.runner import _DrainWorker
+
+    seen = []
+    holder = {}
+
+    def proc(case):
+        seen.append(case)
+        holder["w"].mark_done(case)
+
+    w = _DrainWorker(proc, start_case=0)
+    holder["w"] = w
+    for case in range(4):
+        w.submit(case)
+        w.wait_done(case)  # barrier releases only after proc ran
+        assert seen[-1] == case
+    w.close()
+    assert seen == [0, 1, 2, 3]
+
+
+def test_metrics_pipeline_snapshot():
+    from erlamsa_tpu.services.metrics import Counters
+
+    c = Counters()
+    c.record_stage("dispatch", 1.0)
+    c.record_stage("drain_wait", 0.5)
+    c.record_stage("hash", 1.5)
+    c.record_pipeline_wall(2.0)
+    c.record_drain_backlog(3)
+    c.record_drain_backlog(1)  # high-water mark keeps 3
+    p = c.snapshot()["pipeline"]
+    assert p["wall_s"] == 2.0
+    # stage-seconds sum 3.0 over 2.0s wall: 1.5x overlap won
+    assert p["overlap_ratio"] == pytest.approx(1.5)
+    # device busy bounded by dispatch + drain_wait = 1.5 of 2.0
+    assert p["device_idle_frac"] == pytest.approx(0.25)
+    assert p["drain_backlog_peak"] == 3
+    assert p["stages"]["hash"] == 1.5
+
+    empty = Counters().snapshot()["pipeline"]
+    assert empty["overlap_ratio"] == 0.0
+    assert empty["device_idle_frac"] == 0.0
+
+
+@pytest.mark.slow
+def test_runner_async_sync_bit_identical(tmp_path):
+    """Acceptance (r6): the async double-buffered pipeline produces the
+    SAME bytes as the serialized sync baseline at a fixed -s — schedules,
+    outputs and novelty counts all match, with a batch size that does not
+    divide the seed count (pad rows in every bucket)."""
+    from erlamsa_tpu.corpus.runner import run_corpus_batch
+
+    seeds = [bytes([65 + i]) * (40 * (i + 1)) for i in range(6)]
+
+    def run(pipeline, root, outdir):
+        os.makedirs(outdir)
+        stats = {}
+        opts = {"corpus_dir": root, "corpus": seeds, "feedback": True,
+                "feedback_bus": FeedbackBus(), "seed": (4, 5, 6), "n": 3,
+                "output": os.path.join(outdir, "out-%n.bin"),
+                "_stats": stats, "pipeline": pipeline}
+        assert run_corpus_batch(opts, batch=10) == 0
+        outs = [open(os.path.join(outdir, f"out-{i}.bin"), "rb").read()
+                for i in range(30)]
+        return stats, outs
+
+    st_s, outs_s = run("sync", str(tmp_path / "rs"), str(tmp_path / "os"))
+    st_a, outs_a = run("async", str(tmp_path / "ra"), str(tmp_path / "oa"))
+    assert st_s["pipeline"] == "sync" and st_a["pipeline"] == "async"
+    assert st_s["schedules"] == st_a["schedules"]
+    assert outs_s == outs_a
+    assert st_s["new_hashes"] == st_a["new_hashes"]
+    assert st_a["new_hashes"] > 0

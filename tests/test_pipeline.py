@@ -418,3 +418,41 @@ def test_device_stream_goldens():
     # subprocess (ERLAMSA_PALLAS=2 is a trace-time env switch that must
     # not leak into this pytest process)
     assert gen._pallas2_subprocess() == doc["pallas2_points"]
+
+
+def test_step_async_matches_blocking_call(step, state):
+    """step_async wraps the jitted step without changing its math: the
+    future's forced arrays equal a direct (blocking) call's, and the
+    StepFuture API (block/ready/result) behaves."""
+    from erlamsa_tpu.ops.pipeline import StepFuture, step_async
+
+    base, scores = state
+    batch = pack(SEEDS, capacity=L)
+    fut = step_async(step, base, 5, batch.data, batch.lens, scores)
+    assert isinstance(fut, StepFuture)
+    assert fut.block() is fut
+    assert fut.ready()
+    data, lens, sc, meta = fut.result()
+
+    ref_data, ref_lens, ref_sc, ref_meta = step(
+        base, 5, batch.data, batch.lens, scores
+    )
+    assert np.array_equal(data, np.asarray(ref_data))
+    assert np.array_equal(lens, np.asarray(ref_lens))
+    assert np.array_equal(sc, np.asarray(ref_sc))
+    assert np.array_equal(meta.pattern, np.asarray(ref_meta.pattern))
+    assert np.array_equal(meta.applied, np.asarray(ref_meta.applied))
+    # result() lands everything on host as numpy
+    for arr in (data, lens, sc, meta.pattern, meta.applied):
+        assert isinstance(arr, np.ndarray)
+
+
+def test_resolve_donate_gates_on_backend():
+    """"auto" donation must resolve OFF on CPU (jax ignores donation
+    there with a warning) and pass explicit choices through."""
+    from erlamsa_tpu.ops.pipeline import resolve_donate
+
+    assert resolve_donate(False) is False
+    assert resolve_donate(True) is True
+    expected = jax.default_backend() != "cpu"
+    assert resolve_donate("auto") is expected

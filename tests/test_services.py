@@ -902,3 +902,100 @@ def test_proxy_stream_harness():
     assert out["proxy_cases"] + out["proxy_dropped"] == 60
     assert out["proxy_cases"] >= 40
     assert out["proxy_cases_per_sec"] > 1
+
+
+# ---- adaptive batcher flush + double buffering (r6) ---------------------
+
+
+def test_collect_batch_sweeps_aged_backlog_immediately():
+    """Requests that aged in the queue while a batch was in flight flush
+    as one partial batch the moment the flusher returns — no extra
+    deadline tick per request (the pre-r6 bug)."""
+    import queue as _queue
+
+    from erlamsa_tpu.services.batcher import _Req, collect_batch
+
+    q = _queue.Queue()
+    first = _Req(b"a", {})
+    for payload in (b"b", b"c", b"d"):
+        q.put(_Req(payload, {}))
+    t0 = time.monotonic()
+    reqs = collect_batch(q, first, batch=8, deadline=time.monotonic() - 1.0)
+    elapsed = time.monotonic() - t0
+    assert [r.data for r in reqs] == [b"a", b"b", b"c", b"d"]
+    assert elapsed < 0.2
+    assert q.qsize() == 0
+
+
+def test_collect_batch_full_batch_short_circuits():
+    import queue as _queue
+
+    from erlamsa_tpu.services.batcher import _Req, collect_batch
+
+    q = _queue.Queue()
+    for payload in (b"b", b"c", b"d", b"e"):
+        q.put(_Req(payload, {}))
+    reqs = collect_batch(q, _Req(b"a", {}), batch=3,
+                         deadline=time.monotonic() + 10.0)
+    assert [r.data for r in reqs] == [b"a", b"b", b"c"]
+    assert q.qsize() == 2  # leftovers stay queued for the next flush
+
+
+def test_collect_batch_times_out_to_partial():
+    import queue as _queue
+
+    from erlamsa_tpu.services.batcher import _Req, collect_batch
+
+    q = _queue.Queue()
+    t0 = time.monotonic()
+    reqs = collect_batch(q, _Req(b"a", {}), batch=4,
+                         deadline=time.monotonic() + 0.05)
+    elapsed = time.monotonic() - t0
+    assert [r.data for r in reqs] == [b"a"]
+    assert 0.04 <= elapsed < 1.0
+
+
+def test_tpu_batcher_adaptive_deadline():
+    from erlamsa_tpu.services.batcher import TpuBatcher
+
+    b = TpuBatcher(batch=4, capacity=256, seed=(1, 2, 3),
+                   max_latency_ms=20.0)
+    # cold: no step measurement yet -> the configured cap
+    assert b._deadline_s() == pytest.approx(0.020)
+    # warm: ~half a device step, floored at 1ms...
+    b._step_ewma = 0.004
+    assert b._deadline_s() == pytest.approx(0.002)
+    b._step_ewma = 0.0005
+    assert b._deadline_s() == pytest.approx(0.001)
+    # ...and never above the configured cap
+    b._step_ewma = 1.0
+    assert b._deadline_s() == pytest.approx(0.020)
+
+
+@pytest.mark.slow
+def test_tpu_batcher_double_buffered_serves_concurrent():
+    """Concurrent clients across several flushes: the dispatch/drain
+    split answers everyone (no stranded futures) and the in-flight queue
+    stays bounded."""
+    from erlamsa_tpu.services.batcher import TpuBatcher
+
+    b = TpuBatcher(batch=4, capacity=256, seed=(9, 9, 9),
+                   max_latency_ms=5.0, inflight=2)
+    results = {}
+
+    def client(i):
+        results[i] = b.fuzz(b"double buffer payload %d!" % i, {},
+                            timeout=300)
+
+    threads = [threading.Thread(target=client, args=(i,))
+               for i in range(10)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(300)
+    assert sorted(results) == list(range(10))
+    # every client got a device answer (not a timeout's b"")
+    assert all(isinstance(v, bytes) for v in results.values())
+    assert b.served == 10
+    assert b.flushes >= 3  # batch=4 can't serve 10 in fewer
+    assert 0.0 < b.fill_efficiency <= 1.0
