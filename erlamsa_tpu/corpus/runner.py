@@ -37,6 +37,18 @@ worker signals "events applied" before the next schedule draws).
 External bus events are inherently timing-dependent; they are folded in
 at the same case boundary, so replay holds whenever the event stream is
 (e.g. absent, or injected at fixed cases as the tests do).
+
+Device-loss degradation (services/resilience.py story): an XLA runtime
+error anywhere in the pipeline — a real device abort or an injected
+``device.step`` fault (services/chaos.py) — used to kill the whole run.
+Now it flips the runner into a flagged DEGRADED mode: in-flight futures
+are abandoned, un-finished cases are re-served by the host oracle engine
+(deterministic per (seed, case, slot), though not byte-identical to the
+device stream — degraded mode trades the device's exact output for
+availability), and every DEVICE_PROBE_EVERY cases the runner probes the
+device; a successful probe resumes the device pipeline. The transition
+is visible as metrics events (device_lost / device_recovered) and the
+``degraded`` flag in metrics snapshots and the faas stats op.
 """
 
 from __future__ import annotations
@@ -49,13 +61,16 @@ import time
 
 import numpy as np
 
-from ..services import logger, metrics, out
+from ..services import chaos, logger, metrics, out
 from . import feedback as fb
 from .assembler import materialize, plan_buckets
 from .energy import EnergyScheduler
 from .store import CorpusStore
 
 PIPELINES = ("sync", "async")
+
+# degraded mode probes the device for recovery every N cases
+DEVICE_PROBE_EVERY = 4
 
 
 def _out_hash(data: bytes) -> bytes:
@@ -71,15 +86,23 @@ class _DrainWorker:
     re-raised in the MAIN thread (from wait_done/close) — a dead drain
     must fail the run, not silently stop consuming."""
 
-    def __init__(self, process, start_case: int):
+    def __init__(self, process, start_case: int, discard=None):
         self._process = process
+        self._discard = discard  # best-effort cleanup for abandoned items
         self._q: queue.Queue = queue.Queue()
         self._cv = threading.Condition()
         self._done_case = start_case - 1
+        self._abandoned = False
         self.error: BaseException | None = None
         self._t = threading.Thread(target=self._run, name="corpus-drain",
                                    daemon=True)
         self._t.start()
+
+    @property
+    def done_case(self) -> int:
+        """Highest case whose events/writes have fully landed."""
+        with self._cv:
+            return self._done_case
 
     def submit(self, item):
         metrics.GLOBAL.record_drain_backlog(self._q.qsize() + 1)
@@ -95,7 +118,8 @@ class _DrainWorker:
     def wait_done(self, case: int):
         """Block until `case`'s events are applied (or the worker died)."""
         with self._cv:
-            while self._done_case < case and self.error is None:
+            while (self._done_case < case and self.error is None
+                   and not self._abandoned):
                 self._cv.wait()
         if self.error is not None:
             raise self.error
@@ -105,6 +129,12 @@ class _DrainWorker:
             item = self._q.get()
             if item is None:
                 return
+            if self._abandoned:
+                # flush the queue: its futures are poisoned, but settle
+                # them best-effort so no async work trails the fallback
+                if self._discard is not None:
+                    self._discard(item)
+                continue
             try:
                 self._process(item)
             except BaseException as e:  # noqa: BLE001 — surfaced to main
@@ -112,6 +142,16 @@ class _DrainWorker:
                     self.error = e
                     self._cv.notify_all()
                 return
+
+    def abandon(self):
+        """Detach on device loss: stop at the next queue item, swallow the
+        (already-diagnosed) error, wake any waiter. The un-processed
+        cases are the caller's to re-serve (done_case marks the last one
+        whose effects landed)."""
+        with self._cv:
+            self._abandoned = True
+            self._cv.notify_all()
+        self._q.put(None)
 
     def close(self, join: bool = True):
         self._q.put(None)
@@ -130,7 +170,7 @@ def run_corpus_batch(opts: dict, batch: int = 1024) -> int:
     from ..oracle.mutations import default_mutations
     from ..ops import prng
     from ..ops.buffers import Batch, scan_bound, unpack
-    from ..ops.pipeline import make_class_fuzzer, step_async
+    from ..ops.pipeline import is_device_error, make_class_fuzzer, step_async
     from ..ops.registry import DEVICE_CODES
     from ..ops.scheduler import init_scores
     from ..services.checkpoint import (load_corpus_energies, load_state,
@@ -143,6 +183,14 @@ def run_corpus_batch(opts: dict, batch: int = 1024) -> int:
     use_async = pipeline == "async"
 
     store = CorpusStore(opts["corpus_dir"])
+    # recovery fsck: a previous crash can leave corpus.json and seeds/
+    # disagreeing (entries without files, orphaned/corrupt files) — heal
+    # the store before the scheduler indexes into it
+    fsck = store.fsck()
+    if fsck["missing"] or fsck["corrupt"] or fsck["orphans"]:
+        print(f"# corpus fsck: {fsck['ok']} ok, {fsck['missing']} missing, "
+              f"{fsck['corrupt']} corrupt, {fsck['orphans']} orphaned",
+              file=sys.stderr)
     direct = opts.get("corpus")
     if direct is not None:
         # in-process callers (bench corpus stage, tests) hand seeds over
@@ -255,6 +303,7 @@ def run_corpus_batch(opts: dict, batch: int = 1024) -> int:
             t_a = time.perf_counter()
             b = materialize(plan, samples)
             t_d = time.perf_counter()
+            chaos.fault_point("device.step")
             # keys derive from the SLOT position (0..batch-1) so a
             # sample's stream is a pure function of (seed, case, slot)
             # no matter how the buckets partition the batch; pad rows get
@@ -295,12 +344,72 @@ def run_corpus_batch(opts: dict, batch: int = 1024) -> int:
 
     drain: _DrainWorker | None = None
 
+    def finish_case(case, ids, results, ckpt_scores, device_seconds):
+        """The order-dependent tail every case runs — hashing (slot walk
+        0..batch-1, identical in sync/async/degraded), energy events, bus
+        drain, writes and checkpointing — shared by the device drain path
+        and the degraded oracle path."""
+        # novelty feedback: a never-seen output hash is the cheap
+        # stand-in for new coverage — the source seed earns energy
+        t_h = time.perf_counter()
+        case_bytes = 0
+        for slot in range(batch):
+            payload = results.get(slot, b"")
+            case_bytes += len(payload)
+            h = _out_hash(payload)
+            if h not in seen_hashes:
+                seen_hashes.add(h)
+                tallies["new_hashes"] += 1
+                store.apply_event(fb.Event("new_hash", ids[slot]))
+        tallies["total"] += len(results)
+        metrics.GLOBAL.record_stage("hash", time.perf_counter() - t_h)
+        metrics.GLOBAL.record_batch(len(results), case_bytes,
+                                    device_seconds)
+
+        # external feedback (monitors/proxy/faas) folds in at the case
+        # boundary; anonymous events credit this case's seeds
+        if consume_feedback:
+            credit = sorted(set(ids))
+            for ev in bus.drain():
+                store.apply_event(ev, credit=credit)
+                logger.log("decision", "corpus: %s event from %s -> "
+                           "energy feedback", ev.kind, ev.source or "?")
+
+        ckpt = state_path and ((case + 1 - start_case) % ckpt_every == 0
+                               or case + 1 == n_cases)
+        if not ckpt and drain is not None:
+            # energies are final for this case and no checkpoint pins
+            # this case's store state: unblock the next schedule NOW so
+            # writes below overlap the next case's dispatch
+            drain.mark_done(case)
+
+        t_o = time.perf_counter()
+        for slot in range(batch):
+            payload = results.get(slot, b"")
+            if writer is not None:
+                writer(case * batch + slot, payload, [])
+            else:
+                sys.stdout.buffer.write(payload)
+        metrics.GLOBAL.record_stage("write", time.perf_counter() - t_o)
+        if stats is not None:
+            stats.setdefault("finish_times", []).append(time.perf_counter())
+        if ckpt:
+            # writes land BEFORE the checkpoint marks the case done (a
+            # resumed run must not skip a case whose outputs never hit
+            # disk), and the checkpoint lands before the next schedule
+            # records its hits (else resume would double-count them)
+            save_state(state_path, opts["seed"], case + 1,
+                       np.asarray(ckpt_scores),
+                       corpus_energies=store.energies())
+            store.save()
+            if drain is not None:
+                drain.mark_done(case)
+
     def process_case(work: _CaseWork):
-        """Force one case's futures to host, then the order-dependent
-        tail: hashing (bucket dispatch order is fixed, slot walk is
-        0..batch-1 — identical in sync and async), energy events, bus
-        drain, writes and checkpointing. Runs inline in sync mode, on
-        the drain worker in async mode."""
+        """Force one case's futures to host, then finish_case's
+        order-dependent tail (bucket dispatch order is fixed — identical
+        in sync and async). Runs inline in sync mode, on the drain worker
+        in async mode."""
         case, ids, launched = work.case, work.ids, work.launched
         results: dict[int, bytes] = {}
         t_w = time.perf_counter()
@@ -332,90 +441,140 @@ def run_corpus_batch(opts: dict, batch: int = 1024) -> int:
             )
         drain_wait_s = time.perf_counter() - t_w
         metrics.GLOBAL.record_stage("drain_wait", drain_wait_s)
+        finish_case(case, ids, results, work.scores,
+                    work.dispatch_s + drain_wait_s)
 
-        # novelty feedback: a never-seen output hash is the cheap
-        # stand-in for new coverage — the source seed earns energy
-        t_h = time.perf_counter()
-        case_bytes = 0
-        for slot in range(batch):
-            payload = results.get(slot, b"")
-            case_bytes += len(payload)
-            h = _out_hash(payload)
-            if h not in seen_hashes:
-                seen_hashes.add(h)
-                tallies["new_hashes"] += 1
-                store.apply_event(fb.Event("new_hash", ids[slot]))
-        tallies["total"] += len(results)
-        metrics.GLOBAL.record_stage("hash", time.perf_counter() - t_h)
-        metrics.GLOBAL.record_batch(len(results), case_bytes,
-                                    work.dispatch_s + drain_wait_s)
+    def _scores_to_host(sc):
+        """Pull the score table off a possibly-dead device; if even the
+        copy-out fails, degraded cases keep scheduling from a fresh
+        zero table (energies, the feedback state that matters, live on
+        the host store and survive regardless)."""
+        try:
+            return np.asarray(sc)
+        except Exception:
+            return np.zeros((batch, len(DEVICE_CODES)), np.int32)
 
-        # external feedback (monitors/proxy/faas) folds in at the case
-        # boundary; anonymous events credit this case's seeds
-        if consume_feedback:
-            credit = sorted(set(ids))
-            for ev in bus.drain():
-                store.apply_event(ev, credit=credit)
-                logger.log("decision", "corpus: %s event from %s -> "
-                           "energy feedback", ev.kind, ev.source or "?")
+    def _oracle_case(case, ids):
+        """Host-oracle re-serve of one case: deterministic per
+        (seed, case, slot) — availability at the cost of device-stream
+        byte-identity (the degraded-mode trade documented in README)."""
+        from ..oracle.engine import fuzz as oracle_fuzz
 
-        ckpt = state_path and ((case + 1 - start_case) % ckpt_every == 0
-                               or case + 1 == n_cases)
-        if not ckpt and drain is not None:
-            # energies are final for this case and no checkpoint pins
-            # this case's store state: unblock the next schedule NOW so
-            # writes below overlap the next case's dispatch
-            drain.mark_done(case)
+        a1, a2, a3 = opts["seed"]
+        muta = opts.get("mutations") or default_mutations()
+        results: dict[int, bytes] = {}
+        t_w = time.perf_counter()
+        for slot, sid in enumerate(ids):
+            data = store.get(sid)[:device_max]
+            results[slot] = oracle_fuzz(
+                data, seed=(a1 + case, a2 + slot, a3), mutations=muta,
+            )
+        metrics.GLOBAL.record_stage("oracle_fallback",
+                                    time.perf_counter() - t_w)
+        return results
 
-        def write_outputs():
-            t_o = time.perf_counter()
-            for slot in range(batch):
-                payload = results.get(slot, b"")
-                if writer is not None:
-                    writer(case * batch + slot, payload, [])
-                else:
-                    sys.stdout.buffer.write(payload)
-            metrics.GLOBAL.record_stage("write", time.perf_counter() - t_o)
+    def _probe_device():
+        """One tiny forced device op. The chaos fault point runs first so
+        a still-armed persistent device.step spec keeps probes failing —
+        recovery happens exactly when the (real or injected) fault
+        clears."""
+        chaos.fault_point("device.step")
+        jnp.zeros(8).block_until_ready()
 
-        write_outputs()
-        if stats is not None:
-            stats.setdefault("finish_times", []).append(time.perf_counter())
-        if ckpt:
-            # writes land BEFORE the checkpoint marks the case done (a
-            # resumed run must not skip a case whose outputs never hit
-            # disk), and the checkpoint lands before the next schedule
-            # records its hits (else resume would double-count them)
-            save_state(state_path, opts["seed"], case + 1,
-                       np.asarray(work.scores),
-                       corpus_energies=store.energies())
-            store.save()
-            if drain is not None:
-                drain.mark_done(case)
+    def _discard_work(work):
+        from ..ops.pipeline import drain_futures
+
+        drain_futures(fut for _b, fut in work.launched)
 
     if use_async:
-        drain = _DrainWorker(process_case, start_case)
+        drain = _DrainWorker(process_case, start_case,
+                             discard=_discard_work)
+    drain_floor = start_case  # first case the current drain may wait on
+    device_mode = True
+    probe_at = 0
 
     t0 = time.perf_counter()
     try:
-        for case in range(start_case, n_cases):
-            if drain is not None and case > start_case:
-                # the -s contract's one serialization point: case N's
-                # energy events must land before schedule N+1 draws
-                drain.wait_done(case - 1)
-            ids, launched, scores, dispatch_s = dispatch_case(case, scores)
-            if stats is not None:
-                stats.setdefault("schedules", []).append(list(ids))
-            work = _CaseWork(case, ids, launched, scores, dispatch_s)
-            if drain is not None:
-                drain.submit(work)
+        case = start_case
+        while case < n_cases:
+            if device_mode:
+                try:
+                    if drain is not None and case > drain_floor:
+                        # the -s contract's one serialization point: case
+                        # N's energy events must land before schedule N+1
+                        # draws
+                        drain.wait_done(case - 1)
+                    ids, launched, scores, dispatch_s = dispatch_case(
+                        case, scores
+                    )
+                    if stats is not None:
+                        stats.setdefault("schedules", []).append(list(ids))
+                    work = _CaseWork(case, ids, launched, scores, dispatch_s)
+                    if drain is not None:
+                        drain.submit(work)
+                    else:
+                        process_case(work)
+                    case += 1
+                    if case == n_cases and drain is not None:
+                        # inside the try: a device error surfacing only at
+                        # the final drain still degrades and re-serves the
+                        # tail instead of killing the run
+                        drain.close()
+                        drain = None
+                except Exception as e:  # noqa: BLE001 — filtered below
+                    if not is_device_error(e):
+                        raise
+                    # device lost: flag degraded, abandon in-flight work,
+                    # rewind to the first case whose effects never landed
+                    # (done_case tracks the drain's progress; its writes
+                    # are host-side and complete per case)
+                    redo_from = case
+                    if drain is not None:
+                        redo_from = min(case, drain.done_case + 1)
+                        drain.abandon()
+                        drain = None
+                    logger.log("warning", "corpus: device lost at case %d "
+                               "(%s) — host oracle serves from case %d",
+                               case, e, redo_from)
+                    metrics.GLOBAL.record_event("device_lost")
+                    metrics.GLOBAL.set_degraded(True)
+                    scores = _scores_to_host(scores)
+                    case = redo_from
+                    device_mode = False
+                    probe_at = case + DEVICE_PROBE_EVERY
             else:
-                process_case(work)
-        if drain is not None:
-            drain.close()
-            drain = None
+                if case >= probe_at:
+                    probe_at = case + DEVICE_PROBE_EVERY
+                    try:
+                        _probe_device()
+                    except Exception:
+                        pass  # still down; keep serving from the oracle
+                    else:
+                        logger.log("warning", "corpus: device recovered at "
+                                   "case %d — resuming device pipeline",
+                                   case)
+                        metrics.GLOBAL.record_event("device_recovered")
+                        metrics.GLOBAL.set_degraded(False)
+                        device_mode = True
+                        if use_async:
+                            scores = jnp.asarray(scores)
+                            drain = _DrainWorker(process_case, case,
+                                                 discard=_discard_work)
+                            drain_floor = case
+                        continue
+                t_s = time.perf_counter()
+                ids = sched.schedule(case, batch)
+                metrics.GLOBAL.record_stage("schedule",
+                                            time.perf_counter() - t_s)
+                if stats is not None:
+                    stats.setdefault("schedules", []).append(list(ids))
+                finish_case(case, ids, _oracle_case(case, ids), scores, 0.0)
+                case += 1
     finally:
         if drain is not None:
-            drain.close(join=False)
+            # abandon, not close: close re-raises the drain error and
+            # would mask the exception already unwinding through here
+            drain.abandon()
 
     store.save()
     dt = time.perf_counter() - t0

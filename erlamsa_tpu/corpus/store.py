@@ -24,10 +24,17 @@ import json
 import os
 import threading
 
-from ..services import logger
+from ..services import chaos, logger
+from ..services.resilience import RetryExhausted, RetryPolicy
 from .feedback import EVENT_GAIN, Event
 
 STORE_VERSION = 1
+
+# metadata saves are frequent and cheap; one quick retry absorbs a
+# transient disk error (or an injected store.save fault) so persistence
+# actually happens instead of silently best-efforting into the void
+SAVE_RETRY = RetryPolicy(attempts=2, base=0.01, max_delay=0.1,
+                         retry_on=(OSError,))
 
 INIT_ENERGY = 1.0
 MIN_ENERGY = 0.25
@@ -57,28 +64,60 @@ class CorpusStore:
     # --- persistence (cmanager.py idiom: atomic, best-effort) ------------
 
     def _load(self):
-        if not os.path.exists(self.meta_path):
-            return
-        try:
-            with open(self.meta_path) as f:
-                st = json.load(f)
-            self._meta = dict(st.get("seeds", {}))
-            self._next_idx = max(
-                (m.get("idx", 0) + 1 for m in self._meta.values()), default=0
-            )
-        except (OSError, ValueError) as e:
-            logger.log("warning", "corpus store %s unreadable (%s); "
-                       "starting empty", self.meta_path, e)
+        for candidate in (self.meta_path, self.meta_path + ".bak"):
+            if not os.path.exists(candidate):
+                continue
+            try:
+                with open(candidate) as f:
+                    st = json.load(f)
+                self._meta = dict(st.get("seeds", {}))
+                self._next_idx = max(
+                    (m.get("idx", 0) + 1 for m in self._meta.values()),
+                    default=0,
+                )
+                if candidate != self.meta_path:
+                    logger.log("warning", "corpus store %s unusable; "
+                               "recovered from backup %s", self.meta_path,
+                               candidate)
+                return
+            except (OSError, ValueError) as e:
+                logger.log("warning", "corpus store %s unreadable (%s)",
+                           candidate, e)
+        if os.path.exists(self.meta_path):
+            logger.log("warning", "corpus store %s: no usable snapshot; "
+                       "starting empty", self.meta_path)
 
     def _save_locked(self):
-        """Caller holds self._lock. Atomic: a kill mid-save must never
-        corrupt the previous snapshot (checkpoint.py contract)."""
+        """Caller holds self._lock. Atomic AND durable: tmp is fsynced
+        before the rename publishes it (a power loss after os.replace
+        must not leave a truncated corpus.json — "atomic" against process
+        kills alone is not durability), the previous snapshot survives as
+        .bak, and the directory entry is fsynced so the rename itself
+        sticks. Transient write errors get one retry; a persistently
+        failing disk degrades to best-effort (the live store stays
+        valid)."""
         tmp = self.meta_path + ".tmp"
-        try:
+        blob = json.dumps({"version": STORE_VERSION, "seeds": self._meta})
+
+        def _write():
+            chaos.fault_point("store.save")
             with open(tmp, "w") as f:
-                json.dump({"version": STORE_VERSION, "seeds": self._meta}, f)
+                f.write(blob)
+                f.flush()
+                os.fsync(f.fileno())
+            if os.path.exists(self.meta_path):
+                try:
+                    os.replace(self.meta_path, self.meta_path + ".bak")
+                except OSError:
+                    pass
             os.replace(tmp, self.meta_path)
-        except OSError:
+            from ..services.checkpoint import fsync_dir
+
+            fsync_dir(self.meta_path)
+
+        try:
+            SAVE_RETRY.call(_write, site="store.save")
+        except (RetryExhausted, OSError):
             pass  # persistence is best-effort; the live store stays valid
 
     def save(self):
@@ -103,6 +142,8 @@ class CorpusStore:
                 tmp = path + ".tmp"
                 with open(tmp, "wb") as f:
                     f.write(data)
+                    f.flush()
+                    os.fsync(f.fileno())
                 os.replace(tmp, path)
             self._meta[sid] = {
                 "idx": self._next_idx,
@@ -142,6 +183,89 @@ class CorpusStore:
             else:
                 dup += 1
         return new, dup, skipped
+
+    def fsck(self, adopt_orphans: bool = True) -> dict:
+        """Recovery pass: reconcile corpus.json against seeds/.
+
+        - metadata entries whose seed file is missing are dropped (a
+          schedule would crash reading them);
+        - seed files whose content no longer matches their content-hash
+          name are CORRUPT: quarantined to <root>/quarantine/ and dropped
+          from the metadata;
+        - seed files with no metadata entry (orphans — e.g. a crash
+          between the file write and the corpus.json save) are adopted
+          back into the store, or quarantined with adopt_orphans=False.
+
+        Returns {"missing": n, "corrupt": n, "orphans": n, "ok": n} and
+        persists the reconciled metadata when anything changed. Leftover
+        .tmp files from torn writes are removed."""
+        qdir = os.path.join(self.root, "quarantine")
+        missing = corrupt = orphans = 0
+        orphan_data: list[bytes] = []
+        with self._lock:
+            try:
+                on_disk = set(os.listdir(self.seeds_dir))
+            except OSError:
+                on_disk = set()
+            for name in sorted(on_disk):
+                path = os.path.join(self.seeds_dir, name)
+                if name.endswith(".tmp"):
+                    # torn write: the content it was renaming to is either
+                    # published under its hash or lost — never both
+                    try:
+                        os.unlink(path)
+                    except OSError:
+                        pass
+                    on_disk.discard(name)
+                    continue
+                try:
+                    with open(path, "rb") as f:
+                        data = f.read()
+                except OSError:
+                    continue
+                if seed_id_for(data) != name:
+                    corrupt += 1
+                    os.makedirs(qdir, exist_ok=True)
+                    try:
+                        os.replace(path, os.path.join(qdir, name))
+                    except OSError:
+                        pass
+                    self._meta.pop(name, None)
+                    self._cache.pop(name, None)
+                    on_disk.discard(name)
+                    logger.log("warning", "corpus fsck: %s corrupt "
+                               "(content/hash mismatch), quarantined", name)
+                elif name not in self._meta:
+                    orphans += 1
+                    if adopt_orphans:
+                        orphan_data.append(data)
+                    else:
+                        os.makedirs(qdir, exist_ok=True)
+                        try:
+                            os.replace(path, os.path.join(qdir, name))
+                        except OSError:
+                            pass
+            for sid in [s for s in self._meta if s not in on_disk]:
+                missing += 1
+                del self._meta[sid]
+                self._cache.pop(sid, None)
+                logger.log("warning", "corpus fsck: %s in corpus.json but "
+                           "its seed file is gone; entry dropped", sid)
+            changed = bool(missing or corrupt
+                           or (orphans and not adopt_orphans))
+            if changed:
+                self._save_locked()
+        # adoption re-enters through add() (it takes the lock itself)
+        for data in orphan_data:
+            self.add(data, origin="fsck-orphan")
+        ok = len(self._meta)
+        summary = {"missing": missing, "corrupt": corrupt,
+                   "orphans": orphans, "ok": ok}
+        if missing or corrupt or orphans:
+            logger.log("info", "corpus fsck: %d ok, %d missing, %d corrupt "
+                       "quarantined, %d orphan(s) %s", ok, missing, corrupt,
+                       orphans, "adopted" if adopt_orphans else "quarantined")
+        return summary
 
     def get(self, seed_id: str) -> bytes:
         data = self._cache.get(seed_id)

@@ -85,6 +85,43 @@ def step_async(step, *args, **kwargs) -> StepFuture:
     return StepFuture(data, lens, scores, meta)
 
 
+def is_device_error(exc: BaseException) -> bool:
+    """Did this exception come from the device runtime (XLA abort, device
+    OOM, interconnect loss) rather than from host code? The classifier
+    the corpus runner's device-loss degradation keys on
+    (corpus/runner.py): a device error triggers the host-oracle fallback;
+    anything else propagates as a real bug.
+
+    Injected ``device.step`` faults (services/chaos.py) count as device
+    errors by contract — that is exactly the failure they simulate."""
+    site = getattr(exc, "site", None)
+    if site == "device.step":
+        return True
+    try:
+        from jax.errors import JaxRuntimeError
+
+        if isinstance(exc, JaxRuntimeError):
+            return True
+    except ImportError:  # older jax spellings fall through to name match
+        pass
+    # XlaRuntimeError's module path has moved across jax releases; match
+    # structurally instead of chasing it
+    name = type(exc).__name__
+    return name in ("XlaRuntimeError", "InternalError", "ResourceExhausted",
+                    "DeviceError")
+
+
+def drain_futures(futures) -> None:
+    """Best-effort force of in-flight StepFutures so their buffers settle
+    before a fallback path reuses the device (or gives up on it). Errors
+    are swallowed — the caller already knows the device is sick."""
+    for fut in futures:
+        try:
+            fut.block()
+        except BaseException:
+            pass
+
+
 def _shift_left(data, n, s):
     """Drop the first s bytes (suffix to offset 0)."""
     L = data.shape[0]

@@ -17,8 +17,15 @@ import time
 from dataclasses import dataclass, field
 
 from ..utils.erlrand import gen_urandom_seed
-from . import metrics
+from . import chaos, metrics
+from .resilience import RetryPolicy
 from .supervisor import supervise
+
+# one transient device hiccup (a preempted step, an injected
+# batcher.step fault) must not cost the collected requests their
+# answers; a second failure falls through to the supervisor restart
+STEP_RETRY = RetryPolicy(attempts=2, base=0.02, max_delay=0.2,
+                         retry_on=(Exception,))
 
 
 @dataclass
@@ -201,9 +208,19 @@ class TpuBatcher:
                 pad = [b"\x00"] * (self.batch - len(seeds))
                 packed = pack(seeds + pad, capacity=self.capacity)
                 t0 = time.monotonic()
-                data, lens, self._scores, _meta = self._step(
-                    self._base, self._case, packed.data, packed.lens,
-                    self._scores,
+
+                def _step_once():
+                    # retry is only sound while inputs survive a failed
+                    # attempt: donation invalidates buffers on SUCCESS,
+                    # and a dispatch that raised never consumed them
+                    chaos.fault_point("batcher.step")
+                    return self._step(
+                        self._base, self._case, packed.data, packed.lens,
+                        self._scores,
+                    )
+
+                data, lens, self._scores, _meta = STEP_RETRY.call(
+                    _step_once, site="batcher.step",
                 )
                 self._case += 1
                 self.flushes += 1

@@ -1,0 +1,193 @@
+"""Deterministic fault injection: named fault sites, replayable firings.
+
+The reference never needed a fault injector — OTP supervision was
+exercised daily by real crashes. This port's resilience paths (retry,
+failover, degraded mode; services/resilience.py) would otherwise only run
+in production, so every guarded operation passes through a *named fault
+site* and an injector decides, deterministically, whether that invocation
+fails. The derivation mirrors ops/prng.py's counter philosophy: a firing
+is a pure function of (chaos seed, site, invocation counter) — never of
+wall clock or thread timing — so the same spec + seed replays the same
+failure sequence, and a failure found under chaos is a unit test, not a
+flake.
+
+Spec grammar (``ERLAMSA_FAULTS`` env var or ``--chaos``)::
+
+    spec     := clause ("," clause)*
+    clause   := site ":" mode
+    mode     := "x" N          fail invocations 1..N of the site, then heal
+              | "s" K "x" N    skip the first K invocations, fail the next N
+              | "p" F          each invocation fails with probability F,
+                               drawn from hash(seed, site, counter)
+              | "*"            every invocation fails (persistent fault)
+
+    e.g.  ERLAMSA_FAULTS="dist.send:x2,store.save:x1"
+          ERLAMSA_FAULTS="device.step:*"
+          ERLAMSA_FAULTS="dist.recv:p0.25"
+
+Known sites (grep `fault_point(` for the authoritative list):
+
+    dist.send        parent->node request transmission (services/dist.py)
+    dist.recv        node response parse (services/dist.py)
+    batcher.step     TpuBatcher's jitted device call (services/batcher.py)
+    store.save       corpus.json snapshot write (corpus/store.py)
+    device.step      corpus runner's bucket dispatch (corpus/runner.py)
+    checkpoint.load  --state checkpoint read (services/checkpoint.py)
+
+Injected failures raise ``InjectedFault``, an OSError subclass, so they
+flow through exactly the except-clauses that catch real socket/disk
+errors — the resilience paths cannot special-case them. ``device.step``
+faults are additionally recognized by ops/pipeline.is_device_error so the
+runner's device-loss degradation treats them like an XLA abort.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import threading
+
+
+class InjectedFault(OSError):
+    """A chaos-injected failure. OSError subclass by design: real fault
+    handlers (socket retries, best-effort saves) must catch it without
+    knowing chaos exists."""
+
+    def __init__(self, site: str, invocation: int):
+        super().__init__(f"chaos: injected fault at {site} "
+                         f"(invocation {invocation})")
+        self.site = site
+        self.invocation = invocation
+
+
+class _Clause:
+    __slots__ = ("site", "mode", "skip", "count", "prob")
+
+    def __init__(self, site: str, mode: str, skip: int = 0,
+                 count: int = 0, prob: float = 0.0):
+        self.site = site
+        self.mode = mode  # "count" | "prob" | "always"
+        self.skip = skip
+        self.count = count
+        self.prob = prob
+
+    def fires(self, seed: int, invocation: int) -> bool:
+        if self.mode == "always":
+            return True
+        if self.mode == "count":
+            return self.skip < invocation <= self.skip + self.count
+        # prob: counter-keyed draw — sha256(seed:site:counter) as a
+        # fraction in [0, 1); same invocation always draws the same bit
+        h = hashlib.sha256(
+            f"{seed}:{self.site}:{invocation}".encode()
+        ).digest()
+        frac = int.from_bytes(h[:8], "big") / float(1 << 64)
+        return frac < self.prob
+
+
+def parse_spec(spec: str) -> dict[str, _Clause]:
+    """Parse the fault spec grammar; raises ValueError on a bad spec
+    (a typo'd chaos spec must abort the run, not silently inject
+    nothing)."""
+    clauses: dict[str, _Clause] = {}
+    for raw in spec.split(","):
+        raw = raw.strip()
+        if not raw:
+            continue
+        site, sep, mode = raw.partition(":")
+        site = site.strip()
+        mode = mode.strip()
+        if not sep or not site or not mode:
+            raise ValueError(f"chaos clause {raw!r}: want site:mode")
+        if mode == "*":
+            clauses[site] = _Clause(site, "always")
+        elif mode.startswith("p"):
+            p = float(mode[1:])
+            if not 0.0 <= p <= 1.0:
+                raise ValueError(f"chaos clause {raw!r}: probability "
+                                 f"must be in [0, 1]")
+            clauses[site] = _Clause(site, "prob", prob=p)
+        elif mode.startswith("s"):
+            k, x, n = mode[1:].partition("x")
+            if not x:
+                raise ValueError(f"chaos clause {raw!r}: want sKxN")
+            clauses[site] = _Clause(site, "count", skip=int(k),
+                                    count=int(n))
+        elif mode.startswith("x"):
+            clauses[site] = _Clause(site, "count", count=int(mode[1:]))
+        else:
+            raise ValueError(f"chaos clause {raw!r}: unknown mode "
+                             f"{mode!r} (want xN, sKxN, pF or *)")
+    return clauses
+
+
+class ChaosInjector:
+    """One armed fault spec. Per-site invocation counters advance on
+    every check (fired or not), so a firing is addressable as
+    (seed, site, invocation) — the replay coordinate."""
+
+    def __init__(self, spec: str, seed: int = 0):
+        self.spec = spec
+        self.seed = int(seed)
+        self._clauses = parse_spec(spec)
+        self._lock = threading.Lock()
+        self._invocations: dict[str, int] = {}
+        self.fired: dict[str, int] = {}
+
+    def check(self, site: str) -> None:
+        """Count one invocation of `site`; raise InjectedFault when the
+        spec says this invocation fails."""
+        clause = self._clauses.get(site)
+        if clause is None:
+            return
+        with self._lock:
+            n = self._invocations.get(site, 0) + 1
+            self._invocations[site] = n
+            fire = clause.fires(self.seed, n)
+            if fire:
+                self.fired[site] = self.fired.get(site, 0) + 1
+        if fire:
+            from . import logger, metrics
+
+            metrics.GLOBAL.record_fault(site)
+            logger.log("warning", "chaos: injected fault at %s "
+                       "(invocation %d)", site, n)
+            raise InjectedFault(site, n)
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {"spec": self.spec, "seed": self.seed,
+                    "invocations": dict(self._invocations),
+                    "fired": dict(self.fired)}
+
+
+_ACTIVE: ChaosInjector | None = None
+
+
+def configure(spec: str | None, seed: int = 0) -> ChaosInjector | None:
+    """Arm (or, with a falsy spec, disarm) the process-wide injector.
+    Returns the armed injector."""
+    global _ACTIVE
+    _ACTIVE = ChaosInjector(spec, seed) if spec else None
+    return _ACTIVE
+
+
+def configure_from_env(seed: int = 0) -> ChaosInjector | None:
+    """Arm from ERLAMSA_FAULTS when set; leaves an already-armed injector
+    alone so --chaos wins over the environment."""
+    if _ACTIVE is None:
+        spec = os.environ.get("ERLAMSA_FAULTS")
+        if spec:
+            return configure(spec, seed)
+    return _ACTIVE
+
+
+def active() -> ChaosInjector | None:
+    return _ACTIVE
+
+
+def fault_point(site: str) -> None:
+    """THE hook guarded code calls. Free when no injector is armed."""
+    inj = _ACTIVE
+    if inj is not None:
+        inj.check(site)

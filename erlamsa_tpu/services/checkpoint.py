@@ -12,8 +12,11 @@ stop and resume exactly.
 from __future__ import annotations
 
 import os
+import zlib
 
 import numpy as np
+
+from . import chaos, logger
 
 
 def _engine_stamp(engine: str = "fused") -> np.ndarray:
@@ -27,6 +30,32 @@ def _engine_stamp(engine: str = "fused") -> np.ndarray:
 
     pallas = os.environ.get("ERLAMSA_PALLAS", "0")
     return np.asarray(f"{engine}/pallas{pallas}/M{NUM_DEVICE_MUTATORS}", "U32")
+
+
+def _checksum(fields: dict) -> np.ndarray:
+    """crc32 over every field's raw bytes in key order: cheap end-to-end
+    integrity for the whole checkpoint (npz's per-member zlib CRCs don't
+    catch a member silently missing or a short write of the directory)."""
+    crc = 0
+    for k in sorted(fields):
+        if k == "checksum":
+            continue
+        crc = zlib.crc32(k.encode(), crc)
+        crc = zlib.crc32(np.asarray(fields[k]).tobytes(), crc)
+    return np.asarray(crc & 0xFFFFFFFF, np.uint32)
+
+
+def fsync_dir(path: str) -> None:
+    """fsync the directory holding `path` so the rename that published it
+    is itself durable (shared with corpus/store.py)."""
+    try:
+        dfd = os.open(os.path.dirname(os.path.abspath(path)), os.O_RDONLY)
+        try:
+            os.fsync(dfd)
+        finally:
+            os.close(dfd)
+    except OSError:
+        pass
 
 
 def save_state(path: str, seed, case_idx: int, scores,
@@ -74,6 +103,7 @@ def save_state(path: str, seed, case_idx: int, scores,
                 [int(corpus_energies[s][1]) for s in ce_ids], np.int64
             ),
         )
+    fields["checksum"] = _checksum(fields)
     with open(tmp, "wb") as f:
         np.savez(f, **fields)
         # data must be durable BEFORE the rename publishes it, or a crash
@@ -81,47 +111,95 @@ def save_state(path: str, seed, case_idx: int, scores,
         # silently restarts from case 0
         f.flush()
         os.fsync(f.fileno())
-    os.replace(tmp, path)
-    try:
-        dfd = os.open(os.path.dirname(os.path.abspath(path)), os.O_RDONLY)
+    # keep the previous good checkpoint as .bak: the loaders fall back to
+    # it when the primary turns out corrupt (torn disk, fs bug) — a run
+    # then resumes a few cases earlier instead of restarting from 0
+    if os.path.exists(path):
         try:
-            os.fsync(dfd)
-        finally:
-            os.close(dfd)
-    except OSError:
-        pass
+            os.replace(path, path + ".bak")
+        except OSError:
+            pass
+    os.replace(tmp, path)
+    fsync_dir(path)
+
+
+def _read_verified(path: str) -> dict | None:
+    """Materialize one checkpoint file's fields, verifying the whole-file
+    checksum when present (pre-checksum files pass — their contract was
+    weaker but real). Raises on unreadable/corrupt input; the caller
+    decides whether a fallback exists."""
+    chaos.fault_point("checkpoint.load")
+    with np.load(path) as z:
+        fields = {k: z[k].copy() for k in z.files}
+    if "checksum" in fields:
+        want = int(fields["checksum"])
+        got = int(_checksum(fields))
+        if want != got:
+            raise ValueError(
+                f"checkpoint {path}: checksum mismatch "
+                f"(stored {want:#010x}, computed {got:#010x})"
+            )
+    return fields
+
+
+def _load_fields(path: str, engine: str) -> dict | None:
+    """Load the primary checkpoint, falling back to .bak when the primary
+    is unreadable or fails its checksum. None when neither is usable or
+    the stamp names a different engine/pallas-level/registry (a stampless
+    file is by definition pre-r5: its stream ran the 25-mutator registry
+    and cannot resume bit-faithfully either)."""
+    fields = None
+    for candidate in (path, path + ".bak"):
+        try:
+            fields = _read_verified(candidate)
+            if candidate != path:
+                from . import metrics
+
+                metrics.GLOBAL.record_event("checkpoint_bak_fallback")
+                logger.log("warning", "checkpoint %s unusable, resumed "
+                           "from backup %s", path, candidate)
+            break
+        except Exception as e:
+            if candidate == path:
+                logger.log("warning", "checkpoint %s unreadable (%s), "
+                           "trying backup", path, e)
+            fields = None
+    if fields is None:
+        return None
+    if "engine" not in fields or str(fields["engine"]) != str(
+        _engine_stamp(engine)
+    ):
+        return None
+    return fields
 
 
 def load_state(path: str, engine: str = "fused"):
     """-> (seed tuple, case_idx, scores ndarray, host_scores dict,
-    host_scores_post dict), or None when the file is unreadable/corrupt
-    OR was written under a different engine/pallas-level/registry (the
-    stream is only reproducible per-engine — callers start fresh).
-    Older files without the post state fall back to the pre state."""
+    host_scores_post dict), or None when the file (and its .bak) is
+    unreadable/corrupt OR was written under a different engine/pallas-
+    level/registry (the stream is only reproducible per-engine — callers
+    start fresh). Older files without the post state fall back to the pre
+    state."""
     try:
-        with np.load(path) as z:
-            # a stampless file is by definition pre-r5: its stream ran the
-            # 25-mutator registry and cannot resume bit-faithfully either
-            if "engine" not in z or str(z["engine"]) != str(
-                _engine_stamp(engine)
-            ):
-                return None
-            seed = tuple(int(x) for x in z["seed"])
-            case_idx = int(z["case_idx"])
-            scores = z["scores"].copy()
-            host_scores = {}
-            if "host_codes" in z:
-                host_scores = {
-                    str(c): float(v)
-                    for c, v in zip(z["host_codes"], z["host_values"])
-                }
-            host_post = dict(host_scores)
-            if "host_codes_post" in z:
-                host_post = {
-                    str(c): float(v)
-                    for c, v in zip(z["host_codes_post"],
-                                    z["host_values_post"])
-                }
+        z = _load_fields(path, engine)
+        if z is None:
+            return None
+        seed = tuple(int(x) for x in z["seed"])
+        case_idx = int(z["case_idx"])
+        scores = z["scores"].copy()
+        host_scores = {}
+        if "host_codes" in z:
+            host_scores = {
+                str(c): float(v)
+                for c, v in zip(z["host_codes"], z["host_values"])
+            }
+        host_post = dict(host_scores)
+        if "host_codes_post" in z:
+            host_post = {
+                str(c): float(v)
+                for c, v in zip(z["host_codes_post"],
+                                z["host_values_post"])
+            }
         return seed, case_idx, scores, host_scores, host_post
     except Exception:
         return None
@@ -133,17 +211,13 @@ def load_corpus_energies(path: str, engine: str = "fused") -> dict | None:
     predates the corpus fields. Kept separate from load_state so its
     5-tuple contract (and every existing caller) stays untouched."""
     try:
-        with np.load(path) as z:
-            if "engine" not in z or str(z["engine"]) != str(
-                _engine_stamp(engine)
-            ):
-                return None
-            if "corpus_ids" not in z:
-                return None
-            return {
-                str(s): (float(e), int(h))
-                for s, e, h in zip(z["corpus_ids"], z["corpus_energy"],
-                                   z["corpus_hits"])
-            }
+        z = _load_fields(path, engine)
+        if z is None or "corpus_ids" not in z:
+            return None
+        return {
+            str(s): (float(e), int(h))
+            for s, e, h in zip(z["corpus_ids"], z["corpus_energy"],
+                               z["corpus_hits"])
+        }
     except Exception:
         return None

@@ -174,6 +174,12 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--node", default=None, help="join a parent node host:port")
     p.add_argument("--svcport", type=int, default=17771,
                    help="distribution/control port")
+    p.add_argument("--chaos", default=None, metavar="SPEC",
+                   help="deterministic fault injection spec, e.g. "
+                        "'dist.send:x2,store.save:x1' or 'device.step:*' "
+                        "(services/chaos.py; ERLAMSA_FAULTS is the env "
+                        "equivalent, --chaos wins). Replayable: the same "
+                        "spec + seed fires the same faults")
     return p
 
 
@@ -220,6 +226,19 @@ def main(argv=None) -> int:
         raise SystemExit(f"erlamsa-tpu: {e}")
     with open("./last_seed.txt", "w") as f:  # erlamsa_main.erl:135
         f.write(repr(seed))
+
+    # arm fault injection before any engine/service construction so every
+    # fault_point in the process sees the spec; chaos firings are keyed on
+    # the run seed's first component — replay = same spec + same -s
+    from . import chaos
+
+    try:
+        if args.chaos:
+            chaos.configure(args.chaos, seed=seed[0])
+        else:
+            chaos.configure_from_env(seed=seed[0])
+    except ValueError as e:
+        raise SystemExit(f"erlamsa-tpu: {e}")
 
     from ..oracle.gen import default_generators
     from ..oracle.mutations import default_mutations

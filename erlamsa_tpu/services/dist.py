@@ -12,24 +12,44 @@ node. Here the control plane is a JSON-lines TCP protocol:
 The data plane stays local to each node (its own oracle pool or TPU batch
 engine) — DCN-style corpus fan-out between hosts, device-local mutation,
 matching SURVEY.md §5.8's design obligation.
+
+Resilience (services/resilience.py): the parent's node table is
+health-scored with a per-node circuit breaker — repeated request failures
+open a node's breaker (it stops receiving traffic without waiting for the
+17s keepalive eviction), a cooled-down breaker admits one probe request,
+and a successful probe re-admits the node. route_fuzz retries each node
+and fails over across distinct nodes before falling back to local
+fuzzing, with every hop visible in metrics events. remote_fuzz raises
+ProtocolError on a malformed/missing reply — "the node failed" is an
+exception, never a forged empty fuzz result. Fault sites dist.send /
+dist.recv (services/chaos.py) make all of it deterministically testable.
 """
 
 from __future__ import annotations
 
 import base64
 import json
+import random as _pyrandom
 import socket
 import threading
 import time
 
 from ..constants import NODE_ALIVE_DELTA, NODE_KEEPALIVE, NODES_CHECKTIMER
 from ..utils.erlrand import gen_urandom_seed
-from . import logger
+from . import chaos, logger, metrics
 from .batcher import make_batcher
+from .resilience import HealthTable, RetryExhausted, RetryPolicy
 from .supervisor import supervise
 
 
+class ProtocolError(ValueError):
+    """The peer answered with garbage (or nothing): a node-side failure
+    the caller must treat as retriable, distinct from a fuzzer that
+    legitimately produced empty output."""
+
+
 def _send_json(sock: socket.socket, obj: dict):
+    chaos.fault_point("dist.send")
     sock.sendall(json.dumps(obj).encode() + b"\n")
 
 
@@ -39,6 +59,7 @@ MAX_LINE = 64 * 1024 * 1024
 
 
 def _recv_json(f) -> dict | None:
+    chaos.fault_point("dist.recv")
     line = f.readline(MAX_LINE + 1)
     if not line:
         return None
@@ -47,51 +68,56 @@ def _recv_json(f) -> dict | None:
     return json.loads(line)
 
 
+# per-node request retry: short, bounded — failover to ANOTHER node beats
+# hammering a sick one (the reference just picks a random node per call)
+NODE_RETRY = RetryPolicy(attempts=2, base=0.05, max_delay=0.5,
+                         retry_on=(OSError, ValueError))
+MAX_FAILOVER_NODES = 3  # distinct nodes tried before local fallback
+
+
 class NodePool:
     """Parent-side registry of live worker nodes
-    (erlamsa_app:loop/3, src/erlamsa_app.erl:210-246)."""
+    (erlamsa_app:loop/3, src/erlamsa_app.erl:210-246), health-scored:
+    keepalives keep a node listed, request outcomes move its score and
+    breaker, and pick() routes around open breakers."""
 
     def __init__(self):
-        self._nodes: dict[tuple[str, int], float] = {}
-        self._lock = threading.Lock()
-        import random as _pyrandom
-
         self._rng = _pyrandom.Random(str(gen_urandom_seed()))
+        # breaker cool-down ~ keepalive period: a node evicted for request
+        # failures gets its re-admission probe about when the reference
+        # would first notice it died
+        self.table = HealthTable(self._rng, failure_threshold=2,
+                                 reset_timeout=NODE_KEEPALIVE / 3.0)
         supervise("nodepool-evict", self._evict_loop)
 
     def join(self, host: str, port: int):
-        with self._lock:
-            fresh = (host, port) not in self._nodes
-            self._nodes[(host, port)] = time.time()
-        if fresh:
+        if self.table.touch((host, port)):
             logger.log("info", "node %s:%d joined", host, port)
 
     def _evict_loop(self):
         while True:
             time.sleep(NODES_CHECKTIMER)
-            now = time.time()
-            with self._lock:
-                dead = [k for k, t in self._nodes.items()
-                        if now - t > NODE_ALIVE_DELTA]
-                for k in dead:
-                    del self._nodes[k]
-                    logger.log("info", "node %s:%d evicted", *k)
+            for host, port in self.table.drop_stale(NODE_ALIVE_DELTA):
+                metrics.GLOBAL.record_event("node_evicted")
+                logger.log("info", "node %s:%d evicted (silent)", host, port)
 
-    def pick(self) -> tuple[str, int] | None:
-        """Random live node (get_free_node, src/erlamsa_app.erl:185-190)."""
-        with self._lock:
-            if not self._nodes:
-                return None
-            return self._rng.choice(list(self._nodes))
+    def pick(self, exclude=()) -> tuple[str, int] | None:
+        """A routable node (get_free_node, src/erlamsa_app.erl:185-190) —
+        healthy nodes weighted by score, open breakers skipped, one probe
+        admitted per cooled-down breaker."""
+        return self.table.pick(exclude=exclude)
+
+    def report(self, node: tuple[str, int], ok: bool):
+        self.table.report(node, ok)
 
     def count(self) -> int:
-        with self._lock:
-            return len(self._nodes)
+        return self.table.count()
 
 
 class ParentServer:
-    """Accepts joins and fuzz requests; routes requests to a random worker
-    node, falling back to local fuzzing when no nodes joined."""
+    """Accepts joins and fuzz requests; routes requests across healthy
+    worker nodes with retry + failover, falling back to local fuzzing
+    when no node can serve."""
 
     def __init__(self, port: int, opts: dict, backend: str = "oracle"):
         self.port = port
@@ -116,18 +142,44 @@ class ParentServer:
                     out = self.route_fuzz(data)
                     _send_json(conn, {"op": "result",
                                       "data": base64.b64encode(out).decode()})
-        except (OSError, ValueError):
-            pass
+        except (OSError, ValueError) as e:
+            # a dead/garbling peer must not kill the handler thread, but
+            # it must not vanish either — silent swallowing here hid every
+            # protocol bug and truncated request
+            logger.log("warning", "dist: dropping connection from %s:%d: %s",
+                       addr[0], addr[1], e)
         finally:
             conn.close()
 
-    def route_fuzz(self, data: bytes) -> bytes:
-        node = self.pool.pick()
-        if node is not None:
+    def route_fuzz(self, data: bytes, timeout: float = 90.0) -> bytes:
+        """Route one request: up to MAX_FAILOVER_NODES distinct healthy
+        nodes, each under the per-node retry policy, then the local
+        engine. Outcomes feed the health table, so a failing node's
+        breaker opens after a couple of requests and traffic routes
+        around it until its re-admission probe succeeds."""
+        deadline = time.monotonic() + timeout
+        tried: set = set()
+        while len(tried) < MAX_FAILOVER_NODES:
+            node = self.pool.pick(exclude=tried)
+            if node is None:
+                break
+            tried.add(node)
             try:
-                return remote_fuzz(node[0], node[1], data)
-            except (OSError, ValueError):
-                logger.log("warning", "node %s:%d failed, fuzzing locally", *node)
+                out = NODE_RETRY.call(
+                    remote_fuzz, node[0], node[1], data,
+                    site=f"dist:{node[0]}:{node[1]}", deadline=deadline,
+                )
+                self.pool.report(node, True)
+                return out
+            except (RetryExhausted, OSError, ValueError):
+                self.pool.report(node, False)
+                metrics.GLOBAL.record_event("failover")
+                logger.log("warning", "node %s:%d failed, failing over "
+                           "(%d tried)", node[0], node[1], len(tried))
+        if tried:
+            metrics.GLOBAL.record_event("dist_local_fallback")
+            logger.log("warning", "all %d node(s) failed, fuzzing locally",
+                       len(tried))
         return self.local.fuzz(data, dict(self.opts))
 
     def serve(self, block: bool = True):
@@ -163,13 +215,19 @@ class ParentServer:
 
 def remote_fuzz(host: str, port: int, data: bytes, timeout: float = 90.0) -> bytes:
     """Client call into a node (erlamsa_app:call/2,
-    src/erlamsa_app.erl:248-253)."""
+    src/erlamsa_app.erl:248-253). Raises ProtocolError when the node
+    closes without answering or answers with a non-result — callers can
+    then distinguish "node failed" (failover) from "fuzzer produced empty
+    output" (a legitimate result)."""
     with socket.create_connection((host, port), timeout=timeout) as s:
         _send_json(s, {"op": "fuzz", "data": base64.b64encode(data).decode()})
         resp = _recv_json(s.makefile("rb"))
-        if resp and resp.get("op") == "result":
-            return base64.b64decode(resp.get("data", ""))
-    return b""
+        if resp is None:
+            raise ProtocolError(f"node {host}:{port} closed without a reply")
+        if resp.get("op") != "result" or "data" not in resp:
+            raise ProtocolError(f"node {host}:{port} sent a malformed "
+                                f"reply: {str(resp)[:120]}")
+        return base64.b64decode(resp["data"])
 
 
 class WorkerNode:

@@ -37,6 +37,13 @@ class Counters:
         self.stages: dict[str, float] = {}
         self.pipeline_wall = 0.0
         self.drain_backlog_peak = 0
+        # resilience accounting (services/resilience.py, services/chaos.py):
+        # injected-fault firings per site, retry/breaker/failover event
+        # tallies, and the degraded flag — 1 while the corpus runner serves
+        # from the host oracle because the device was lost
+        self.faults: dict[str, int] = {}
+        self.events: dict[str, int] = {}
+        self.degraded = 0
         self.t0 = time.perf_counter()
 
     def record_batch(self, n_samples: int, n_bytes: int, device_seconds: float):
@@ -82,6 +89,24 @@ class Counters:
             if depth > self.drain_backlog_peak:
                 self.drain_backlog_peak = depth
 
+    def record_fault(self, site: str):
+        """One chaos-injected failure fired at `site`."""
+        with self._lock:
+            self.faults[site] = self.faults.get(site, 0) + 1
+
+    def record_event(self, kind: str):
+        """One resilience event: retry:<site>, breaker_open/closed,
+        failover, dist_local_fallback, node_evicted, device_lost,
+        device_recovered, ..."""
+        with self._lock:
+            self.events[kind] = self.events.get(kind, 0) + 1
+
+    def set_degraded(self, on: bool):
+        """Flip the degraded-mode flag (corpus runner fell back to the
+        host oracle after device loss / recovered)."""
+        with self._lock:
+            self.degraded = 1 if on else 0
+
     def snapshot(self) -> dict:
         with self._lock:
             wall = time.perf_counter() - self.t0
@@ -104,7 +129,24 @@ class Counters:
                 ) if self.pipeline_wall else 0.0,
                 "drain_backlog_peak": self.drain_backlog_peak,
             }
+            resilience = {
+                "degraded": self.degraded,
+                "faults": dict(self.faults),
+                "events": dict(self.events),
+            }
+        # outside self._lock: supervisor owns its own registry lock, and
+        # holding both here would order them against callers
+        from .supervisor import thread_stats
+
+        resilience["services"] = thread_stats()
+        from . import chaos
+
+        inj = chaos.active()
+        if inj is not None:
+            resilience["chaos"] = inj.stats()
+        with self._lock:
             return {
+                "resilience": resilience,
                 "pipeline": pipeline,
                 "samples": self.samples,
                 "batches": self.batches,

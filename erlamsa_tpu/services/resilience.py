@@ -1,0 +1,311 @@
+"""Reusable resilience policies: retry, circuit breaker, node health.
+
+The reference leans on OTP for all of this — crashed children restart
+one_for_one (src/erlamsa_sup.erl:51-54), dead distribution nodes fall out
+of the parent's table after 17 silent seconds (src/erlamsa_app.erl:
+210-246), hung cases are reaped (src/erlamsa_fsupervisor.erl:96-105).
+This module is the policy half of that story for the Python port, shared
+by services/dist.py (multi-node failover), services/batcher.py (device
+step retry) and corpus/store.py (durable-save retry):
+
+- RetryPolicy: jittered exponential backoff with deadline propagation —
+  a caller-supplied monotonic deadline caps total time spent retrying,
+  so a 90s client budget is never blown inside a retry loop.
+- CircuitBreaker: per-endpoint closed/open/half-open gate. A run of
+  failures opens the breaker (calls are refused without touching the
+  endpoint); after a cool-down one probe call is admitted and its
+  outcome closes or re-opens the circuit.
+- HealthTable: breaker-backed endpoint registry with an EWMA health
+  score — the NodePool's brain: pick() prefers healthy endpoints,
+  refuses open-breaker ones, and admits half-open probes so an evicted
+  node that recovered is re-admitted automatically.
+
+Determinism: retry jitter is drawn from a counter-keyed hash when the
+policy is given a key (the chaos replay contract — see services/chaos.py)
+and from os.urandom otherwise. Sleeps affect WHEN work happens, never
+what is computed, so jitter never breaks the -s output contract.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import threading
+import time
+
+from . import logger, metrics
+
+
+class RetryExhausted(Exception):
+    """Every attempt failed (or the deadline passed); the last underlying
+    error is the __cause__."""
+
+
+class RetryPolicy:
+    """Jittered exponential retry with deadline propagation.
+
+    attempts: total tries (1 = no retry). base/factor/max_delay: the
+    backoff schedule base * factor**n clipped to max_delay. jitter: each
+    delay is scaled by a uniform draw in [1-jitter, 1]. retry_on: the
+    exception types worth retrying — anything else propagates
+    immediately.
+    """
+
+    def __init__(self, attempts: int = 3, base: float = 0.05,
+                 factor: float = 2.0, max_delay: float = 2.0,
+                 jitter: float = 0.5,
+                 retry_on: tuple = (OSError, ValueError)):
+        self.attempts = max(1, int(attempts))
+        self.base = base
+        self.factor = factor
+        self.max_delay = max_delay
+        self.jitter = min(max(jitter, 0.0), 1.0)
+        self.retry_on = retry_on
+
+    def delay(self, attempt: int, key: str | None = None) -> float:
+        """Backoff before retry number `attempt` (1-based). With a key the
+        jitter draw is hash(key, attempt) — replayable; without, urandom."""
+        d = min(self.base * (self.factor ** (attempt - 1)), self.max_delay)
+        if self.jitter <= 0.0:
+            return d
+        if key is not None:
+            h = hashlib.sha256(f"{key}:{attempt}".encode()).digest()
+            frac = int.from_bytes(h[:8], "big") / float(1 << 64)
+        else:
+            frac = int.from_bytes(os.urandom(8), "big") / float(1 << 64)
+        return d * (1.0 - self.jitter * frac)
+
+    def call(self, fn, *args, site: str = "?", deadline: float | None = None,
+             key: str | None = None, on_retry=None, **kwargs):
+        """Run fn(*args, **kwargs) under this policy.
+
+        deadline: absolute time.monotonic() bound — no retry sleep starts
+        past it, and the sleep itself is clipped to the time remaining
+        (deadline propagation: a caller's budget caps the whole loop).
+        on_retry(attempt, exc): caller hook per failed attempt (e.g. mark
+        an endpoint unhealthy before the next try). Raises RetryExhausted
+        (with the last error as __cause__) when every attempt failed."""
+        last: BaseException | None = None
+        for attempt in range(1, self.attempts + 1):
+            try:
+                return fn(*args, **kwargs)
+            except self.retry_on as e:
+                last = e
+                metrics.GLOBAL.record_event(f"retry:{site}")
+                if on_retry is not None:
+                    on_retry(attempt, e)
+                if attempt >= self.attempts:
+                    break
+                d = self.delay(attempt, key=key)
+                if deadline is not None:
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        logger.log("warning", "retry %s: deadline passed "
+                                   "after attempt %d: %s", site, attempt, e)
+                        break
+                    d = min(d, remaining)
+                logger.log("warning", "retry %s: attempt %d failed (%s), "
+                           "retrying in %.3fs", site, attempt, e, d)
+                if d > 0:
+                    time.sleep(d)
+        raise RetryExhausted(
+            f"{site}: {self.attempts} attempt(s) failed"
+        ) from last
+
+
+# breaker states
+CLOSED, OPEN, HALF_OPEN = "closed", "open", "half_open"
+
+
+class CircuitBreaker:
+    """Per-endpoint failure gate (the dist parent's eviction, made
+    re-admitting). failure_threshold consecutive failures open the
+    circuit; while open, allow() refuses instantly; after reset_timeout
+    one HALF_OPEN probe is admitted — success closes the circuit,
+    failure re-opens it for another cool-down."""
+
+    def __init__(self, failure_threshold: int = 3,
+                 reset_timeout: float = 5.0, name: str = "?"):
+        self.failure_threshold = max(1, int(failure_threshold))
+        self.reset_timeout = reset_timeout
+        self.name = name
+        self._lock = threading.Lock()
+        self._state = CLOSED
+        self._failures = 0
+        self._opened_at = 0.0
+        self._probing = False
+
+    @property
+    def state(self) -> str:
+        with self._lock:
+            self._maybe_half_open()
+            return self._state
+
+    def _maybe_half_open(self):
+        if (self._state == OPEN
+                and time.monotonic() - self._opened_at >= self.reset_timeout):
+            self._state = HALF_OPEN
+            self._probing = False
+
+    def allow(self) -> bool:
+        """May a call proceed now? In HALF_OPEN exactly one caller gets a
+        True (the probe) until its outcome is recorded."""
+        with self._lock:
+            self._maybe_half_open()
+            if self._state == CLOSED:
+                return True
+            if self._state == HALF_OPEN and not self._probing:
+                self._probing = True
+                return True
+            return False
+
+    def record_success(self):
+        with self._lock:
+            if self._state != CLOSED:
+                metrics.GLOBAL.record_event("breaker_closed")
+                logger.log("info", "breaker %s: probe ok, circuit closed",
+                           self.name)
+            self._state = CLOSED
+            self._failures = 0
+            self._probing = False
+
+    def record_failure(self):
+        with self._lock:
+            self._maybe_half_open()
+            self._failures += 1
+            if self._state == HALF_OPEN or (
+                    self._state == CLOSED
+                    and self._failures >= self.failure_threshold):
+                self._state = OPEN
+                self._opened_at = time.monotonic()
+                self._probing = False
+                metrics.GLOBAL.record_event("breaker_open")
+                logger.log("warning", "breaker %s: circuit OPEN after %d "
+                           "failure(s), cooling %.1fs", self.name,
+                           self._failures, self.reset_timeout)
+
+
+class NodeHealth:
+    """One endpoint's health record: EWMA success score in [0, 1] plus
+    its breaker. A fresh node starts optimistic (score 1.0)."""
+
+    __slots__ = ("score", "breaker", "last_seen", "successes", "failures")
+
+    EWMA = 0.3  # weight of the newest outcome
+
+    def __init__(self, name: str = "?", failure_threshold: int = 3,
+                 reset_timeout: float = 5.0):
+        self.score = 1.0
+        self.breaker = CircuitBreaker(failure_threshold, reset_timeout, name)
+        self.last_seen = time.monotonic()
+        self.successes = 0
+        self.failures = 0
+
+    def report(self, ok: bool):
+        self.score = (1.0 - self.EWMA) * self.score + self.EWMA * (
+            1.0 if ok else 0.0
+        )
+        if ok:
+            self.successes += 1
+            self.breaker.record_success()
+        else:
+            self.failures += 1
+            self.breaker.record_failure()
+
+
+class HealthTable:
+    """Endpoint registry scored for routing. touch() registers/refreshes
+    (the keepalive path), report() folds an outcome in, pick() returns a
+    usable endpoint — healthy ones weighted by score, open breakers
+    skipped, half-open probes admitted (that admission IS the
+    re-admission path for a recovered node)."""
+
+    def __init__(self, rng, failure_threshold: int = 3,
+                 reset_timeout: float = 5.0):
+        self._rng = rng
+        self._lock = threading.Lock()
+        self._nodes: dict = {}
+        self._failure_threshold = failure_threshold
+        self._reset_timeout = reset_timeout
+
+    def touch(self, endpoint) -> bool:
+        """Register/refresh an endpoint; True when it is new."""
+        with self._lock:
+            fresh = endpoint not in self._nodes
+            if fresh:
+                self._nodes[endpoint] = NodeHealth(
+                    str(endpoint), self._failure_threshold,
+                    self._reset_timeout,
+                )
+            self._nodes[endpoint].last_seen = time.monotonic()
+        return fresh
+
+    def drop(self, endpoint):
+        with self._lock:
+            self._nodes.pop(endpoint, None)
+
+    def drop_stale(self, max_age: float) -> list:
+        """Remove endpoints silent for more than max_age (the keepalive
+        eviction); returns the dropped endpoints."""
+        now = time.monotonic()
+        with self._lock:
+            dead = [k for k, h in self._nodes.items()
+                    if now - h.last_seen > max_age]
+            for k in dead:
+                del self._nodes[k]
+        return dead
+
+    def report(self, endpoint, ok: bool):
+        with self._lock:
+            h = self._nodes.get(endpoint)
+            if h is not None:
+                h.report(ok)
+
+    def pick(self, exclude=()):
+        """A usable endpoint or None. Closed-breaker endpoints are drawn
+        score-weighted; when none qualify, a half-open breaker may admit
+        one probe call (re-admission)."""
+        with self._lock:
+            usable = []
+            half_open = []
+            for ep, h in self._nodes.items():
+                if ep in exclude:
+                    continue
+                st = h.breaker.state
+                if st == CLOSED:
+                    usable.append((ep, max(h.score, 0.05)))
+                elif st == HALF_OPEN:
+                    half_open.append(ep)
+            if usable:
+                total = sum(w for _, w in usable)
+                r = self._rng.random() * total
+                for ep, w in usable:
+                    r -= w
+                    if r <= 0:
+                        return ep
+                return usable[-1][0]
+            for ep in half_open:
+                if self._nodes[ep].breaker.allow():
+                    metrics.GLOBAL.record_event("node_probe")
+                    return ep
+            return None
+
+    def count(self) -> int:
+        with self._lock:
+            return len(self._nodes)
+
+    def endpoints(self) -> list:
+        with self._lock:
+            return list(self._nodes)
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                str(ep): {
+                    "score": round(h.score, 3),
+                    "state": h.breaker.state,
+                    "successes": h.successes,
+                    "failures": h.failures,
+                }
+                for ep, h in self._nodes.items()
+            }

@@ -6,7 +6,15 @@ proxy/httpsvc one_for_one with intensity 5 restarts per 1 second
 crash loop terminates the tree instead of spinning. Python threads don't
 restart themselves, so service loops here run under ``supervise``: the
 target is re-invoked on an unhandled exception, with the reference's
-intensity/period circuit breaker.
+intensity/period circuit breaker plus a capped exponential backoff
+between restarts (a crash loop used to spin its 5 attempts in
+milliseconds doing no useful work; now each restart waits a beat, and
+the cap keeps a genuine storm inside the escalation window).
+
+Every SupervisedThread registers itself so services/metrics.py can
+surface per-thread crash counts and gave_up state (snapshot()["resilience"]
+["services"], also served by the faas stats op) — the observability the
+reference gets for free from OTP's sasl reports.
 """
 
 from __future__ import annotations
@@ -18,6 +26,27 @@ from . import logger
 
 RESTART_INTENSITY = 5  # src/erlamsa_sup.erl:51-54
 RESTART_PERIOD = 1.0
+RESTART_BACKOFF = 0.02  # first restart delay; doubles per consecutive crash
+RESTART_BACKOFF_MAX = 0.2  # capped below period/intensity so a persistent
+#                            crasher still accumulates enough crashes inside
+#                            one period to trip the give-up breaker
+
+_registry_lock = threading.Lock()
+_registry: dict[str, "SupervisedThread"] = {}
+
+
+def thread_stats() -> dict:
+    """{name: {crashes, gave_up, alive}} for every supervised thread this
+    process ever started (same-named restarts overwrite — latest wins)."""
+    with _registry_lock:
+        return {
+            name: {
+                "crashes": t.total_crashes,
+                "gave_up": t.gave_up,
+                "alive": t.is_alive(),
+            }
+            for name, t in _registry.items()
+        }
 
 
 class SupervisedThread:
@@ -26,31 +55,42 @@ class SupervisedThread:
     After more than `intensity` crashes within `period` seconds the
     supervisor gives up (like OTP escalating a restart storm), logs at
     critical, and the thread exits. A target that RETURNS normally is
-    considered finished — only exceptions restart it.
+    considered finished — only exceptions restart it. Consecutive crashes
+    back off exponentially (backoff * 2^n, capped at backoff_max) so a
+    failing dependency gets breathing room instead of a hot spin.
     """
 
     def __init__(self, name: str, target, args=(), kwargs=None,
                  intensity: int = RESTART_INTENSITY,
-                 period: float = RESTART_PERIOD):
+                 period: float = RESTART_PERIOD,
+                 backoff: float = RESTART_BACKOFF,
+                 backoff_max: float = RESTART_BACKOFF_MAX):
         self.name = name
         self.target = target
         self.args = args
         self.kwargs = kwargs or {}
         self.intensity = intensity
         self.period = period
+        self.backoff = backoff
+        self.backoff_max = backoff_max
         self.crashes: list[float] = []
+        self.total_crashes = 0
         self.gave_up = False
         self._thread = threading.Thread(
             target=self._run, name=f"sup:{name}", daemon=True
         )
+        with _registry_lock:
+            _registry[name] = self
 
     def _run(self):
+        consecutive = 0
         while True:
             try:
                 self.target(*self.args, **self.kwargs)
                 return  # normal completion: don't resurrect
             except Exception as e:
                 now = time.monotonic()
+                self.total_crashes += 1
                 self.crashes = [
                     t for t in self.crashes if now - t < self.period
                 ] + [now]
@@ -62,8 +102,13 @@ class SupervisedThread:
                         self.name, len(self.crashes), self.period, e,
                     )
                     return
-                logger.log("error", "service %s crashed, restarting: %s",
-                           self.name, e)
+                delay = min(self.backoff * (2 ** consecutive),
+                            self.backoff_max)
+                consecutive += 1
+                logger.log("error", "service %s crashed, restarting in "
+                           "%.2fs: %s", self.name, delay, e)
+                if delay > 0:
+                    time.sleep(delay)
 
     def start(self) -> "SupervisedThread":
         self._thread.start()

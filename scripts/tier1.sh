@@ -8,13 +8,23 @@
 # corpus batch (async pipeline, 2 cases) after the tests — a cheap
 # end-to-end check that the double-buffered runner dispatches, drains
 # and reports throughput without needing the full bench.py harness.
+#
+# scripts/tier1.sh --chaos-smoke additionally runs a tiny corpus batch
+# twice — clean, then under an injected dist-failure + store-failure
+# spec (ERLAMSA_FAULTS="dist.send:x2,store.save:x1") — and asserts the
+# two output streams are byte-identical: transparent faults must be
+# absorbed by retries, never reach the data path (services/chaos.py).
 set -o pipefail
 
 bench_smoke=0
-if [ "${1:-}" = "--bench-smoke" ]; then
-  bench_smoke=1
-  shift
-fi
+chaos_smoke=0
+while [ $# -gt 0 ]; do
+  case "$1" in
+    --bench-smoke) bench_smoke=1; shift ;;
+    --chaos-smoke) chaos_smoke=1; shift ;;
+    *) break ;;
+  esac
+done
 
 rm -f /tmp/_t1.log
 timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
@@ -51,6 +61,58 @@ finally:
 ok = rc == 0 and stats.get("pipeline") == "async" and stats.get("total", 0) > 0
 print(f"BENCH_SMOKE={'ok' if ok else 'FAIL'} "
       f"total={stats.get('total')} pipeline={stats.get('pipeline')}")
+sys.exit(0 if ok else 1)
+EOF
+  rc=$?
+fi
+
+if [ $rc -eq 0 ] && [ $chaos_smoke -eq 1 ]; then
+  echo "== chaos smoke: transparent faults must be byte-identical =="
+  timeout -k 10 600 env JAX_PLATFORMS=cpu python - <<'EOF'
+import os, shutil, sys, tempfile
+
+from erlamsa_tpu.corpus.runner import run_corpus_batch
+from erlamsa_tpu.services import chaos, metrics
+
+SEEDS = [b"hello resilience", b"foo bar baz qux", b"the quick brown fox"]
+
+
+def one_run(root, spec):
+    chaos.configure(spec, seed=42)
+    outdir = os.path.join(root, "out")
+    os.makedirs(outdir)
+    rc = run_corpus_batch(
+        {
+            "corpus_dir": os.path.join(root, "corpus"),
+            "corpus": SEEDS,
+            "feedback": True,
+            "seed": (42, 42, 42),
+            "n": 4,
+            "output": os.path.join(outdir, "%n.out"),
+            "pipeline": "async",
+        },
+        batch=8,
+    )
+    chaos.configure(None)
+    blob = b""
+    for f in sorted(os.listdir(outdir), key=lambda s: int(s.split(".")[0])):
+        blob += open(os.path.join(outdir, f), "rb").read()
+    return rc, blob
+
+
+root = tempfile.mkdtemp(prefix="tier1_chaos_smoke_")
+try:
+    rc1, clean = one_run(os.path.join(root, "clean"), None)
+    rc2, faulted = one_run(os.path.join(root, "faulted"),
+                           "dist.send:x2,store.save:x1")
+finally:
+    shutil.rmtree(root, ignore_errors=True)
+events = metrics.GLOBAL.snapshot()["resilience"]["events"]
+ok = (rc1 == rc2 == 0 and clean and faulted == clean
+      and events.get("retry:store.save", 0) >= 1)
+print(f"CHAOS_SMOKE={'ok' if ok else 'FAIL'} bytes={len(clean)} "
+      f"identical={faulted == clean} "
+      f"store_retries={events.get('retry:store.save', 0)}")
 sys.exit(0 if ok else 1)
 EOF
   rc=$?

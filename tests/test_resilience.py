@@ -1,0 +1,480 @@
+"""Resilience layer tests: chaos injection replay, retry/breaker/health
+policies, durable checkpoint/store recovery, and the two end-to-end
+properties the layer exists for — transparent faults leave corpus output
+byte-identical at a fixed seed, and hard device faults degrade to the
+host oracle with the transition visible in metrics.
+
+The reference gets its fault tolerance from OTP supervision exercised by
+real crashes; here every failure is injected deterministically
+(services/chaos.py) so the same spec + seed replays the same sequence."""
+
+import os
+import socket
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from erlamsa_tpu.corpus.store import CorpusStore, seed_id_for
+from erlamsa_tpu.services import chaos, metrics
+from erlamsa_tpu.services.chaos import (ChaosInjector, InjectedFault,
+                                        parse_spec)
+from erlamsa_tpu.services.checkpoint import load_state, save_state
+from erlamsa_tpu.services.resilience import (CLOSED, HALF_OPEN, OPEN,
+                                             CircuitBreaker, HealthTable,
+                                             RetryExhausted, RetryPolicy)
+
+SEED = (42, 42, 42)  # the pinned -s 42 replay seed
+
+
+@pytest.fixture(autouse=True)
+def _chaos_disarmed():
+    """Every test starts and ends with no injector armed and the
+    degraded flag down — chaos state is process-global."""
+    chaos.configure(None)
+    yield
+    chaos.configure(None)
+    metrics.GLOBAL.set_degraded(False)
+
+
+# ---- spec grammar -------------------------------------------------------
+
+
+def test_parse_spec_grammar():
+    cl = parse_spec("dist.send:x2,store.save:x1")
+    assert cl["dist.send"].mode == "count" and cl["dist.send"].count == 2
+    assert cl["store.save"].count == 1
+    cl = parse_spec("device.step:*")
+    assert cl["device.step"].mode == "always"
+    cl = parse_spec("dist.recv:p0.25")
+    assert cl["dist.recv"].mode == "prob" and cl["dist.recv"].prob == 0.25
+    cl = parse_spec("batcher.step:s3x2")
+    assert cl["batcher.step"].skip == 3 and cl["batcher.step"].count == 2
+
+
+@pytest.mark.parametrize("bad", ["justasite", "site:", "site:q9",
+                                 "site:p1.5", "site:sx2"])
+def test_parse_spec_rejects_garbage(bad):
+    with pytest.raises(ValueError):
+        parse_spec(bad)
+
+
+def test_count_clause_fires_then_heals():
+    inj = ChaosInjector("s:x2", seed=1)
+    fired = []
+    for _ in range(4):
+        try:
+            inj.check("s")
+            fired.append(False)
+        except InjectedFault:
+            fired.append(True)
+    assert fired == [True, True, False, False]
+    assert inj.stats()["fired"]["s"] == 2
+
+
+def test_skip_clause_delays_firing():
+    inj = ChaosInjector("s:s2x1", seed=1)
+    outcomes = []
+    for _ in range(4):
+        try:
+            inj.check("s")
+            outcomes.append("ok")
+        except InjectedFault:
+            outcomes.append("fault")
+    assert outcomes == ["ok", "ok", "fault", "ok"]
+
+
+def test_prob_clause_is_replayable():
+    def firing_pattern(seed):
+        inj = ChaosInjector("s:p0.5", seed=seed)
+        pat = []
+        for _ in range(64):
+            try:
+                inj.check("s")
+                pat.append(0)
+            except InjectedFault:
+                pat.append(1)
+        return pat
+
+    a, b = firing_pattern(7), firing_pattern(7)
+    assert a == b and 0 < sum(a) < 64  # same seed replays; faults do fire
+    assert firing_pattern(8) != a  # a different seed draws differently
+
+
+def test_injected_fault_is_oserror_with_site():
+    inj = ChaosInjector("dist.send:*")
+    with pytest.raises(OSError) as ei:
+        inj.check("dist.send")
+    assert ei.value.site == "dist.send" and ei.value.invocation == 1
+
+
+def test_fault_point_free_when_disarmed():
+    chaos.configure(None)
+    chaos.fault_point("anything")  # no injector: must be a no-op
+    chaos.configure("x:*", seed=0)
+    with pytest.raises(InjectedFault):
+        chaos.fault_point("x")
+    chaos.fault_point("y")  # un-specced sites never fire
+
+
+def test_env_configure_does_not_override_cli(monkeypatch):
+    monkeypatch.setenv("ERLAMSA_FAULTS", "env.site:*")
+    armed = chaos.configure("cli.site:*", seed=3)
+    assert chaos.configure_from_env(seed=3) is armed  # --chaos wins
+    chaos.configure(None)
+    assert chaos.configure_from_env(seed=3).spec == "env.site:*"
+
+
+# ---- retry policy -------------------------------------------------------
+
+
+def test_retry_succeeds_after_transient_failures():
+    calls = []
+
+    def flaky():
+        calls.append(1)
+        if len(calls) < 3:
+            raise OSError("transient")
+        return "ok"
+
+    p = RetryPolicy(attempts=3, base=0.001, jitter=0.0)
+    assert p.call(flaky, site="t") == "ok"
+    assert len(calls) == 3
+
+
+def test_retry_exhausted_keeps_cause():
+    p = RetryPolicy(attempts=2, base=0.001)
+    with pytest.raises(RetryExhausted) as ei:
+        p.call(lambda: (_ for _ in ()).throw(OSError("disk")), site="t")
+    assert isinstance(ei.value.__cause__, OSError)
+
+
+def test_retry_only_catches_listed_types():
+    p = RetryPolicy(attempts=3, base=0.001, retry_on=(OSError,))
+    calls = []
+
+    def wrong_kind():
+        calls.append(1)
+        raise KeyError("not retriable")
+
+    with pytest.raises(KeyError):
+        p.call(wrong_kind, site="t")
+    assert len(calls) == 1  # no retry burned on a non-listed type
+
+
+def test_retry_jitter_deterministic_with_key():
+    p = RetryPolicy(base=0.05, jitter=0.5)
+    assert p.delay(1, key="k") == p.delay(1, key="k")
+    assert p.delay(1, key="k") != p.delay(2, key="k")
+    d = p.delay(3, key="k")
+    assert 0.0 < d <= 0.2  # base * factor**2, jitter only shrinks
+
+
+def test_retry_deadline_caps_the_loop():
+    p = RetryPolicy(attempts=10, base=0.2, jitter=0.0)
+    t0 = time.monotonic()
+    with pytest.raises(RetryExhausted):
+        p.call(lambda: (_ for _ in ()).throw(OSError("x")), site="t",
+               deadline=time.monotonic() + 0.25)
+    assert time.monotonic() - t0 < 2.0  # 10 attempts * 0.2s+ were clipped
+
+
+# ---- circuit breaker ----------------------------------------------------
+
+
+def test_breaker_opens_after_threshold_and_readmits():
+    b = CircuitBreaker(failure_threshold=2, reset_timeout=0.1, name="t")
+    assert b.state == CLOSED and b.allow()
+    b.record_failure()
+    assert b.state == CLOSED
+    b.record_failure()
+    assert b.state == OPEN and not b.allow()
+    time.sleep(0.12)
+    assert b.state == HALF_OPEN
+    assert b.allow()  # the single probe admission
+    assert not b.allow()  # ... is single
+    b.record_success()
+    assert b.state == CLOSED and b.allow()
+
+
+def test_breaker_failed_probe_reopens():
+    b = CircuitBreaker(failure_threshold=1, reset_timeout=0.05, name="t")
+    b.record_failure()
+    assert b.state == OPEN
+    time.sleep(0.07)
+    assert b.allow()
+    b.record_failure()  # probe failed: straight back to OPEN
+    assert b.state == OPEN and not b.allow()
+
+
+# ---- health table -------------------------------------------------------
+
+
+def test_health_table_routes_around_open_breakers():
+    import random
+
+    t = HealthTable(random.Random(7), failure_threshold=1,
+                    reset_timeout=30.0)
+    t.touch("a")
+    t.touch("b")
+    t.report("a", False)  # opens a's breaker
+    assert all(t.pick() == "b" for _ in range(10))
+    t.report("b", False)
+    assert t.pick() is None  # both open, nothing cooled down yet
+
+
+def test_health_table_half_open_probe_readmits():
+    import random
+
+    t = HealthTable(random.Random(7), failure_threshold=1,
+                    reset_timeout=0.05)
+    t.touch("a")
+    t.report("a", False)
+    assert t.pick() is None
+    time.sleep(0.07)
+    assert t.pick() == "a"  # the re-admission probe
+    t.report("a", True)
+    assert t.pick() == "a" and t.stats()["a"]["state"] == CLOSED
+
+
+def test_health_table_drop_stale():
+    import random
+
+    t = HealthTable(random.Random(1))
+    t.touch("a")
+    time.sleep(0.05)
+    t.touch("b")
+    assert set(t.drop_stale(0.03)) == {"a"}
+    assert t.endpoints() == ["b"]
+
+
+# ---- durable checkpoint -------------------------------------------------
+
+
+def test_checkpoint_bak_fallback_on_corruption(tmp_path):
+    path = str(tmp_path / "state.npz")
+    scores = np.arange(6, dtype=np.int32).reshape(2, 3)
+    save_state(path, (1, 2, 3), 5, scores)
+    save_state(path, (1, 2, 3), 9, scores)  # first save now lives in .bak
+    assert os.path.exists(path + ".bak")
+    with open(path, "r+b") as f:  # torn write: truncate the primary
+        f.truncate(40)
+    st = load_state(path)
+    assert st is not None and st[1] == 5  # resumed from the .bak snapshot
+
+
+def test_checkpoint_checksum_rejects_bitrot(tmp_path):
+    path = str(tmp_path / "state.npz")
+    save_state(path, (1, 2, 3), 5, np.zeros((2, 3), np.int32))
+    blob = bytearray(open(path, "rb").read())
+    # npz members are zlib streams with their own CRCs; flip bytes until
+    # the whole-file checksum (or the member CRC) trips — either way the
+    # loader must answer None, never garbage
+    blob[len(blob) // 2] ^= 0xFF
+    with open(path, "wb") as f:
+        f.write(bytes(blob))
+    assert load_state(path) is None
+
+
+def test_checkpoint_load_fault_falls_back(tmp_path):
+    path = str(tmp_path / "state.npz")
+    save_state(path, (1, 2, 3), 5, np.zeros((2, 3), np.int32))
+    save_state(path, (1, 2, 3), 7, np.zeros((2, 3), np.int32))
+    chaos.configure("checkpoint.load:x1", seed=0)  # primary read fails once
+    st = load_state(path)
+    assert st is not None and st[1] == 5  # answered from .bak
+
+
+# ---- durable store + fsck -----------------------------------------------
+
+
+def test_store_save_survives_one_injected_fault(tmp_path):
+    chaos.configure("store.save:x1", seed=0)
+    st = CorpusStore(str(tmp_path))
+    st.add(b"seed one")
+    chaos.configure(None)
+    st2 = CorpusStore(str(tmp_path))  # the retried save really landed
+    assert len(st2) == 1
+
+
+def test_store_fsck_reconciles(tmp_path):
+    st = CorpusStore(str(tmp_path))
+    keep, _ = st.add(b"keep me")
+    gone, _ = st.add(b"gone soon")
+    bad, _ = st.add(b"will corrupt")
+    os.unlink(os.path.join(st.seeds_dir, gone))  # meta without file
+    with open(os.path.join(st.seeds_dir, bad), "wb") as f:
+        f.write(b"flipped bits")  # file no longer matches its hash name
+    orphan = seed_id_for(b"orphan bytes")
+    with open(os.path.join(st.seeds_dir, orphan), "wb") as f:
+        f.write(b"orphan bytes")  # file without meta
+    with open(os.path.join(st.seeds_dir, "x.tmp"), "wb") as f:
+        f.write(b"torn")
+
+    st2 = CorpusStore(str(tmp_path))
+    report = st2.fsck()
+    assert report == {"missing": 1, "corrupt": 1, "orphans": 1, "ok": 2}
+    assert keep in st2 and orphan in st2
+    assert gone not in st2 and bad not in st2
+    assert os.path.exists(os.path.join(str(tmp_path), "quarantine", bad))
+    assert not os.path.exists(os.path.join(st.seeds_dir, "x.tmp"))
+    # a second pass finds a clean store
+    assert st2.fsck() == {"missing": 0, "corrupt": 0, "orphans": 0, "ok": 2}
+
+
+# ---- dist protocol + failover -------------------------------------------
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _garbage_node(reply: bytes):
+    """A fake worker node that answers every connection with `reply`."""
+    srv = socket.socket()
+    srv.bind(("127.0.0.1", 0))
+    srv.listen(4)
+
+    def loop():
+        while True:
+            try:
+                conn, _ = srv.accept()
+            except OSError:
+                return
+            conn.recv(65536)
+            if reply:
+                conn.sendall(reply)
+            conn.close()
+
+    threading.Thread(target=loop, daemon=True).start()
+    return srv, srv.getsockname()[1]
+
+
+def test_remote_fuzz_raises_on_malformed_reply():
+    from erlamsa_tpu.services.dist import ProtocolError, remote_fuzz
+
+    srv, port = _garbage_node(b'{"op": "nonsense"}\n')
+    with pytest.raises(ProtocolError):
+        remote_fuzz("127.0.0.1", port, b"data", timeout=5)
+    srv.close()
+    srv2, port2 = _garbage_node(b"")  # closes without any reply
+    with pytest.raises(ProtocolError):
+        remote_fuzz("127.0.0.1", port2, b"data", timeout=5)
+    srv2.close()
+
+
+def test_route_fuzz_fails_over_to_local():
+    """A joined node that answers garbage must not poison the request:
+    route_fuzz retries, opens the node's breaker, and serves locally."""
+    from erlamsa_tpu.services.dist import ParentServer
+
+    srv, port = _garbage_node(b'{"op": "broken"}\n')
+    parent = ParentServer(_free_port(), {"workers": 2, "seed": (1, 2, 3)})
+    parent.pool.join("127.0.0.1", port)
+    before = metrics.GLOBAL.snapshot()["resilience"]["events"]
+    out = parent.route_fuzz(b"failover test data\n", timeout=20.0)
+    assert out != b""  # the local engine answered
+    ev = metrics.GLOBAL.snapshot()["resilience"]["events"]
+    assert ev.get("failover", 0) > before.get("failover", 0)
+    assert (ev.get("dist_local_fallback", 0)
+            > before.get("dist_local_fallback", 0))
+    # one routed-failure report per route_fuzz; threshold 2 opens the
+    # breaker on the second request, after which the node gets no traffic
+    out2 = parent.route_fuzz(b"failover test data\n", timeout=20.0)
+    srv.close()
+    assert out2 != b""
+    assert parent.pool.table.stats()[str(("127.0.0.1", port))]["state"] == OPEN
+
+
+# ---- end-to-end: degraded mode (fast — the fault fires pre-compile) -----
+
+
+def _run_corpus(tmp_path, tag, spec=None, n=6, batch=8, pipeline="async",
+                n_seeds=3):
+    """One corpus run into per-case output files; returns the byte
+    stream concatenated in case/slot order."""
+    from erlamsa_tpu.corpus.runner import run_corpus_batch
+
+    chaos.configure(spec, seed=SEED[0])
+    outdir = tmp_path / f"out-{tag}"
+    outdir.mkdir()
+    opts = {
+        "corpus_dir": str(tmp_path / f"corpus-{tag}"),
+        "corpus": [b"hello resilience", b"foo bar baz qux",
+                   b"the quick brown fox"][:n_seeds],
+        "seed": SEED,
+        "n": n,
+        "feedback": True,
+        "pipeline": pipeline,
+        "output": str(outdir / "%n.out"),
+    }
+    rc = run_corpus_batch(opts, batch=batch)
+    chaos.configure(None)
+    blob = b""
+    for name in sorted(os.listdir(outdir), key=lambda s: int(s.split(".")[0])):
+        with open(outdir / name, "rb") as f:
+            blob += f.read()
+    return rc, blob
+
+
+@pytest.mark.parametrize("pipeline", ["async", "sync"])
+def test_persistent_device_fault_degrades_to_oracle(tmp_path, pipeline):
+    """ISSUE acceptance: a persistent device.step fault completes in
+    degraded (oracle) mode with degraded=1 in the metrics snapshot."""
+    rc, blob = _run_corpus(tmp_path, f"deg-{pipeline}",
+                           spec="device.step:*", pipeline=pipeline)
+    assert rc == 0 and blob  # the run completed and produced output
+    res = metrics.GLOBAL.snapshot()["resilience"]
+    assert res["degraded"] == 1
+    assert res["events"].get("device_lost", 0) >= 1
+    assert res["faults"].get("device.step", 0) >= 1
+    # degraded output is itself deterministic: replay matches
+    rc2, blob2 = _run_corpus(tmp_path, f"deg2-{pipeline}",
+                             spec="device.step:*", pipeline=pipeline)
+    assert rc2 == 0 and blob2 == blob
+
+
+def test_degraded_state_rides_faas_stats_op(tmp_path):
+    """The faas stats op serves metrics.GLOBAL.snapshot() — the degraded
+    flag and chaos tallies must be visible in it."""
+    _run_corpus(tmp_path, "stats", spec="device.step:*", n=2)
+    chaos.configure("device.step:*", seed=SEED[0])  # stats reflect an
+    snap = metrics.GLOBAL.snapshot()                # armed injector
+    assert snap["resilience"]["degraded"] == 1
+    assert snap["resilience"]["chaos"]["spec"] == "device.step:*"
+    assert "services" in snap["resilience"]
+
+
+# ---- end-to-end: byte-identity under transparent faults (chaos tier) ----
+
+
+@pytest.mark.slow
+def test_transparent_faults_byte_identical(tmp_path):
+    """ISSUE acceptance: dist send failure x2 + one store save failure at
+    the pinned seed leave corpus output byte-identical to the clean run
+    (the faults are absorbed by retries, never reaching the data path)."""
+    rc1, clean = _run_corpus(tmp_path, "clean")
+    rc2, faulted = _run_corpus(tmp_path, "faulted",
+                               spec="dist.send:x2,store.save:x1")
+    assert rc1 == rc2 == 0
+    assert faulted == clean
+    res = metrics.GLOBAL.snapshot()["resilience"]
+    assert res["events"].get("retry:store.save", 0) >= 1  # it really fired
+
+
+@pytest.mark.slow
+def test_device_recovery_resumes_pipeline(tmp_path):
+    """A transient device fault degrades, then a probe brings the device
+    pipeline back (device_recovered) and the run still completes."""
+    rc, blob = _run_corpus(tmp_path, "recover", spec="device.step:x1",
+                           n=8)
+    assert rc == 0 and blob
+    res = metrics.GLOBAL.snapshot()["resilience"]
+    assert res["events"].get("device_lost", 0) >= 1
+    assert res["events"].get("device_recovered", 0) >= 1
+    assert res["degraded"] == 0  # recovered by the end of the run

@@ -3,7 +3,8 @@
 import threading
 import time
 
-from erlamsa_tpu.services.supervisor import SupervisedThread, supervise
+from erlamsa_tpu.services.supervisor import (SupervisedThread, supervise,
+                                             thread_stats)
 
 
 def test_crashing_target_is_restarted():
@@ -61,3 +62,59 @@ def test_normal_return_is_not_restarted():
     t = supervise("oneshot", lambda: calls.append(1))
     t.join(5)
     assert calls == [1] and not t.is_alive()
+
+
+def test_restarts_back_off_between_crashes():
+    """Consecutive crashes must not hot-spin: each restart waits
+    backoff * 2^n (capped), so a crash loop leaves breathing room."""
+    stamps = []
+    done = threading.Event()
+
+    def flaky():
+        stamps.append(time.monotonic())
+        if len(stamps) < 4:
+            raise RuntimeError("boom")
+        done.set()
+
+    t = SupervisedThread("backoff", flaky, intensity=10, period=60.0,
+                         backoff=0.05, backoff_max=0.4).start()
+    assert done.wait(10)
+    t.join(5)
+    gaps = [b - a for a, b in zip(stamps, stamps[1:])]
+    # schedule 0.05, 0.1, 0.2 — each gap at least its backoff
+    assert len(gaps) == 3
+    for gap, want in zip(gaps, (0.05, 0.1, 0.2)):
+        assert gap >= want * 0.9
+
+
+def test_crash_counts_surface_in_registry_and_metrics():
+    """Satellite: per-thread crash counts + gave_up state flow through
+    thread_stats() into metrics snapshots (and thus the faas stats op)."""
+    from erlamsa_tpu.services import metrics
+
+    def storm():
+        raise RuntimeError("always")
+
+    t = SupervisedThread("storm-stats", storm, intensity=2, period=60.0,
+                         backoff=0.0).start()
+    t.join(10)
+    st = thread_stats()["storm-stats"]
+    assert st["gave_up"] and st["crashes"] == 3 and not st["alive"]
+    snap = metrics.GLOBAL.snapshot()
+    svc = snap["resilience"]["services"]["storm-stats"]
+    assert svc["gave_up"] and svc["crashes"] == 3
+
+
+def test_backoff_cap_keeps_giveup_breaker_armed():
+    """The backoff cap must sit far enough below period/intensity that a
+    persistent crasher still trips the give-up breaker instead of being
+    paced forever (intensity+1 crashes must fit inside one period)."""
+    attempts = []
+
+    def storm():
+        attempts.append(1)
+        raise RuntimeError("always")
+
+    t = SupervisedThread("capped-storm", storm).start()  # stock settings
+    t.join(10)
+    assert t.gave_up and len(attempts) == 6  # intensity 5 + the tripping one

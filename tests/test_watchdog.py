@@ -93,6 +93,56 @@ def test_engine_hung_case_does_not_break_determinism():
     assert got[3] == plain[2]
 
 
+def test_timed_out_target_does_not_hold_slot_semaphore():
+    """Leak contract the batcher relies on: slot permits are acquired and
+    released by the CALLER around run_with_timeout, never inside the
+    guarded target — so an abandoned (still-blocked) target thread cannot
+    hold a slot, and the pipeline keeps flowing after a timeout."""
+    slots = threading.Semaphore(1)
+    release = threading.Event()
+
+    def hung_step():
+        release.wait(30)
+
+    # the batcher discipline: acquire, run under the watchdog, release on
+    # every exit — CaseTimeout included
+    assert slots.acquire(timeout=1)
+    try:
+        with pytest.raises(CaseTimeout):
+            run_with_timeout(hung_step, 0.2)
+    finally:
+        slots.release()
+
+    # the permit must be available immediately, while the abandoned
+    # target thread is still blocked inside hung_step
+    assert slots.acquire(timeout=1)
+    slots.release()
+    release.set()
+
+
+def test_timed_out_target_in_guarded_region_would_leak():
+    """The inverse contract, pinned so nobody moves the acquire inside
+    the guarded call: a target that acquires the semaphore itself and
+    hangs DOES strand the permit until it unblocks — exactly why the
+    batcher acquires outside run_with_timeout."""
+    slots = threading.Semaphore(1)
+    release = threading.Event()
+
+    def greedy_step():
+        slots.acquire()
+        try:
+            release.wait(30)
+        finally:
+            slots.release()
+
+    with pytest.raises(CaseTimeout):
+        run_with_timeout(greedy_step, 0.2)
+    assert not slots.acquire(timeout=0.3)  # stranded by the zombie thread
+    release.set()
+    assert slots.acquire(timeout=5)  # returned only once it unblocked
+    slots.release()
+
+
 def test_oracle_batcher_pool_survives_hung_case(monkeypatch):
     """One hung case must not drain the worker pool: the request gets an
     empty answer and the worker serves the next request."""
