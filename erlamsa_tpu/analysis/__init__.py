@@ -1,0 +1,54 @@
+"""fuzzlint: repo-specific AST invariant checking.
+
+The whole value proposition of this port rests on invariants nothing in
+pytest can see: byte-identical replay at a fixed seed, pure counter-based
+PRNG in every ``ops/`` kernel, no host syncs inside traced functions,
+correct locking around the drain-worker/flusher threads, and chaos-site
+coverage over every raw network/durable-write primitive. This package
+enforces them mechanically at diff time — pure stdlib ``ast``, no
+third-party deps, fast enough to run in front of every tier-1 gate.
+
+Rule catalogue (see the rules_* modules for each rule's contract):
+
+    no-wallclock-nondeterminism   replay paths must not read entropy/clock
+    traced-host-sync              no host syncs reachable from jit kernels
+    per-call-constant-tables      device constants hoisted/cached, not
+                                  rebuilt inside traced bodies
+    lock-discipline               declared guarded fields only touched
+                                  under their declared lock
+    broad-except                  bare ``except Exception`` needs a reason
+    chaos-site-coverage           raw send/recv + durable writes route
+                                  through a chaos fault site
+    unused-import                 imports bound but never referenced
+
+Suppressions are per-line comments::
+
+    # lint: <rule>-ok <reason>
+
+on the offending line or the line directly above it.  ``broad-except``
+suppressions additionally REQUIRE a non-empty reason — an unexplained
+swallow is exactly the bug class the rule exists for.
+
+CLI::
+
+    python -m erlamsa_tpu.analysis.lint [paths...]
+
+exits non-zero with ``path:line rule message`` findings on stdout.
+
+Policy: a new rule lands together with fixture tests (one fires-on-
+violation and one passes-on-clean case in tests/test_analysis.py) and a
+tree that lints clean under it.
+"""
+
+from __future__ import annotations
+
+from .core import RULES, Finding, LintConfig, Module, run_lint, rule
+
+# importing the rule modules registers every rule in RULES
+from . import rules_determinism  # noqa: E402,F401  (registration import)
+from . import rules_device  # noqa: E402,F401
+from . import rules_resilience  # noqa: E402,F401
+from . import rules_threads  # noqa: E402,F401
+from . import symbols  # noqa: E402,F401
+
+__all__ = ["RULES", "Finding", "LintConfig", "Module", "run_lint", "rule"]

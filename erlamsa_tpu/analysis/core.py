@@ -1,0 +1,339 @@
+"""fuzzlint core: rule registry, module model, suppressions, driver.
+
+Everything here is pure stdlib ``ast`` — the linter must be runnable in a
+jax-free context (CI image bootstrap, pre-commit) and finish in well
+under the tier-1 gate's 5-second budget for the whole package.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import os
+import re
+from typing import Callable, Iterable, Iterator
+
+#: suppression comment grammar: ``# lint: <rule>-ok <optional reason>``
+SUPPRESS_RE = re.compile(r"#\s*lint:\s*([a-z0-9][a-z0-9-]*)-ok\b:?\s*(.*)")
+
+#: rules whose suppression must carry a non-empty reason; an unexplained
+#: annotation is itself a finding for these
+REASON_REQUIRED = frozenset({"broad-except"})
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    path: str
+    line: int
+    rule: str
+    message: str
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line} {self.rule} {self.message}"
+
+
+@dataclasses.dataclass
+class LintConfig:
+    """Repo policy knobs. Paths are package-relative prefixes (an empty
+    string matches everything — how fixture tests scope rules onto
+    standalone files)."""
+
+    #: replay paths for no-wallclock-nondeterminism; services/ is
+    #: deliberately absent (metrics/session clocks are legitimate there)
+    wallclock_paths: tuple = ("ops/", "corpus/", "utils/erlrand.py")
+    #: monotonic/perf clocks never feed replay values, only metrics
+    wallclock_allowed: tuple = ("time.monotonic", "time.perf_counter",
+                               "time.perf_counter_ns", "time.monotonic_ns")
+    #: ops/ scope for the traced-function rules
+    traced_paths: tuple = ("ops/",)
+    #: ops/ modules whose key/data-led functions are traced kernels by
+    #: convention (the make_fuzzer/registry calling convention); "*"
+    #: means every module in traced_paths
+    kernel_modules: tuple = (
+        "byte_mutators", "line_mutators", "num_mutators", "seq_mutators",
+        "utf8_mutators", "payload_mutators", "fuse_mutators", "patterns",
+        "lenfield", "crc32", "prng", "sizer", "fused", "scheduler",
+    )
+    #: modules whose raw send/recv + durable writes must route through a
+    #: chaos fault site (chaos-site-coverage)
+    chaos_modules: tuple = ("services/dist.py", "corpus/store.py",
+                            "services/checkpoint.py")
+
+    def in_scope(self, rel: str, prefixes: tuple) -> bool:
+        return any(rel.startswith(p) for p in prefixes)
+
+
+DEFAULT_CONFIG = LintConfig()
+
+
+class Module:
+    """One parsed source file plus its suppression table."""
+
+    def __init__(self, path: str, rel: str, src: str):
+        self.path = path
+        self.rel = rel
+        self.src = src
+        self.tree = ast.parse(src, filename=path)
+        self.lines = src.splitlines()
+        # line (1-based) -> {rule: reason}
+        self.suppressions: dict[int, dict[str, str]] = {}
+        for i, line in enumerate(self.lines, 1):
+            m = SUPPRESS_RE.search(line)
+            if m:
+                self.suppressions.setdefault(i, {})[m.group(1)] = (
+                    m.group(2).strip()
+                )
+
+    def suppression(self, line: int, rule_name: str) -> str | None:
+        """Reason for a suppression covering `line` (same line or the
+        line directly above), or None when not suppressed. An empty
+        string means 'suppressed without a reason'."""
+        for ln in (line, line - 1):
+            reasons = self.suppressions.get(ln)
+            if reasons is not None and rule_name in reasons:
+                return reasons[rule_name]
+        return None
+
+    @property
+    def basename(self) -> str:
+        return os.path.splitext(os.path.basename(self.rel))[0]
+
+
+RuleFn = Callable[[Module, LintConfig], Iterable[Finding]]
+
+#: rule name -> checker; populated via @rule by the rules_* modules
+RULES: dict[str, RuleFn] = {}
+
+
+def rule(name: str) -> Callable[[RuleFn], RuleFn]:
+    def deco(fn: RuleFn) -> RuleFn:
+        RULES[name] = fn
+        fn.rule_name = name  # type: ignore[attr-defined]
+        return fn
+
+    return deco
+
+
+# --- shared AST helpers ---------------------------------------------------
+
+
+def dotted_name(node: ast.AST) -> str | None:
+    """'a.b.c' for a Name/Attribute chain, None for anything dynamic."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def call_name(node: ast.Call) -> str | None:
+    return dotted_name(node.func)
+
+
+def root_name(node: ast.AST) -> str | None:
+    """Leftmost Name of an expression ('x' for x.a[0].b), else None."""
+    while isinstance(node, (ast.Attribute, ast.Subscript)):
+        node = node.value
+    if isinstance(node, ast.Name):
+        return node.id
+    return None
+
+
+def import_aliases(tree: ast.AST) -> dict[str, str]:
+    """Local alias -> fully qualified imported name, over the whole file
+    (function-local imports included: the binding site doesn't change
+    what the name denotes)."""
+    aliases: dict[str, str] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                aliases[a.asname or a.name.split(".")[0]] = (
+                    a.name if a.asname else a.name.split(".")[0]
+                )
+        elif isinstance(node, ast.ImportFrom):
+            for a in node.names:
+                if a.name == "*":
+                    continue
+                if node.module:
+                    aliases[a.asname or a.name] = f"{node.module}.{a.name}"
+                else:  # `from . import payloads` — a sibling module
+                    aliases[a.asname or a.name] = a.name
+    return aliases
+
+
+def imported_module_aliases(tree: ast.AST) -> set[str]:
+    """Local names that are bound to a MODULE: `import x` / `import x as
+    y` / `from . import sibling` (relative sibling imports bind module
+    objects; `from pkg import name` may bind anything and is excluded)."""
+    mods: set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                mods.add(a.asname or a.name.split(".")[0])
+        elif isinstance(node, ast.ImportFrom) and node.module is None:
+            for a in node.names:
+                if a.name != "*":
+                    mods.add(a.asname or a.name)
+    return mods
+
+
+def expand_alias(dotted: str, aliases: dict[str, str]) -> str:
+    """Resolve the first segment of a dotted name through the module's
+    import aliases: '_pyrandom.Random' -> 'random.Random'."""
+    head, _, rest = dotted.partition(".")
+    full = aliases.get(head, head)
+    return f"{full}.{rest}" if rest else full
+
+
+def functions(tree: ast.AST) -> Iterator[ast.FunctionDef]:
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            yield node  # type: ignore[misc]
+
+
+def own_body_walk(fn: ast.AST) -> Iterator[ast.AST]:
+    """Walk a function's own statements, NOT descending into nested
+    function/class definitions (those have their own scope and their own
+    findings)."""
+    stack: list[ast.AST] = list(ast.iter_child_nodes(fn))
+    while stack:
+        node = stack.pop()
+        yield node
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef, ast.Lambda)):
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def decorator_names(fn: ast.AST, aliases: dict[str, str]) -> list[str]:
+    """Expanded dotted names of a function's decorators; a decorator call
+    like @partial(jax.jit, ...) contributes both 'functools.partial' and
+    its first argument's name ('jax.jit')."""
+    names: list[str] = []
+    for dec in getattr(fn, "decorator_list", []):
+        target = dec.func if isinstance(dec, ast.Call) else dec
+        d = dotted_name(target)
+        if d:
+            names.append(expand_alias(d, aliases))
+        if isinstance(dec, ast.Call) and dec.args:
+            inner = dotted_name(dec.args[0])
+            if inner:
+                names.append(expand_alias(inner, aliases))
+    return names
+
+
+CACHE_DECORATORS = frozenset({
+    "functools.lru_cache", "functools.cache", "lru_cache", "cache",
+})
+
+
+def is_cached(fn: ast.AST, aliases: dict[str, str]) -> bool:
+    return any(d in CACHE_DECORATORS for d in decorator_names(fn, aliases))
+
+
+def param_names(fn: ast.AST) -> set[str]:
+    a = fn.args
+    names = [p.arg for p in a.posonlyargs + a.args + a.kwonlyargs]
+    if a.vararg:
+        names.append(a.vararg.arg)
+    if a.kwarg:
+        names.append(a.kwarg.arg)
+    return set(names)
+
+
+def module_level_bindings(tree: ast.Module) -> set[str]:
+    """Names bound at module top level (constants, functions, classes,
+    imports)."""
+    names: set[str] = set()
+    for node in tree.body:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            names.add(node.name)
+        elif isinstance(node, ast.Assign):
+            for t in node.targets:
+                for n in ast.walk(t):
+                    if isinstance(n, ast.Name):
+                        names.add(n.id)
+        elif isinstance(node, ast.AnnAssign) and isinstance(node.target,
+                                                           ast.Name):
+            names.add(node.target.id)
+        elif isinstance(node, ast.Import):
+            for a in node.names:
+                names.add(a.asname or a.name.split(".")[0])
+        elif isinstance(node, ast.ImportFrom):
+            for a in node.names:
+                if a.name != "*":
+                    names.add(a.asname or a.name)
+    return names
+
+
+# --- file discovery and the driver ---------------------------------------
+
+
+def package_rel(path: str) -> str:
+    """Path relative to the erlamsa_tpu package root ('ops/prng.py');
+    files outside the package key on their basename (fixture files)."""
+    parts = os.path.abspath(path).split(os.sep)
+    if "erlamsa_tpu" in parts:
+        idx = len(parts) - 1 - parts[::-1].index("erlamsa_tpu")
+        rel = "/".join(parts[idx + 1:])
+        if rel:
+            return rel
+    return os.path.basename(path)
+
+
+def iter_py_files(paths: Iterable[str]) -> Iterator[str]:
+    for p in paths:
+        if os.path.isdir(p):
+            for dirpath, dirnames, filenames in os.walk(p):
+                dirnames[:] = [d for d in sorted(dirnames)
+                               if d != "__pycache__"]
+                for f in sorted(filenames):
+                    if f.endswith(".py"):
+                        yield os.path.join(dirpath, f)
+        elif p.endswith(".py"):
+            yield p
+
+
+def load_modules(paths: Iterable[str]) -> tuple[list[Module], list[Finding]]:
+    mods: list[Module] = []
+    errors: list[Finding] = []
+    for path in iter_py_files(paths):
+        try:
+            with open(path, encoding="utf-8") as f:
+                src = f.read()
+            mods.append(Module(path, package_rel(path), src))
+        except (OSError, SyntaxError, ValueError) as e:
+            line = getattr(e, "lineno", None) or 0
+            errors.append(Finding(path, line, "parse-error", str(e)))
+    return mods, errors
+
+
+def run_lint(paths: Iterable[str], rules: Iterable[str] | None = None,
+             config: LintConfig = DEFAULT_CONFIG) -> list[Finding]:
+    """Lint `paths` (files or directories) under the selected rules
+    (default: all registered). Returns surviving findings sorted by
+    (path, line, rule); suppressed findings are dropped unless the rule
+    requires a reason and the annotation has none."""
+    selected = list(RULES) if rules is None else list(rules)
+    unknown = [r for r in selected if r not in RULES]
+    if unknown:
+        raise ValueError(f"unknown rule(s): {', '.join(unknown)} "
+                         f"(known: {', '.join(sorted(RULES))})")
+    mods, findings = load_modules(paths)
+    for name in selected:
+        checker = RULES[name]
+        for mod in mods:
+            for f in checker(mod, config):
+                reason = mod.suppression(f.line, f.rule)
+                if reason is None:
+                    findings.append(f)
+                elif f.rule in REASON_REQUIRED and not reason:
+                    findings.append(dataclasses.replace(
+                        f, message=f.message
+                        + " (suppression present but gives no reason)"))
+    findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    return findings

@@ -1,0 +1,63 @@
+"""fuzzlint CLI: ``python -m erlamsa_tpu.analysis.lint [paths...]``.
+
+Exits 0 on a clean tree, 1 with one ``path:line rule message`` line per
+finding, 2 on usage errors. With no paths, lints the erlamsa_tpu package
+this module was imported from. Pure stdlib + AST: the whole package
+lints in well under a second, so this runs in front of the tier-1 gate
+(scripts/tier1.sh, opt out with --no-lint).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+from . import RULES, run_lint
+
+
+def _package_root() -> str:
+    return os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m erlamsa_tpu.analysis.lint",
+        description="repo-specific AST invariant checker (fuzzlint)",
+    )
+    ap.add_argument("paths", nargs="*",
+                    help="files/directories to lint "
+                         "(default: the erlamsa_tpu package)")
+    ap.add_argument("--rules", default=None,
+                    help="comma-separated subset of rules to run")
+    ap.add_argument("--list-rules", action="store_true",
+                    help="print the rule catalogue and exit")
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        for name in sorted(RULES):
+            print(name)
+        return 0
+
+    rules = None
+    if args.rules:
+        rules = [r.strip() for r in args.rules.split(",") if r.strip()]
+    paths = args.paths or [_package_root()]
+    for p in paths:
+        if not os.path.exists(p):
+            print(f"error: no such path: {p}", file=sys.stderr)
+            return 2
+    try:
+        findings = run_lint(paths, rules=rules)
+    except ValueError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+    for f in findings:
+        print(f.render())
+    if findings:
+        print(f"{len(findings)} finding(s)", file=sys.stderr)
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

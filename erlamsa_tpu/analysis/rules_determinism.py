@@ -1,0 +1,75 @@
+"""no-wallclock-nondeterminism: replay paths take no entropy or clock.
+
+Byte-identical replay at a fixed ``-s`` seed (the PAPER.md north star,
+re-pinned by the sync==async barrier and the faults-are-transparent
+contract) only holds while every value on the replay path is a pure
+function of (seed, case, sample). A single ``time.time()`` or
+``os.urandom`` read in ``ops/``, ``corpus/`` or the erlrand stream
+silently breaks it — and nothing fails until golden-digest archaeology.
+
+Flagged in the configured replay paths (``LintConfig.wallclock_paths``):
+
+- ``time.time`` / ``time.time_ns`` (monotonic/perf clocks are allowed:
+  they feed metrics, never replay values)
+- ``os.urandom``, ``uuid.*``, ``secrets.*``
+- the ``random`` stdlib module (any call)
+- ``datetime.now`` / ``datetime.utcnow``
+- ``numpy.random.default_rng()`` / ``numpy.random.Generator()`` with no
+  arguments (unseeded); seeded construction is counter-keyed and fine
+
+``services/`` is deliberately out of scope — session tokens, keepalive
+timers and metrics clocks are legitimate wall-clock consumers there.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .core import (Finding, LintConfig, Module, call_name, expand_alias,
+                   import_aliases, rule)
+
+#: exact fully-qualified calls that are never allowed on a replay path
+DENY_EXACT = frozenset({
+    "time.time", "time.time_ns",
+    "os.urandom", "os.getrandom",
+    "datetime.datetime.now", "datetime.datetime.utcnow", "datetime.now",
+    "datetime.utcnow",
+})
+
+#: module prefixes where every call is nondeterministic
+DENY_PREFIX = ("random.", "uuid.", "secrets.")
+
+#: unseeded construction is nondeterministic; with a seed argument these
+#: are counter-keyed and legitimate (corpus/energy.py's schedule draws)
+DENY_IF_UNSEEDED = frozenset({
+    "numpy.random.default_rng", "numpy.random.Generator",
+    "numpy.random.RandomState",
+})
+
+
+@rule("no-wallclock-nondeterminism")
+def check_wallclock(mod: Module, config: LintConfig):
+    if not config.in_scope(mod.rel, config.wallclock_paths):
+        return
+    aliases = import_aliases(mod.tree)
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        name = call_name(node)
+        if name is None:
+            continue
+        full = expand_alias(name, aliases)
+        if full in config.wallclock_allowed:
+            continue
+        if full in DENY_EXACT or full.startswith(DENY_PREFIX):
+            yield Finding(
+                mod.path, node.lineno, "no-wallclock-nondeterminism",
+                f"`{name}` on a replay path: replay values must be pure "
+                f"functions of (seed, case, sample), never clock/entropy",
+            )
+        elif full in DENY_IF_UNSEEDED and not node.args:
+            yield Finding(
+                mod.path, node.lineno, "no-wallclock-nondeterminism",
+                f"unseeded `{name}()` on a replay path: pass an explicit "
+                f"counter-derived seed",
+            )

@@ -1,0 +1,166 @@
+"""Device purity rules for ``ops/``: traced-host-sync and
+per-call-constant-tables.
+
+**traced-host-sync.** A host sync inside a traced function (np coercion
+of a traced value, ``.item()``, ``.block_until_ready()``) either fails at
+trace time or — worse — silently forces a device round-trip per call and
+serializes the async pipeline. The rule computes the traced set per
+module: roots are (a) functions decorated with ``jax.jit`` (directly or
+via ``partial``), (b) functions passed to a ``jax.jit(...)`` call, and
+(c) in the configured kernel modules, every function whose first
+parameter is ``key`` or ``data`` — the make_fuzzer/registry kernel
+calling convention. The set is closed over module-local calls;
+``lru_cache``-decorated helpers are excluded (they run host-side once by
+design — that's what the cache is for).
+
+**per-call-constant-tables.** ``jnp.asarray(<module constant>)`` inside a
+function body re-stages the constant on every trace (and on every eager
+call): retrace-path allocation noise for tables that never change. Such
+tables must be hoisted to module level or built in an ``lru_cache``'d
+helper. Flagged: ``jnp.asarray`` whose first argument is an ALL_CAPS
+module-level name (``_FUNNY_TABLE``) or an imported-module attribute
+(``payloads.TABLE``), in any non-cached function in ``ops/``. Local
+coercions like ``jnp.asarray(n, jnp.int32)`` are not tables and pass.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .core import (Finding, LintConfig, Module, call_name, decorator_names,
+                   expand_alias, functions, import_aliases,
+                   imported_module_aliases, is_cached,
+                   module_level_bindings, own_body_walk, param_names,
+                   root_name, rule)
+
+#: method calls that are host syncs wherever they appear in traced code
+SYNC_METHODS = frozenset({"item", "block_until_ready"})
+
+#: calls that coerce their (traced) argument onto the host
+COERCE_CALLS = frozenset({
+    "numpy.asarray", "numpy.array", "jax.device_get", "float", "int",
+    "bool", "bytes",
+})
+
+JNP_ASARRAY = frozenset({"jax.numpy.asarray", "jnp.asarray"})
+
+
+def _jit_roots(mod: Module, aliases: dict[str, str]) -> set[str]:
+    """Function names jitted by decorator or by a jax.jit(name, ...)
+    call anywhere in the module."""
+    roots: set[str] = set()
+    for fn in functions(mod.tree):
+        decs = decorator_names(fn, aliases)
+        if any(d == "jax.jit" or d.endswith(".jit") for d in decs):
+            roots.add(fn.name)
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.Call):
+            name = call_name(node)
+            if name and expand_alias(name, aliases) == "jax.jit":
+                if node.args and isinstance(node.args[0], ast.Name):
+                    roots.add(node.args[0].id)
+    return roots
+
+
+def _traced_functions(mod: Module, config: LintConfig) -> list[ast.FunctionDef]:
+    aliases = import_aliases(mod.tree)
+    by_name: dict[str, list[ast.FunctionDef]] = {}
+    all_fns = list(functions(mod.tree))
+    for fn in all_fns:
+        by_name.setdefault(fn.name, []).append(fn)
+
+    roots = _jit_roots(mod, aliases)
+    kernel_mod = ("*" in config.kernel_modules
+                  or mod.basename in config.kernel_modules)
+    if kernel_mod:
+        for fn in all_fns:
+            args = fn.args.posonlyargs + fn.args.args
+            if args and args[0].arg in ("key", "data"):
+                roots.add(fn.name)
+
+    # close over module-local calls, skipping cached host-side helpers
+    traced: dict[int, ast.FunctionDef] = {}
+    frontier = [fn for name in roots for fn in by_name.get(name, [])]
+    while frontier:
+        fn = frontier.pop()
+        if id(fn) in traced or is_cached(fn, aliases):
+            continue
+        traced[id(fn)] = fn
+        for node in own_body_walk(fn):
+            if isinstance(node, ast.Call) and isinstance(node.func, ast.Name):
+                frontier.extend(by_name.get(node.func.id, []))
+    return list(traced.values())
+
+
+@rule("traced-host-sync")
+def check_traced_host_sync(mod: Module, config: LintConfig):
+    if not config.in_scope(mod.rel, config.traced_paths):
+        return
+    aliases = import_aliases(mod.tree)
+    for fn in _traced_functions(mod, config):
+        params = param_names(fn)
+        for node in own_body_walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            if (isinstance(node.func, ast.Attribute)
+                    and node.func.attr in SYNC_METHODS
+                    and not node.args):
+                yield Finding(
+                    mod.path, node.lineno, "traced-host-sync",
+                    f"`.{node.func.attr}()` inside traced `{fn.name}`: "
+                    f"host sync in a jit-reachable function",
+                )
+                continue
+            name = call_name(node)
+            if name is None or not node.args:
+                continue
+            full = expand_alias(name, aliases)
+            if full in COERCE_CALLS and root_name(node.args[0]) in params:
+                yield Finding(
+                    mod.path, node.lineno, "traced-host-sync",
+                    f"`{name}(...)` coerces a traced value to the host "
+                    f"inside `{fn.name}` (jit-reachable); keep it on "
+                    f"device or move the coercion outside the kernel",
+                )
+
+
+@rule("per-call-constant-tables")
+def check_constant_tables(mod: Module, config: LintConfig):
+    if not config.in_scope(mod.rel, config.traced_paths):
+        return
+    aliases = import_aliases(mod.tree)
+    module_names = module_level_bindings(mod.tree)
+    imported_mods = imported_module_aliases(mod.tree)
+    for fn in functions(mod.tree):
+        if is_cached(fn, aliases):
+            continue
+        locals_ = param_names(fn) | {
+            n.id for node in own_body_walk(fn)
+            for n in ast.walk(node)
+            if isinstance(n, ast.Name) and isinstance(n.ctx, ast.Store)
+        }
+        for node in own_body_walk(fn):
+            if not isinstance(node, ast.Call) or not node.args:
+                continue
+            name = call_name(node)
+            if name is None or expand_alias(name, aliases) not in JNP_ASARRAY:
+                continue
+            arg = node.args[0]
+            hit = None
+            if isinstance(arg, ast.Name):
+                if (arg.id in module_names and arg.id not in locals_
+                        and arg.id.upper() == arg.id):
+                    hit = arg.id
+            elif isinstance(arg, ast.Attribute) and isinstance(arg.value,
+                                                              ast.Name):
+                base = arg.value.id
+                if (base in imported_mods and base not in locals_
+                        and arg.attr.upper() == arg.attr):
+                    hit = f"{base}.{arg.attr}"
+            if hit:
+                yield Finding(
+                    mod.path, node.lineno, "per-call-constant-tables",
+                    f"`jnp.asarray({hit})` built inside `{fn.name}` on "
+                    f"every call/trace: hoist it to module level or an "
+                    f"lru_cache'd helper",
+                )

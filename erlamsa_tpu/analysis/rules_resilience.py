@@ -1,0 +1,142 @@
+"""Resilience rules: broad-except and chaos-site-coverage.
+
+**broad-except.** ``except Exception`` (or a bare ``except:``) swallows
+the very corruption signals the resilience layer exists to surface — a
+checksum mismatch read as "no checkpoint", a protocol bug read as "peer
+went away". Every broad handler must either narrow to the failure
+classes it actually expects (``(OSError, ValueError)``-style tuples) or
+carry ``# lint: broad-except-ok <reason>`` naming why swallowing
+everything is the intended semantics (supervision points, port
+isolation, give-up-with-empty-answer paths). The reason is mandatory:
+an unexplained annotation is still a finding.
+
+**chaos-site-coverage.** PR 5's contract is that every failure path is
+deterministically testable: a raw ``socket.send*/recv*`` or durable
+write (``open(.., "w"/"wb")``, ``os.replace``, ``np.savez``) that does
+NOT pass a ``chaos.fault_point(...)`` in its enclosing function is a
+resilience path no chaos spec can ever exercise. Scope is the configured
+transport/durability modules (``LintConfig.chaos_modules``); one finding
+per raw call outside a fault-site-carrying function.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .core import (Finding, LintConfig, Module, call_name, own_body_walk,
+                   rule)
+
+BROAD_NAMES = frozenset({"Exception", "BaseException"})
+
+
+def _is_broad(handler: ast.ExceptHandler) -> str | None:
+    """The broad name a handler catches, or None when it's narrow."""
+    t = handler.type
+    if t is None:
+        return "except:"
+    if isinstance(t, ast.Name) and t.id in BROAD_NAMES:
+        return f"except {t.id}"
+    if isinstance(t, ast.Tuple):
+        for el in t.elts:
+            if isinstance(el, ast.Name) and el.id in BROAD_NAMES:
+                return f"except (... {el.id} ...)"
+    return None
+
+
+@rule("broad-except")
+def check_broad_except(mod: Module, config: LintConfig):
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.ExceptHandler):
+            continue
+        broad = _is_broad(node)
+        if broad is None:
+            continue
+        yield Finding(
+            mod.path, node.lineno, "broad-except",
+            f"`{broad}` can mask real corruption: narrow it to the "
+            f"failure classes this path expects, or annotate "
+            f"`# lint: broad-except-ok <reason>`",
+        )
+
+
+#: method names that are raw network transmission primitives
+RAW_SOCKET_METHODS = frozenset({
+    "sendall", "sendto", "recv", "recvfrom", "recv_into", "recvmsg",
+    "readline",
+})
+
+#: dotted calls that are durable-write primitives
+DURABLE_CALLS = frozenset({
+    "os.replace", "os.rename", "np.savez", "numpy.savez",
+    "np.savez_compressed", "numpy.savez_compressed",
+})
+
+FAULT_POINT_CALLS = frozenset({
+    "chaos.fault_point", "fault_point", "chaos.check",
+})
+
+
+def _write_mode_open(node: ast.Call) -> bool:
+    if call_name(node) != "open":
+        return False
+    mode = None
+    if len(node.args) >= 2 and isinstance(node.args[1], ast.Constant):
+        mode = node.args[1].value
+    for kw in node.keywords:
+        if kw.arg == "mode" and isinstance(kw.value, ast.Constant):
+            mode = kw.value.value
+    return isinstance(mode, str) and any(c in mode for c in "wax")
+
+
+def _raw_site(node: ast.Call) -> str | None:
+    if (isinstance(node.func, ast.Attribute)
+            and node.func.attr in RAW_SOCKET_METHODS):
+        return f".{node.func.attr}(...)"
+    name = call_name(node)
+    if name in DURABLE_CALLS:
+        return f"{name}(...)"
+    if _write_mode_open(node):
+        return "open(..., 'w')"
+    return None
+
+
+def _functions_with_bodies(tree: ast.Module):
+    """(scope-name, body-walk) pairs: every function plus the module
+    top level, each walked without descending into nested defs."""
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            yield node.name, list(own_body_walk(node))
+    top = []
+    stack = list(ast.iter_child_nodes(tree))
+    while stack:
+        n = stack.pop()
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                          ast.Lambda)):
+            continue
+        top.append(n)
+        stack.extend(ast.iter_child_nodes(n))
+    yield "<module>", top
+
+
+@rule("chaos-site-coverage")
+def check_chaos_site_coverage(mod: Module, config: LintConfig):
+    if not config.in_scope(mod.rel, config.chaos_modules):
+        return
+    for scope, body in _functions_with_bodies(mod.tree):
+        has_site = any(
+            isinstance(n, ast.Call) and call_name(n) in FAULT_POINT_CALLS
+            for n in body
+        )
+        if has_site:
+            continue
+        for n in body:
+            if isinstance(n, ast.Call):
+                raw = _raw_site(n)
+                if raw:
+                    yield Finding(
+                        mod.path, n.lineno, "chaos-site-coverage",
+                        f"raw `{raw}` in `{scope}` has no chaos fault "
+                        f"site: route it through a chaos.fault_point(..)"
+                        f"-carrying or RetryPolicy-wrapped helper so "
+                        f"fault specs can exercise this path",
+                    )

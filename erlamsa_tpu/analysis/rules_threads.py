@@ -1,0 +1,134 @@
+"""lock-discipline: declared guarded fields are only touched under their
+declared lock.
+
+The drain-worker/flusher threads (services/batcher.py), the feedback bus
+and the corpus store are the three places where a stray unlocked read or
+write silently breaks the determinism contract (a torn ``_meta`` read
+reorders a schedule; an unlocked ``_overflow`` read races its lazy
+construction). The rule is opt-in by declaration: a class states its
+locking contract as a class attribute ::
+
+    class CorpusStore:
+        _GUARDED_BY = {"_lock": ("_meta", "_next_idx", "_cache")}
+
+and from then on every ``self.<field>`` access to a declared field must
+sit inside ``with self.<lock>:`` — in every method except ``__init__``
+(single-threaded construction) and methods named ``*_locked`` (the
+documented caller-holds-the-lock convention).
+
+Classes without a ``_GUARDED_BY`` declaration are not checked; the three
+threaded owners above declare theirs, and new lock-owning classes are
+expected to (review enforces the declaration, the linter enforces the
+contract).
+
+Nested functions defined inside a method are checked with an EMPTY held-
+lock set even when the ``def`` appears lexically inside a ``with`` — a
+closure can escape and run after the lock is released.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .core import Finding, LintConfig, Module, rule
+
+
+def _guarded_decl(cls: ast.ClassDef) -> dict[str, tuple[str, ...]] | None:
+    """Parse `_GUARDED_BY = {"_lock": ("f1", "f2")}` from a class body."""
+    for node in cls.body:
+        if not isinstance(node, ast.Assign):
+            continue
+        if not any(isinstance(t, ast.Name) and t.id == "_GUARDED_BY"
+                   for t in node.targets):
+            continue
+        if not isinstance(node.value, ast.Dict):
+            return None
+        decl: dict[str, tuple[str, ...]] = {}
+        for k, v in zip(node.value.keys, node.value.values):
+            if not (isinstance(k, ast.Constant) and isinstance(k.value, str)):
+                return None
+            if not isinstance(v, (ast.Tuple, ast.List)):
+                return None
+            fields = []
+            for el in v.elts:
+                if not (isinstance(el, ast.Constant)
+                        and isinstance(el.value, str)):
+                    return None
+                fields.append(el.value)
+            decl[k.value] = tuple(fields)
+        return decl
+    return None
+
+
+def _with_locks(node: ast.With) -> set[str]:
+    """Lock attribute names acquired by `with self.<name>[, ...]:`."""
+    locks: set[str] = set()
+    for item in node.items:
+        expr = item.context_expr
+        if (isinstance(expr, ast.Attribute)
+                and isinstance(expr.value, ast.Name)
+                and expr.value.id == "self"):
+            locks.add(expr.attr)
+    return locks
+
+
+def _check_body(mod: Module, method_name: str, body, held: frozenset,
+                field_to_lock: dict[str, str]):
+    for stmt in body:
+        yield from _check_node(mod, method_name, stmt, held, field_to_lock)
+
+
+def _check_node(mod: Module, method_name: str, node: ast.AST,
+                held: frozenset, field_to_lock: dict[str, str]):
+    if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+        # a closure may outlive the lock scope: re-check with nothing held
+        # unless it follows the *_locked naming convention
+        if not node.name.endswith("_locked"):
+            yield from _check_body(mod, node.name, node.body, frozenset(),
+                                   field_to_lock)
+        return
+    if isinstance(node, ast.Lambda):
+        yield from _check_node(mod, method_name, node.body, frozenset(),
+                               field_to_lock)
+        return
+    if isinstance(node, ast.With):
+        inner = held | _with_locks(node)
+        for item in node.items:
+            yield from _check_node(mod, method_name, item.context_expr,
+                                   held, field_to_lock)
+        yield from _check_body(mod, method_name, node.body,
+                               frozenset(inner), field_to_lock)
+        return
+    if (isinstance(node, ast.Attribute)
+            and isinstance(node.value, ast.Name)
+            and node.value.id == "self"
+            and node.attr in field_to_lock
+            and field_to_lock[node.attr] not in held):
+        yield Finding(
+            mod.path, node.lineno, "lock-discipline",
+            f"`self.{node.attr}` touched in `{method_name}` without "
+            f"holding `self.{field_to_lock[node.attr]}` (declared in "
+            f"_GUARDED_BY)",
+        )
+        return  # don't double-report nested pieces of the same access
+    for child in ast.iter_child_nodes(node):
+        yield from _check_node(mod, method_name, child, held, field_to_lock)
+
+
+@rule("lock-discipline")
+def check_lock_discipline(mod: Module, config: LintConfig):
+    for cls in ast.walk(mod.tree):
+        if not isinstance(cls, ast.ClassDef):
+            continue
+        decl = _guarded_decl(cls)
+        if decl is None:
+            continue
+        field_to_lock = {f: lock for lock, fields in decl.items()
+                         for f in fields}
+        for item in cls.body:
+            if not isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            if item.name == "__init__" or item.name.endswith("_locked"):
+                continue
+            yield from _check_body(mod, item.name, item.body, frozenset(),
+                                   field_to_lock)

@@ -1,0 +1,110 @@
+"""Module symbol table + the unused-import rule.
+
+The symbol table is deliberately simple — names bound by imports vs.
+names referenced anywhere (loads, deletes, ``__all__`` strings, and
+names inside string-literal annotations, which ``from __future__ import
+annotations`` files use freely). That is enough to drive the dead-name
+sweep the linter owes the tree: an import nothing references is parse
+cost, reader noise, and — for accelerator modules — sometimes a
+surprise backend initialization.
+
+``__init__.py`` files are skipped entirely: re-exporting is their job.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from .core import Finding, LintConfig, Module, rule
+
+_WORD_RE = re.compile(r"[A-Za-z_][A-Za-z0-9_]*")
+
+
+class ImportBinding:
+    __slots__ = ("name", "lineno", "what")
+
+    def __init__(self, name: str, lineno: int, what: str):
+        self.name = name  # the local name the import binds
+        self.lineno = lineno
+        self.what = what  # human-readable import description
+
+
+def import_bindings(tree: ast.Module) -> list[ImportBinding]:
+    out: list[ImportBinding] = []
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                local = a.asname or a.name.split(".")[0]
+                out.append(ImportBinding(local, node.lineno,
+                                         f"import {a.name}"))
+        elif isinstance(node, ast.ImportFrom):
+            if node.module == "__future__":
+                continue
+            src = "." * node.level + (node.module or "")
+            for a in node.names:
+                if a.name == "*":
+                    continue
+                out.append(ImportBinding(a.asname or a.name, node.lineno,
+                                         f"from {src} import {a.name}"))
+    return out
+
+
+def _annotation_strings(tree: ast.Module) -> list[str]:
+    """String constants sitting in annotation position (postponed-
+    evaluation style hints like ``q: "queue.Queue[_Req]"``)."""
+    out: list[str] = []
+
+    def grab(ann):
+        for n in ast.walk(ann):
+            if isinstance(n, ast.Constant) and isinstance(n.value, str):
+                out.append(n.value)
+
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            a = node.args
+            for p in a.posonlyargs + a.args + a.kwonlyargs:
+                if p.annotation:
+                    grab(p.annotation)
+            for p in (a.vararg, a.kwarg):
+                if p is not None and p.annotation:
+                    grab(p.annotation)
+            if node.returns:
+                grab(node.returns)
+        elif isinstance(node, ast.AnnAssign):
+            grab(node.annotation)
+    return out
+
+
+def referenced_names(tree: ast.Module) -> set[str]:
+    """Every name the module can be said to use: loads/deletes, names in
+    string annotations, and ``__all__`` entries."""
+    used: set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Name) and isinstance(node.ctx,
+                                                     (ast.Load, ast.Del)):
+            used.add(node.id)
+        elif isinstance(node, ast.Assign):
+            for t in node.targets:
+                if isinstance(t, ast.Name) and t.id == "__all__":
+                    for n in ast.walk(node.value):
+                        if (isinstance(n, ast.Constant)
+                                and isinstance(n.value, str)):
+                            used.add(n.value)
+    for s in _annotation_strings(tree):
+        used.update(_WORD_RE.findall(s))
+    return used
+
+
+@rule("unused-import")
+def check_unused_import(mod: Module, config: LintConfig):
+    if mod.rel.endswith("__init__.py"):
+        return  # re-exporting is an __init__'s purpose
+    used = referenced_names(mod.tree)
+    for b in import_bindings(mod.tree):
+        if b.name not in used:
+            yield Finding(
+                mod.path, b.lineno, "unused-import",
+                f"`{b.what}` binds `{b.name}` which nothing references; "
+                f"delete it",
+            )

@@ -52,6 +52,9 @@ EVENT_GAIN = {
 class FeedbackBus:
     """Bounded thread-safe publish/drain queue."""
 
+    # lock discipline (analysis/rules_threads.py enforces this declaration)
+    _GUARDED_BY = {"_lock": ("_events", "published", "dropped")}
+
     def __init__(self, maxlen: int = 4096):
         self._events: deque[Event] = deque(maxlen=maxlen)
         self._lock = threading.Lock()

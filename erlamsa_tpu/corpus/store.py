@@ -49,6 +49,10 @@ def seed_id_for(data: bytes) -> str:
 class CorpusStore:
     """Deduped seed corpus with per-seed scheduling metadata."""
 
+    # lock discipline (analysis/rules_threads.py enforces this declaration):
+    # every touch of these fields happens with _lock held
+    _GUARDED_BY = {"_lock": ("_meta", "_next_idx", "_cache")}
+
     def __init__(self, root: str, create: bool = True):
         self.root = root
         self.seeds_dir = os.path.join(root, "seeds")
@@ -59,11 +63,15 @@ class CorpusStore:
         self._meta: dict[str, dict] = {}
         self._next_idx = 0
         self._cache: dict[str, bytes] = {}
-        self._load()
+        with self._lock:
+            self._load_locked()
 
     # --- persistence (cmanager.py idiom: atomic, best-effort) ------------
 
-    def _load(self):
+    def _load_locked(self):
+        """Caller holds self._lock (only __init__, before any thread can
+        see the store — locked anyway so the guarded-field discipline
+        holds by inspection, not by timing argument)."""
         for candidate in (self.meta_path, self.meta_path + ".bak"):
             if not os.path.exists(candidate):
                 continue
@@ -139,12 +147,22 @@ class CorpusStore:
                 return sid, False
             path = os.path.join(self.seeds_dir, sid)
             if not os.path.exists(path):
-                tmp = path + ".tmp"
-                with open(tmp, "wb") as f:
-                    f.write(data)
-                    f.flush()
-                    os.fsync(f.fileno())
-                os.replace(tmp, path)
+                def _write_seed():
+                    chaos.fault_point("store.seed")
+                    tmp = path + ".tmp"
+                    with open(tmp, "wb") as f:
+                        f.write(data)
+                        f.flush()
+                        os.fsync(f.fileno())
+                    os.replace(tmp, path)
+
+                try:
+                    SAVE_RETRY.call(_write_seed, site="store.seed")
+                except (RetryExhausted, OSError):
+                    # best-effort like _save_locked: the seed keeps being
+                    # served from the in-memory cache this run; fsck drops
+                    # the metadata entry if the file never landed
+                    pass
             self._meta[sid] = {
                 "idx": self._next_idx,
                 "len": len(data),
@@ -227,6 +245,7 @@ class CorpusStore:
                     corrupt += 1
                     os.makedirs(qdir, exist_ok=True)
                     try:
+                        # lint: chaos-site-coverage-ok quarantine move on the recovery path
                         os.replace(path, os.path.join(qdir, name))
                     except OSError:
                         pass
@@ -242,6 +261,7 @@ class CorpusStore:
                     else:
                         os.makedirs(qdir, exist_ok=True)
                         try:
+                            # lint: chaos-site-coverage-ok quarantine move on the recovery path
                             os.replace(path, os.path.join(qdir, name))
                         except OSError:
                             pass
@@ -258,7 +278,7 @@ class CorpusStore:
         # adoption re-enters through add() (it takes the lock itself)
         for data in orphan_data:
             self.add(data, origin="fsck-orphan")
-        ok = len(self._meta)
+        ok = len(self)
         summary = {"missing": missing, "corrupt": corrupt,
                    "orphans": orphans, "ok": ok}
         if missing or corrupt or orphans:
@@ -268,24 +288,29 @@ class CorpusStore:
         return summary
 
     def get(self, seed_id: str) -> bytes:
-        data = self._cache.get(seed_id)
+        with self._lock:
+            data = self._cache.get(seed_id)
         if data is None:
             with open(os.path.join(self.seeds_dir, seed_id), "rb") as f:
                 data = f.read()
-            self._cache[seed_id] = data
+            with self._lock:
+                self._cache[seed_id] = data
         return data
 
     def ids(self) -> list[str]:
         """Seed ids in insertion order — THE deterministic ordering every
         scheduler draw indexes into (energy.EnergyScheduler)."""
         with self._lock:
-            return sorted(self._meta, key=lambda s: self._meta[s]["idx"])
+            items = sorted(self._meta.items(), key=lambda kv: kv[1]["idx"])
+        return [sid for sid, _ in items]
 
     def __len__(self) -> int:
-        return len(self._meta)
+        with self._lock:
+            return len(self._meta)
 
     def __contains__(self, seed_id: str) -> bool:
-        return seed_id in self._meta
+        with self._lock:
+            return seed_id in self._meta
 
     def meta(self, seed_id: str) -> dict:
         with self._lock:
@@ -315,7 +340,7 @@ class CorpusStore:
         `credit` set — the seeds scheduled in the case that was in flight,
         the same attribution AFL makes."""
         gain = EVENT_GAIN.get(ev.kind, 1.0)
-        if ev.seed_id is not None and ev.seed_id in self._meta:
+        if ev.seed_id is not None and ev.seed_id in self:
             self.bump(ev.seed_id, gain, ev.kind)
         elif credit:
             share = gain / len(credit)

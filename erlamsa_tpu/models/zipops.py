@@ -19,7 +19,7 @@ def list_members(data: bytes) -> list[tuple[str, bytes]] | None:
     try:
         with zipfile.ZipFile(io.BytesIO(data)) as z:
             return [(i.filename, z.read(i.filename)) for i in z.infolist()]
-    except Exception:
+    except Exception:  # lint: broad-except-ok any parse failure means not-a-ZIP
         return None
 
 

@@ -44,7 +44,7 @@ from .num_mutators import (
 from .registry import DEVICE_CODES
 from .scheduler import adjust_scores, weighted_pick
 from .seq_mutators import _span as _span_draw
-from .utf8_mutators import _FUNNY_LENS, _FUNNY_TABLE
+from .utf8_mutators import funny_tables
 
 PERM_WINDOW = 256  # byte-permute window cap (radamsa uses 20)
 PERM_LINES = 64  # line-permute window cap
@@ -284,8 +284,7 @@ def _pg_utf8_widen(key, t):
 
 def _pg_utf8_insert(key, t):
     p = _zeros()
-    table = jnp.asarray(_FUNNY_TABLE)
-    lens = jnp.asarray(_FUNNY_LENS)
+    table, lens = funny_tables()
     pos = prng.rand(prng.sub(key, prng.TAG_POS), t.n)
     row = prng.rand(prng.sub(key, prng.TAG_VAL), table.shape[0])
     seq = table[row]
@@ -504,10 +503,10 @@ def _pg_none(key, t):
 
 def _payload_pg(draw):
     def pg(key, t):
-        from .payload_mutators import _table
+        from .payload_mutators import payload_tables
 
         p = _zeros()
-        tab, _lens = _table()
+        tab, _lens = payload_tables()
         pos, drop, row, lit_len, reps, delta = draw(key, t.n)
         p["kind"] = jnp.int32(K_SPLICE)
         p["pos"] = pos

@@ -17,6 +17,8 @@ first 18 digits, and a lone '-' chain collapses to one sign.
 
 from __future__ import annotations
 
+import functools
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -47,6 +49,15 @@ def _interesting_numbers() -> "np.ndarray":
 _INTERESTING_NP = _interesting_numbers()
 
 
+@functools.lru_cache(maxsize=None)
+def _interesting_dev():
+    """The interesting-numbers table as a device constant, built once per
+    process instead of per call/trace. Concrete even under an active
+    trace — see utf8_mutators.funny_tables."""
+    with jax.ensure_compile_time_eval():
+        return jnp.asarray(_INTERESTING_NP)
+
+
 def _rand_log_i64(key, n) -> jax.Array:
     """rand_log with the result clamped into int64 (reference draws up to
     2^127 bignums; we cap the bit width at 62)."""
@@ -65,7 +76,7 @@ def _mutate_num(key, v: jax.Array) -> jax.Array:
     catch-all, as in the reference's clause order."""
     t = prng.rand(prng.sub(key, prng.TAG_VAL), 12)
     ki = prng.sub(key, prng.TAG_AUX)
-    interesting_tbl = jnp.asarray(_INTERESTING_NP)
+    interesting_tbl = _interesting_dev()
     interesting = interesting_tbl[
         prng.rand(prng.sub(ki, 1), interesting_tbl.shape[0])
     ]

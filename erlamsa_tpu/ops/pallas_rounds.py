@@ -96,7 +96,8 @@ from .registry import (
     P_TEXT_3L,
     P_WIDENABLE,
 )
-from .utf8_mutators import _FUNNY_LENS, _FUNNY_TABLE
+from .payload_mutators import payload_tables
+from .utf8_mutators import funny_tables
 
 R_MAX = MAX_BURST_MUTATIONS
 M = NUM_DEVICE_MUTATORS
@@ -1191,8 +1192,8 @@ def case_rounds_single(key, data_row, n, scores, pri, rounds):
     pri2 = jnp.asarray(pri, jnp.int32).reshape(1, M)
     sc2 = jnp.asarray(scores, jnp.int32).reshape(1, M)
     data2 = data_row.reshape(1, L)
-    funny_t = jnp.asarray(_FUNNY_TABLE)
-    funny_l = jnp.asarray(_FUNNY_LENS, jnp.int32).reshape(1, -1)
+    funny_t, _funny_lens = funny_tables()
+    funny_l = _funny_lens.astype(jnp.int32).reshape(1, -1)
     # interesting numbers as int32 halves: int64 VECTORS never enter the
     # kernel (32-bit Mosaic); scalars are reassembled in _tbl_at64
     _itbl64 = np.asarray(_INTERESTING_NP, np.int64)
@@ -1200,8 +1201,8 @@ def case_rounds_single(key, data_row, n, scores, pri, rounds):
     int_lo = jnp.asarray(
         (_itbl64 & 0xFFFFFFFF).astype(np.uint32).astype(np.int32)
     ).reshape(1, -1)
-    pay_t = jnp.asarray(payloads.TABLE)
-    pay_l = jnp.asarray(payloads.LENS, jnp.int32).reshape(1, -1)
+    pay_t, _pay_lens = payload_tables()
+    pay_l = _pay_lens.astype(jnp.int32).reshape(1, -1)
     out_shape = (
         jax.ShapeDtypeStruct((1, L), jnp.uint8),
         jax.ShapeDtypeStruct((1, 1), jnp.int32),

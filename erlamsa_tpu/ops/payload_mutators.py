@@ -33,6 +33,9 @@ standalone switch kernel so both engines emit the same streams):
 
 from __future__ import annotations
 
+import functools
+
+import jax
 import jax.numpy as jnp
 
 from . import payloads, prng
@@ -41,13 +44,25 @@ from . import payloads, prng
 _AAA_COUNTS = (127, 128, 255, 256, 16383, 16384, 32767, 32768, 65535, 65536)
 
 
-def _table():
-    return jnp.asarray(payloads.TABLE), jnp.asarray(payloads.LENS)
+@functools.lru_cache(maxsize=None)
+def payload_tables():
+    """Device-resident (table, lens) for the packed payload table, built
+    once per process instead of per call/trace (also used by the pallas
+    rounds engine). Concrete even under an active trace — see
+    utf8_mutators.funny_tables."""
+    with jax.ensure_compile_time_eval():
+        return jnp.asarray(payloads.TABLE), jnp.asarray(payloads.LENS)
+
+
+@functools.lru_cache(maxsize=None)
+def _aaa_counts():
+    with jax.ensure_compile_time_eval():
+        return jnp.asarray(_AAA_COUNTS, jnp.int32)
 
 
 def draw_ab(key, n):
     """-> (pos, drop, row, lit_len, reps, delta): the ab edit program."""
-    _tab, lens = _table()
+    _tab, lens = payload_tables()
     kt = prng.sub(key, prng.TAG_TABLE)
     v = prng.rand(prng.sub(key, prng.TAG_MASK), 5)
     pos_ins = prng.rand(prng.sub(key, prng.TAG_POS), jnp.maximum(n, 1))
@@ -58,7 +73,7 @@ def draw_ab(key, n):
     t = prng.rand(prng.sub(kt, 2), 11)
     aaa_reps = jnp.where(
         t < 10,
-        jnp.asarray(_AAA_COUNTS, jnp.int32)[jnp.clip(t, 0, 9)],
+        _aaa_counts()[jnp.clip(t, 0, 9)],
         prng.rand(prng.sub(kt, 3), 1024),
     )
 
@@ -85,7 +100,7 @@ def draw_ab(key, n):
 
 def draw_ad(key, n):
     """-> (pos, drop, row, lit_len, reps, delta): the ad edit program."""
-    _tab, lens = _table()
+    _tab, lens = payload_tables()
     kt = prng.sub(key, prng.TAG_TABLE)
     v = prng.rand(prng.sub(key, prng.TAG_MASK), 4)
     delim_row = payloads.DELIM0 + prng.rand(prng.sub(kt, 1), payloads.N_DELIM)
@@ -121,7 +136,7 @@ def lit_splice(data, n, pos, drop, lit, lit_len, reps):
 
 def _payload_kernel(draw):
     def kernel(key, data, n):
-        tab, _lens = _table()
+        tab, _lens = payload_tables()
         pos, drop, row, lit_len, reps, delta = draw(key, n)
         out, n_out = lit_splice(data, n, pos, drop, tab[row], lit_len, reps)
         return out, n_out, delta

@@ -118,7 +118,7 @@ def drain_futures(futures) -> None:
     for fut in futures:
         try:
             fut.block()
-        except BaseException:
+        except BaseException:  # lint: broad-except-ok drain after device loss; caller knows
             pass
 
 

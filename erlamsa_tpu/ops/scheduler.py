@@ -29,9 +29,20 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
+import functools
+
 from ..constants import MAX_SCORE, MIN_SCORE
 from . import prng
 from .registry import DEVICE_MUTATORS, NUM_DEVICE_MUTATORS, PRED_INDEX_NP, predicates
+
+
+@functools.lru_cache(maxsize=None)
+def _pred_index():
+    """registry.PRED_INDEX_NP as a device constant, built once per
+    process instead of per call/trace. Concrete even under an active
+    trace — see utf8_mutators.funny_tables."""
+    with jax.ensure_compile_time_eval():
+        return jnp.asarray(PRED_INDEX_NP)
 
 _KERNELS = tuple(m.kernel for m in DEVICE_MUTATORS)
 
@@ -58,7 +69,7 @@ def weighted_pick(key, data, n, scores, pri, preds=None):
     M = NUM_DEVICE_MUTATORS
     if preds is None:
         preds = predicates(data, n)  # bool[NUM_PREDS]
-    applicable = preds[jnp.asarray(PRED_INDEX_NP)] & (pri > 0)
+    applicable = preds[_pred_index()] & (pri > 0)
 
     # weighted permutation: r_m = rand(score_m * pri_m), sorted desc.
     # One threefry call for all M draws (bits % bound, bias < 1e-7 at

@@ -8,6 +8,8 @@ kernels splice a randomly chosen row into the sample with a masked gather.
 
 from __future__ import annotations
 
+import functools
+
 import jax
 import jax.numpy as jnp
 
@@ -16,6 +18,17 @@ from . import prng
 from .byte_mutators import _guard_empty, _positions
 
 _FUNNY_TABLE, _FUNNY_LENS = funny_unicode_np()
+
+
+@functools.lru_cache(maxsize=None)
+def funny_tables():
+    """Device-resident (table, lens) for the funny-unicode splice, built
+    once per process instead of once per call/trace (shared with the
+    fused and pallas engines). ensure_compile_time_eval keeps the arrays
+    CONCRETE even when the first call happens inside a jit trace — a
+    cached tracer would escape its trace and poison every later call."""
+    with jax.ensure_compile_time_eval():
+        return jnp.asarray(_FUNNY_TABLE), jnp.asarray(_FUNNY_LENS)
 
 
 def splice(data, n, pos, repl, repl_len, drop_len):
@@ -67,8 +80,7 @@ def utf8_widen(key, data, n):
 def utf8_insert(key, data, n):
     """ui: insert a funny unicode sequence after a random byte
     (erlamsa_mutations.erl:1091-1099)."""
-    table = jnp.asarray(_FUNNY_TABLE)
-    lens = jnp.asarray(_FUNNY_LENS)
+    table, lens = funny_tables()
     p = prng.rand(prng.sub(key, prng.TAG_POS), n)
     row = prng.rand(prng.sub(key, prng.TAG_VAL), table.shape[0])
     seq = table[row]

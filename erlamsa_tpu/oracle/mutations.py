@@ -16,13 +16,12 @@ from __future__ import annotations
 
 import base64 as b64mod
 import math
-from typing import Any, Callable
+from typing import Callable
 
 import numpy as np
 
 from ..constants import ABSMAX_BINARY_BLOCK, MAX_SCORE, MIN_SCORE
 from ..models import fieldpred, fuse as fusemod, jsonfmt, sgmlfmt, strlex, treeops, zipops
-from ..utils import erlrand
 from ..utils.bytehelpers import binarish, flush_bvecs, halve
 from ..utils.erlrand import ErlRand
 from ..utils.tables import funny_unicode, interesting_numbers
@@ -630,7 +629,7 @@ def base64_mutator(ctx: Ctx):
                     total_d += d
                     new_meta = [mm, ("base64_mutator", d)] + new_meta
                     continue
-                except Exception:
+                except Exception:  # lint: broad-except-ok not base64: keep chunk unchanged
                     pass
             new_cs.append(chunk)
         return fn, [strlex.unlex(new_cs)] + ll[1:], new_meta, total_d

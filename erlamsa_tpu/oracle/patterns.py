@@ -244,7 +244,7 @@ def pat_archiver(ctx: Ctx, ll, rows, meta):
             new_members.append((name, content))
     try:
         return [zipops.rebuild(new_members)], frows, [("archiver", "ok")] + meta
-    except Exception:
+    except Exception:  # lint: broad-except-ok rebuild failure degrades to joined bytes
         return [joined], frows, [("archiver", "failed")] + meta
 
 
@@ -277,7 +277,7 @@ def pat_compressed(ctx: Ctx, ll, rows, meta):
             meta = [("compressed", kind)] + meta
             ok = True
             break
-        except Exception:
+        except Exception:  # lint: broad-except-ok codec probe: try the next kind
             continue
     if not ok or new_bin == bin_:
         this, rest2 = _split(ctx.r, bin_, rest)

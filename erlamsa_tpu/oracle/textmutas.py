@@ -7,7 +7,6 @@ tables with the reference's draw order.
 
 from __future__ import annotations
 
-from ..models import strlex
 from ..utils.erlrand import ErlRand
 from ..utils.tables import DELIMETERS, REV_CONNECTS, SHELL_INJECTS, SILLY_STRINGS
 

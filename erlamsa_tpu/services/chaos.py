@@ -31,8 +31,10 @@ Known sites (grep `fault_point(` for the authoritative list):
     dist.recv        node response parse (services/dist.py)
     batcher.step     TpuBatcher's jitted device call (services/batcher.py)
     store.save       corpus.json snapshot write (corpus/store.py)
+    store.seed       seed-file publish in CorpusStore.add (corpus/store.py)
     device.step      corpus runner's bucket dispatch (corpus/runner.py)
     checkpoint.load  --state checkpoint read (services/checkpoint.py)
+    checkpoint.save  --state checkpoint write (services/checkpoint.py)
 
 Injected failures raise ``InjectedFault``, an OSError subclass, so they
 flow through exactly the except-clauses that catch real socket/disk

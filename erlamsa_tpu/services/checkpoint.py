@@ -12,11 +12,20 @@ stop and resume exactly.
 from __future__ import annotations
 
 import os
+import zipfile
 import zlib
 
 import numpy as np
 
 from . import chaos, logger
+from .resilience import RetryExhausted, RetryPolicy
+
+# checkpoint saves ride the same quick-retry policy as the corpus store:
+# one retry absorbs a transient disk error (or an injected checkpoint.save
+# fault); a persistently failing disk degrades to best-effort — the run
+# continues and resume restarts from the previous good checkpoint
+SAVE_RETRY = RetryPolicy(attempts=2, base=0.01, max_delay=0.1,
+                         retry_on=(OSError,))
 
 
 def _engine_stamp(engine: str = "fused") -> np.ndarray:
@@ -104,23 +113,32 @@ def save_state(path: str, seed, case_idx: int, scores,
             ),
         )
     fields["checksum"] = _checksum(fields)
-    with open(tmp, "wb") as f:
-        np.savez(f, **fields)
-        # data must be durable BEFORE the rename publishes it, or a crash
-        # right after os.replace leaves a truncated checkpoint and the run
-        # silently restarts from case 0
-        f.flush()
-        os.fsync(f.fileno())
-    # keep the previous good checkpoint as .bak: the loaders fall back to
-    # it when the primary turns out corrupt (torn disk, fs bug) — a run
-    # then resumes a few cases earlier instead of restarting from 0
-    if os.path.exists(path):
-        try:
-            os.replace(path, path + ".bak")
-        except OSError:
-            pass
-    os.replace(tmp, path)
-    fsync_dir(path)
+
+    def _write():
+        chaos.fault_point("checkpoint.save")
+        with open(tmp, "wb") as f:
+            np.savez(f, **fields)
+            # data must be durable BEFORE the rename publishes it, or a
+            # crash right after os.replace leaves a truncated checkpoint
+            # and the run silently restarts from case 0
+            f.flush()
+            os.fsync(f.fileno())
+        # keep the previous good checkpoint as .bak: the loaders fall back
+        # to it when the primary turns out corrupt (torn disk, fs bug) — a
+        # run then resumes a few cases earlier instead of restarting from 0
+        if os.path.exists(path):
+            try:
+                os.replace(path, path + ".bak")
+            except OSError:
+                pass
+        os.replace(tmp, path)
+        fsync_dir(path)
+
+    try:
+        SAVE_RETRY.call(_write, site="checkpoint.save")
+    except (RetryExhausted, OSError):
+        logger.log("warning", "checkpoint %s: save failed; run continues, "
+                   "resume falls back to the previous checkpoint", path)
 
 
 def _read_verified(path: str) -> dict | None:
@@ -159,7 +177,8 @@ def _load_fields(path: str, engine: str) -> dict | None:
                 logger.log("warning", "checkpoint %s unusable, resumed "
                            "from backup %s", path, candidate)
             break
-        except Exception as e:
+        except (OSError, KeyError, ValueError, zipfile.BadZipFile,
+                zlib.error) as e:
             if candidate == path:
                 logger.log("warning", "checkpoint %s unreadable (%s), "
                            "trying backup", path, e)
@@ -201,7 +220,8 @@ def load_state(path: str, engine: str = "fused"):
                                 z["host_values_post"])
             }
         return seed, case_idx, scores, host_scores, host_post
-    except Exception:
+    except (OSError, KeyError, ValueError, TypeError, zipfile.BadZipFile,
+            zlib.error):
         return None
 
 
@@ -219,5 +239,6 @@ def load_corpus_energies(path: str, engine: str = "fused") -> dict | None:
             for s, e, h in zip(z["corpus_ids"], z["corpus_energy"],
                                z["corpus_hits"])
         }
-    except Exception:
+    except (OSError, KeyError, ValueError, TypeError, zipfile.BadZipFile,
+            zlib.error):
         return None

@@ -15,7 +15,8 @@ import os
 import threading
 import time
 
-from ..constants import NODE_ALIVE_DELTA  # noqa: F401  (re-exported constants live here)
+# lint: unused-import-ok re-exported: cluster callers import it from here
+from ..constants import NODE_ALIVE_DELTA  # noqa: F401
 
 SESSION_EXPIRETIME = 600.0  # src/erlamsa.hrl:71
 TOKEN_BITS = 160  # src/erlamsa.hrl:69

@@ -209,7 +209,7 @@ class ParentServer:
         self._stop.set()
         try:
             self._srv.close()
-        except Exception:
+        except OSError:
             pass
 
 

@@ -22,8 +22,6 @@ load exactly like the reference's external_muta example.
 from __future__ import annotations
 
 import importlib
-from typing import Any
-
 from ..constants import MAX_SCORE
 
 

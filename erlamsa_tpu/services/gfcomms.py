@@ -75,5 +75,5 @@ class GfComms:
         self._stop.set()
         try:
             self._srv.close()
-        except Exception:
+        except OSError:
             pass

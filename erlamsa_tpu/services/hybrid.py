@@ -173,7 +173,7 @@ class HybridDispatcher:
                 # (e.g. a blocked import this env scrub didn't prevent):
                 # TimeoutError routes to the same degrade path
                 list(self._pool.map(warmup, range(workers), timeout=60))
-            except Exception as e:  # noqa: BLE001
+            except Exception as e:  # lint: broad-except-ok degrade to thread pool on any bootstrap failure
                 # a worker died or hung during bootstrap: reap the
                 # executor rather than leak its workers, and degrade to
                 # the thread pool — slower (GIL-bound) but functional
@@ -192,7 +192,7 @@ class HybridDispatcher:
                 for p in getattr(self._pool, "_processes", {}).values():
                     try:
                         p.terminate()
-                    except Exception:  # noqa: BLE001 — already dead is fine
+                    except Exception:  # lint: broad-except-ok already-dead worker is fine
                         pass
                 self._pool.shutdown(wait=False, cancel_futures=True)
                 self._pool = cf.ThreadPoolExecutor(max_workers=workers)

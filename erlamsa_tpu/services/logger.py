@@ -91,7 +91,7 @@ class Logger:
                 if lvl <= sink_lvl:
                     try:
                         write(line)
-                    except Exception:
+                    except Exception:  # lint: broad-except-ok a broken sink must not kill the log loop
                         pass
             self._q.task_done()
 
@@ -145,7 +145,7 @@ class SqliteSink:
         self._op_err = sqlite3.OperationalError
         try:
             self._conn.execute("PRAGMA journal_mode=WAL")
-        except Exception:  # noqa: BLE001 — e.g. WAL unsupported on this fs
+        except Exception:  # lint: broad-except-ok WAL may be unsupported on this fs
             pass
         self._conn.execute(
             "CREATE TABLE IF NOT EXISTS log ("

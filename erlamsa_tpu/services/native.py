@@ -9,7 +9,6 @@ gracefully rather than breaking the CLI.
 from __future__ import annotations
 
 import ctypes
-import os
 import shutil
 import subprocess
 import threading

@@ -450,7 +450,7 @@ class FuzzProxy:
         self._stop.set()
         try:
             self._srv.close()
-        except Exception:
+        except OSError:
             pass
 
 

@@ -88,7 +88,7 @@ class SupervisedThread:
             try:
                 self.target(*self.args, **self.kwargs)
                 return  # normal completion: don't resurrect
-            except Exception as e:
+            except Exception as e:  # lint: broad-except-ok a crash IS the supervised event
                 now = time.monotonic()
                 self.total_crashes += 1
                 self.crashes = [

@@ -236,7 +236,7 @@ class BridgeServer:
             raise ProtocolError(f"unknown opcode {opcode:#x}")
         except ProtocolError as e:
             return encode_frame(OP_ERROR, {"error": str(e)})
-        except Exception as e:  # never kill the port on a bad sample
+        except Exception as e:  # lint: broad-except-ok never kill the port on a bad sample
             return encode_frame(OP_ERROR, {"error": f"{type(e).__name__}: {e}"})
 
     def serve_stream(self, read, write) -> None:

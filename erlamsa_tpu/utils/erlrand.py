@@ -269,6 +269,7 @@ def gen_urandom_seed() -> tuple[int, int, int]:
     import os
 
     def word() -> int:
+        # lint: no-wallclock-nondeterminism-ok entropy mints the run seed; everything downstream is pure in it
         b = os.urandom(2)
         return b[1] + (b[0] << 8)
 

@@ -38,7 +38,7 @@ def run_with_timeout(fn, timeout: float, /, *args, **kwargs):
     def target():
         try:
             box["result"] = fn(*args, **kwargs)
-        except BaseException as e:  # re-raised in the caller below
+        except BaseException as e:  # lint: broad-except-ok re-raised in the caller below
             box["error"] = e
         finally:
             done.set()

@@ -14,17 +14,35 @@
 # spec (ERLAMSA_FAULTS="dist.send:x2,store.save:x1") — and asserts the
 # two output streams are byte-identical: transparent faults must be
 # absorbed by retries, never reach the data path (services/chaos.py).
+#
+# The gate starts with fuzzlint (erlamsa_tpu/analysis): pure-AST
+# invariant checks (determinism, device purity, lock discipline,
+# resilience coverage) over the whole package in ~2s. Opt out with
+# --no-lint (e.g. while iterating on a known-dirty tree).
 set -o pipefail
 
 bench_smoke=0
 chaos_smoke=0
+lint=1
 while [ $# -gt 0 ]; do
   case "$1" in
     --bench-smoke) bench_smoke=1; shift ;;
     --chaos-smoke) chaos_smoke=1; shift ;;
+    --lint) lint=1; shift ;;
+    --no-lint) lint=0; shift ;;
     *) break ;;
   esac
 done
+
+if [ $lint -eq 1 ]; then
+  echo "== fuzzlint: static invariant checks =="
+  timeout -k 5 60 python -m erlamsa_tpu.analysis.lint erlamsa_tpu/
+  lint_rc=$?
+  echo LINT_CLEAN=$([ $lint_rc -eq 0 ] && echo 1 || echo 0)
+  if [ $lint_rc -ne 0 ]; then
+    exit $lint_rc
+  fi
+fi
 
 rm -f /tmp/_t1.log
 timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \

@@ -1,0 +1,429 @@
+"""fuzzlint (erlamsa_tpu/analysis): per-rule fixtures, suppressions, CLI.
+
+Each bad fixture is minimal and must produce EXACTLY one finding of its
+rule — a rule that fires twice on the minimal trigger would double-count
+real code — and each good fixture must produce none. The final test
+lints the shipped package itself: the rule set is enforced, not
+aspirational.
+"""
+
+import os
+import textwrap
+
+import erlamsa_tpu
+from erlamsa_tpu.analysis import LintConfig, RULES, run_lint
+from erlamsa_tpu.analysis.lint import main as lint_main
+
+#: fixture files live outside the package, so their package-relative key
+#: is the bare filename; empty-prefix configs put them in scope per rule
+ALL_SCOPE = LintConfig(
+    wallclock_paths=("",),
+    traced_paths=("",),
+    kernel_modules=("*",),
+    chaos_modules=("",),
+)
+
+
+def lint_src(tmp_path, src, rules, config=ALL_SCOPE, name="fixture.py"):
+    p = tmp_path / name
+    p.write_text(textwrap.dedent(src))
+    return run_lint([str(p)], rules=rules, config=config)
+
+
+def one_finding(findings, rule):
+    assert len(findings) == 1, [f.render() for f in findings]
+    assert findings[0].rule == rule
+    return findings[0]
+
+
+# ---- no-wallclock-nondeterminism ----------------------------------------
+
+
+def test_wallclock_bad(tmp_path):
+    f = one_finding(
+        lint_src(tmp_path, """\
+            import time
+
+            def stamp():
+                return time.time()
+        """, ["no-wallclock-nondeterminism"]),
+        "no-wallclock-nondeterminism",
+    )
+    assert f.line == 4
+
+
+def test_wallclock_good_monotonic_and_seeded_rng(tmp_path):
+    assert lint_src(tmp_path, """\
+        import time
+        import numpy as np
+
+        def stamp():
+            return time.monotonic()
+
+        def draws(seed):
+            return np.random.default_rng(seed).integers(0, 10, 4)
+    """, ["no-wallclock-nondeterminism"]) == []
+
+
+def test_wallclock_unseeded_rng_flagged(tmp_path):
+    one_finding(
+        lint_src(tmp_path, """\
+            import numpy as np
+
+            def draws():
+                return np.random.default_rng().integers(0, 10, 4)
+        """, ["no-wallclock-nondeterminism"]),
+        "no-wallclock-nondeterminism",
+    )
+
+
+def test_wallclock_out_of_scope_path_passes(tmp_path):
+    # default config scopes by package-relative path; a fixture outside
+    # ops//corpus/ is not a replay path
+    assert lint_src(tmp_path, """\
+        import time
+
+        def stamp():
+            return time.time()
+    """, ["no-wallclock-nondeterminism"], config=LintConfig()) == []
+
+
+# ---- traced-host-sync ---------------------------------------------------
+
+
+def test_traced_host_sync_bad_coercion(tmp_path):
+    f = one_finding(
+        lint_src(tmp_path, """\
+            import numpy as np
+
+            def kernel(key, data):
+                return np.asarray(data)
+        """, ["traced-host-sync"]),
+        "traced-host-sync",
+    )
+    assert "kernel" in f.message
+
+
+def test_traced_host_sync_bad_item_via_callee(tmp_path):
+    # the sync sits in a helper only REACHABLE from the jitted root
+    one_finding(
+        lint_src(tmp_path, """\
+            import jax
+
+            def helper(x):
+                return x.item()
+
+            @jax.jit
+            def root(data):
+                return helper(data)
+        """, ["traced-host-sync"]),
+        "traced-host-sync",
+    )
+
+
+def test_traced_host_sync_good(tmp_path):
+    # host-side helpers (not key/data-led, not jitted) and cached
+    # constant builders are exempt
+    assert lint_src(tmp_path, """\
+        import functools
+
+        import numpy as np
+
+        def pack_host(samples):
+            return np.asarray(samples)
+
+        @functools.lru_cache(maxsize=None)
+        def table(key_unused=None):
+            return np.asarray([1, 2, 3])
+    """, ["traced-host-sync"]) == []
+
+
+# ---- per-call-constant-tables -------------------------------------------
+
+
+def test_constant_tables_bad(tmp_path):
+    f = one_finding(
+        lint_src(tmp_path, """\
+            import jax.numpy as jnp
+
+            TABLE = (1, 2, 3)
+
+            def kernel(key):
+                return jnp.asarray(TABLE)
+        """, ["per-call-constant-tables"]),
+        "per-call-constant-tables",
+    )
+    assert "TABLE" in f.message
+
+
+def test_constant_tables_good_cached_and_local_coercion(tmp_path):
+    assert lint_src(tmp_path, """\
+        import functools
+
+        import jax.numpy as jnp
+
+        TABLE = (1, 2, 3)
+
+        @functools.lru_cache(maxsize=None)
+        def table():
+            return jnp.asarray(TABLE)
+
+        def kernel(key):
+            n = key + 1
+            return table()[jnp.asarray(n, jnp.int32)]
+    """, ["per-call-constant-tables"]) == []
+
+
+# ---- lock-discipline ----------------------------------------------------
+
+
+LOCK_BAD = """\
+    import threading
+
+    class Box:
+        _GUARDED_BY = {"_lock": ("_val",)}
+
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._val = 0
+
+        def bump(self):
+            self._val += 1
+"""
+
+
+def test_lock_discipline_bad(tmp_path):
+    f = one_finding(
+        lint_src(tmp_path, LOCK_BAD, ["lock-discipline"]),
+        "lock-discipline",
+    )
+    assert "_val" in f.message and "bump" in f.message
+
+
+def test_lock_discipline_good(tmp_path):
+    assert lint_src(tmp_path, """\
+        import threading
+
+        class Box:
+            _GUARDED_BY = {"_lock": ("_val",)}
+
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._val = 0
+
+            def bump(self):
+                with self._lock:
+                    self._val += 1
+
+            def _drain_locked(self):
+                return self._val
+    """, ["lock-discipline"]) == []
+
+
+def test_lock_discipline_closure_does_not_inherit_lock(tmp_path):
+    # a def inside `with self._lock:` may outlive the lock — still a finding
+    one_finding(
+        lint_src(tmp_path, """\
+            import threading
+
+            class Box:
+                _GUARDED_BY = {"_lock": ("_val",)}
+
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._val = 0
+
+                def bump(self):
+                    with self._lock:
+                        def later():
+                            return self._val
+                        return later
+        """, ["lock-discipline"]),
+        "lock-discipline",
+    )
+
+
+def test_lock_discipline_undeclared_class_not_checked(tmp_path):
+    assert lint_src(tmp_path, """\
+        import threading
+
+        class Box:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._val = 0
+
+            def bump(self):
+                self._val += 1
+    """, ["lock-discipline"]) == []
+
+
+# ---- broad-except -------------------------------------------------------
+
+
+def test_broad_except_bad(tmp_path):
+    one_finding(
+        lint_src(tmp_path, """\
+            def f():
+                try:
+                    return 1
+                except Exception:
+                    return None
+        """, ["broad-except"]),
+        "broad-except",
+    )
+
+
+def test_broad_except_good_narrow_tuple(tmp_path):
+    assert lint_src(tmp_path, """\
+        def f():
+            try:
+                return 1
+            except (OSError, ValueError):
+                return None
+    """, ["broad-except"]) == []
+
+
+def test_broad_except_suppressed_with_reason(tmp_path):
+    assert lint_src(tmp_path, """\
+        def f():
+            try:
+                return 1
+            except Exception:  # lint: broad-except-ok give-up path answers empty
+                return None
+    """, ["broad-except"]) == []
+
+
+def test_broad_except_suppression_requires_reason(tmp_path):
+    f = one_finding(
+        lint_src(tmp_path, """\
+            def f():
+                try:
+                    return 1
+                except Exception:  # lint: broad-except-ok
+                    return None
+        """, ["broad-except"]),
+        "broad-except",
+    )
+    assert "no reason" in f.message
+
+
+# ---- chaos-site-coverage ------------------------------------------------
+
+
+def test_chaos_coverage_bad(tmp_path):
+    f = one_finding(
+        lint_src(tmp_path, """\
+            import os
+
+            def publish(tmp, path):
+                os.replace(tmp, path)
+        """, ["chaos-site-coverage"]),
+        "chaos-site-coverage",
+    )
+    assert "publish" in f.message
+
+
+def test_chaos_coverage_good_fault_point(tmp_path):
+    assert lint_src(tmp_path, """\
+        import os
+
+        def publish(tmp, path, chaos):
+            chaos.fault_point("store.save")
+            os.replace(tmp, path)
+    """, ["chaos-site-coverage"]) == []
+
+
+def test_chaos_coverage_suppression_on_preceding_line(tmp_path):
+    assert lint_src(tmp_path, """\
+        import os
+
+        def quarantine(src, dst):
+            # lint: chaos-site-coverage-ok recovery path
+            os.replace(src, dst)
+    """, ["chaos-site-coverage"]) == []
+
+
+# ---- unused-import ------------------------------------------------------
+
+
+def test_unused_import_bad(tmp_path):
+    f = one_finding(
+        lint_src(tmp_path, """\
+            import os
+
+            X = 1
+        """, ["unused-import"]),
+        "unused-import",
+    )
+    assert "os" in f.message
+
+
+def test_unused_import_good_string_annotation(tmp_path):
+    assert lint_src(tmp_path, """\
+        import queue
+
+        def take(q: "queue.Queue[int]") -> int:
+            return q.get()
+    """, ["unused-import"]) == []
+
+
+def test_unused_import_reexport_suppression(tmp_path):
+    assert lint_src(tmp_path, """\
+        # lint: unused-import-ok re-exported for callers
+        import os
+
+        X = 1
+    """, ["unused-import"]) == []
+
+
+# ---- driver / CLI -------------------------------------------------------
+
+
+def test_unknown_rule_raises(tmp_path):
+    (tmp_path / "m.py").write_text("X = 1\n")
+    try:
+        run_lint([str(tmp_path / "m.py")], rules=["no-such-rule"])
+    except ValueError as e:
+        assert "no-such-rule" in str(e)
+    else:
+        raise AssertionError("unknown rule accepted")
+
+
+def test_parse_error_is_a_finding(tmp_path):
+    p = tmp_path / "broken.py"
+    p.write_text("def f(:\n")
+    findings = run_lint([str(p)])
+    assert [f.rule for f in findings] == ["parse-error"]
+
+
+def test_rule_catalogue_covers_the_issue_contract():
+    assert {
+        "no-wallclock-nondeterminism", "traced-host-sync",
+        "per-call-constant-tables", "lock-discipline", "broad-except",
+        "chaos-site-coverage", "unused-import",
+    } <= set(RULES)
+
+
+def test_cli_exit_codes(tmp_path, capsys):
+    bad = tmp_path / "bad.py"
+    bad.write_text("def f():\n    try:\n        return 1\n"
+                   "    except Exception:\n        return None\n")
+    clean = tmp_path / "clean.py"
+    clean.write_text("X = 1\n")
+
+    assert lint_main([str(clean)]) == 0
+    assert lint_main([str(bad)]) == 1
+    out = capsys.readouterr().out
+    assert "bad.py:4 broad-except" in out
+
+    assert lint_main(["--list-rules"]) == 0
+    assert lint_main([str(tmp_path / "missing.py")]) == 2
+    assert lint_main(["--rules", "no-such-rule", str(clean)]) == 2
+
+
+def test_package_lints_clean():
+    """The tentpole's teeth: the shipped tree itself has zero findings,
+    so every rule is enforced on real code, not just on fixtures."""
+    root = os.path.dirname(os.path.abspath(erlamsa_tpu.__file__))
+    findings = run_lint([root])
+    assert findings == [], "\n".join(f.render() for f in findings)
