@@ -248,6 +248,15 @@ def child_main() -> None:
     from erlamsa_tpu.ops.registry import NUM_DEVICE_MUTATORS
 
     base = prng.base_key((1, 2, 3))
+    # ERLAMSA_BENCH_TRACE=/path.json: capture a Chrome-trace artifact of
+    # the whole bench run (spans from the batcher/runner/pipeline hot
+    # paths) alongside the JSON record — load it in Perfetto to see where
+    # a regression lives instead of re-deriving it from stage timings
+    trace_path = os.environ.get("ERLAMSA_BENCH_TRACE", "")
+    if trace_path:
+        from erlamsa_tpu.obs import trace as _obs_trace
+
+        _obs_trace.configure(path=trace_path)
     stages = [(BATCH, SEED_LEN, CAPACITY, ITERS)]
     if os.environ.get("ERLAMSA_BENCH_ESCALATE") and BATCH > 256:
         stages.insert(0, (256, SEED_LEN, CAPACITY, max(2, ITERS // 3)))
@@ -359,6 +368,12 @@ def child_main() -> None:
             _write_result(line)
         except Exception as e:  # noqa: BLE001 — earlier numbers stand
             _phase(f"service stage FAILED: {type(e).__name__}: {e}", t0)
+    if trace_path:
+        _obs_trace.export()
+        record["trace_file"] = trace_path
+        line = json.dumps(record)
+        _write_result(line)
+        _phase(f"trace artifact written to {trace_path}", t0)
     print(line)
 
 

@@ -39,11 +39,21 @@ class LintConfig:
     standalone files)."""
 
     #: replay paths for no-wallclock-nondeterminism; services/ is
-    #: deliberately absent (metrics/session clocks are legitimate there)
-    wallclock_paths: tuple = ("ops/", "corpus/", "utils/erlrand.py")
+    #: deliberately absent (metrics/session clocks are legitimate there).
+    #: obs/ is included: the observability side channel may use monotonic
+    #: clocks (allowed below) but must never read wall-clock entropy that
+    #: could leak into replay values
+    wallclock_paths: tuple = ("ops/", "corpus/", "utils/erlrand.py", "obs/")
     #: monotonic/perf clocks never feed replay values, only metrics
     wallclock_allowed: tuple = ("time.monotonic", "time.perf_counter",
                                "time.perf_counter_ns", "time.monotonic_ns")
+    #: replay paths where obs values (spans, timings) must stay WRITE-ONLY:
+    #: opening a span around replay code is sanctioned, but no obs value
+    #: may flow back into returns, arguments, arithmetic or indexing there
+    obs_backflow_paths: tuple = ("ops/", "corpus/", "utils/erlrand.py")
+    #: first dotted segment(s) that mark a call as obs-rooted after alias
+    #: expansion (`from ..obs import trace` -> 'obs.trace.span')
+    obs_roots: tuple = ("obs",)
     #: ops/ scope for the traced-function rules
     traced_paths: tuple = ("ops/",)
     #: ops/ modules whose key/data-led functions are traced kernels by

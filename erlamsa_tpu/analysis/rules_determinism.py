@@ -19,6 +19,15 @@ Flagged in the configured replay paths (``LintConfig.wallclock_paths``):
 
 ``services/`` is deliberately out of scope — session tokens, keepalive
 timers and metrics clocks are legitimate wall-clock consumers there.
+
+The obs/ observability subsystem (spans, histograms, flight recorder) is
+a SANCTIONED side channel: monotonic clocks inside ``obs/`` and spans
+opened via the tracer API (``with trace.span(...):``) around replay code
+are fine. What is NOT fine is an obs value flowing BACK into a replay
+path — a span handle or timing returned from, passed into, computed
+with, or used to index replay code (``LintConfig.obs_backflow_paths``).
+That would make replay output a function of the wall clock again, just
+laundered through the tracer.
 """
 
 from __future__ import annotations
@@ -26,7 +35,7 @@ from __future__ import annotations
 import ast
 
 from .core import (Finding, LintConfig, Module, call_name, expand_alias,
-                   import_aliases, rule)
+                   import_aliases, root_name, rule)
 
 #: exact fully-qualified calls that are never allowed on a replay path
 DENY_EXACT = frozenset({
@@ -49,9 +58,14 @@ DENY_IF_UNSEEDED = frozenset({
 
 @rule("no-wallclock-nondeterminism")
 def check_wallclock(mod: Module, config: LintConfig):
-    if not config.in_scope(mod.rel, config.wallclock_paths):
-        return
     aliases = import_aliases(mod.tree)
+    if config.in_scope(mod.rel, config.wallclock_paths):
+        yield from _check_clock_calls(mod, config, aliases)
+    if config.in_scope(mod.rel, config.obs_backflow_paths):
+        yield from _check_obs_backflow(mod, config, aliases)
+
+
+def _check_clock_calls(mod: Module, config: LintConfig, aliases: dict):
     for node in ast.walk(mod.tree):
         if not isinstance(node, ast.Call):
             continue
@@ -73,3 +87,83 @@ def check_wallclock(mod: Module, config: LintConfig):
                 f"unseeded `{name}()` on a replay path: pass an explicit "
                 f"counter-derived seed",
             )
+
+
+def _check_obs_backflow(mod: Module, config: LintConfig, aliases: dict):
+    """obs values are write-only on replay paths: a span opened with
+    ``with trace.span(...):`` (no value captured into replay data) is the
+    sanctioned form; returning, passing, computing with, or indexing by an
+    obs call result or a span handle is flagged."""
+
+    def obs_rooted(expr: ast.AST) -> bool:
+        if not isinstance(expr, ast.Call):
+            return False
+        n = call_name(expr)
+        if n is None:
+            return False
+        full = expand_alias(n, aliases)
+        return (full.partition(".")[0] in config.obs_roots
+                or full.startswith("erlamsa_tpu.obs"))
+
+    # flow-insensitive taint: names bound to an obs call result, either
+    # by assignment or by `with trace.span(...) as sp:`
+    tainted: set[str] = set()
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.Assign) and obs_rooted(node.value):
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    tainted.add(t.id)
+        elif (isinstance(node, ast.withitem) and obs_rooted(node.context_expr)
+              and isinstance(node.optional_vars, ast.Name)):
+            tainted.add(node.optional_vars.id)
+
+    def first_leak(expr: ast.AST) -> ast.AST | None:
+        """First obs call or tainted name inside `expr` whose VALUE would
+        leak. Method calls ON a tainted object (sp.annotate(...)) are
+        skipped — their arguments flow into obs, not out of it."""
+        stack = [expr]
+        while stack:
+            n = stack.pop()
+            if isinstance(n, ast.Call):
+                if obs_rooted(n):
+                    return n
+                if root_name(n.func) in tainted:
+                    continue
+                stack.extend(ast.iter_child_nodes(n))
+                continue
+            if (isinstance(n, ast.Name) and isinstance(n.ctx, ast.Load)
+                    and n.id in tainted):
+                return n
+            stack.extend(ast.iter_child_nodes(n))
+        return None
+
+    # dedupe: the same leaking expression is reachable from nested
+    # contexts (a Return wrapping a BinOp wrapping the tainted name)
+    seen: set[tuple[int, int]] = set()
+    for node in ast.walk(mod.tree):
+        leak = how = None
+        if isinstance(node, ast.Return) and node.value is not None:
+            leak, how = first_leak(node.value), "returned from"
+        elif isinstance(node, (ast.BinOp, ast.Compare)):
+            leak, how = first_leak(node), "computed with"
+        elif isinstance(node, ast.Subscript):
+            leak, how = first_leak(node.slice), "used to index"
+        elif (isinstance(node, ast.Call) and not obs_rooted(node)
+                and root_name(node.func) not in tainted):
+            for arg in list(node.args) + [kw.value for kw in node.keywords]:
+                leak = first_leak(arg)
+                if leak is not None:
+                    how = "passed into"
+                    break
+        if leak is None:
+            continue
+        key = (leak.lineno, leak.col_offset)
+        if key in seen:
+            continue
+        seen.add(key)
+        yield Finding(
+            mod.path, leak.lineno, "no-wallclock-nondeterminism",
+            f"obs value {how} replay code: observability is a write-only "
+            f"side channel — open spans with `with trace.span(...):` and "
+            f"never let span/timing values feed replay computation",
+        )

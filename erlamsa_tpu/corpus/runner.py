@@ -61,6 +61,7 @@ import time
 
 import numpy as np
 
+from ..obs import trace
 from ..services import chaos, logger, metrics, out
 from . import feedback as fb
 from .assembler import materialize, plan_buckets
@@ -290,9 +291,10 @@ def run_corpus_batch(opts: dict, batch: int = 1024) -> int:
         before the next dispatch — the serialized baseline.
         Returns (ids, launched, scores_out)."""
         t_s = time.perf_counter()
-        ids = sched.schedule(case, batch)
-        samples = [store.get(sid) for sid in ids]
-        plans = plan_buckets(samples, device_max=device_max)
+        with trace.span("corpus.schedule", case=case):
+            ids = sched.schedule(case, batch)
+            samples = [store.get(sid) for sid in ids]
+            plans = plan_buckets(samples, device_max=device_max)
         metrics.GLOBAL.record_stage("schedule", time.perf_counter() - t_s)
         tallies["truncated"] += sum(len(s) > device_max for s in samples)
 
@@ -301,7 +303,9 @@ def run_corpus_batch(opts: dict, batch: int = 1024) -> int:
         assemble_s = dispatch_s = 0.0
         for plan in plans:
             t_a = time.perf_counter()
-            b = materialize(plan, samples)
+            with trace.span("corpus.assemble", case=case,
+                            capacity=plan.capacity):
+                b = materialize(plan, samples)
             t_d = time.perf_counter()
             chaos.fault_point("device.step")
             # keys derive from the SLOT position (0..batch-1) so a
@@ -314,10 +318,13 @@ def run_corpus_batch(opts: dict, batch: int = 1024) -> int:
             gather = b.slots[np.arange(b.rows_padded) % b.rows]
             sc_in = (jnp.take(scores_out, gather, axis=0) if use_async
                      else scores_out[gather])
-            fut = step_async(
-                step, base, case, idx, b.data, b.lens, sc_in,
-                scan_len=scan_bound(int(b.lens[:b.rows].max()), b.capacity),
-            )
+            with trace.span("corpus.dispatch", case=case,
+                            capacity=b.capacity, rows=b.rows):
+                fut = step_async(
+                    step, base, case, idx, b.data, b.lens, sc_in,
+                    scan_len=scan_bound(int(b.lens[:b.rows].max()),
+                                        b.capacity),
+                )
             if use_async:
                 scores_out = scores_out.at[jnp.asarray(b.slots)].set(
                     fut.scores[:b.rows]
@@ -353,14 +360,15 @@ def run_corpus_batch(opts: dict, batch: int = 1024) -> int:
         # stand-in for new coverage — the source seed earns energy
         t_h = time.perf_counter()
         case_bytes = 0
-        for slot in range(batch):
-            payload = results.get(slot, b"")
-            case_bytes += len(payload)
-            h = _out_hash(payload)
-            if h not in seen_hashes:
-                seen_hashes.add(h)
-                tallies["new_hashes"] += 1
-                store.apply_event(fb.Event("new_hash", ids[slot]))
+        with trace.span("corpus.hash", case=case):
+            for slot in range(batch):
+                payload = results.get(slot, b"")
+                case_bytes += len(payload)
+                h = _out_hash(payload)
+                if h not in seen_hashes:
+                    seen_hashes.add(h)
+                    tallies["new_hashes"] += 1
+                    store.apply_event(fb.Event("new_hash", ids[slot]))
         tallies["total"] += len(results)
         metrics.GLOBAL.record_stage("hash", time.perf_counter() - t_h)
         metrics.GLOBAL.record_batch(len(results), case_bytes,
@@ -384,12 +392,13 @@ def run_corpus_batch(opts: dict, batch: int = 1024) -> int:
             drain.mark_done(case)
 
         t_o = time.perf_counter()
-        for slot in range(batch):
-            payload = results.get(slot, b"")
-            if writer is not None:
-                writer(case * batch + slot, payload, [])
-            else:
-                sys.stdout.buffer.write(payload)
+        with trace.span("corpus.write", case=case):
+            for slot in range(batch):
+                payload = results.get(slot, b"")
+                if writer is not None:
+                    writer(case * batch + slot, payload, [])
+                else:
+                    sys.stdout.buffer.write(payload)
         metrics.GLOBAL.record_stage("write", time.perf_counter() - t_o)
         if stats is not None:
             stats.setdefault("finish_times", []).append(time.perf_counter())
@@ -414,8 +423,9 @@ def run_corpus_batch(opts: dict, batch: int = 1024) -> int:
         results: dict[int, bytes] = {}
         t_w = time.perf_counter()
         for b, fut in launched:
-            new_data, new_lens, _new_sc, meta = fut.result()
-            outs = unpack(Batch(new_data[:b.rows], new_lens[:b.rows]))
+            with trace.span("corpus.drain", case=case, capacity=b.capacity):
+                new_data, new_lens, _new_sc, meta = fut.result()
+                outs = unpack(Batch(new_data[:b.rows], new_lens[:b.rows]))
             for j, slot in enumerate(b.slots):
                 results[int(slot)] = outs[j]
             # per-mutator applied counters (registry rows, device side)
@@ -441,6 +451,9 @@ def run_corpus_batch(opts: dict, batch: int = 1024) -> int:
             )
         drain_wait_s = time.perf_counter() - t_w
         metrics.GLOBAL.record_stage("drain_wait", drain_wait_s)
+        # dispatch + drain_wait bounds the device-batch turnaround
+        metrics.GLOBAL.observe("batch_latency",
+                               work.dispatch_s + drain_wait_s)
         finish_case(case, ids, results, work.scores,
                     work.dispatch_s + drain_wait_s)
 
@@ -464,11 +477,12 @@ def run_corpus_batch(opts: dict, batch: int = 1024) -> int:
         muta = opts.get("mutations") or default_mutations()
         results: dict[int, bytes] = {}
         t_w = time.perf_counter()
-        for slot, sid in enumerate(ids):
-            data = store.get(sid)[:device_max]
-            results[slot] = oracle_fuzz(
-                data, seed=(a1 + case, a2 + slot, a3), mutations=muta,
-            )
+        with trace.span("corpus.oracle_fallback", case=case):
+            for slot, sid in enumerate(ids):
+                data = store.get(sid)[:device_max]
+                results[slot] = oracle_fuzz(
+                    data, seed=(a1 + case, a2 + slot, a3), mutations=muta,
+                )
         metrics.GLOBAL.record_stage("oracle_fallback",
                                     time.perf_counter() - t_w)
         return results

@@ -1,0 +1,29 @@
+"""Observability subsystem: span tracing, latency histograms, crash
+flight recorder, Prometheus exposition.
+
+Everything here is a pure SIDE CHANNEL off the fuzzing paths: spans and
+histograms record when work happened and how long it took, never what was
+computed — mutated output at a fixed ``-s`` is byte-identical with
+tracing on or off (pinned by tests/test_obs.py), and with tracing
+disabled a ``trace.span()`` call is one attribute check returning a
+shared no-op.
+
+Modules (all pure stdlib — importable in jax-free contexts like the
+fuzzlint CI leg):
+
+    trace.py   counter-keyed spans with monotonic timing; Chrome-trace
+               (Perfetto-loadable) JSON export (``--trace FILE``) and
+               optional jax.profiler annotation passthrough (``--xprof``)
+    hist.py    log2-bucketed latency histograms (batch / request /
+               device-step), folded into services.metrics.Counters
+    flight.py  bounded ring of recent spans + resilience events, dumped
+               to timestamped JSONL on device loss, breaker-open,
+               supervisor give-up, or SIGUSR2
+    prom.py    Prometheus text exposition over the metrics snapshot;
+               the faas ``GET /metrics`` body and the standalone
+               ``--metrics-port`` exporter
+"""
+
+from . import flight, hist, trace  # lint: unused-import-ok re-exported submodules
+
+__all__ = ["flight", "hist", "trace"]

@@ -1,0 +1,170 @@
+"""Flight recorder: a bounded ring of recent spans and resilience
+events, dumped to timestamped JSONL when things go wrong.
+
+The ring always collects resilience notes (they are rare and tiny);
+span notes flow in only while tracing is enabled (obs/trace.py mirrors
+every finished span here). On a trip event — device-loss degradation,
+breaker-open, supervisor give-up — or on SIGUSR2, the ring is written
+to ``flightrec-<timestamp>-<reason>.jsonl`` in the configured directory
+so post-mortems of chaos runs and real incidents no longer depend on
+scrollback. Without a configured directory (``--flight-dir``), trips
+still log a one-line warning but nothing hits disk.
+
+Times are monotonic offsets from recorder start — the recorder must not
+introduce wall-clock reads into replay-adjacent code paths (fuzzlint's
+no-wallclock rule covers obs/ too); the dump filename carries the only
+wall-clock timestamp, via strftime at dump time.
+"""
+
+from __future__ import annotations
+
+import collections
+import json
+import os
+import threading
+import time
+
+#: event kinds (metrics.record_event) that automatically dump the ring
+TRIP_KINDS = frozenset({"device_lost", "breaker_open", "supervisor_give_up"})
+
+#: ring capacity: at ~200B/entry this is ~1MB resident, covering the
+#: last few seconds of spans at full pipeline rate plus all rare events
+RING_SIZE = 4096
+
+#: min seconds between automatic dumps — a fault storm (breaker flapping,
+#: repeated device probes failing) must not write hundreds of files
+DUMP_DEBOUNCE_S = 5.0
+
+
+class FlightRecorder:
+    def __init__(self, ring_size: int = RING_SIZE):
+        self._lock = threading.Lock()
+        self._ring: collections.deque = collections.deque(maxlen=ring_size)
+        self._dir: str | None = None
+        self._t0 = time.monotonic()
+        self._last_dump = -DUMP_DEBOUNCE_S
+        self._dumps = 0
+        self._signal_installed = False
+
+    # -- configuration ----------------------------------------------------
+
+    def configure(self, dump_dir: str | None):
+        """Set (or clear) the dump directory and, the first time a
+        directory is set, install a SIGUSR2 handler so a live process
+        can be asked for its ring (`kill -USR2 <pid>`). Signal install
+        is best-effort: it only works on the main thread and on
+        platforms that have SIGUSR2."""
+        with self._lock:
+            self._dir = dump_dir
+        if dump_dir:
+            os.makedirs(dump_dir, exist_ok=True)
+            self._install_signal()
+
+    def _install_signal(self):
+        if self._signal_installed:
+            return
+        try:
+            import signal
+
+            signal.signal(signal.SIGUSR2,
+                          lambda signum, frame: self.dump("sigusr2"))
+            self._signal_installed = True
+        except (ValueError, AttributeError, OSError):
+            # ValueError: not the main thread (e.g. configured from a
+            # server worker); AttributeError: no SIGUSR2 on this
+            # platform. Dumps on trip events still work.
+            pass
+
+    # -- recording --------------------------------------------------------
+
+    def note(self, kind: str, **fields) -> None:
+        """Record a resilience/lifecycle event; auto-dump on trip kinds."""
+        entry = {"t": round(time.monotonic() - self._t0, 6),
+                 "type": "event", "kind": kind}
+        if fields:
+            entry.update(fields)
+        with self._lock:
+            self._ring.append(entry)
+        if kind in TRIP_KINDS:
+            self.dump(kind)
+
+    def note_span(self, name: str, span_id: int, parent_id: int,
+                  t0: float, dur: float, attrs: dict) -> None:
+        """Record a finished span (called by the tracer, so only while
+        tracing is enabled)."""
+        entry = {"t": round(t0, 6), "type": "span", "name": name,
+                 "span_id": span_id, "parent_id": parent_id,
+                 "dur": round(dur, 6)}
+        if attrs:
+            entry["attrs"] = dict(attrs)
+        with self._lock:
+            self._ring.append(entry)
+
+    # -- dumping ----------------------------------------------------------
+
+    def dump(self, reason: str, force: bool = False) -> str | None:
+        """Write the ring to a timestamped JSONL file; returns the path,
+        or None when no directory is configured / debounced. SIGUSR2 and
+        explicit calls bypass the debounce (force)."""
+        force = force or reason == "sigusr2"
+        with self._lock:
+            if not self._dir:
+                self._warn_once(reason)
+                return None
+            now = time.monotonic()
+            if not force and now - self._last_dump < DUMP_DEBOUNCE_S:
+                return None
+            self._last_dump = now
+            self._dumps += 1
+            entries = list(self._ring)
+            seq = self._dumps
+            dump_dir = self._dir
+        stamp = time.strftime("%Y%m%d-%H%M%S")
+        safe = "".join(c if c.isalnum() or c in "-_" else "_" for c in reason)
+        path = os.path.join(dump_dir, f"flightrec-{stamp}-{seq:03d}-{safe}.jsonl")
+        try:
+            with open(path, "w") as f:
+                f.write(json.dumps({"type": "meta", "reason": reason,
+                                    "entries": len(entries)}) + "\n")
+                for entry in entries:
+                    f.write(json.dumps(entry) + "\n")
+        except OSError as e:
+            from ..services import logger
+
+            logger.log("error", "flight recorder dump failed: %s", e)
+            return None
+        from ..services import logger
+
+        logger.log("warning", "flight recorder: dumped %d entries to %s "
+                   "(reason: %s)", len(entries), path, reason)
+        return path
+
+    def _warn_once(self, reason: str):
+        # only nag on real trips, once per process
+        if getattr(self, "_warned", False) or reason == "sigusr2":
+            return
+        self._warned = True
+        from ..services import logger
+
+        logger.log("info", "flight recorder: trip '%s' but no --flight-dir "
+                   "configured; ring not dumped", reason)
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {"entries": len(self._ring), "dumps": self._dumps,
+                    "dir": self._dir}
+
+
+GLOBAL = FlightRecorder()
+
+
+def configure(dump_dir: str | None):
+    GLOBAL.configure(dump_dir)
+
+
+def note(kind: str, **fields) -> None:
+    GLOBAL.note(kind, **fields)
+
+
+def dump(reason: str, force: bool = False) -> str | None:
+    return GLOBAL.dump(reason, force=force)

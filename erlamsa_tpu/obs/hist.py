@@ -1,0 +1,92 @@
+"""Log2-bucketed latency histograms.
+
+Fixed power-of-two boundaries (2^k seconds, k = -17..6: ~7.6µs up to
+64s, plus +Inf) so histograms merge trivially, cost one array index per
+observe, and map 1:1 onto Prometheus cumulative ``le`` buckets
+(obs/prom.py). The bucket index comes from ``math.frexp`` — no log()
+call, no loop — keeping observe() cheap enough for per-request use in
+the batcher hot path.
+
+Pure stdlib; thread-safe (one lock per histogram).
+"""
+
+from __future__ import annotations
+
+import math
+import threading
+
+#: bucket upper bounds in seconds: 2^-17 (~7.6us) .. 2^6 (64s)
+K_MIN = -17
+K_MAX = 6
+BOUNDS: tuple[float, ...] = tuple(2.0 ** k for k in range(K_MIN, K_MAX + 1))
+N_BUCKETS = len(BOUNDS) + 1  # + the +Inf overflow bucket
+
+
+def bucket_index(seconds: float) -> int:
+    """Index of the smallest bound >= seconds (last index = +Inf).
+
+    v = m * 2^e with m in [0.5, 1): v <= 2^(e-1) iff m == 0.5, else the
+    smallest power-of-two bound is 2^e.
+    """
+    if seconds <= BOUNDS[0]:
+        return 0
+    m, e = math.frexp(seconds)
+    k = e - 1 if m == 0.5 else e
+    if k > K_MAX:
+        return N_BUCKETS - 1
+    return k - K_MIN
+
+
+class Hist:
+    """One histogram: counts per log2 bucket plus sum/count for means.
+
+    snapshot() returns plain data (no shared mutable state) so callers
+    can render or serialize it lock-free.
+    """
+
+    __slots__ = ("_lock", "_counts", "_sum", "_count")
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._counts = [0] * N_BUCKETS
+        self._sum = 0.0
+        self._count = 0
+
+    def observe(self, seconds: float) -> None:
+        if seconds < 0.0:
+            seconds = 0.0
+        i = bucket_index(seconds)
+        with self._lock:
+            self._counts[i] += 1
+            self._sum += seconds
+            self._count += 1
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            counts = list(self._counts)
+            total, n = self._sum, self._count
+        return {"bounds": list(BOUNDS), "counts": counts,
+                "sum": total, "count": n}
+
+    def quantile(self, q: float) -> float:
+        """Estimated q-quantile (upper bound of the bucket holding the
+        q-th observation); 0.0 when empty. Good to within one log2
+        bucket — ample for p50/p99 dashboards."""
+        with self._lock:
+            n = self._count
+            counts = list(self._counts)
+        if n == 0:
+            return 0.0
+        rank = q * n
+        seen = 0
+        for i, c in enumerate(counts):
+            seen += c
+            if seen >= rank:
+                return BOUNDS[i] if i < len(BOUNDS) else float("inf")
+        return float("inf")
+
+    def summary(self) -> dict:
+        """Compact summary for Counters.snapshot(): count / sum / p50 /
+        p99 (the fields the bench record and faas stats op surface)."""
+        return {"count": self._count, "sum": self._sum,
+                "p50": self.quantile(0.5), "p99": self.quantile(0.99)}

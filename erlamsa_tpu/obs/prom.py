@@ -1,0 +1,190 @@
+"""Prometheus text exposition over the metrics snapshot.
+
+render() turns services.metrics.Counters into the text format
+(version 0.0.4): throughput counters, derived-rate gauges, per-mutator
+and per-bucket tallies (padded-bytes-wasted is the gauge the paged-arena
+roadmap item wants driven to ~0), resilience/fault/breaker state, and
+the log2 latency histograms as cumulative ``le`` buckets.
+
+Served from two places, both thin wrappers around render():
+
+  * ``GET /metrics`` on the faas server (services/faas.py)
+  * a standalone stdlib HTTP exporter on ``--metrics-port`` for batch
+    runs that have no faas server to scrape
+
+This module imports services.metrics, so unlike the rest of obs/ it is
+NOT imported from the obs package __init__ — faas/cli import it lazily.
+"""
+
+from __future__ import annotations
+
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from ..services import metrics
+
+CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+
+#: histogram name -> Prometheus metric stem
+_HIST_METRICS = {
+    "batch_latency": "erlamsa_batch_latency_seconds",
+    "request_latency": "erlamsa_request_latency_seconds",
+    "device_step": "erlamsa_device_step_seconds",
+}
+
+
+def _escape(value: str) -> str:
+    return (str(value).replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
+def _fmt(v: float) -> str:
+    if v == float("inf"):
+        return "+Inf"
+    f = float(v)
+    return repr(int(f)) if f == int(f) else repr(f)
+
+
+class _Writer:
+    def __init__(self):
+        self.lines: list[str] = []
+
+    def head(self, name: str, kind: str, help_text: str):
+        self.lines.append(f"# HELP {name} {help_text}")
+        self.lines.append(f"# TYPE {name} {kind}")
+
+    def sample(self, name: str, value, labels: dict | None = None):
+        if labels:
+            inner = ",".join(f'{k}="{_escape(v)}"'
+                             for k, v in labels.items())
+            self.lines.append(f"{name}{{{inner}}} {_fmt(value)}")
+        else:
+            self.lines.append(f"{name} {_fmt(value)}")
+
+    def text(self) -> str:
+        return "\n".join(self.lines) + "\n"
+
+
+def render(counters: metrics.Counters | None = None) -> str:
+    """The full exposition for one Counters instance (default: GLOBAL)."""
+    c = counters if counters is not None else metrics.GLOBAL
+    snap = c.snapshot()
+    w = _Writer()
+
+    w.head("erlamsa_samples_total", "counter", "Fuzzed samples produced.")
+    w.sample("erlamsa_samples_total", snap["samples"])
+    w.head("erlamsa_batches_total", "counter", "Device batches stepped.")
+    w.sample("erlamsa_batches_total", snap["batches"])
+    w.head("erlamsa_requests_total", "counter",
+           "Client requests answered (faas/batcher).")
+    w.sample("erlamsa_requests_total", snap["requests"])
+    w.head("erlamsa_bytes_out_total", "counter", "Output bytes produced.")
+    w.sample("erlamsa_bytes_out_total", snap["bytes_out"])
+    w.head("erlamsa_device_seconds_total", "counter",
+           "Cumulative device step time.")
+    w.sample("erlamsa_device_seconds_total", snap["device_s"])
+
+    w.head("erlamsa_samples_per_second", "gauge",
+           "Samples/sec since process start (derived in snapshot).")
+    w.sample("erlamsa_samples_per_second", snap["samples_per_sec"])
+    w.head("erlamsa_requests_per_second", "gauge",
+           "Requests/sec since process start (derived in snapshot).")
+    w.sample("erlamsa_requests_per_second", snap["requests_per_sec"])
+
+    pipeline = snap["pipeline"]
+    w.head("erlamsa_pipeline_overlap_ratio", "gauge",
+           "Sum of per-stage wall over pipelined wall (1.0 = serialized).")
+    w.sample("erlamsa_pipeline_overlap_ratio", pipeline["overlap_ratio"])
+    w.head("erlamsa_device_idle_fraction", "gauge",
+           "Fraction of pipelined wall with no device step in flight.")
+    w.sample("erlamsa_device_idle_fraction", pipeline["device_idle_frac"])
+    w.head("erlamsa_drain_backlog_peak", "gauge",
+           "High-water mark of cases queued behind the drain worker.")
+    w.sample("erlamsa_drain_backlog_peak", pipeline["drain_backlog_peak"])
+    w.head("erlamsa_stage_seconds_total", "counter",
+           "Cumulative wall seconds per pipeline stage.")
+    for stage, secs in pipeline["stages"].items():
+        w.sample("erlamsa_stage_seconds_total", secs, {"stage": stage})
+
+    resilience = snap["resilience"]
+    w.head("erlamsa_degraded", "gauge",
+           "1 while serving from the host oracle after device loss.")
+    w.sample("erlamsa_degraded", resilience["degraded"])
+    w.head("erlamsa_fault_injected_total", "counter",
+           "Chaos-injected failures fired, by site.")
+    for site, n in sorted(resilience["faults"].items()):
+        w.sample("erlamsa_fault_injected_total", n, {"site": site})
+    w.head("erlamsa_resilience_events_total", "counter",
+           "Resilience events (retries, breaker transitions, failovers).")
+    for kind, n in sorted(resilience["events"].items()):
+        w.sample("erlamsa_resilience_events_total", n, {"kind": kind})
+
+    w.head("erlamsa_mutator_applied_total", "counter",
+           "Mutations applied, by mutator registry code.")
+    for code, entry in snap["mutators"].items():
+        w.sample("erlamsa_mutator_applied_total", entry["applied"],
+                 {"code": code})
+    w.head("erlamsa_mutator_failed_total", "counter",
+           "Mutations attempted but not applied, by mutator code.")
+    for code, entry in snap["mutators"].items():
+        w.sample("erlamsa_mutator_failed_total", entry["failed"],
+                 {"code": code})
+
+    w.head("erlamsa_bucket_rows_total", "counter",
+           "Rows assembled, by capacity bucket.")
+    for cap, b in snap["buckets"].items():
+        w.sample("erlamsa_bucket_rows_total", b["rows"], {"capacity": cap})
+    w.head("erlamsa_bucket_padded_bytes_wasted_total", "counter",
+           "Padding bytes uploaded but never fuzzed, by capacity bucket.")
+    for cap, b in snap["buckets"].items():
+        w.sample("erlamsa_bucket_padded_bytes_wasted_total",
+                 b["padded_bytes_wasted"], {"capacity": cap})
+
+    for hist_name, metric in _HIST_METRICS.items():
+        h = c.hists[hist_name].snapshot()
+        w.head(metric, "histogram",
+               f"Log2-bucketed {hist_name.replace('_', ' ')} in seconds.")
+        cumulative = 0
+        for bound, count in zip(h["bounds"], h["counts"]):
+            cumulative += count
+            w.sample(metric + "_bucket", cumulative, {"le": _fmt(bound)})
+        w.sample(metric + "_bucket", h["count"], {"le": "+Inf"})
+        w.sample(metric + "_sum", h["sum"])
+        w.sample(metric + "_count", h["count"])
+
+    return w.text()
+
+
+class _MetricsHandler(BaseHTTPRequestHandler):
+    def do_GET(self):
+        if self.path.split("?")[0] != "/metrics":
+            self.send_error(404)
+            return
+        body = render().encode()
+        self.send_response(200)
+        self.send_header("Content-Type", CONTENT_TYPE)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def log_message(self, fmt, *args):
+        pass  # scrapes every 15s must not spam stderr
+
+
+def serve_metrics(port: int, host: str = "0.0.0.0", block: bool = False):
+    """The ``--metrics-port`` exporter: /metrics on its own stdlib HTTP
+    server, so batch runs (no faas) are scrapeable too. Returns the
+    server; non-blocking by default (daemon thread)."""
+    httpd = ThreadingHTTPServer((host, port), _MetricsHandler)
+    httpd.daemon_threads = True
+    if block:
+        httpd.serve_forever()
+        return httpd
+    import threading
+
+    t = threading.Thread(target=httpd.serve_forever, daemon=True,
+                         name="metrics-exporter")
+    t.start()
+    from ..services import logger
+
+    logger.log("info", "metrics exporter on %s:%d/metrics", host, port)
+    return httpd

@@ -1,0 +1,294 @@
+"""Low-overhead span tracer with Chrome-trace-event export.
+
+Spans wrap the pipeline's hot stages (batcher enqueue→flush→step→drain,
+corpus plan→upload→device-step→score→feedback, dist RPCs, host-oracle
+calls). Each span gets a COUNTER-KEYED id (a process-wide monotonic
+counter, never wall clock or entropy — ids must be stable enough to
+correlate with JSON log lines, not random) and monotonic-clock timing.
+
+Disabled (the default), ``span()`` is one attribute read returning a
+shared no-op context manager — the <1% overhead contract the bench
+corpus stage pins. Enabled, completed spans append one small dict to a
+bounded in-memory event list exported as Chrome trace events
+(``{"traceEvents": [...]}``), loadable in Perfetto / chrome://tracing,
+and are mirrored into the flight recorder ring (obs/flight.py) so a
+crash dump carries the seconds of spans before the incident.
+
+``--xprof DIR`` additionally starts a ``jax.profiler`` trace into DIR
+and annotates every span as a TraceAnnotation so XLA device timelines
+and host spans line up in XProf/TensorBoard. jax is imported lazily and
+only on that path — this module stays stdlib-pure otherwise.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+
+from . import flight
+
+#: bounded event list: ~100 bytes/event, 500k events ~ 50MB worst case;
+#: beyond it events are dropped and counted (never silently)
+MAX_EVENTS = 500_000
+
+
+class _NoopSpan:
+    """The disabled-path singleton: every method is a no-op."""
+
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+    def annotate(self, **attrs):
+        return self
+
+    @property
+    def span_id(self):
+        return 0
+
+
+_NOOP = _NoopSpan()
+
+
+class Span:
+    """One live span. Use as a context manager; timing is monotonic
+    (perf_counter) and never feeds back into replay values — the
+    fuzzlint no-wallclock rule enforces that spans stay write-only from
+    replay paths."""
+
+    __slots__ = ("tracer", "name", "attrs", "span_id", "parent_id",
+                 "_t0", "_xprof_ctx")
+
+    def __init__(self, tracer: "Tracer", name: str, attrs: dict):
+        self.tracer = tracer
+        self.name = name
+        self.attrs = attrs
+        self.span_id = 0
+        self.parent_id = 0
+        self._t0 = 0.0
+        self._xprof_ctx = None
+
+    def annotate(self, **attrs):
+        """Attach extra args to the span (merged into the trace event)."""
+        self.attrs.update(attrs)
+        return self
+
+    def __enter__(self):
+        tr = self.tracer
+        self.span_id = tr._next_id()
+        stack = tr._stack()
+        self.parent_id = stack[-1].span_id if stack else 0
+        stack.append(self)
+        if tr._xprof:
+            try:
+                import jax
+
+                self._xprof_ctx = jax.profiler.TraceAnnotation(self.name)
+                self._xprof_ctx.__enter__()
+            except Exception:  # lint: broad-except-ok xprof is best-effort decoration
+                self._xprof_ctx = None
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        t1 = time.perf_counter()
+        if self._xprof_ctx is not None:
+            try:
+                self._xprof_ctx.__exit__(*exc)
+            except Exception:  # lint: broad-except-ok xprof is best-effort decoration
+                pass
+        tr = self.tracer
+        stack = tr._stack()
+        if stack and stack[-1] is self:
+            stack.pop()
+        tr._finish(self, self._t0, t1)
+        return False
+
+
+class Tracer:
+    """Process-wide span collector. configure() arms it; span() is the
+    one hot-path entry point."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._enabled = False
+        self._path: str | None = None
+        self._xprof: str | None = None
+        self._events: list[dict] = []
+        self._dropped = 0
+        self._id = 0
+        self._t_base = time.perf_counter()
+        self._tls = threading.local()
+        self._atexit_installed = False
+        self._exported_upto = -1
+
+    # -- configuration ----------------------------------------------------
+
+    def configure(self, path: str | None = None, xprof: str | None = None):
+        """Arm tracing (``--trace FILE`` / ``--xprof DIR``). Either
+        argument alone enables span collection; export() writes the
+        Chrome trace when a path is set. Calling with neither disables
+        tracing again."""
+        with self._lock:
+            self._path = path
+            self._xprof = xprof
+            self._enabled = bool(path or xprof)
+            self._events = []
+            self._dropped = 0
+            self._t_base = time.perf_counter()
+            self._exported_upto = -1
+        if xprof:
+            try:
+                import jax
+
+                jax.profiler.start_trace(xprof)
+            except Exception as e:  # lint: broad-except-ok xprof needs a working jax; trace-file path must survive without it
+                from ..services import logger
+
+                logger.log("warning", "obs: jax.profiler unavailable "
+                           "(%s); spans still trace to file", e)
+                with self._lock:
+                    self._xprof = None
+                    self._enabled = bool(path)
+        if self._enabled and not self._atexit_installed:
+            import atexit
+
+            atexit.register(self.export)
+            self._atexit_installed = True
+
+    def enabled(self) -> bool:
+        return self._enabled
+
+    # -- hot path ---------------------------------------------------------
+
+    def span(self, name: str, **attrs):
+        """Open a span (context manager). Free when tracing is disabled."""
+        if not self._enabled:
+            return _NOOP
+        return Span(self, name, attrs)
+
+    def current_span_id(self) -> int:
+        """Innermost live span id on this thread (0 = none) — the
+        correlation key JSON log lines carry."""
+        stack = getattr(self._tls, "stack", None)
+        return stack[-1].span_id if stack else 0
+
+    def _stack(self) -> list:
+        stack = getattr(self._tls, "stack", None)
+        if stack is None:
+            stack = self._tls.stack = []
+        return stack
+
+    def _next_id(self) -> int:
+        with self._lock:
+            self._id += 1
+            return self._id
+
+    def _finish(self, span: Span, t0: float, t1: float):
+        ts_us = (t0 - self._t_base) * 1e6
+        dur_us = (t1 - t0) * 1e6
+        ev = {
+            "name": span.name, "ph": "X", "ts": round(ts_us, 1),
+            "dur": round(dur_us, 1), "pid": os.getpid(),
+            "tid": threading.get_ident(),
+            "args": {"span_id": span.span_id,
+                     "parent_id": span.parent_id, **span.attrs},
+        }
+        with self._lock:
+            if len(self._events) < MAX_EVENTS:
+                self._events.append(ev)
+            else:
+                self._dropped += 1
+        flight.GLOBAL.note_span(span.name, span.span_id, span.parent_id,
+                                t0 - self._t_base, t1 - t0, span.attrs)
+
+    # -- export -----------------------------------------------------------
+
+    def export(self, path: str | None = None) -> str | None:
+        """Write the Chrome trace JSON to `path` (default: the configured
+        ``--trace`` file). Idempotent — safe to call from finally blocks
+        AND atexit; returns the path written, or None when there is
+        nowhere to write."""
+        path = path or self._path
+        if not path:
+            return None
+        with self._lock:
+            events = list(self._events)
+            dropped = self._dropped
+            # atexit backstop after an explicit export with no new spans:
+            # nothing to add, and the target dir may already be gone
+            # (tests export into a tempdir they then remove)
+            if path == self._path and len(events) == self._exported_upto:
+                return path
+        names = {}
+        for ev in events:
+            names.setdefault(ev["tid"], None)
+        meta = [
+            {"name": "thread_name", "ph": "M", "pid": os.getpid(),
+             "tid": tid, "args": {"name": f"thread-{i}"}}
+            for i, tid in enumerate(sorted(names))
+        ]
+        doc = {
+            "traceEvents": meta + events,
+            "displayTimeUnit": "ms",
+            "otherData": {"tool": "erlamsa_tpu", "dropped_events": dropped},
+        }
+        tmp = path + ".tmp"
+        try:
+            with open(tmp, "w") as f:
+                json.dump(doc, f)
+            os.replace(tmp, path)
+        except OSError as e:
+            # export must never take the process down (it runs from
+            # finally blocks and atexit); the spans stay in memory
+            from ..services import logger
+
+            logger.log("warning", "obs: trace export to %s failed: %s",
+                       path, e)
+            return None
+        with self._lock:
+            if path == self._path:
+                self._exported_upto = len(events)
+        if self._xprof:
+            try:
+                import jax
+
+                jax.profiler.stop_trace()
+            except Exception:  # lint: broad-except-ok stop is best-effort; trace may already be stopped
+                pass
+            self._xprof = None
+        return path
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {"enabled": self._enabled, "events": len(self._events),
+                    "dropped": self._dropped, "path": self._path}
+
+
+GLOBAL = Tracer()
+
+
+def configure(path: str | None = None, xprof: str | None = None):
+    GLOBAL.configure(path=path, xprof=xprof)
+
+
+def span(name: str, **attrs):
+    return GLOBAL.span(name, **attrs)
+
+
+def enabled() -> bool:
+    return GLOBAL.enabled()
+
+
+def current_span_id() -> int:
+    return GLOBAL.current_span_id()
+
+
+def export(path: str | None = None) -> str | None:
+    return GLOBAL.export(path)

@@ -23,6 +23,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..constants import MAX_BURST_MUTATIONS
+from ..obs import trace
 from . import prng
 from .patterns import DEFAULT_PATTERN_PRI_NP, pattern_plan
 from .registry import DEFAULT_DEVICE_PRI, NUM_DEVICE_MUTATORS
@@ -55,7 +56,9 @@ class StepFuture(NamedTuple):
 
     def block(self) -> "StepFuture":
         """Wait for the device step to finish (outputs stay on device)."""
-        jax.block_until_ready((self.data, self.lens, self.scores, self.meta))
+        with trace.span("device.force"):
+            jax.block_until_ready((self.data, self.lens, self.scores,
+                                   self.meta))
         return self
 
     def ready(self) -> bool:
@@ -68,12 +71,13 @@ class StepFuture(NamedTuple):
     def result(self):
         """Force completion and return host copies:
         (data, lens, scores, meta) as numpy arrays / FuzzMeta-of-numpy."""
-        return (
-            np.asarray(self.data), np.asarray(self.lens),
-            np.asarray(self.scores),
-            FuzzMeta(np.asarray(self.meta.pattern),
-                     np.asarray(self.meta.applied)),
-        )
+        with trace.span("device.force"):
+            return (
+                np.asarray(self.data), np.asarray(self.lens),
+                np.asarray(self.scores),
+                FuzzMeta(np.asarray(self.meta.pattern),
+                         np.asarray(self.meta.applied)),
+            )
 
 
 def step_async(step, *args, **kwargs) -> StepFuture:

@@ -28,6 +28,7 @@ def _ensure_deep_stack():
         sys.setrecursionlimit(20000)
 
 from ..constants import TOO_MANY_FAILED_ATTEMPTS
+from ..obs import trace
 from ..utils.erlrand import ErlRand, gen_urandom_seed
 from . import gen as genmod
 from . import patterns as patmod
@@ -120,9 +121,10 @@ class Engine:
             if fails > self.maxfails:
                 break
             try:
-                data, meta = run_with_timeout(
-                    self.run_case, self.max_running_time, i
-                )
+                with trace.span("oracle.case", case=i):
+                    data, meta = run_with_timeout(
+                        self.run_case, self.max_running_time, i
+                    )
             except CaseTimeout:
                 # reference kills the case worker and moves on
                 # (src/erlamsa_main.erl:211-220)

@@ -16,6 +16,7 @@ import threading
 import time
 from dataclasses import dataclass, field
 
+from ..obs import trace
 from ..utils.erlrand import gen_urandom_seed
 from . import chaos, metrics
 from .resilience import RetryPolicy
@@ -92,18 +93,20 @@ class OracleBatcher:
             if budget is None:
                 budget = self.max_running_time
             try:
-                req.result = run_with_timeout(
-                    fuzz,
-                    budget,
-                    req.data,
-                    seed=req.opts.get("seed") or gen_urandom_seed(),
-                    **{k: v for k, v in req.opts.items()
-                       if k not in ("seed", "maxrunningtime")},
-                )
+                with trace.span("oracle.case", bytes=len(req.data)):
+                    req.result = run_with_timeout(
+                        fuzz,
+                        budget,
+                        req.data,
+                        seed=req.opts.get("seed") or gen_urandom_seed(),
+                        **{k: v for k, v in req.opts.items()
+                           if k not in ("seed", "maxrunningtime")},
+                    )
             except Exception:  # lint: broad-except-ok empty answer is the give-up convention
                 req.result = b""  # incl. CaseTimeout: empty answer,
                 # like the reference's 90s give-up (fsupervisor.erl:83-86)
             req.done.set()
+            metrics.GLOBAL.record_request(time.monotonic() - req.t_enq)
 
     def fuzz(self, data: bytes, opts: dict, timeout: float = 90.0) -> bytes:
         req = _Req(data, opts)
@@ -198,9 +201,11 @@ class TpuBatcher:
             # sooner, and arrivals that queue up during the wait get
             # swept into one fuller batch the moment a slot frees
             self._slots.acquire()
-            reqs = collect_batch(
-                self._q, first, self.batch, first.t_enq + self._deadline_s()
-            )
+            with trace.span("batcher.collect"):
+                reqs = collect_batch(
+                    self._q, first, self.batch,
+                    first.t_enq + self._deadline_s()
+                )
             try:
                 if self._scores_dirty.is_set():
                     # the drain hit a device error: the chained scores
@@ -209,7 +214,8 @@ class TpuBatcher:
                     self._scores_dirty.clear()
                 seeds = [r.data for r in reqs]
                 pad = [b"\x00"] * (self.batch - len(seeds))
-                packed = pack(seeds + pad, capacity=self.capacity)
+                with trace.span("batcher.pack", reqs=len(reqs)):
+                    packed = pack(seeds + pad, capacity=self.capacity)
                 t0 = time.monotonic()
 
                 def _step_once():
@@ -222,9 +228,10 @@ class TpuBatcher:
                         self._scores,
                     )
 
-                data, lens, self._scores, _meta = STEP_RETRY.call(
-                    _step_once, site="batcher.step",
-                )
+                with trace.span("batcher.dispatch", reqs=len(reqs)):
+                    data, lens, self._scores, _meta = STEP_RETRY.call(
+                        _step_once, site="batcher.step",
+                    )
                 self._case += 1
                 self.flushes += 1
                 self.served += len(reqs)
@@ -248,7 +255,8 @@ class TpuBatcher:
         while True:
             reqs, data, lens, t0 = self._inflight.get()
             try:
-                results = unpack(Batch(np.asarray(data), np.asarray(lens)))
+                with trace.span("batcher.drain", reqs=len(reqs)):
+                    results = unpack(Batch(np.asarray(data), np.asarray(lens)))
             except BaseException:  # lint: broad-except-ok unblock waiters before the restart
                 for r in reqs:
                     r.done.set()
@@ -259,9 +267,13 @@ class TpuBatcher:
             self._step_ewma = (dt if self._step_ewma <= 0.0
                                else 0.3 * dt + 0.7 * self._step_ewma)
             metrics.GLOBAL.record_stage("batcher_drain", dt)
+            # dt spans dispatch→forced-results: the device-batch latency
+            metrics.GLOBAL.observe("batch_latency", dt)
+            now = time.monotonic()
             for r, res in zip(reqs, results):
                 r.result = res
                 r.done.set()
+                metrics.GLOBAL.record_request(now - r.t_enq)
             self._slots.release()
 
     def fuzz(self, data: bytes, opts: dict, timeout: float = 90.0) -> bytes:

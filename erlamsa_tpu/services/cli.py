@@ -180,6 +180,31 @@ def build_parser() -> argparse.ArgumentParser:
                         "(services/chaos.py; ERLAMSA_FAULTS is the env "
                         "equivalent, --chaos wins). Replayable: the same "
                         "spec + seed fires the same faults")
+    obs = p.add_argument_group(
+        "observability (erlamsa_tpu/obs; pure side channel — outputs at a "
+        "fixed -s are byte-identical with tracing on or off)")
+    obs.add_argument("--trace", default=None, metavar="FILE",
+                     help="write a Chrome-trace-event JSON of pipeline "
+                          "spans to FILE (load in Perfetto or "
+                          "chrome://tracing)")
+    obs.add_argument("--xprof", default=None, metavar="DIR",
+                     help="also run jax.profiler into DIR and annotate "
+                          "spans, lining host spans up with XLA device "
+                          "timelines in XProf/TensorBoard")
+    obs.add_argument("--metrics-port", type=int, default=None, metavar="PORT",
+                     help="serve Prometheus text exposition on "
+                          "PORT/metrics (the faas server also serves "
+                          "GET /metrics without this flag)")
+    obs.add_argument("--flight-dir", default=None, metavar="DIR",
+                     help="flight recorder dump directory: the ring of "
+                          "recent spans/events is written here as JSONL "
+                          "on device loss, breaker-open, supervisor "
+                          "give-up, or SIGUSR2")
+    obs.add_argument("--log-format", choices=["text", "json"],
+                     default="text",
+                     help="json: one object per log line with "
+                          "level/ts/component/span_id, correlating logs "
+                          "with traces and flight dumps")
     return p
 
 
@@ -200,6 +225,10 @@ def main(argv=None) -> int:
             print(f"{ts}\t{message}")
         print(f"# {len(rows)} finding(s)", file=sys.stderr)
         return 0
+
+    if args.log_format != "text":
+        # before any sink logs a line, so every record is structured
+        logger.GLOBAL.set_format(args.log_format)
 
     if args.logger:
         spec = {}
@@ -239,6 +268,26 @@ def main(argv=None) -> int:
             chaos.configure_from_env(seed=seed[0])
     except ValueError as e:
         raise SystemExit(f"erlamsa-tpu: {e}")
+
+    # observability arms before engines/services for the same reason as
+    # chaos: every span/event from construction onward must be seen
+    from ..obs import flight, trace
+
+    if args.flight_dir:
+        flight.configure(args.flight_dir)
+    if args.trace or args.xprof:
+        trace.configure(path=args.trace, xprof=args.xprof)
+    if args.metrics_port:
+        from ..obs import prom
+
+        prom.serve_metrics(args.metrics_port)
+
+    def _finish():
+        # idempotent: trace.export() is a no-op without --trace, and the
+        # atexit hook (armed in trace.configure) backstops service modes
+        # that never reach these finallys
+        trace.export()
+        logger.GLOBAL.flush()
 
     from ..oracle.gen import default_generators
     from ..oracle.mutations import default_mutations
@@ -342,7 +391,7 @@ def main(argv=None) -> int:
         try:
             return run_corpus_batch(opts, batch=args.batch)
         finally:
-            logger.GLOBAL.flush()
+            _finish()
 
     if args.backend == "tpu":
         from .batchrunner import run_tpu_batch
@@ -350,7 +399,7 @@ def main(argv=None) -> int:
         try:
             return run_tpu_batch(opts, batch=args.batch)
         finally:
-            logger.GLOBAL.flush()
+            _finish()
 
     if args.corpus:
         # stateless oracle path with a store: dedup the inputs into DIR
@@ -377,8 +426,8 @@ def main(argv=None) -> int:
         return _run_oracle(opts)
     finally:
         # findings from the last cases must reach durable sinks (sqlite/
-        # file) before the daemon drain thread dies with the process
-        logger.GLOBAL.flush()
+        # file), and the trace must land, before the process dies
+        _finish()
 
 
 def _run_oracle(opts: dict) -> int:

@@ -35,6 +35,7 @@ import threading
 import time
 
 from ..constants import NODE_ALIVE_DELTA, NODE_KEEPALIVE, NODES_CHECKTIMER
+from ..obs import trace
 from ..utils.erlrand import gen_urandom_seed
 from . import chaos, logger, metrics
 from .batcher import make_batcher
@@ -165,10 +166,12 @@ class ParentServer:
                 break
             tried.add(node)
             try:
-                out = NODE_RETRY.call(
-                    remote_fuzz, node[0], node[1], data,
-                    site=f"dist:{node[0]}:{node[1]}", deadline=deadline,
-                )
+                with trace.span("dist.route", node=f"{node[0]}:{node[1]}",
+                                attempt=len(tried)):
+                    out = NODE_RETRY.call(
+                        remote_fuzz, node[0], node[1], data,
+                        site=f"dist:{node[0]}:{node[1]}", deadline=deadline,
+                    )
                 self.pool.report(node, True)
                 return out
             except (RetryExhausted, OSError, ValueError):
@@ -219,15 +222,19 @@ def remote_fuzz(host: str, port: int, data: bytes, timeout: float = 90.0) -> byt
     closes without answering or answers with a non-result — callers can
     then distinguish "node failed" (failover) from "fuzzer produced empty
     output" (a legitimate result)."""
-    with socket.create_connection((host, port), timeout=timeout) as s:
-        _send_json(s, {"op": "fuzz", "data": base64.b64encode(data).decode()})
-        resp = _recv_json(s.makefile("rb"))
-        if resp is None:
-            raise ProtocolError(f"node {host}:{port} closed without a reply")
-        if resp.get("op") != "result" or "data" not in resp:
-            raise ProtocolError(f"node {host}:{port} sent a malformed "
-                                f"reply: {str(resp)[:120]}")
-        return base64.b64decode(resp["data"])
+    with trace.span("dist.remote_fuzz", node=f"{host}:{port}",
+                    bytes=len(data)):
+        with socket.create_connection((host, port), timeout=timeout) as s:
+            _send_json(s, {"op": "fuzz",
+                           "data": base64.b64encode(data).decode()})
+            resp = _recv_json(s.makefile("rb"))
+            if resp is None:
+                raise ProtocolError(f"node {host}:{port} closed without "
+                                    "a reply")
+            if resp.get("op") != "result" or "data" not in resp:
+                raise ProtocolError(f"node {host}:{port} sent a malformed "
+                                    f"reply: {str(resp)[:120]}")
+            return base64.b64decode(resp["data"])
 
 
 class WorkerNode:

@@ -113,6 +113,16 @@ class _Handler(BaseHTTPRequestHandler):
         self.end_headers()
         self.wfile.write(body)
 
+    def do_GET(self):  # noqa: N802
+        # Prometheus scrape endpoint: counters aren't secrets (same rule
+        # as the stats op), so no auth gate — scrapers don't do sessions
+        if self.path.split("?")[0] == "/metrics":
+            from ..obs import prom
+
+            self._reply(200, prom.render().encode(), ctype=prom.CONTENT_TYPE)
+            return
+        self._reply(404, b"not found")
+
     def do_POST(self):  # noqa: N802
         length = int(self.headers.get("Content-Length", 0))
         body = self.rfile.read(length)

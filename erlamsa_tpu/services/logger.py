@@ -9,6 +9,7 @@ configured sinks.
 
 from __future__ import annotations
 
+import json
 import queue
 import socket
 import sys
@@ -23,6 +24,18 @@ LEVELS = {
     "info": 4, "meta": 5, "decision": 6, "debug": 7,
 }
 
+LOG_FORMATS = ("text", "json")
+
+
+def _component_of(msg: str) -> str:
+    """Component tag from the established message convention — a short
+    'component: ...' prefix ('corpus: device lost', 'faas: ...'). Used
+    only for the structured format; absent prefix -> '-'."""
+    head, sep, _rest = msg.partition(":")
+    if sep and head and len(head) <= 24 and " " not in head:
+        return head
+    return "-"
+
 
 class Logger:
     def __init__(self):
@@ -30,6 +43,7 @@ class Logger:
         self._q: queue.Queue = queue.Queue()
         self._thread: threading.Thread | None = None
         self._log_data = True
+        self._format = "text"
 
     def add_sink(self, level: str, write: Callable[[str], None]):
         self._sinks.append((LEVELS.get(level, 4), write))
@@ -72,6 +86,18 @@ class Logger:
             self.add_sink(level, SqliteSink(path))
         if spec.get("no_io_logging"):
             self._log_data = False
+        if "format" in spec:
+            self.set_format(spec["format"])
+
+    def set_format(self, fmt: str):
+        """'text' (the tab-separated default) or 'json' (--log-format
+        json): one object per line with level/ts/component/span_id, so
+        log lines correlate with flight-recorder dumps and trace spans
+        by span_id."""
+        if fmt not in LOG_FORMATS:
+            raise ValueError(f"log format must be one of {LOG_FORMATS}, "
+                             f"got {fmt!r}")
+        self._format = fmt
 
     def _ensure_thread(self):
         if self._thread is None:
@@ -115,7 +141,16 @@ class Logger:
             return
         ts = time.strftime("%Y-%m-%d %H:%M:%S")
         msg = fmt % args if args else fmt
-        self._q.put((LEVELS.get(level, 4), f"{ts}\t{level}\t{msg}"))
+        if self._format == "json":
+            from ..obs import trace
+
+            line = json.dumps({
+                "ts": ts, "level": level, "component": _component_of(msg),
+                "span_id": trace.current_span_id(), "msg": msg,
+            })
+        else:
+            line = f"{ts}\t{level}\t{msg}"
+        self._q.put((LEVELS.get(level, 4), line))
 
     def log_data(self, level: str, fmt: str, args, data: bytes, render="str"):
         """Log with a (capped) data payload (erlamsa_logger:log_data/4)."""
@@ -159,8 +194,20 @@ class SqliteSink:
         self._lock = threading.Lock()
 
     def __call__(self, line: str) -> None:
-        parts = line.split("\t", 2)
-        ts, level, msg = (parts if len(parts) == 3 else ("", "info", line))
+        if line.startswith("{"):
+            # --log-format json lines: pull the columns out of the object
+            # instead of mis-splitting on tabs inside the JSON
+            try:
+                rec = json.loads(line)
+                ts = str(rec.get("ts", ""))
+                level = str(rec.get("level", "info"))
+                msg = str(rec.get("msg", line))
+            except ValueError:
+                ts, level, msg = "", "info", line
+        else:
+            parts = line.split("\t", 2)
+            ts, level, msg = (parts if len(parts) == 3
+                              else ("", "info", line))
         with self._lock:
             for attempt in (0, 1):
                 try:

@@ -11,7 +11,14 @@ from __future__ import annotations
 import threading
 import time
 
+from ..obs import flight, hist
 from . import logger
+
+#: latency histograms folded into the snapshot and the Prometheus
+#: exposition (obs/prom.py): batch_latency is collect→drain for one
+#: device batch, request_latency is enqueue→answer for one faas/batcher
+#: request, device_step is the device-side step time alone
+HIST_NAMES = ("batch_latency", "request_latency", "device_step")
 
 
 class Counters:
@@ -22,7 +29,11 @@ class Counters:
         self.samples = 0
         self.bytes_out = 0
         self.batches = 0
+        self.requests = 0
         self.device_time = 0.0
+        # log2-bucketed latency histograms; each Hist carries its own
+        # lock, so observe() calls stay OUTSIDE self._lock (no nesting)
+        self.hists: dict[str, hist.Hist] = {n: hist.Hist() for n in HIST_NAMES}
         # per-mutator applied/failed tallies, keyed by registry code:
         # device counts come from FuzzMeta.applied (corpus/runner.py),
         # host counts from the oracle's used/failed metas
@@ -52,6 +63,18 @@ class Counters:
             self.bytes_out += n_bytes
             self.batches += 1
             self.device_time += device_seconds
+        self.hists["device_step"].observe(device_seconds)
+
+    def record_request(self, latency_seconds: float):
+        """One client-visible request answered (faas/batcher), with its
+        enqueue→answer latency."""
+        with self._lock:
+            self.requests += 1
+        self.hists["request_latency"].observe(latency_seconds)
+
+    def observe(self, name: str, seconds: float):
+        """Feed one observation into a named latency histogram."""
+        self.hists[name].observe(seconds)
 
     def record_mutator(self, code: str, applied: bool = True, n: int = 1):
         with self._lock:
@@ -93,13 +116,19 @@ class Counters:
         """One chaos-injected failure fired at `site`."""
         with self._lock:
             self.faults[site] = self.faults.get(site, 0) + 1
+        # outside the lock: the flight ring has its own lock and a trip
+        # may write a dump file — never under the counters lock
+        flight.GLOBAL.note("fault", site=site)
 
     def record_event(self, kind: str):
         """One resilience event: retry:<site>, breaker_open/closed,
         failover, dist_local_fallback, node_evicted, device_lost,
-        device_recovered, ..."""
+        device_recovered, supervisor_give_up, ..."""
         with self._lock:
             self.events[kind] = self.events.get(kind, 0) + 1
+        # trip kinds (device_lost, breaker_open, supervisor_give_up)
+        # auto-dump the ring inside note()
+        flight.GLOBAL.note(kind)
 
     def set_degraded(self, on: bool):
         """Flip the degraded-mode flag (corpus runner fell back to the
@@ -144,19 +173,27 @@ class Counters:
         inj = chaos.active()
         if inj is not None:
             resilience["chaos"] = inj.stats()
+        # hists have their own locks — summarize them outside self._lock
+        hists = {name: h.summary() for name, h in self.hists.items()}
         with self._lock:
+            # derived rates computed HERE, under the lock, from one
+            # consistent read — consumers (faas stats, bench, README
+            # examples) must not re-derive them from racy field reads
             return {
                 "resilience": resilience,
                 "pipeline": pipeline,
                 "samples": self.samples,
                 "batches": self.batches,
+                "requests": self.requests,
                 "bytes_out": self.bytes_out,
                 "wall_s": round(wall, 3),
                 "device_s": round(self.device_time, 3),
                 "samples_per_sec": round(self.samples / wall, 1) if wall else 0.0,
+                "requests_per_sec": round(self.requests / wall, 2) if wall else 0.0,
                 "device_samples_per_sec": round(
                     self.samples / self.device_time, 1
                 ) if self.device_time else 0.0,
+                "hist": hists,
                 "mutators": {
                     code: {"applied": a, "failed": f}
                     for code, (a, f) in sorted(self.mutators.items())

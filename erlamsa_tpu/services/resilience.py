@@ -179,6 +179,13 @@ class CircuitBreaker:
                 self._state = OPEN
                 self._opened_at = time.monotonic()
                 self._probing = False
+                # name the breaker in the flight ring BEFORE the generic
+                # breaker_open event trips the dump, so the dump says
+                # WHICH circuit opened
+                from ..obs import flight
+
+                flight.GLOBAL.note("breaker_detail", name=self.name,
+                                   failures=self._failures)
                 metrics.GLOBAL.record_event("breaker_open")
                 logger.log("warning", "breaker %s: circuit OPEN after %d "
                            "failure(s), cooling %.1fs", self.name,

@@ -101,6 +101,12 @@ class SupervisedThread:
                         "service %s crashed %d times in %.1fs, giving up: %s",
                         self.name, len(self.crashes), self.period, e,
                     )
+                    # a give-up is a flight-recorder trip: dump the ring
+                    # while the scrollback leading here is still in it
+                    # (lazy import keeps supervisor import-light)
+                    from . import metrics
+
+                    metrics.GLOBAL.record_event("supervisor_give_up")
                     return
                 delay = min(self.backoff * (2 ** consecutive),
                             self.backoff_max)

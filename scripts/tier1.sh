@@ -15,6 +15,13 @@
 # two output streams are byte-identical: transparent faults must be
 # absorbed by retries, never reach the data path (services/chaos.py).
 #
+# scripts/tier1.sh --obs-smoke additionally runs a tiny traced corpus
+# batch with the standalone metrics exporter up, then validates BOTH
+# observability artifacts: the --trace file must be well-formed Chrome
+# trace JSON with corpus spans, and a live GET /metrics scrape must
+# serve Prometheus text with throughput counters and latency histogram
+# buckets (erlamsa_tpu/obs).
+#
 # The gate starts with fuzzlint (erlamsa_tpu/analysis): pure-AST
 # invariant checks (determinism, device purity, lock discipline,
 # resilience coverage) over the whole package in ~2s. Opt out with
@@ -23,11 +30,13 @@ set -o pipefail
 
 bench_smoke=0
 chaos_smoke=0
+obs_smoke=0
 lint=1
 while [ $# -gt 0 ]; do
   case "$1" in
     --bench-smoke) bench_smoke=1; shift ;;
     --chaos-smoke) chaos_smoke=1; shift ;;
+    --obs-smoke) obs_smoke=1; shift ;;
     --lint) lint=1; shift ;;
     --no-lint) lint=0; shift ;;
     *) break ;;
@@ -131,6 +140,58 @@ ok = (rc1 == rc2 == 0 and clean and faulted == clean
 print(f"CHAOS_SMOKE={'ok' if ok else 'FAIL'} bytes={len(clean)} "
       f"identical={faulted == clean} "
       f"store_retries={events.get('retry:store.save', 0)}")
+sys.exit(0 if ok else 1)
+EOF
+  rc=$?
+fi
+
+if [ $rc -eq 0 ] && [ $obs_smoke -eq 1 ]; then
+  echo "== obs smoke: trace artifact + /metrics scrape =="
+  timeout -k 10 600 env JAX_PLATFORMS=cpu python - <<'EOF'
+import json, os, shutil, socket, sys, tempfile, urllib.request
+
+from erlamsa_tpu.corpus.runner import run_corpus_batch
+from erlamsa_tpu.obs import prom, trace
+
+s = socket.socket()
+s.bind(("127.0.0.1", 0))
+port = s.getsockname()[1]
+s.close()
+prom.serve_metrics(port, host="127.0.0.1")
+
+tmpdir = tempfile.mkdtemp(prefix="tier1_obs_smoke_")
+trace_file = os.path.join(tmpdir, "trace.json")
+try:
+    trace.configure(path=trace_file)
+    rc = run_corpus_batch(
+        {
+            "corpus_dir": os.path.join(tmpdir, "corpus"),
+            "corpus": [bytes([65 + i]) * (40 * (i + 1)) for i in range(6)],
+            "feedback": True,
+            "seed": (1, 2, 3),
+            "n": 2,
+            "output": os.devnull,
+            "pipeline": "async",
+        },
+        batch=8,
+    )
+    trace.export()
+    doc = json.load(open(trace_file))
+    xev = [e for e in doc["traceEvents"] if e.get("ph") == "X"]
+    trace_ok = (rc == 0 and xev
+                and all(k in e for k in ("name", "ts", "dur", "pid", "tid")
+                        for e in xev)
+                and any(e["name"].startswith("corpus.") for e in xev))
+    body = urllib.request.urlopen(
+        f"http://127.0.0.1:{port}/metrics", timeout=10).read().decode()
+    prom_ok = ("erlamsa_samples_total" in body
+               and "erlamsa_batch_latency_seconds_bucket" in body
+               and 'le="+Inf"' in body)
+finally:
+    shutil.rmtree(tmpdir, ignore_errors=True)
+ok = trace_ok and prom_ok
+print(f"OBS_SMOKE={'ok' if ok else 'FAIL'} trace_events={len(xev)} "
+      f"trace_ok={trace_ok} prom_ok={prom_ok}")
 sys.exit(0 if ok else 1)
 EOF
   rc=$?

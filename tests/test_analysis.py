@@ -21,6 +21,7 @@ ALL_SCOPE = LintConfig(
     traced_paths=("",),
     kernel_modules=("*",),
     chaos_modules=("",),
+    obs_backflow_paths=("",),
 )
 
 
@@ -85,6 +86,65 @@ def test_wallclock_out_of_scope_path_passes(tmp_path):
 
         def stamp():
             return time.time()
+    """, ["no-wallclock-nondeterminism"], config=LintConfig()) == []
+
+
+def test_obs_backflow_bad(tmp_path):
+    # three distinct leak shapes: span handle indexing the output, a
+    # current_span_id() folded into replay bytes, and an obs value passed
+    # into a non-obs call
+    findings = lint_src(tmp_path, """\
+        from erlamsa_tpu.obs import trace
+
+
+        def truncate(out):
+            with trace.span("corpus.step") as sp:
+                pass
+            return out[:sp.span_id]
+
+
+        def stamp_bytes(data):
+            t = trace.current_span_id()
+            return data + bytes([t % 256])
+
+
+        def feed(consume):
+            consume(trace.current_span_id())
+    """, ["no-wallclock-nondeterminism"])
+    assert [f.line for f in findings] == [7, 12, 16], \
+        [f.render() for f in findings]
+    assert all("side channel" in f.message for f in findings)
+
+
+def test_obs_backflow_good_write_only_spans(tmp_path):
+    # the sanctioned forms: plain `with trace.span(...):`, annotating a
+    # captured handle (arguments flow INTO obs), and replay values
+    # returned from inside a span
+    assert lint_src(tmp_path, """\
+        from erlamsa_tpu.obs import trace
+
+
+        def step(data):
+            with trace.span("corpus.step", rows=len(data)):
+                out = data * 2
+            return out
+
+
+        def annotated(data):
+            with trace.span("corpus.pack") as sp:
+                sp.annotate(extra=1)
+                return data + b"x"
+    """, ["no-wallclock-nondeterminism"]) == []
+
+
+def test_obs_backflow_out_of_scope_path_passes(tmp_path):
+    # services/ may legitimately read span ids (the JSON log format does)
+    assert lint_src(tmp_path, """\
+        from erlamsa_tpu.obs import trace
+
+
+        def log_line():
+            return trace.current_span_id()
     """, ["no-wallclock-nondeterminism"], config=LintConfig()) == []
 
 

@@ -1,0 +1,449 @@
+"""Observability subsystem (erlamsa_tpu/obs): span tracer, log2
+histograms, Prometheus exposition, flight recorder — and the contract
+that makes them shippable: obs is a pure SIDE CHANNEL. Outputs at a
+fixed -s seed are byte-identical with tracing on or off, and every
+artifact (trace JSON, /metrics text, flight dump) is pinned by schema
+here, not by eyeballing.
+"""
+
+import json
+import math
+import os
+import urllib.request
+
+import pytest
+
+from erlamsa_tpu.obs import flight, hist, prom, trace
+from erlamsa_tpu.obs.flight import FlightRecorder
+from erlamsa_tpu.obs.trace import _NOOP, Tracer
+from erlamsa_tpu.services import chaos, metrics
+
+SEED = (42, 42, 42)
+
+
+@pytest.fixture(autouse=True)
+def _obs_reset():
+    """Tracer/flight/chaos state is process-global; every test starts
+    and ends dark."""
+    trace.GLOBAL.configure()
+    flight.GLOBAL.configure(None)
+    # the flight dump debounce is global too — one test's dump must not
+    # swallow the next test's trip
+    flight.GLOBAL._last_dump = -flight.DUMP_DEBOUNCE_S
+    yield
+    trace.GLOBAL.configure()
+    flight.GLOBAL.configure(None)
+    chaos.configure(None)
+    metrics.GLOBAL.set_degraded(False)
+
+
+# ---- hist: log2 buckets --------------------------------------------------
+
+
+def test_hist_bucket_index_log2():
+    # exact powers of two land in their own <= bucket
+    assert hist.BOUNDS[hist.bucket_index(0.5)] == 0.5
+    assert hist.BOUNDS[hist.bucket_index(1.0)] == 1.0
+    # values just above a bound go to the next bucket
+    assert hist.BOUNDS[hist.bucket_index(0.5001)] == 1.0
+    # extremes: tiny values hit the first bucket, huge ones overflow
+    assert hist.bucket_index(1e-9) == 0
+    assert hist.bucket_index(1e9) == hist.N_BUCKETS - 1
+    # monotonic over a sweep
+    idx = [hist.bucket_index(2.0 ** (k / 3)) for k in range(-40, 20)]
+    assert idx == sorted(idx)
+
+
+def test_hist_observe_snapshot_quantile():
+    h = hist.Hist()
+    for v in (0.001, 0.002, 0.25, 0.5, 4.0):
+        h.observe(v)
+    h.observe(-1.0)  # clamped to zero, not dropped
+    snap = h.snapshot()
+    assert snap["count"] == 6
+    assert math.isclose(snap["sum"], 0.001 + 0.002 + 0.25 + 0.5 + 4.0)
+    assert sum(snap["counts"]) == 6
+    assert len(snap["counts"]) == hist.N_BUCKETS
+    # quantiles return bucket upper bounds: conservative, never invented
+    assert h.quantile(0.5) <= 0.5
+    assert h.quantile(0.99) >= 4.0
+    s = h.summary()
+    assert s["count"] == 6 and s["p50"] <= s["p99"]
+
+
+def test_hist_empty():
+    h = hist.Hist()
+    assert h.snapshot()["count"] == 0
+    assert h.quantile(0.5) == 0.0
+    assert h.summary() == {"count": 0, "sum": 0.0, "p50": 0.0, "p99": 0.0}
+
+
+# ---- trace: spans and Chrome export --------------------------------------
+
+
+def test_disabled_tracer_is_free():
+    t = Tracer()
+    assert not t.enabled()
+    sp = t.span("anything", x=1)
+    assert sp is _NOOP  # the shared no-op singleton, no allocation
+    with sp as s:
+        assert s.span_id == 0
+    assert t.current_span_id() == 0
+
+
+@pytest.fixture
+def local_tracer():
+    """A private Tracer, disarmed afterwards so its atexit export hook
+    (registered by configure) becomes a no-op once tmp_path is gone."""
+    t = Tracer()
+    yield t
+    t.configure()
+
+
+def test_trace_export_chrome_schema(tmp_path, local_tracer):
+    path = str(tmp_path / "trace.json")
+    t = local_tracer
+    t.configure(path=path)
+    with t.span("outer", case=1) as outer:
+        assert t.current_span_id() == outer.span_id
+        with t.span("inner") as inner:
+            assert t.current_span_id() == inner.span_id
+            inner.annotate(rows=8)
+        assert t.current_span_id() == outer.span_id
+    assert t.current_span_id() == 0
+    assert t.export() == path
+
+    doc = json.load(open(path))
+    assert doc["displayTimeUnit"] == "ms"
+    assert doc["otherData"]["dropped_events"] == 0
+    xev = [e for e in doc["traceEvents"] if e["ph"] == "X"]
+    meta = [e for e in doc["traceEvents"] if e["ph"] == "M"]
+    assert len(xev) == 2 and meta  # thread_name metadata present
+    by_name = {e["name"]: e for e in xev}
+    for e in xev:  # required Chrome trace event fields
+        assert {"name", "ph", "ts", "dur", "pid", "tid", "args"} <= set(e)
+        assert e["ts"] >= 0 and e["dur"] >= 0
+    # nesting is recorded: inner's parent is outer, outer is a root
+    assert (by_name["inner"]["args"]["parent_id"]
+            == by_name["outer"]["args"]["span_id"])
+    assert by_name["outer"]["args"]["parent_id"] == 0
+    assert by_name["inner"]["args"]["rows"] == 8  # annotate() merged
+    assert by_name["outer"]["args"]["case"] == 1
+
+
+def test_trace_event_cap_counts_drops(tmp_path, local_tracer):
+    t = local_tracer
+    t.configure(path=str(tmp_path / "t.json"))
+    t._events = [None] * trace.MAX_EVENTS  # simulate a full buffer
+    with t.span("overflow"):
+        pass
+    assert t.stats()["dropped"] == 1
+    assert t.stats()["events"] == trace.MAX_EVENTS
+
+
+def test_trace_export_survives_missing_dir(tmp_path, local_tracer):
+    t = local_tracer
+    t.configure(path=str(tmp_path / "gone" / "sub" / "t.json"))
+    with t.span("s"):
+        pass
+    assert t.export() is None  # logged, not raised
+
+
+# ---- metrics: derived rates and hist folding -----------------------------
+
+
+def test_counters_snapshot_derived_rates_and_hists():
+    c = metrics.Counters()
+    c.record_batch(8, 800, 0.5)
+    c.record_request(0.25)
+    c.observe("batch_latency", 0.125)
+    snap = c.snapshot()
+    assert snap["samples"] == 8 and snap["batches"] == 1
+    assert snap["requests"] == 1
+    assert snap["samples_per_sec"] > 0
+    assert snap["requests_per_sec"] > 0
+    assert snap["hist"]["device_step"]["count"] == 1
+    assert snap["hist"]["request_latency"]["count"] == 1
+    assert snap["hist"]["batch_latency"]["p50"] == 0.125
+
+
+# ---- prom: golden exposition ---------------------------------------------
+
+
+def _golden_counters():
+    c = metrics.Counters()
+    c.record_batch(8, 800, 0.5)
+    c.record_request(0.25)
+    c.record_mutator("bf", applied=True, n=3)
+    c.record_mutator("bf", applied=False, n=1)
+    c.record_bucket(256, rows=10, pad_rows=2, padded_bytes_wasted=300)
+    c.record_fault("device.step")
+    c.record_event("retry:store.save")
+    return c
+
+
+def test_prom_render_golden():
+    text = prom.render(_golden_counters())
+    lines = text.splitlines()
+    for expected in [
+        "erlamsa_samples_total 8",
+        "erlamsa_batches_total 1",
+        "erlamsa_requests_total 1",
+        "erlamsa_bytes_out_total 800",
+        "erlamsa_device_seconds_total 0.5",
+        'erlamsa_mutator_applied_total{code="bf"} 3',
+        'erlamsa_mutator_failed_total{code="bf"} 1',
+        'erlamsa_bucket_rows_total{capacity="256"} 10',
+        'erlamsa_bucket_padded_bytes_wasted_total{capacity="256"} 300',
+        'erlamsa_fault_injected_total{site="device.step"} 1',
+        'erlamsa_resilience_events_total{kind="retry:store.save"} 1',
+        "erlamsa_degraded 0",
+        # 0.5s device step lands exactly in the le="0.5" log2 bucket
+        'erlamsa_device_step_seconds_bucket{le="0.5"} 1',
+        'erlamsa_device_step_seconds_bucket{le="+Inf"} 1',
+        "erlamsa_device_step_seconds_sum 0.5",
+        "erlamsa_device_step_seconds_count 1",
+        'erlamsa_request_latency_seconds_bucket{le="0.25"} 1',
+        "erlamsa_request_latency_seconds_count 1",
+    ]:
+        assert expected in lines, f"missing: {expected!r}\n{text}"
+    # every sample line's metric has HELP+TYPE heads, cumulative buckets
+    # never decrease
+    heads = {ln.split()[2] for ln in lines if ln.startswith("# TYPE")}
+    for ln in lines:
+        if ln.startswith("#"):
+            continue
+        stem = ln.split("{")[0].split(" ")[0]
+        base = stem
+        for suffix in ("_bucket", "_sum", "_count"):
+            if stem.endswith(suffix) and stem.removesuffix(suffix) in heads:
+                base = stem.removesuffix(suffix)
+        assert base in heads, f"sample without TYPE head: {ln}"
+    cum = [float(ln.split()[-1].replace("+Inf", "inf"))
+           for ln in lines if ln.startswith("erlamsa_device_step_seconds_bucket")]
+    assert cum == sorted(cum)
+
+
+def test_prom_label_escaping():
+    c = metrics.Counters()
+    c.record_event('weird"kind\\with\nstuff')
+    text = prom.render(c)
+    assert '{kind="weird\\"kind\\\\with\\nstuff"}' in text
+
+
+def _free_port():
+    import socket
+
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def test_standalone_metrics_exporter():
+    port = _free_port()
+    srv = prom.serve_metrics(port, host="127.0.0.1")
+    try:
+        resp = urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/metrics", timeout=10)
+        assert resp.headers["Content-Type"] == prom.CONTENT_TYPE
+        body = resp.read().decode()
+        assert "erlamsa_samples_total" in body
+        with pytest.raises(urllib.error.HTTPError) as err:
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/other", timeout=10)
+        assert err.value.code == 404
+    finally:
+        srv.shutdown()
+
+
+def test_faas_serves_metrics():
+    from erlamsa_tpu.services.faas import serve
+
+    port = _free_port()
+    srv = serve("127.0.0.1", port, {"workers": 2, "seed": (1, 2, 3)},
+                backend="oracle", block=False)
+    try:
+        resp = urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/metrics", timeout=10)
+        assert resp.status == 200
+        assert resp.headers["Content-Type"] == prom.CONTENT_TYPE
+        assert "erlamsa_requests_total" in resp.read().decode()
+        with pytest.raises(urllib.error.HTTPError) as err:
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/nope", timeout=10)
+        assert err.value.code == 404
+    finally:
+        srv.shutdown()
+
+
+# ---- flight recorder -----------------------------------------------------
+
+
+def test_flight_ring_and_trip_dump(tmp_path):
+    fr = FlightRecorder(ring_size=8)
+    fr.configure(str(tmp_path))
+    for i in range(20):  # ring is bounded: only the last 8 survive
+        fr.note("tick", i=i)
+    fr.note_span("corpus.step", span_id=7, parent_id=0, t0=0.1,
+                 dur=0.01, attrs={"case": 3})
+    path = fr.dump("unit-test", force=True)
+    assert path and os.path.exists(path)
+    lines = [json.loads(ln) for ln in open(path)]
+    assert lines[0]["type"] == "meta"
+    assert lines[0]["reason"] == "unit-test"
+    assert lines[0]["entries"] == len(lines) - 1 == 8
+    kinds = [ln.get("kind") for ln in lines[1:]]
+    assert kinds.count("tick") == 7  # oldest ticks evicted
+    span_entries = [ln for ln in lines[1:] if ln["type"] == "span"]
+    assert span_entries[0]["name"] == "corpus.step"
+    assert span_entries[0]["attrs"] == {"case": 3}
+
+
+def test_flight_trip_kinds_auto_dump(tmp_path):
+    fr = FlightRecorder()
+    fr.configure(str(tmp_path))
+    fr.note("retry:store.save")  # not a trip: no dump
+    assert fr.stats()["dumps"] == 0
+    fr.note("device_lost")
+    assert fr.stats()["dumps"] == 1
+    fr.note("breaker_open")  # debounced: within DUMP_DEBOUNCE_S
+    assert fr.stats()["dumps"] == 1
+
+
+def test_flight_no_dir_is_quiet():
+    fr = FlightRecorder()
+    fr.note("device_lost")
+    assert fr.dump("manual") is None
+    assert fr.stats() == {"entries": 1, "dumps": 0, "dir": None}
+
+
+# ---- end-to-end: chaos trip produces a flight dump -----------------------
+
+
+def _run_corpus(tmp_path, tag, spec=None, trace_path=None, n=2):
+    """A tiny corpus run (mirrors tests/test_resilience.py); returns
+    (rc, concatenated output bytes)."""
+    from erlamsa_tpu.corpus.runner import run_corpus_batch
+
+    chaos.configure(spec, seed=SEED[0])
+    if trace_path:
+        trace.configure(path=trace_path)
+    outdir = tmp_path / f"out-{tag}"
+    outdir.mkdir()
+    rc = run_corpus_batch(
+        {
+            "corpus_dir": str(tmp_path / f"corpus-{tag}"),
+            "corpus": [b"hello observability", b"foo bar baz qux",
+                       b"the quick brown fox"],
+            "seed": SEED,
+            "n": n,
+            "feedback": True,
+            "pipeline": "async",
+            "output": str(outdir / "%n.out"),
+        },
+        batch=8,
+    )
+    if trace_path:
+        trace.export()
+        trace.GLOBAL.configure()
+    chaos.configure(None)
+    blob = b""
+    for name in sorted(os.listdir(outdir), key=lambda s: int(s.split(".")[0])):
+        with open(outdir / name, "rb") as f:
+            blob += f.read()
+    return rc, blob
+
+
+def test_device_loss_dumps_flight_recorder(tmp_path):
+    """ISSUE acceptance: an injected device loss (chaos `device.step:*`)
+    leaves a post-mortem flightrec-*.jsonl in --flight-dir."""
+    dump_dir = tmp_path / "flight"
+    flight.configure(str(dump_dir))
+    rc, blob = _run_corpus(tmp_path, "trip", spec="device.step:*")
+    assert rc == 0 and blob  # degraded run still completes
+    dumps = sorted(os.listdir(dump_dir))
+    assert dumps and dumps[0].startswith("flightrec-")
+    assert dumps[0].endswith(".jsonl")
+    lines = [json.loads(ln) for ln in open(dump_dir / dumps[0])]
+    assert lines[0]["type"] == "meta"
+    assert lines[0]["reason"] == "device_lost"
+    # the ring carried the faults that led up to the trip
+    assert any(e.get("kind") == "fault" and e.get("site") == "device.step"
+               for e in lines[1:])
+
+
+def test_corpus_trace_artifact_and_byte_identity(tmp_path):
+    """ISSUE acceptance, both halves: the --trace artifact from a corpus
+    run is well-formed Chrome trace JSON with the runner's spans, AND
+    output at the fixed seed is byte-identical with tracing on or off —
+    obs is a pure side channel."""
+    rc_off, blob_off = _run_corpus(tmp_path, "off")
+    trace_file = str(tmp_path / "run.trace.json")
+    rc_on, blob_on = _run_corpus(tmp_path, "on", trace_path=trace_file)
+    assert rc_off == rc_on == 0
+    assert blob_on == blob_off and blob_off
+
+    doc = json.load(open(trace_file))
+    xev = [e for e in doc["traceEvents"] if e.get("ph") == "X"]
+    assert xev, "corpus run produced no spans"
+    names = {e["name"] for e in xev}
+    assert {"corpus.schedule", "corpus.dispatch", "corpus.drain"} <= names
+    for e in xev:
+        assert {"name", "ph", "ts", "dur", "pid", "tid", "args"} <= set(e)
+
+
+# ---- logger: structured JSON mode ----------------------------------------
+
+
+def test_logger_json_format():
+    from erlamsa_tpu.services import logger
+
+    lg = logger.Logger()
+    got = []
+    lg.add_sink("debug", got.append)
+    lg.set_format("json")
+    with trace.GLOBAL.span("s"):  # disabled tracer -> span_id 0
+        lg.log("info", "corpus: device lost, %d cases", 3)
+    lg.flush()
+    rec = json.loads(got[0])
+    assert rec["level"] == "info"
+    assert rec["component"] == "corpus"
+    assert rec["msg"] == "corpus: device lost, 3 cases"
+    assert rec["span_id"] == 0
+    assert rec["ts"]
+
+    lg.set_format("text")
+    lg.log("info", "plain")
+    lg.flush()
+    assert got[1].endswith("\tinfo\tplain")
+    with pytest.raises(ValueError):
+        lg.set_format("xml")
+
+
+def test_logger_json_carries_live_span_id(tmp_path):
+    from erlamsa_tpu.services import logger
+
+    trace.configure(path=str(tmp_path / "t.json"))
+    lg = logger.Logger()
+    got = []
+    lg.add_sink("debug", got.append)
+    lg.set_format("json")
+    with trace.GLOBAL.span("live") as sp:
+        lg.log("info", "inside")
+    lg.flush()
+    assert json.loads(got[0])["span_id"] == sp.span_id > 0
+
+
+def test_sqlite_sink_accepts_json_lines(tmp_path):
+    from erlamsa_tpu.services.logger import SqliteSink, query_log
+
+    db = str(tmp_path / "log.db")
+    sink = SqliteSink(db)
+    sink(json.dumps({"ts": "2026-01-01 00:00:00", "level": "finding",
+                     "component": "corpus", "span_id": 5, "msg": "crash"}))
+    sink("2026-01-01 00:00:01\tinfo\tplain line")
+    rows = query_log(db)
+    assert [(r[2], r[3]) for r in rows] == [("finding", "crash"),
+                                            ("info", "plain line")]
