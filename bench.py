@@ -169,7 +169,7 @@ def _run_full_set_stage(batch_n: int, seed_len: int, cases: int, t0: float):
 
 
 def _run_corpus_stage(batch_n: int, seed_len: int, cases: int, t0: float,
-                      pipeline: str = "async"):
+                      pipeline: str = "async", layout: str = "buckets"):
     """Feedback-driven corpus engine over a MIXED-LENGTH seed set: store
     dedup -> energy schedule -> power-of-two length buckets -> device
     batches, the `--corpus DIR --feedback` CLI path (corpus/runner.py).
@@ -182,9 +182,16 @@ def _run_corpus_stage(batch_n: int, seed_len: int, cases: int, t0: float,
     fixed (1,2,3) seed both produce byte-identical outputs, so the
     async/sync throughput ratio isolates the overlap win.
 
+    `layout` selects the device memory layout (buckets = per-capacity
+    panels re-uploaded every case, arena = the r9 paged device-resident
+    arena where seeds cross PCIe once at admission). The returned stats
+    dict carries `bytes_uploaded` for both, so the arena leg's
+    bytes-per-sample reduction is a measured record field.
+
     Returns (warm_samples_per_sec, per-bucket padded-waste dict,
-    novel-hash count). Warm = first case (trace+compile) dropped via the
-    runner's per-case finish timestamps; needs cases >= 2."""
+    novel-hash count, stats dict). Warm = first case (trace+compile)
+    dropped via the runner's per-case finish timestamps; needs
+    cases >= 2."""
     import shutil
     import tempfile
 
@@ -208,6 +215,7 @@ def _run_corpus_stage(batch_n: int, seed_len: int, cases: int, t0: float,
             "output": os.devnull,
             "_stats": stats,
             "pipeline": pipeline,
+            "layout": layout,
         }
         rc = run_corpus_batch(opts, batch=batch_n)
     finally:
@@ -221,10 +229,11 @@ def _run_corpus_stage(batch_n: int, seed_len: int, cases: int, t0: float,
         for cap, b in sorted(stats["buckets"].items())
     }
     _phase(
-        f"corpus stage ({pipeline}): {warm_sps:,.0f} samples/s warm, "
-        f"buckets={list(waste)} padded-waste/sample={waste}", t0,
+        f"corpus stage ({pipeline}/{layout}): {warm_sps:,.0f} samples/s "
+        f"warm, buckets={list(waste)} padded-waste/sample={waste} "
+        f"uploaded={stats.get('bytes_uploaded', 0):,}B", t0,
     )
-    return warm_sps, waste, stats.get("new_hashes", 0)
+    return warm_sps, waste, stats.get("new_hashes", 0), stats
 
 
 def child_main() -> None:
@@ -319,16 +328,40 @@ def child_main() -> None:
     # ERLAMSA_BENCH_SYNC=0 skips just the sync comparison leg.
     if os.environ.get("ERLAMSA_BENCH_CORPUS", "1") != "0":
         try:
-            corpus_sps, waste, novel = _run_corpus_stage(
+            corpus_sps, waste, novel, cstats = _run_corpus_stage(
                 BATCH, SEED_LEN, max(2, ITERS // 3), t0, pipeline="async"
             )
             record["corpus_samples_per_sec"] = round(corpus_sps, 1)
             record["corpus_padded_waste_per_sample"] = waste
             record["corpus_novel_hashes"] = novel
+            record["corpus_upload_bytes_per_sample"] = round(
+                cstats.get("bytes_uploaded", 0) / max(cstats.get("total", 1), 1), 1
+            )
             line = json.dumps(record)
             _write_result(line)
+            # arena leg: same shape, --layout arena. Seeds cross PCIe once
+            # at admission, so bytes-uploaded-per-sample collapses to the
+            # per-case page-table + row-length traffic — the r9 headline.
+            # ERLAMSA_BENCH_ARENA=0 skips it.
+            if os.environ.get("ERLAMSA_BENCH_ARENA", "1") != "0":
+                arena_sps, _, _, astats = _run_corpus_stage(
+                    BATCH, SEED_LEN, max(2, ITERS // 3), t0,
+                    pipeline="async", layout="arena"
+                )
+                record["corpus_arena_samples_per_sec"] = round(arena_sps, 1)
+                a_bps = astats.get("bytes_uploaded", 0) / max(
+                    astats.get("total", 1), 1)
+                record["corpus_arena_upload_bytes_per_sample"] = round(a_bps, 1)
+                b_bps = cstats.get("bytes_uploaded", 0) / max(
+                    cstats.get("total", 1), 1)
+                record["corpus_arena_upload_reduction"] = round(
+                    b_bps / a_bps, 1) if a_bps else 0.0
+                record["corpus_arena_step_shapes"] = len(
+                    astats.get("step_shapes", ()))
+                line = json.dumps(record)
+                _write_result(line)
             if os.environ.get("ERLAMSA_BENCH_SYNC", "1") != "0":
-                sync_sps, _, _ = _run_corpus_stage(
+                sync_sps, _, _, _ = _run_corpus_stage(
                     BATCH, SEED_LEN, max(2, ITERS // 3), t0, pipeline="sync"
                 )
                 record["corpus_sync_samples_per_sec"] = round(sync_sps, 1)

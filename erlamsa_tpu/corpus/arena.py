@@ -1,0 +1,400 @@
+"""Device-resident paged seed arena: upload seeds once, mutate forever.
+
+The bucket assembler (assembler.py) re-builds and re-uploads a padded
+panel for every scheduled case — the same seed bytes cross the host→
+device link every time the scheduler picks them, and a mixed corpus
+compiles O(log²) (B, L) bucket shapes. This module keeps seed bytes ON
+the device in an arena of fixed-size pages (ops/paged.py) addressed
+through an int32 page table, the Ragged Paged Attention layout
+(PAPERS.md, arxiv 2604.15464) applied to the corpus:
+
+  * `PageAllocator` — pure-host bookkeeping: a free list of page ids,
+    per-seed page runs, pin counts (a pinned run is referenced by the
+    case being assembled and must not be evicted), LRU eviction by
+    last-scheduled case, and defrag compaction that renumbers live
+    pages toward the front of the arena for gather locality.
+  * `DeviceArena` — the allocator plus the device tensor: `ensure()`
+    admits a seed's bytes as zero-padded pages (ONE upload per seed,
+    pow2-chunked so admission compiles O(log) programs), `table_for()`
+    builds a batch's page table + true-length vector, `gather()` pulls
+    the working buffer for the mutation step, `adopt()` scatters
+    device-resident output rows back in as new runs without a host
+    round trip, and `reset()` rebuilds after device loss.
+
+Spill-to-host: when the arena cannot hold a scheduled seed (pages
+exhausted even after eviction, or an injected ``arena.spill`` chaos
+fault), the seed stays host-resident for that case — its table row
+points at the zero page and the runner overlays the row from host
+bytes. Spills cost one extra upload but never change output bytes; the
+chaos test pins that transparency.
+
+Determinism: page ids depend only on the deterministic call sequence
+(alloc order, eviction order by (last_used, seed id), LIFO free-list
+reuse) — no clocks, no thread timing. The `tick` every call takes is
+the case counter, so at a fixed -s two runs allocate identically.
+
+Threading: the allocator and the device tensor are owned by the main
+dispatch thread. Only the admission queue (`enqueue`, fed by the store
+listener from service threads) is shared, and it is lock-guarded.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Sequence
+
+import threading
+
+import numpy as np
+
+from ..obs import trace
+from ..services import chaos
+
+#: re-exported reserved-page convention (ops/paged.py is jax-importing;
+#: the allocator half of this module must stay importable without it)
+ZERO_PAGE = 0
+TRASH_PAGE = 1
+RESERVED_PAGES = 2
+
+
+def _next_pow2(n: int) -> int:
+    return 1 << max(0, (int(n) - 1)).bit_length()
+
+
+class PageAllocator:
+    """Host-side page bookkeeping for one arena. No jax anywhere: the
+    allocator is property-testable on any box (tests/test_arena.py).
+
+    Owned by the main dispatch thread — see the module docstring."""
+
+    def __init__(self, num_pages: int, page: int):
+        if num_pages <= RESERVED_PAGES:
+            raise ValueError(f"arena needs > {RESERVED_PAGES} pages, "
+                             f"got {num_pages}")
+        if page <= 0:
+            raise ValueError(f"page size must be positive, got {page}")
+        self.num_pages = int(num_pages)
+        self.page = int(page)
+        # descending so pop() hands out ascending ids first; freed runs
+        # go back LIFO — both deterministic given the call sequence
+        self._free = list(range(self.num_pages - 1, RESERVED_PAGES - 1, -1))
+        self._runs: dict[str, list[int]] = {}
+        self._lens: dict[str, int] = {}
+        self._pins: dict[str, int] = {}
+        self._last_used: dict[str, int] = {}
+        self.evictions = 0
+        self.defrags = 0
+        self.frees_since_defrag = 0
+
+    # -- queries ---------------------------------------------------------
+
+    def pages_for(self, nbytes: int) -> int:
+        return max(1, -(-int(nbytes) // self.page))
+
+    def free_pages(self) -> int:
+        return len(self._free)
+
+    def resident(self, sid: str) -> bool:
+        return sid in self._runs
+
+    def run(self, sid: str) -> list[int]:
+        return self._runs[sid]
+
+    def length(self, sid: str) -> int:
+        return self._lens[sid]
+
+    def occupancy(self) -> float:
+        usable = self.num_pages - RESERVED_PAGES
+        return 1.0 - len(self._free) / usable if usable else 0.0
+
+    # -- alloc/free/pin --------------------------------------------------
+
+    def alloc(self, sid: str, nbytes: int, tick: int) -> list[int] | None:
+        """Reserve a page run for `sid` (None if the free list is too
+        short — the caller evicts or spills). nbytes is the TRUE length;
+        the run covers ceil(nbytes/page) pages."""
+        if sid in self._runs:
+            raise ValueError(f"seed {sid} already resident")
+        need = self.pages_for(nbytes)
+        if need > len(self._free):
+            return None
+        pages = [self._free.pop() for _ in range(need)]
+        self._runs[sid] = pages
+        self._lens[sid] = int(nbytes)
+        self._pins[sid] = 0
+        self._last_used[sid] = int(tick)
+        return pages
+
+    def free(self, sid: str) -> int:
+        """Release a run back to the free list; returns pages freed."""
+        if self._pins.get(sid, 0):
+            raise ValueError(f"seed {sid} is pinned ({self._pins[sid]})")
+        pages = self._runs.pop(sid)
+        del self._lens[sid], self._pins[sid], self._last_used[sid]
+        self._free.extend(pages)
+        self.frees_since_defrag += len(pages)
+        return len(pages)
+
+    def pin(self, sid: str):
+        """Ref-count a run the current case's table points at — pinned
+        runs survive eviction until the matching unpin."""
+        self._pins[sid] += 1
+
+    def unpin(self, sid: str):
+        if self._pins[sid] <= 0:
+            raise ValueError(f"seed {sid} is not pinned")
+        self._pins[sid] -= 1
+
+    def touch(self, sid: str, tick: int):
+        self._last_used[sid] = int(tick)
+
+    # -- eviction / defrag -----------------------------------------------
+
+    def evict_for(self, need: int) -> list[str]:
+        """Free least-recently-scheduled unpinned runs until `need`
+        pages are available (or no candidates remain). Ties break on
+        seed id so eviction order is replayable. Returns evicted sids."""
+        evicted: list[str] = []
+        while len(self._free) < need:
+            victims = sorted(
+                (sid for sid, p in self._pins.items() if p == 0),
+                key=lambda sid: (self._last_used[sid], sid),
+            )
+            if not victims:
+                break
+            self.free(victims[0])
+            evicted.append(victims[0])
+        self.evictions += len(evicted)
+        return evicted
+
+    def defrag(self) -> np.ndarray:
+        """Compact live runs toward the front of the arena and return
+        the int32[num_pages] source map for ops/paged.permute_pages
+        (new_arena[i] = old_arena[src[i]]). Runs are renumbered in
+        ascending order of their current first page, so relative layout
+        is preserved and the move is deterministic."""
+        src = np.arange(self.num_pages, dtype=np.int32)
+        nxt = RESERVED_PAGES
+        for sid in sorted(self._runs, key=lambda s: self._runs[s][0]):
+            old = self._runs[sid]
+            new = list(range(nxt, nxt + len(old)))
+            src[new] = old
+            self._runs[sid] = new
+            nxt += len(old)
+        self._free = list(range(self.num_pages - 1, nxt - 1, -1))
+        self.defrags += 1
+        self.frees_since_defrag = 0
+        return src
+
+    def stats(self) -> dict:
+        return {
+            "pages": self.num_pages,
+            "page_size": self.page,
+            "pages_free": len(self._free),
+            "occupancy": round(self.occupancy(), 4),
+            "resident_seeds": len(self._runs),
+            "evictions": self.evictions,
+            "defrags": self.defrags,
+        }
+
+
+class DeviceArena:
+    """The allocator married to the device tensor. All methods except
+    `enqueue` are main-thread-only (module docstring)."""
+
+    _GUARDED_BY = {"_lock": ("_pending",)}
+
+    def __init__(self, num_pages: int, page: int | None = None,
+                 row_pages: int = 1, donate="auto"):
+        from ..ops import paged
+
+        self._paged = paged
+        self.alloc = PageAllocator(num_pages, page or paged.PAGE)
+        self.page = self.alloc.page
+        # every gathered row spans row_pages pages: the run's ONE
+        # working-buffer width. Seeds longer than this are truncated at
+        # admission (the same clamp the bucket path applies at its
+        # device cap; metrics.record_truncated counts them)
+        self.row_pages = int(row_pages)
+        self.width = self.page * self.row_pages
+        self._arena = paged.new_arena(num_pages, self.page)
+        self._donate = donate
+        self._staged_idx: list[int] = []
+        self._staged_pages: list[np.ndarray] = []
+        self._lock = threading.Lock()
+        self._pending: list[str] = []
+        self.spills = 0
+        self.uploads = 0
+        self.bytes_uploaded = 0
+
+    # -- admission -------------------------------------------------------
+
+    def enqueue(self, sid: str):
+        """Store-admission hook (CorpusStore.listener): note a new seed
+        for upload at the next case boundary. Thread-safe; the upload
+        itself happens on the main thread in drain_pending()."""
+        with self._lock:
+            self._pending.append(sid)
+
+    def drain_pending(self, get: Callable[[str], bytes], tick: int):
+        """Admit every seed queued by enqueue() since the last case."""
+        with self._lock:
+            pending, self._pending = self._pending, []
+        for sid in pending:
+            self.ensure(sid, get(sid), tick)
+        if pending:
+            self.flush()
+
+    def _spill_forced(self) -> bool:
+        try:
+            chaos.fault_point("arena.spill")
+        except OSError:
+            # an injected arena.spill fault: treat this seed as if the
+            # arena were full — it must ride the host-overlay path and
+            # the output stream must not change (tests pin this)
+            return True
+        return False
+
+    def ensure(self, sid: str, data: bytes, tick: int) -> bool:
+        """Make `sid` resident (True) or report a spill (False). Bytes
+        are clamped to the row width and paged out zero-padded, so a
+        gathered row matches a packed panel row exactly."""
+        if self.alloc.resident(sid):
+            self.alloc.touch(sid, tick)
+            return True
+        if self._spill_forced():
+            self.spills += 1
+            return False
+        data = data[:self.width]
+        need = self.alloc.pages_for(len(data))
+        pages = self.alloc.alloc(sid, len(data), tick)
+        if pages is None:
+            with trace.span("corpus.arena.evict", need=need):
+                self.alloc.evict_for(need)
+            pages = self.alloc.alloc(sid, len(data), tick)
+        if pages is None:
+            self.spills += 1
+            return False
+        buf = np.zeros(len(pages) * self.page, np.uint8)
+        buf[:len(data)] = np.frombuffer(data, np.uint8)
+        self._staged_idx.extend(pages)
+        self._staged_pages.append(buf.reshape(len(pages), self.page))
+        return True
+
+    def flush(self):
+        """Upload staged pages in one pow2-padded chunk (padding rows
+        target the trash page), so admission compiles O(log) shapes over
+        a run, not one per seed count."""
+        if not self._staged_idx:
+            return
+        k = len(self._staged_idx)
+        kp = _next_pow2(k)
+        idx = np.full(kp, TRASH_PAGE, np.int32)
+        idx[:k] = self._staged_idx
+        pages = np.zeros((kp, self.page), np.uint8)
+        pages[:k] = np.vstack(self._staged_pages)
+        self._staged_idx, self._staged_pages = [], []
+        with trace.span("corpus.arena.upload", pages=k, padded=kp):
+            self._arena = self._paged.upload_pages(
+                self._arena, idx, pages, donate=self._donate
+            )
+        self.uploads += 1
+        self.bytes_uploaded += int(pages.nbytes + idx.nbytes)
+
+    # -- batch addressing ------------------------------------------------
+
+    def table_for(self, sids: Sequence[str], samples: Sequence[bytes],
+                  tick: int):
+        """Build one case's page table. Returns (table int32[B, P],
+        lens int32[B], spilled rows). Every resident run is pinned while
+        the table is built so a later row's eviction cannot steal its
+        pages, then unpinned — the gather dispatch order makes the table
+        safe to use after unpinning (uploads queue behind the gather)."""
+        rows = len(sids)
+        table = np.full((rows, self.row_pages), ZERO_PAGE, np.int32)
+        lens = np.zeros(rows, np.int32)
+        spilled: list[int] = []
+        pinned: list[str] = []
+        with trace.span("corpus.arena.alloc", rows=rows, tick=tick):
+            for r, (sid, data) in enumerate(zip(sids, samples)):
+                if self.ensure(sid, data, tick):
+                    # the allocator's recorded length is authoritative:
+                    # for store seeds it equals the clamped sample
+                    # length, and adopted seeds (device-only bytes)
+                    # have no host sample at all
+                    lens[r] = self.alloc.length(sid)
+                    run = self.alloc.run(sid)
+                    table[r, :len(run)] = run
+                    self.alloc.pin(sid)
+                    pinned.append(sid)
+                else:
+                    lens[r] = min(len(data), self.width)
+                    spilled.append(r)
+            self.flush()
+        for sid in pinned:
+            self.alloc.unpin(sid)
+        return table, lens, spilled
+
+    def gather(self, table: np.ndarray):
+        """Device gather: uint8[B, row_pages*page] working buffer."""
+        with trace.span("corpus.arena.gather", rows=int(table.shape[0])):
+            return self._paged.gather_rows(self._arena, table)
+
+    def adopt(self, sids: Sequence[str], data, lens: Sequence[int],
+              tick: int) -> list[str]:
+        """Scatter device-resident output rows (uint8[B, row_pages*page])
+        back into the arena as new runs — the admission path that never
+        crosses PCIe. Rows whose run cannot be allocated are skipped and
+        returned (the caller may fall back to host-side ensure())."""
+        rows, width = data.shape
+        if width != self.width:
+            raise ValueError(f"adopt rows are {width}B, arena rows "
+                             f"are {self.width}B")
+        table = np.full((rows, self.row_pages), TRASH_PAGE, np.int32)
+        skipped: list[str] = []
+        for r, sid in enumerate(sids):
+            if self.alloc.resident(sid):
+                continue
+            pages = self.alloc.alloc(sid, min(int(lens[r]), self.width),
+                                     tick)
+            if pages is None:
+                skipped.append(sid)
+                continue
+            table[r, :len(pages)] = pages
+        with trace.span("corpus.arena.scatter", rows=rows):
+            self._arena = self._paged.scatter_rows(
+                self._arena, table, data, donate=self._donate
+            )
+        return skipped
+
+    # -- maintenance -----------------------------------------------------
+
+    def maybe_defrag(self) -> bool:
+        """Compact once enough pages have churned through the free list
+        (a quarter of the arena) — cheap insurance that long runs stay
+        front-packed for gather locality after heavy eviction."""
+        usable = self.alloc.num_pages - RESERVED_PAGES
+        if self.alloc.frees_since_defrag < max(16, usable // 4):
+            return False
+        self.defrag()
+        return True
+
+    def defrag(self):
+        src = self.alloc.defrag()
+        with trace.span("corpus.arena.defrag"):
+            self._arena = self._paged.permute_pages(
+                self._arena, src, donate=self._donate
+            )
+
+    def reset(self):
+        """Device-loss recovery: drop every run and rebuild an empty
+        arena tensor (the old one died with the device). Cumulative
+        counters survive; the runner re-seeds from the store."""
+        self.alloc = PageAllocator(self.alloc.num_pages, self.page)
+        self._staged_idx, self._staged_pages = [], []
+        self._arena = self._paged.new_arena(self.alloc.num_pages, self.page)
+
+    def stats(self) -> dict:
+        s = self.alloc.stats()
+        s.update(spills=self.spills, uploads=self.uploads,
+                 bytes_uploaded=self.bytes_uploaded)
+        return s

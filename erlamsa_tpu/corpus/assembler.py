@@ -105,23 +105,29 @@ def plan_buckets(samples: Sequence[bytes], slack: float = GROWTH_SLACK,
 
 
 def materialize(plan: BucketPlan, samples: Sequence[bytes]) -> Bucket:
-    """Build one plan's padded device panel (the expensive half)."""
+    """Build one plan's padded device panel (the expensive half).
+
+    Vectorized: one flat join of the row payloads and one masked scatter
+    into the zero panel, instead of a per-row np.frombuffer loop — the
+    row-major order of a boolean-mask assignment matches the join order
+    exactly, so the panel is byte-identical to the loop it replaced.
+    """
     cap = plan.capacity
     rows = len(plan.slots)
+    # oversized samples (beyond the device cap) are truncated to
+    # capacity rather than dropped — the scheduler picked them, and a
+    # truncated mutation beats an empty slot; the runner counts them
+    # into metrics.Counters (erlamsa_truncated_rows_total)
+    src = [samples[plan.slots[r % rows]] for r in range(plan.rows_padded)]
+    lens = np.fromiter((min(len(s), cap) for s in src), np.int32,
+                       count=plan.rows_padded)
+    flat = np.frombuffer(
+        b"".join(s[:n] for s, n in zip(src, lens.tolist())), np.uint8
+    )
     data = np.zeros((plan.rows_padded, cap), np.uint8)
-    lens = np.zeros(plan.rows_padded, np.int32)
-    wasted = 0
-    for r in range(plan.rows_padded):
-        s = samples[plan.slots[r % rows]]
-        # oversized samples (beyond the device cap) are truncated to
-        # capacity rather than dropped — the scheduler picked them,
-        # and a truncated mutation beats an empty slot; the runner
-        # logs the overflow count
-        n = min(len(s), cap)
-        data[r, :n] = np.frombuffer(s[:n], np.uint8)
-        lens[r] = n
-        if r < rows:
-            wasted += cap - n
+    if flat.size:
+        data[np.arange(cap) < lens[:, None]] = flat
+    wasted = int(cap * rows - int(lens[:rows].sum()))
     return Bucket(
         capacity=cap,
         slots=plan.slots,

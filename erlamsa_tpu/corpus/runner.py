@@ -64,11 +64,18 @@ import numpy as np
 from ..obs import trace
 from ..services import chaos, logger, metrics, out
 from . import feedback as fb
-from .assembler import materialize, plan_buckets
+from .assembler import Bucket, bucket_capacity, materialize, plan_buckets
 from .energy import EnergyScheduler
 from .store import CorpusStore
 
 PIPELINES = ("sync", "async")
+
+# corpus memory layouts (--layout): buckets re-assembles and re-uploads
+# pow2-padded panels per case (the default until arena parity is proven
+# on real hardware); arena keeps seed bytes device-resident in fixed-size
+# pages (corpus/arena.py) and addresses each case through a page table —
+# one compiled step shape, ~zero padded waste, seeds cross PCIe once
+LAYOUTS = ("buckets", "arena")
 
 # degraded mode probes the device for recovery every N cases
 DEVICE_PROBE_EVERY = 4
@@ -182,6 +189,11 @@ def run_corpus_batch(opts: dict, batch: int = 1024) -> int:
         raise ValueError(f"pipeline must be one of {PIPELINES}, "
                          f"got {pipeline!r}")
     use_async = pipeline == "async"
+    layout = str(opts.get("layout") or "buckets")
+    if layout not in LAYOUTS:
+        raise ValueError(f"layout must be one of {LAYOUTS}, "
+                         f"got {layout!r}")
+    use_arena = layout == "arena"
 
     store = CorpusStore(opts["corpus_dir"])
     # recovery fsck: a previous crash can leave corpus.json and seeds/
@@ -233,6 +245,43 @@ def run_corpus_batch(opts: dict, batch: int = 1024) -> int:
     bus = opts.get("feedback_bus", fb.GLOBAL)
     consume_feedback = bool(opts.get("feedback"))
 
+    arena = None
+    trunc_cap = device_max  # truncation threshold (both layouts)
+
+    def _seed_arena(tick):
+        """Upload every stored seed once — after this, scheduling a seed
+        costs a page-table row, not a PCIe copy."""
+        with trace.span("corpus.arena.seed", seeds=len(store), tick=tick):
+            for sid in store.ids():
+                arena.ensure(sid, store.get(sid), tick)
+            arena.flush()
+
+    if use_arena:
+        from ..ops import paged
+        from .arena import RESERVED_PAGES, DeviceArena
+
+        # ONE working width for the whole run: the capacity class of the
+        # largest stored seed. The fused engine's streams are a function
+        # of the static row width (ops/pipeline.py ENGINE VERSION
+        # NOTES), so arena==buckets byte-identity holds exactly when the
+        # bucket path would place every seed in this same class — the
+        # configuration the tests pin and README documents.
+        max_len = max(len(store.get(sid)) for sid in store.ids())
+        trunc_cap = bucket_capacity(max_len, device_max=device_max)
+        page = min(int(opts.get("arena_page") or paged.PAGE), trunc_cap)
+        row_pages = trunc_cap // page
+        need = sum(-(-min(len(store.get(sid)), trunc_cap) // page)
+                   for sid in store.ids())
+        num_pages = int(opts.get("arena_pages")
+                        or RESERVED_PAGES + max(64, 2 * need))
+        num_pages = max(num_pages, RESERVED_PAGES + row_pages)
+        arena = DeviceArena(num_pages, page=page, row_pages=row_pages,
+                            donate="auto" if use_async else False)
+        _seed_arena(tick=-1)
+        # store-admission hook: seeds added mid-run (faas/monitors)
+        # queue here and upload at the next case boundary
+        store.listener = arena.enqueue
+
     n_cases = opts.get("n", 1)
     start_case = 0
     ckpt_every = max(1, int(opts.get("checkpoint_every", 1)))
@@ -272,7 +321,11 @@ def run_corpus_batch(opts: dict, batch: int = 1024) -> int:
     seen_hashes: set[bytes] = set()
     bucket_stats: dict[int, dict] = {}
     # tallies the drain worker owns in async mode (main reads after join)
-    tallies = {"truncated": 0, "total": 0, "new_hashes": 0}
+    tallies = {"truncated": 0, "total": 0, "new_hashes": 0,
+               "bytes_uploaded": 0}
+    # distinct (rows, capacity, scan_len) triples the jitted step saw —
+    # the compiled-program count the arena drives to O(1)
+    step_shapes: set[tuple] = set()
 
     # sync mode keeps the score table host-resident. One conversion for
     # the whole run — per bucket only that bucket's ROWS are gathered and
@@ -280,6 +333,62 @@ def run_corpus_batch(opts: dict, batch: int = 1024) -> int:
     # the entire table every case).
     if not use_async:
         scores = np.array(scores)
+
+    def _dispatch_arena(case, ids, samples, scores_in):
+        """Arena layout's dispatch: build the page table (the cheap host
+        half riding the async pipeline's assemble slot), gather the
+        working buffer out of the device arena, and run ONE uniform
+        (batch, width) step — no per-class panels, no per-case seed
+        re-upload. Spilled rows (arena full / injected arena.spill
+        fault) are overlaid from host bytes, which costs an upload but
+        never changes output bytes."""
+        t_a = time.perf_counter()
+        with trace.span("corpus.assemble", case=case, capacity=trunc_cap):
+            arena.drain_pending(store.get, tick=case)
+            arena.maybe_defrag()
+            table, lens, spilled = arena.table_for(ids, samples, tick=case)
+        t_d = time.perf_counter()
+        chaos.fault_point("device.step")
+        data = arena.gather(table)
+        if spilled:
+            # pow2-padded overlay rows keep the compiled set bounded;
+            # padding repeats the first spilled row — idempotent, the
+            # same bytes land twice
+            k = len(spilled)
+            kp = max(8, 1 << (k - 1).bit_length())
+            rows_idx = np.asarray(
+                (spilled + [spilled[0]] * (kp - k))[:kp], np.int32)
+            panel = np.zeros((kp, trunc_cap), np.uint8)
+            for j, r in enumerate(spilled):
+                s = samples[r][:trunc_cap]
+                panel[j, :len(s)] = np.frombuffer(s, np.uint8)
+            panel[k:] = panel[0]
+            data = data.at[rows_idx].set(panel)
+            tallies["bytes_uploaded"] += panel.nbytes + rows_idx.nbytes
+        idx = np.arange(batch, dtype=np.int32)
+        sl = scan_bound(int(lens.max()) if batch else 0, trunc_cap)
+        # fresh score gather (like the bucket path) so donation never
+        # consumes the live table while the drain may still read it
+        sc_in = (jnp.take(scores_in, jnp.asarray(idx), axis=0)
+                 if use_async else scores_in[idx])
+        tallies["bytes_uploaded"] += (table.nbytes + lens.nbytes
+                                      + idx.nbytes)
+        step_shapes.add((batch, trunc_cap, sl))
+        with trace.span("corpus.dispatch", case=case, capacity=trunc_cap,
+                        rows=batch):
+            fut = step_async(step, base, case, idx, data, lens, sc_in,
+                             scan_len=sl)
+        scores_out = fut.scores if use_async else np.asarray(fut.scores)
+        # shape-only placeholder panel: process_case never reads bucket
+        # data (outputs come from the future), and holding the donated
+        # working buffer in the work item would pin device memory
+        b = Bucket(capacity=trunc_cap, slots=idx,
+                   data=np.zeros((batch, 0), np.uint8), lens=lens,
+                   rows=batch, padded_bytes_wasted=0)
+        t_e = time.perf_counter()
+        metrics.GLOBAL.record_stage("assemble", t_d - t_a)
+        metrics.GLOBAL.record_stage("dispatch", t_e - t_d)
+        return ids, [(b, fut)], scores_out, t_e - t_d
 
     def dispatch_case(case, scores_in):
         """Schedule, assemble and dispatch every bucket of one case.
@@ -294,9 +403,15 @@ def run_corpus_batch(opts: dict, batch: int = 1024) -> int:
         with trace.span("corpus.schedule", case=case):
             ids = sched.schedule(case, batch)
             samples = [store.get(sid) for sid in ids]
-            plans = plan_buckets(samples, device_max=device_max)
+            plans = (None if use_arena
+                     else plan_buckets(samples, device_max=device_max))
         metrics.GLOBAL.record_stage("schedule", time.perf_counter() - t_s)
-        tallies["truncated"] += sum(len(s) > device_max for s in samples)
+        trunc = sum(len(s) > trunc_cap for s in samples)
+        if trunc:
+            tallies["truncated"] += trunc
+            metrics.GLOBAL.record_truncated(trunc)
+        if use_arena:
+            return _dispatch_arena(case, ids, samples, scores_in)
 
         launched = []
         scores_out = scores_in
@@ -318,12 +433,15 @@ def run_corpus_batch(opts: dict, batch: int = 1024) -> int:
             gather = b.slots[np.arange(b.rows_padded) % b.rows]
             sc_in = (jnp.take(scores_out, gather, axis=0) if use_async
                      else scores_out[gather])
+            sl = scan_bound(int(b.lens[:b.rows].max()), b.capacity)
+            tallies["bytes_uploaded"] += (b.data.nbytes + b.lens.nbytes
+                                          + idx.nbytes)
+            step_shapes.add((b.rows_padded, b.capacity, sl))
             with trace.span("corpus.dispatch", case=case,
                             capacity=b.capacity, rows=b.rows):
                 fut = step_async(
                     step, base, case, idx, b.data, b.lens, sc_in,
-                    scan_len=scan_bound(int(b.lens[:b.rows].max()),
-                                        b.capacity),
+                    scan_len=sl,
                 )
             if use_async:
                 scores_out = scores_out.at[jnp.asarray(b.slots)].set(
@@ -570,6 +688,11 @@ def run_corpus_batch(opts: dict, batch: int = 1024) -> int:
                         metrics.GLOBAL.record_event("device_recovered")
                         metrics.GLOBAL.set_degraded(False)
                         device_mode = True
+                        if use_arena:
+                            # the old arena tensor died with the device:
+                            # rebuild empty and pay the seed upload once
+                            arena.reset()
+                            _seed_arena(tick=case)
                         if use_async:
                             scores = jnp.asarray(scores)
                             drain = _DrainWorker(process_case, case,
@@ -597,22 +720,33 @@ def run_corpus_batch(opts: dict, batch: int = 1024) -> int:
     new_hashes = tallies["new_hashes"]
     if tallies["truncated"]:
         print(f"# {tallies['truncated']} scheduled samples exceeded the "
-              f"device budget ({device_max}B) and were truncated",
+              f"device budget ({trunc_cap}B) and were truncated",
               file=sys.stderr)
+    bytes_up = tallies["bytes_uploaded"] + (arena.bytes_uploaded
+                                            if arena is not None else 0)
+    if arena is not None:
+        metrics.GLOBAL.record_arena(arena.stats())
     if stats is not None:
         stats.update(total=total, dt=dt, batch=batch,
                      buckets=bucket_stats, new_hashes=new_hashes,
-                     pipeline=pipeline, store_stats=store.stats())
-    logger.log("info", "corpus backend (%s pipeline): %d samples in %.2fs "
-               "(%.0f samples/s), %d novel output hashes",
-               pipeline, total, dt, total / max(dt, 1e-9), new_hashes)
+                     pipeline=pipeline, layout=layout,
+                     bytes_uploaded=bytes_up,
+                     step_shapes=sorted(step_shapes),
+                     store_stats=store.stats())
+        if arena is not None:
+            stats["arena"] = arena.stats()
+    logger.log("info", "corpus backend (%s pipeline, %s layout): %d "
+               "samples in %.2fs (%.0f samples/s), %d novel output hashes",
+               pipeline, layout, total, dt, total / max(dt, 1e-9),
+               new_hashes)
     waste = sum(b["padded_bytes_wasted"] for b in bucket_stats.values())
     rows = sum(b["rows"] for b in bucket_stats.values())
     print(
         f"# {total} samples, {dt:.2f}s, {total / max(dt, 1e-9):.0f} "
-        f"samples/s ({pipeline} pipeline), {new_hashes} novel hashes, "
-        f"{len(bucket_stats)} buckets, "
-        f"{waste / max(rows, 1):.0f} padded bytes wasted/sample",
+        f"samples/s ({pipeline} pipeline, {layout} layout), "
+        f"{new_hashes} novel hashes, {len(bucket_stats)} buckets, "
+        f"{waste / max(rows, 1):.0f} padded bytes wasted/sample, "
+        f"{bytes_up / max(total, 1):.0f} bytes uploaded/sample",
         file=sys.stderr,
     )
     return 0

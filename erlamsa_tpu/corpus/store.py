@@ -63,6 +63,12 @@ class CorpusStore:
         self._meta: dict[str, dict] = {}
         self._next_idx = 0
         self._cache: dict[str, bytes] = {}
+        # admission hook: called with the seed id of every NEWLY added
+        # seed, outside the store lock (callers may be service threads).
+        # The arena layout uses it to stage device uploads at store
+        # admission — a seed crosses PCIe once, here, then mutates from
+        # device pages (corpus/arena.py)
+        self.listener = None
         with self._lock:
             self._load_locked()
 
@@ -175,6 +181,10 @@ class CorpusStore:
             self._next_idx += 1
             self._cache[sid] = data
             self._save_locked()
+        if self.listener is not None:
+            # outside self._lock: the listener (arena admission queue)
+            # has its own lock and must not nest under the store's
+            self.listener(sid)
         return sid, True
 
     def add_paths(self, paths: list[str]) -> tuple[int, int, int]:

@@ -139,6 +139,38 @@ def render(counters: metrics.Counters | None = None) -> str:
         w.sample("erlamsa_bucket_padded_bytes_wasted_total",
                  b["padded_bytes_wasted"], {"capacity": cap})
 
+    w.head("erlamsa_truncated_rows_total", "counter",
+           "Scheduled rows truncated to the device/arena capacity.")
+    w.sample("erlamsa_truncated_rows_total", snap.get("truncated", 0))
+
+    arena = snap.get("arena")
+    if arena:
+        w.head("erlamsa_arena_pages", "gauge",
+               "Total pages in the device-resident corpus arena.")
+        w.sample("erlamsa_arena_pages", arena["pages"])
+        w.head("erlamsa_arena_pages_free", "gauge",
+               "Free-list length of the corpus arena (pages).")
+        w.sample("erlamsa_arena_pages_free", arena["pages_free"])
+        w.head("erlamsa_arena_page_occupancy", "gauge",
+               "Fraction of allocatable arena pages holding seed bytes.")
+        w.sample("erlamsa_arena_page_occupancy", arena["occupancy"])
+        w.head("erlamsa_arena_resident_seeds", "gauge",
+               "Seeds currently resident in arena pages.")
+        w.sample("erlamsa_arena_resident_seeds", arena["resident_seeds"])
+        w.head("erlamsa_arena_evictions_total", "counter",
+               "Seed runs evicted from the arena (LRU, on pressure).")
+        w.sample("erlamsa_arena_evictions_total", arena["evictions"])
+        w.head("erlamsa_arena_defrags_total", "counter",
+               "Arena defrag compactions performed.")
+        w.sample("erlamsa_arena_defrags_total", arena["defrags"])
+        w.head("erlamsa_arena_spills_total", "counter",
+               "Seeds served from the host-overlay spill path.")
+        w.sample("erlamsa_arena_spills_total", arena["spills"])
+        w.head("erlamsa_arena_bytes_uploaded_total", "counter",
+               "Bytes uploaded into arena pages at admission.")
+        w.sample("erlamsa_arena_bytes_uploaded_total",
+                 arena["bytes_uploaded"])
+
     for hist_name, metric in _HIST_METRICS.items():
         h = c.hists[hist_name].snapshot()
         w.head(metric, "histogram",

@@ -1,0 +1,120 @@
+"""Paged gather/scatter kernels for the device-resident corpus arena.
+
+The Ragged Paged Attention idea (PAPERS.md, arxiv 2604.15464) applied to
+fuzzing: instead of padding every seed to its pow2 size class (one
+compiled (B, L) program per class, padded bytes re-uploaded every case),
+seed bytes live on the device in an arena of fixed-size pages
+``uint8[num_pages, PAGE]`` and a batch is addressed through an int32
+page table ``[B, pages_per_row]``. The mutation step then sees ONE
+working-buffer shape for every seed length — gather rows out of the
+arena by page index, run the fused engine, and (optionally) scatter
+survivor bytes back into freshly allocated pages.
+
+Page-table conventions (corpus/arena.py builds the tables):
+
+  * page 0 is the ZERO page: never allocated, never written. Table
+    entries past a row's last real page point here, so a gathered row is
+    zero beyond its pages with no tail masking — matching the
+    zero-padded panels the bucket assembler builds.
+  * page 1 is the TRASH page: the scatter target for table entries that
+    must not land anywhere. Several rows may scatter to it in one call;
+    its content is undefined and never gathered.
+  * upload zero-pads a seed's final partial page, so arena bytes past a
+    row's true length are zero exactly like a packed panel row.
+
+Everything here is shape-stable by construction: gather/scatter compile
+once per (num_pages, B, pages_per_row) triple, and the arena module pads
+upload index vectors to pow2 chunks so admission traffic compiles O(log)
+programs, not O(seeds).
+
+Donation: scatter/upload/permute consume the arena and return the next
+version. resolve_donate("auto") keeps CPU (no aliasing support) quiet
+while TPU/GPU update the arena in place.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .pipeline import resolve_donate
+
+#: default page width in bytes — one lane-width row, the same floor as
+#: the bucket assembler's MIN_BUCKET (a 256-cap seed is exactly one page)
+PAGE = 256
+
+ZERO_PAGE = 0
+TRASH_PAGE = 1
+#: first allocatable page id (0 and 1 are reserved, see module docstring)
+RESERVED_PAGES = 2
+
+
+def new_arena(num_pages: int, page: int = PAGE):
+    """A fresh all-zero arena. Page 0 starts (and stays) zero."""
+    if num_pages < RESERVED_PAGES + 1:
+        raise ValueError(f"arena needs > {RESERVED_PAGES} pages, "
+                         f"got {num_pages}")
+    return jnp.zeros((num_pages, page), jnp.uint8)
+
+
+def _gather(arena, table):
+    rows = table.shape[0]
+    return arena[table].reshape(rows, -1)
+
+
+def _scatter(arena, table, data):
+    rows, run = table.shape
+    return arena.at[table].set(data.reshape(rows, run, -1))
+
+
+def _upload(arena, idx, pages):
+    return arena.at[idx].set(pages)
+
+
+def _permute(arena, src):
+    return arena[src]
+
+
+_gather_j = jax.jit(_gather)
+_scatter_j = jax.jit(_scatter, donate_argnums=0)
+_scatter_nd = jax.jit(_scatter)
+_upload_j = jax.jit(_upload, donate_argnums=0)
+_upload_nd = jax.jit(_upload)
+_permute_j = jax.jit(_permute, donate_argnums=0)
+_permute_nd = jax.jit(_permute)
+
+
+def gather_rows(arena, table):
+    """uint8[num_pages, PAGE], int32[B, P] -> uint8[B, P*PAGE].
+
+    Row i is the concatenation of pages table[i, :] — with ZERO_PAGE
+    tail entries this reproduces a zero-padded panel row exactly. The
+    arena is NOT consumed (it is gathered again next case)."""
+    return _gather_j(arena, table)
+
+
+def scatter_rows(arena, table, data, donate="auto"):
+    """Write uint8[B, P*PAGE] rows into pages table[i, :] and return the
+    updated arena. Rows that must not land anywhere use TRASH_PAGE
+    entries; duplicate trash entries race benignly (trash is never
+    gathered). The caller's arena handle is consumed when donating."""
+    f = _scatter_j if resolve_donate(donate) else _scatter_nd
+    return f(arena, table, data)
+
+
+def upload_pages(arena, idx, pages, donate="auto"):
+    """Admission: write uint8[k, PAGE] page payloads at page ids
+    int32[k] and return the updated arena. Pad unused tail entries of
+    `idx` with TRASH_PAGE (never ZERO_PAGE) so chunked shapes stay
+    pow2-bounded without touching live pages."""
+    f = _upload_j if resolve_donate(donate) else _upload_nd
+    return f(arena, idx, pages)
+
+
+def permute_pages(arena, src, donate="auto"):
+    """Defrag: new_arena[i] = old_arena[src[i]] for a full int32
+    [num_pages] source map (identity entries for untouched pages). The
+    allocator compacts live pages toward the front and rewrites its
+    runs; this applies the same move device-side in one shot."""
+    f = _permute_j if resolve_donate(donate) else _permute_nd
+    return f(arena, src)

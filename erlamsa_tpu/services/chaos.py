@@ -33,6 +33,9 @@ Known sites (grep `fault_point(` for the authoritative list):
     store.save       corpus.json snapshot write (corpus/store.py)
     store.seed       seed-file publish in CorpusStore.add (corpus/store.py)
     device.step      corpus runner's bucket dispatch (corpus/runner.py)
+    arena.spill      paged-arena admission (corpus/arena.py): an injected
+                     fault forces the seed onto the host-overlay spill
+                     path — outputs must not change (tests pin this)
     checkpoint.load  --state checkpoint read (services/checkpoint.py)
     checkpoint.save  --state checkpoint write (services/checkpoint.py)
 

@@ -169,6 +169,22 @@ def build_parser() -> argparse.ArgumentParser:
                         "overlaps host assembly, device mutation and "
                         "output drain; sync is the serialized baseline. "
                         "Outputs are byte-identical at a fixed -s")
+    p.add_argument("--layout", choices=["buckets", "arena"],
+                   default="buckets",
+                   help="corpus memory layout: buckets (default) "
+                        "re-uploads pow2-padded panels per case; arena "
+                        "keeps seeds device-resident in fixed-size pages "
+                        "addressed through a page table — one compiled "
+                        "step, ~zero padded waste, each seed crosses "
+                        "PCIe once (corpus/arena.py)")
+    p.add_argument("--arena-pages", type=int, default=None, metavar="N",
+                   help="arena page count (default: 2x the pages the "
+                        "store needs, min 64 — eviction/spill handle "
+                        "overflow)")
+    p.add_argument("--arena-page", type=int, default=None, metavar="BYTES",
+                   help="arena page size in bytes (default 256, the "
+                        "device lane width; must divide the run's "
+                        "working width)")
     p.add_argument("--state", default=None,
                    help="checkpoint file (.npz) for stop/resume of batch runs")
     p.add_argument("--node", default=None, help="join a parent node host:port")
@@ -317,6 +333,9 @@ def main(argv=None) -> int:
         "corpus_dir": args.corpus,
         "feedback": args.feedback,
         "pipeline": args.pipeline,
+        "layout": args.layout,
+        "arena_pages": args.arena_pages,
+        "arena_page": args.arena_page,
         "output": args.output,
         "verbose": args.verbose,
         "meta_path": args.meta,

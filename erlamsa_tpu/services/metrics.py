@@ -41,6 +41,13 @@ class Counters:
         self.mutators: dict[str, list[int]] = {}
         # per-capacity-bucket assembly stats (corpus/assembler.py)
         self.buckets: dict[int, dict[str, int]] = {}
+        # scheduled rows truncated to the device/arena capacity — the
+        # overflow count the assembler comment promises is surfaced
+        self.truncated = 0
+        # latest paged-arena health snapshot (corpus/arena.py stats():
+        # pages/pages_free/occupancy/resident_seeds/evictions/defrags/
+        # spills/uploads/bytes_uploaded) — gauge-style, set not summed
+        self.arena: dict | None = None
         # pipeline overlap accounting (corpus/runner.py, services/batcher):
         # per-stage wall seconds keyed by stage name; when stages run on
         # overlapping threads, sum(stages) > pipeline_wall_s measures the
@@ -93,6 +100,20 @@ class Counters:
             b["rows"] += rows
             b["pad_rows"] += pad_rows
             b["padded_bytes_wasted"] += padded_bytes_wasted
+
+    def record_truncated(self, n: int):
+        """`n` scheduled rows exceeded the device/arena capacity this
+        case and were truncated. Rare enough to breadcrumb every time —
+        a run that silently truncates is a run fuzzing the wrong bytes."""
+        with self._lock:
+            self.truncated += n
+        # outside the lock: the flight ring has its own lock
+        flight.GLOBAL.note("truncated_rows", count=n)
+
+    def record_arena(self, stats: dict):
+        """Latest arena health snapshot (corpus/arena.py stats())."""
+        with self._lock:
+            self.arena = dict(stats)
 
     def record_stage(self, name: str, seconds: float):
         """Accumulate wall time for one pipeline stage (schedule, assemble,
@@ -200,6 +221,8 @@ class Counters:
                 },
                 "buckets": {cap: dict(b)
                             for cap, b in sorted(self.buckets.items())},
+                "truncated": self.truncated,
+                "arena": dict(self.arena) if self.arena else None,
             }
 
 

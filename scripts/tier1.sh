@@ -22,6 +22,12 @@
 # serve Prometheus text with throughput counters and latency histogram
 # buckets (erlamsa_tpu/obs).
 #
+# scripts/tier1.sh --arena-smoke additionally runs a tiny corpus batch
+# under BOTH memory layouts (--layout buckets|arena) and asserts the
+# paged-arena contract: byte-identical output streams, exactly ONE
+# compiled step shape for the arena run, and zero padded bytes wasted
+# (corpus/arena.py + ops/paged.py).
+#
 # The gate starts with fuzzlint (erlamsa_tpu/analysis): pure-AST
 # invariant checks (determinism, device purity, lock discipline,
 # resilience coverage) over the whole package in ~2s. Opt out with
@@ -31,12 +37,14 @@ set -o pipefail
 bench_smoke=0
 chaos_smoke=0
 obs_smoke=0
+arena_smoke=0
 lint=1
 while [ $# -gt 0 ]; do
   case "$1" in
     --bench-smoke) bench_smoke=1; shift ;;
     --chaos-smoke) chaos_smoke=1; shift ;;
     --obs-smoke) obs_smoke=1; shift ;;
+    --arena-smoke) arena_smoke=1; shift ;;
     --lint) lint=1; shift ;;
     --no-lint) lint=0; shift ;;
     *) break ;;
@@ -140,6 +148,60 @@ ok = (rc1 == rc2 == 0 and clean and faulted == clean
 print(f"CHAOS_SMOKE={'ok' if ok else 'FAIL'} bytes={len(clean)} "
       f"identical={faulted == clean} "
       f"store_retries={events.get('retry:store.save', 0)}")
+sys.exit(0 if ok else 1)
+EOF
+  rc=$?
+fi
+
+if [ $rc -eq 0 ] && [ $arena_smoke -eq 1 ]; then
+  echo "== arena smoke: paged layout must match buckets byte-for-byte =="
+  timeout -k 10 600 env JAX_PLATFORMS=cpu python - <<'EOF'
+import os, shutil, sys, tempfile
+
+from erlamsa_tpu.corpus.runner import run_corpus_batch
+
+# mixed LENGTHS, one capacity class (len*slack <= 256): the configuration
+# where arena==buckets byte-identity is the pinned contract (README)
+SEEDS = [bytes([65 + i]) * (20 * (i + 1)) for i in range(6)]
+
+
+def one_run(root, layout):
+    outdir = os.path.join(root, "out")
+    os.makedirs(outdir)
+    stats = {}
+    rc = run_corpus_batch(
+        {
+            "corpus_dir": os.path.join(root, "corpus"),
+            "corpus": SEEDS,
+            "feedback": True,
+            "seed": (9, 9, 9),
+            "n": 2,
+            "output": os.path.join(outdir, "%n.out"),
+            "pipeline": "async",
+            "layout": layout,
+            "_stats": stats,
+        },
+        batch=8,
+    )
+    blob = b""
+    for f in sorted(os.listdir(outdir), key=lambda s: int(s.split(".")[0])):
+        blob += open(os.path.join(outdir, f), "rb").read()
+    return rc, blob, stats
+
+
+root = tempfile.mkdtemp(prefix="tier1_arena_smoke_")
+try:
+    rc_b, blob_b, st_b = one_run(os.path.join(root, "buckets"), "buckets")
+    rc_a, blob_a, st_a = one_run(os.path.join(root, "arena"), "arena")
+finally:
+    shutil.rmtree(root, ignore_errors=True)
+waste = sum(b["padded_bytes_wasted"] for b in st_a["buckets"].values())
+ok = (rc_b == rc_a == 0 and blob_b and blob_a == blob_b
+      and len(st_a["step_shapes"]) == 1 and waste == 0
+      and st_a["bytes_uploaded"] < st_b["bytes_uploaded"])
+print(f"ARENA_SMOKE={'ok' if ok else 'FAIL'} identical={blob_a == blob_b} "
+      f"step_shapes={len(st_a['step_shapes'])} padded_waste={waste} "
+      f"upload_bytes={st_a['bytes_uploaded']}/{st_b['bytes_uploaded']}")
 sys.exit(0 if ok else 1)
 EOF
   rc=$?

@@ -1,0 +1,388 @@
+"""Paged device-resident corpus arena (corpus/arena.py + ops/paged.py):
+allocator properties, page-table gather/scatter round-trips on the CPU
+backend, arena health metrics/exposition, and the (slow-marked)
+end-to-end contracts — arena==buckets byte-identity at a fixed -s and
+transparency of injected ``arena.spill`` chaos faults."""
+
+import os
+
+import numpy as np
+import pytest
+
+from erlamsa_tpu.corpus.arena import (RESERVED_PAGES, TRASH_PAGE, ZERO_PAGE,
+                                      DeviceArena, PageAllocator)
+from erlamsa_tpu.services import chaos, metrics
+
+# ---- allocator properties ----------------------------------------------
+
+
+def test_allocator_alloc_free_reuse():
+    a = PageAllocator(num_pages=10, page=16)
+    r1 = a.alloc("s1", 40, tick=0)  # 3 pages
+    r2 = a.alloc("s2", 16, tick=0)  # 1 page
+    # reserved pages never handed out, no page handed out twice
+    assert min(r1 + r2) >= RESERVED_PAGES
+    assert len(set(r1 + r2)) == 4
+    assert a.free_pages() == 10 - RESERVED_PAGES - 4
+    assert a.resident("s1") and a.length("s1") == 40
+    freed = a.free("s2")
+    assert freed == 1 and not a.resident("s2")
+    # LIFO reuse: the page s2 gave back is the next one handed out
+    assert a.alloc("s3", 8, tick=1) == r2
+    with pytest.raises(ValueError):
+        a.alloc("s1", 8, tick=1)  # already resident
+
+
+def test_allocator_full_returns_none():
+    a = PageAllocator(num_pages=RESERVED_PAGES + 2, page=8)
+    assert a.alloc("big", 100, tick=0) is None  # needs 13 pages
+    assert a.alloc("fits", 16, tick=0) is not None
+    assert a.alloc("one-more", 16, tick=0) is None  # free list empty
+    assert a.free_pages() == 0 and a.occupancy() == 1.0
+
+
+def test_allocator_pin_refcount_blocks_eviction():
+    a = PageAllocator(num_pages=8, page=8)
+    a.alloc("s1", 8, tick=0)
+    a.alloc("s2", 8, tick=1)
+    a.pin("s1")
+    a.pin("s1")  # ref-counted: two pins need two unpins
+    assert a.evict_for(need=99) == ["s2"]  # pinned run survives
+    with pytest.raises(ValueError):
+        a.free("s1")
+    a.unpin("s1")
+    with pytest.raises(ValueError):
+        a.free("s1")  # still one pin outstanding
+    a.unpin("s1")
+    a.free("s1")
+    with pytest.raises(KeyError):
+        a.unpin("s2")  # evicted: no pin state left
+
+
+def test_allocator_evicts_lru_first():
+    a = PageAllocator(num_pages=RESERVED_PAGES + 3, page=8)
+    for sid, tick in (("old", 5), ("mid", 7), ("new", 9)):
+        a.alloc(sid, 8, tick=tick)
+    a.touch("old", 20)  # scheduling refreshes recency
+    assert a.evict_for(need=2) == ["mid", "new"]
+    assert a.resident("old") and a.evictions == 2
+
+
+def test_allocator_defrag_compacts_and_preserves_runs():
+    a = PageAllocator(num_pages=12, page=8)
+    a.alloc("s1", 24, tick=0)
+    a.alloc("s2", 8, tick=0)
+    a.alloc("s3", 16, tick=0)
+    a.free("s2")  # hole between s1 and s3
+    before = {sid: a.run(sid)[:] for sid in ("s1", "s3")}
+    src = a.defrag()
+    # live pages are packed from RESERVED_PAGES with no holes
+    live = sorted(p for sid in ("s1", "s3") for p in a.run(sid))
+    assert live == list(range(RESERVED_PAGES, RESERVED_PAGES + len(live)))
+    # src maps every NEW page to the OLD page whose bytes it must hold
+    for sid in ("s1", "s3"):
+        for old_p, new_p in zip(before[sid], a.run(sid)):
+            assert src[new_p] == old_p
+    assert a.defrags == 1 and a.frees_since_defrag == 0
+    # free list covers exactly the tail
+    assert a.free_pages() == 12 - RESERVED_PAGES - len(live)
+
+
+def test_allocator_property_fuzz():
+    """Randomized (seeded) alloc/free/evict churn: pages are never
+    double-allocated, reserved pages never leave the free side, and
+    used + free always partitions the allocatable range."""
+    rng = np.random.default_rng(7)
+    a = PageAllocator(num_pages=32, page=8)
+    live: list[str] = []
+    for i in range(300):
+        op = rng.integers(0, 3)
+        if op == 0:
+            sid = f"s{i}"
+            if a.alloc(sid, int(rng.integers(1, 60)), tick=i) is not None:
+                live.append(sid)
+        elif op == 1 and live:
+            a.free(live.pop(int(rng.integers(0, len(live)))))
+        elif op == 2:
+            evicted = a.evict_for(int(rng.integers(0, 6)))
+            live = [s for s in live if s not in evicted]
+        used = [p for sid in live for p in a.run(sid)]
+        assert len(set(used)) == len(used)
+        assert all(p >= RESERVED_PAGES for p in used)
+        assert len(used) + a.free_pages() == 32 - RESERVED_PAGES
+
+
+# ---- device arena round-trips (CPU backend) -----------------------------
+
+
+def _mixed_seeds():
+    return {f"seed{i}": bytes([0x30 + i]) * ln
+            for i, ln in enumerate((5, 8, 17, 31, 32, 1))}
+
+
+def test_arena_gather_roundtrip_and_zero_tail():
+    ar = DeviceArena(num_pages=32, page=8, row_pages=4, donate=False)
+    seeds = _mixed_seeds()
+    for sid, data in seeds.items():
+        assert ar.ensure(sid, data, tick=0)
+    ar.flush()
+    sids = list(seeds)
+    table, lens, spilled = ar.table_for(sids, [seeds[s] for s in sids],
+                                        tick=1)
+    assert spilled == []
+    got = np.asarray(ar.gather(table))
+    assert got.shape == (len(sids), 32)
+    for r, sid in enumerate(sids):
+        n = int(lens[r])
+        assert n == len(seeds[sid])
+        assert bytes(got[r][:n]) == seeds[sid]
+        # past the true length the row is zero, exactly like a packed
+        # panel row (partial-page zero-pad + ZERO_PAGE tail entries)
+        assert not got[r][n:].any()
+    # short rows end in zero-page table entries
+    assert table[sids.index("seed5"), 1:].tolist() == [ZERO_PAGE] * 3
+
+
+def test_arena_scatter_adopt_roundtrip():
+    ar = DeviceArena(num_pages=64, page=8, row_pages=4, donate=False)
+    rows = np.frombuffer(os.urandom(3 * 32), np.uint8).reshape(3, 32).copy()
+    lens = [32, 9, 20]
+    for r, n in enumerate(lens):
+        rows[r, n:] = 0
+    import jax.numpy as jnp
+
+    skipped = ar.adopt(["a", "b", "c"], jnp.asarray(rows), lens, tick=0)
+    assert skipped == []
+    table, got_lens, spilled = ar.table_for(["a", "b", "c"],
+                                            [b"", b"", b""], tick=1)
+    assert spilled == [] and got_lens.tolist() == lens
+    got = np.asarray(ar.gather(table))
+    np.testing.assert_array_equal(got, rows)
+
+
+def test_arena_defrag_preserves_gathered_bytes():
+    ar = DeviceArena(num_pages=32, page=8, row_pages=4, donate=False)
+    seeds = _mixed_seeds()
+    for sid, data in seeds.items():
+        ar.ensure(sid, data, tick=0)
+    ar.flush()
+    ar.alloc.free("seed1")  # punch a hole, then compact
+    del seeds["seed1"]
+    ar.defrag()
+    sids = list(seeds)
+    table, lens, _ = ar.table_for(sids, [seeds[s] for s in sids], tick=1)
+    got = np.asarray(ar.gather(table))
+    for r, sid in enumerate(sids):
+        assert bytes(got[r][:int(lens[r])]) == seeds[sid]
+
+
+def test_arena_truncates_to_row_width():
+    ar = DeviceArena(num_pages=32, page=8, row_pages=2, donate=False)
+    assert ar.ensure("long", b"x" * 100, tick=0)  # clamped to 16
+    ar.flush()
+    table, lens, _ = ar.table_for(["long"], [b"x" * 100], tick=1)
+    assert lens.tolist() == [16]
+    assert bytes(np.asarray(ar.gather(table))[0]) == b"x" * 16
+
+
+def test_arena_pressure_spills_then_evicts():
+    # room for exactly one 4-page run beyond reserved pages
+    ar = DeviceArena(num_pages=RESERVED_PAGES + 4, page=8, row_pages=4,
+                     donate=False)
+    assert ar.ensure("first", b"a" * 32, tick=0)
+    # second seed: arena full, first seed unpinned -> LRU eviction
+    assert ar.ensure("second", b"b" * 32, tick=1)
+    assert not ar.alloc.resident("first") and ar.alloc.evictions == 1
+    # pinned resident seed blocks eviction -> spill
+    ar.alloc.pin("second")
+    assert not ar.ensure("third", b"c" * 32, tick=2)
+    assert ar.spills == 1
+    ar.alloc.unpin("second")
+
+
+def test_arena_spill_chaos_fault_forces_host_path():
+    chaos.configure("arena.spill:x2", seed=3)
+    try:
+        ar = DeviceArena(num_pages=32, page=8, row_pages=2, donate=False)
+        assert not ar.ensure("s1", b"abc", tick=0)  # injected spill
+        assert not ar.ensure("s1", b"abc", tick=0)  # injected spill
+        assert ar.ensure("s1", b"abc", tick=0)  # fault healed
+        assert ar.spills == 2
+        table, lens, spilled = ar.table_for(["s1"], [b"abc"], tick=1)
+        assert spilled == []  # resident now
+    finally:
+        chaos.configure(None)
+
+
+def test_arena_table_for_reports_spilled_rows():
+    chaos.configure("arena.spill:x1", seed=3)
+    try:
+        ar = DeviceArena(num_pages=32, page=8, row_pages=2, donate=False)
+        table, lens, spilled = ar.table_for(
+            ["s1", "s2"], [b"abcd", b"efgh"], tick=0)
+        assert spilled == [0]
+        # the spilled row's table points nowhere (zero page), but its
+        # true length is still reported for the host overlay
+        assert table[0].tolist() == [ZERO_PAGE, ZERO_PAGE]
+        assert lens.tolist() == [4, 4]
+        assert bytes(np.asarray(ar.gather(table))[1][:4]) == b"efgh"
+    finally:
+        chaos.configure(None)
+
+
+def test_arena_reset_drops_runs():
+    ar = DeviceArena(num_pages=32, page=8, row_pages=2, donate=False)
+    ar.ensure("s1", b"abcd", tick=0)
+    ar.flush()
+    before = ar.bytes_uploaded
+    ar.reset()
+    assert not ar.alloc.resident("s1")
+    assert ar.bytes_uploaded == before  # cumulative counters survive
+    assert ar.ensure("s1", b"abcd", tick=1)
+
+
+def test_arena_enqueue_drains_pending():
+    ar = DeviceArena(num_pages=32, page=8, row_pages=2, donate=False)
+    seeds = {"s1": b"abcd", "s2": b"efghijkl"}
+    ar.enqueue("s1")
+    ar.enqueue("s2")
+    ar.drain_pending(seeds.__getitem__, tick=0)
+    assert ar.alloc.resident("s1") and ar.alloc.resident("s2")
+    assert ar.uploads == 1  # one pow2-padded chunk, not one per seed
+
+
+# ---- metrics / exposition ----------------------------------------------
+
+
+def test_truncated_counter_and_flight_breadcrumb():
+    from erlamsa_tpu.obs import flight
+
+    c = metrics.Counters()
+    c.record_truncated(3)
+    c.record_truncated(2)
+    assert c.snapshot()["truncated"] == 5
+    assert any(e.get("kind") == "truncated_rows" and e.get("count") == 2
+               for e in list(flight.GLOBAL._ring))
+
+
+def test_prom_arena_golden_exposition():
+    from erlamsa_tpu.obs import prom
+
+    c = metrics.Counters()
+    c.record_truncated(4)
+    c.record_arena({"pages": 128, "page_size": 256, "pages_free": 96,
+                    "occupancy": 0.2540, "resident_seeds": 17,
+                    "evictions": 2, "defrags": 1, "spills": 3,
+                    "uploads": 5, "bytes_uploaded": 65536})
+    c.record_bucket(512, rows=8, pad_rows=0, padded_bytes_wasted=0)
+    lines = prom.render(c).splitlines()
+    for expected in [
+        "erlamsa_truncated_rows_total 4",
+        "erlamsa_arena_pages 128",
+        "erlamsa_arena_pages_free 96",
+        "erlamsa_arena_page_occupancy 0.254",
+        "erlamsa_arena_resident_seeds 17",
+        "erlamsa_arena_evictions_total 2",
+        "erlamsa_arena_defrags_total 1",
+        "erlamsa_arena_spills_total 3",
+        "erlamsa_arena_bytes_uploaded_total 65536",
+        'erlamsa_bucket_padded_bytes_wasted_total{capacity="512"} 0',
+    ]:
+        assert expected in lines, f"missing: {expected}"
+    # without an arena snapshot the gauges are absent, not zero
+    assert "erlamsa_arena_pages" not in prom.render(metrics.Counters())
+
+
+def test_store_listener_fires_for_new_seeds_only(tmp_path):
+    from erlamsa_tpu.corpus.store import CorpusStore
+
+    st = CorpusStore(str(tmp_path))
+    seen = []
+    st.listener = seen.append
+    sid, new = st.add(b"fresh seed")
+    assert new and seen == [sid]
+    st.add(b"fresh seed")  # dup: no event
+    assert seen == [sid]
+
+
+# ---- end-to-end contracts (engine-compiling: slow) ----------------------
+
+
+def _run_corpus(layout, root, outdir, seeds, chaos_spec=None, n=3,
+                batch=10, **extra):
+    from erlamsa_tpu.corpus.feedback import FeedbackBus
+    from erlamsa_tpu.corpus.runner import run_corpus_batch
+
+    chaos.configure(chaos_spec, seed=13)
+    try:
+        os.makedirs(outdir)
+        stats = {}
+        opts = {"corpus_dir": root, "corpus": seeds, "feedback": True,
+                "feedback_bus": FeedbackBus(), "seed": (4, 5, 6), "n": n,
+                "output": os.path.join(outdir, "out-%n.bin"),
+                "_stats": stats, "pipeline": "async", "layout": layout}
+        opts.update(extra)
+        assert run_corpus_batch(opts, batch=batch) == 0
+        outs = [open(os.path.join(outdir, f"out-{i}.bin"), "rb").read()
+                for i in range(n * batch)]
+        return stats, outs
+    finally:
+        chaos.configure(None)
+
+
+#: mixed LENGTHS, one capacity class: the fused engine's streams are a
+#: function of the static row width, so arena==buckets identity is
+#: pinned where the bucket path puts every seed in the arena's class
+#: (len*slack <= 256 here). That class-capacity-is-stream-identity fact
+#: predates the arena (ops/pipeline.py ENGINE VERSION NOTES).
+_ONE_CLASS_SEEDS = [bytes([65 + i]) * (20 * (i + 1)) for i in range(6)]
+
+
+@pytest.mark.slow
+def test_runner_arena_buckets_bit_identical(tmp_path):
+    """Acceptance (r9): --layout arena produces byte-identical output to
+    --layout buckets at a fixed -s, with ONE compiled step shape and
+    zero padded bytes wasted."""
+    st_b, outs_b = _run_corpus("buckets", str(tmp_path / "rb"),
+                               str(tmp_path / "ob"), _ONE_CLASS_SEEDS)
+    st_a, outs_a = _run_corpus("arena", str(tmp_path / "ra"),
+                               str(tmp_path / "oa"), _ONE_CLASS_SEEDS)
+    assert st_a["layout"] == "arena" and st_b["layout"] == "buckets"
+    assert st_b["schedules"] == st_a["schedules"]
+    assert outs_b == outs_a
+    assert st_b["new_hashes"] == st_a["new_hashes"] > 0
+    # O(1) compiled programs and ~0 padded waste
+    assert len(st_a["step_shapes"]) == 1
+    assert all(b["padded_bytes_wasted"] == 0
+               for b in st_a["buckets"].values())
+    assert st_a["arena"]["spills"] == 0
+    # the whole point: seeds upload once, not once per case
+    assert st_a["bytes_uploaded"] < st_b["bytes_uploaded"]
+
+
+@pytest.mark.slow
+def test_runner_arena_spill_chaos_transparent(tmp_path):
+    """Injected arena.spill faults force the host-overlay path but must
+    never change output bytes (the chaos transparency contract)."""
+    st_c, outs_c = _run_corpus("arena", str(tmp_path / "rc"),
+                               str(tmp_path / "oc"), _ONE_CLASS_SEEDS)
+    st_f, outs_f = _run_corpus("arena", str(tmp_path / "rf"),
+                               str(tmp_path / "of"), _ONE_CLASS_SEEDS,
+                               chaos_spec="arena.spill:x4")
+    assert outs_f == outs_c
+    assert st_f["arena"]["spills"] == 4
+    assert st_c["arena"]["spills"] == 0
+
+
+@pytest.mark.slow
+def test_runner_arena_eviction_pressure_transparent(tmp_path):
+    """A deliberately tiny arena (constant eviction + spill pressure)
+    still produces byte-identical output — residency is a performance
+    property, never a correctness one."""
+    st_big, outs_big = _run_corpus("arena", str(tmp_path / "rb"),
+                                   str(tmp_path / "ob"), _ONE_CLASS_SEEDS)
+    st_tiny, outs_tiny = _run_corpus(
+        "arena", str(tmp_path / "rt"), str(tmp_path / "ot"),
+        _ONE_CLASS_SEEDS, arena_pages=RESERVED_PAGES + 2)
+    assert outs_tiny == outs_big
+    assert (st_tiny["arena"]["evictions"] + st_tiny["arena"]["spills"]) > 0
