@@ -60,6 +60,22 @@ def _next_pow2(n: int) -> int:
     return 1 << max(0, (int(n) - 1)).bit_length()
 
 
+def fit_page(page: int, cap: int) -> int:
+    """Largest power of two <= `page` that divides the row capacity
+    `cap`. A page that does not divide the capacity would make resident
+    rows (row_pages * page wide) narrower than the truncation cap —
+    lengths past the row width, spill overlays with mismatched shapes —
+    so the runner rounds the requested page through this before
+    building the arena. Always >= 1 (1 divides everything)."""
+    if page <= 0:
+        raise ValueError(f"page size must be positive, got {page}")
+    if cap <= 0:
+        raise ValueError(f"row capacity must be positive, got {cap}")
+    page = min(int(page), int(cap))
+    # pow2 floor of the request, then the largest pow2 dividing cap
+    return min(1 << (page.bit_length() - 1), cap & -cap)
+
+
 class PageAllocator:
     """Host-side page bookkeeping for one arena. No jax anywhere: the
     allocator is property-testable on any box (tests/test_arena.py).
@@ -268,6 +284,13 @@ class DeviceArena:
         need = self.alloc.pages_for(len(data))
         pages = self.alloc.alloc(sid, len(data), tick)
         if pages is None:
+            # close the staging window BEFORE evicting: a seed staged
+            # earlier in this window (bulk admission is unpinned) may be
+            # the eviction victim, and recycling its pages while its
+            # payload still sits in _staged_pages would put duplicate
+            # indices with different payloads into one upload scatter —
+            # nondeterministic on TPU/GPU (silent seed-byte corruption)
+            self.flush()
             with trace.span("corpus.arena.evict", need=need):
                 self.alloc.evict_for(need)
             pages = self.alloc.alloc(sid, len(data), tick)
@@ -287,6 +310,11 @@ class DeviceArena:
         if not self._staged_idx:
             return
         k = len(self._staged_idx)
+        if len(set(self._staged_idx)) != k:
+            # duplicate indices in one scatter are nondeterministic on
+            # TPU/GPU — fail loudly rather than corrupt seed bytes
+            raise RuntimeError("staged page ids alias (a staged page was "
+                               "freed and reallocated before flush)")
         kp = _next_pow2(k)
         idx = np.full(kp, TRASH_PAGE, np.int32)
         idx[:k] = self._staged_idx
@@ -314,24 +342,29 @@ class DeviceArena:
         lens = np.zeros(rows, np.int32)
         spilled: list[int] = []
         pinned: list[str] = []
-        with trace.span("corpus.arena.alloc", rows=rows, tick=tick):
-            for r, (sid, data) in enumerate(zip(sids, samples)):
-                if self.ensure(sid, data, tick):
-                    # the allocator's recorded length is authoritative:
-                    # for store seeds it equals the clamped sample
-                    # length, and adopted seeds (device-only bytes)
-                    # have no host sample at all
-                    lens[r] = self.alloc.length(sid)
-                    run = self.alloc.run(sid)
-                    table[r, :len(run)] = run
-                    self.alloc.pin(sid)
-                    pinned.append(sid)
-                else:
-                    lens[r] = min(len(data), self.width)
-                    spilled.append(r)
-            self.flush()
-        for sid in pinned:
-            self.alloc.unpin(sid)
+        try:
+            with trace.span("corpus.arena.alloc", rows=rows, tick=tick):
+                for r, (sid, data) in enumerate(zip(sids, samples)):
+                    if self.ensure(sid, data, tick):
+                        # the allocator's recorded length is
+                        # authoritative: for store seeds it equals the
+                        # clamped sample length, and adopted seeds
+                        # (device-only bytes) have no host sample at all
+                        lens[r] = self.alloc.length(sid)
+                        run = self.alloc.run(sid)
+                        table[r, :len(run)] = run
+                        self.alloc.pin(sid)
+                        pinned.append(sid)
+                    else:
+                        lens[r] = min(len(data), self.width)
+                        spilled.append(r)
+                self.flush()
+        finally:
+            # unconditional unpin: an ensure()/flush() escape (e.g. an
+            # XLA error mid-upload) must not leave runs unevictable for
+            # the rest of the run
+            for sid in pinned:
+                self.alloc.unpin(sid)
         return table, lens, spilled
 
     def gather(self, table: np.ndarray):
@@ -388,8 +421,13 @@ class DeviceArena:
     def reset(self):
         """Device-loss recovery: drop every run and rebuild an empty
         arena tensor (the old one died with the device). Cumulative
-        counters survive; the runner re-seeds from the store."""
-        self.alloc = PageAllocator(self.alloc.num_pages, self.page)
+        counters survive — evictions/defrags carry into the fresh
+        allocator so the Prometheus counters (type: counter) never go
+        backwards; the runner re-seeds from the store."""
+        old = self.alloc
+        self.alloc = PageAllocator(old.num_pages, self.page)
+        self.alloc.evictions = old.evictions
+        self.alloc.defrags = old.defrags
         self._staged_idx, self._staged_pages = [], []
         self._arena = self._paged.new_arena(self.alloc.num_pages, self.page)
 

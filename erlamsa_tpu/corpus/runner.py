@@ -258,7 +258,7 @@ def run_corpus_batch(opts: dict, batch: int = 1024) -> int:
 
     if use_arena:
         from ..ops import paged
-        from .arena import RESERVED_PAGES, DeviceArena
+        from .arena import RESERVED_PAGES, DeviceArena, fit_page
 
         # ONE working width for the whole run: the capacity class of the
         # largest stored seed. The fused engine's streams are a function
@@ -266,12 +266,25 @@ def run_corpus_batch(opts: dict, batch: int = 1024) -> int:
         # NOTES), so arena==buckets byte-identity holds exactly when the
         # bucket path would place every seed in this same class — the
         # configuration the tests pin and README documents.
-        max_len = max(len(store.get(sid)) for sid in store.ids())
-        trunc_cap = bucket_capacity(max_len, device_max=device_max)
-        page = min(int(opts.get("arena_page") or paged.PAGE), trunc_cap)
+        sizes = [len(store.get(sid)) for sid in store.ids()]
+        if not sizes:
+            print("no corpus seeds to page into the arena",
+                  file=sys.stderr)
+            return 1
+        trunc_cap = bucket_capacity(max(sizes), device_max=device_max)
+        page_opt = int(opts.get("arena_page") or paged.PAGE)
+        # the page must divide the capacity class exactly — otherwise
+        # row_pages*page < trunc_cap and resident rows come up narrower
+        # than the truncation cap (shape mismatch on any spill overlay)
+        page = fit_page(page_opt, trunc_cap)
+        if page != page_opt:
+            print(f"# arena: page size {page_opt} does not fit the "
+                  f"{trunc_cap}B capacity class, using {page}",
+                  file=sys.stderr)
         row_pages = trunc_cap // page
-        need = sum(-(-min(len(store.get(sid)), trunc_cap) // page)
-                   for sid in store.ids())
+        # max(1, ...) matches PageAllocator.pages_for: a zero-length
+        # seed still occupies one page
+        need = sum(max(1, -(-min(n, trunc_cap) // page)) for n in sizes)
         num_pages = int(opts.get("arena_pages")
                         or RESERVED_PAGES + max(64, 2 * need))
         num_pages = max(num_pages, RESERVED_PAGES + row_pages)

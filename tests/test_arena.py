@@ -10,7 +10,7 @@ import numpy as np
 import pytest
 
 from erlamsa_tpu.corpus.arena import (RESERVED_PAGES, TRASH_PAGE, ZERO_PAGE,
-                                      DeviceArena, PageAllocator)
+                                      DeviceArena, PageAllocator, fit_page)
 from erlamsa_tpu.services import chaos, metrics
 
 # ---- allocator properties ----------------------------------------------
@@ -112,6 +112,20 @@ def test_allocator_property_fuzz():
         assert len(used) + a.free_pages() == 32 - RESERVED_PAGES
 
 
+def test_fit_page_divides_capacity():
+    assert fit_page(256, 256) == 256
+    assert fit_page(8, 256) == 8
+    assert fit_page(24, 256) == 16  # pow2 floor of the request
+    assert fit_page(512, 256) == 256  # clamped to the capacity
+    # non-pow2 capacity (1_000_000 = 2**6 * 5**6): largest pow2 divisor
+    assert fit_page(256, 1_000_000) == 64
+    assert fit_page(5, 7) == 1  # 1 always divides
+    with pytest.raises(ValueError):
+        fit_page(0, 256)
+    with pytest.raises(ValueError):
+        fit_page(8, 0)
+
+
 # ---- device arena round-trips (CPU backend) -----------------------------
 
 
@@ -200,6 +214,41 @@ def test_arena_pressure_spills_then_evicts():
     ar.alloc.unpin("second")
 
 
+def test_arena_eviction_never_aliases_staged_pages():
+    """Eviction during an open staging window (bulk admission is
+    unpinned) must not recycle a page a staged payload still targets —
+    that would put duplicate indices with different payloads into one
+    upload scatter, nondeterministic on TPU/GPU. ensure() closes the
+    window by flushing before it evicts; flush() raises if aliased
+    staged ids ever slip through."""
+    # room for exactly two 1-page runs beyond the reserved pages
+    ar = DeviceArena(num_pages=RESERVED_PAGES + 2, page=8, row_pages=1,
+                     donate=False)
+    assert ar.ensure("a", b"AAAA", tick=0)  # staged, unflushed
+    assert ar.ensure("b", b"BBBB", tick=1)  # staged, unflushed
+    # arena full: admitting c evicts LRU "a" mid-window
+    assert ar.ensure("c", b"CCCC", tick=2)
+    ar.flush()
+    assert not ar.alloc.resident("a") and ar.alloc.evictions == 1
+    table, lens, spilled = ar.table_for(["b", "c"], [b"BBBB", b"CCCC"],
+                                        tick=3)
+    assert spilled == []
+    got = np.asarray(ar.gather(table))
+    assert bytes(got[0][:4]) == b"BBBB"
+    assert bytes(got[1][:4]) == b"CCCC"
+
+
+def test_arena_flush_rejects_aliased_staged_ids():
+    ar = DeviceArena(num_pages=32, page=8, row_pages=1, donate=False)
+    ar.ensure("s1", b"old!", tick=0)
+    # simulate the bug the guard exists for: a staged page freed and
+    # reallocated before flush
+    ar.alloc.free("s1")
+    ar.ensure("s2", b"new!", tick=1)
+    with pytest.raises(RuntimeError, match="alias"):
+        ar.flush()
+
+
 def test_arena_spill_chaos_fault_forces_host_path():
     chaos.configure("arena.spill:x2", seed=3)
     try:
@@ -234,11 +283,33 @@ def test_arena_reset_drops_runs():
     ar = DeviceArena(num_pages=32, page=8, row_pages=2, donate=False)
     ar.ensure("s1", b"abcd", tick=0)
     ar.flush()
+    ar.alloc.evictions = 3  # pretend churn before the device died
+    ar.alloc.defrags = 2
     before = ar.bytes_uploaded
     ar.reset()
     assert not ar.alloc.resident("s1")
     assert ar.bytes_uploaded == before  # cumulative counters survive
+    # evictions/defrags are exposed as Prometheus counters: they must
+    # never go backwards across a device-loss reset
+    assert ar.alloc.evictions == 3 and ar.alloc.defrags == 2
     assert ar.ensure("s1", b"abcd", tick=1)
+
+
+def test_arena_table_for_unpins_on_error():
+    ar = DeviceArena(num_pages=32, page=8, row_pages=2, donate=False)
+    ar.ensure("s1", b"abcd", tick=0)
+    ar.ensure("s2", b"efgh", tick=0)
+    ar.flush()
+    boom = RuntimeError("xla died mid-upload")
+
+    def exploding_flush():
+        raise boom
+
+    ar.flush = exploding_flush
+    with pytest.raises(RuntimeError, match="mid-upload"):
+        ar.table_for(["s1", "s2"], [b"abcd", b"efgh"], tick=1)
+    # pins were released on the error path: both runs stay evictable
+    assert sorted(ar.alloc.evict_for(need=99)) == ["s1", "s2"]
 
 
 def test_arena_enqueue_drains_pending():
