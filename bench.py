@@ -392,9 +392,18 @@ def child_main() -> None:
             os.environ.setdefault("ERLAMSA_LOAD_PROXY_N", "1000")
             svc = load_bench.run_all()
             record.update(svc)
+            serving = ""
+            if "faas_continuous_reqs_per_sec" in svc:
+                serving = (
+                    f", continuous {svc['faas_continuous_reqs_per_sec']} "
+                    f"req/s (p99 {svc['faas_continuous_p99_ms']} ms, fill "
+                    f"{svc.get('faas_continuous_slot_fill_efficiency')}), "
+                    f"flush {svc.get('faas_flush_reqs_per_sec')} req/s "
+                    f"(p99 {svc.get('faas_flush_p99_ms')} ms)"
+                )
             _phase(
                 f"service stage: faas {svc['faas_reqs_per_sec']} req/s "
-                f"(p99 {svc['faas_p99_ms']} ms), proxy "
+                f"(p99 {svc['faas_p99_ms']} ms){serving}, proxy "
                 f"{svc['proxy_cases_per_sec']} cases/s", t0,
             )
             line = json.dumps(record)

@@ -16,13 +16,13 @@ ERLAMSA_LOAD_CONC shrink it for smoke runs. Everything binds loopback.
 
 from __future__ import annotations
 
+import http.client
 import json
 import os
 import socket
 import statistics
 import threading
 import time
-import urllib.request
 
 
 def _free_port() -> int:
@@ -34,15 +34,26 @@ def _free_port() -> int:
 
 
 def faas_load(n_requests: int, concurrency: int, backend: str = "oracle",
+              serving: str | None = None, capacity: int | None = None,
+              slots: int | None = None,
               payload: bytes = b"faas load sample value=123\n") -> dict:
     """Start a FaaS server, fire n_requests with a bounded worker pool,
-    return {reqs_per_sec, p50_ms, p99_ms, errors, fill_efficiency?}."""
+    return {reqs_per_sec, p50_ms, p99_ms, errors, fill_efficiency?} plus
+    — when the backend engine reports stats() — serving_mode,
+    slot_fill_efficiency, steps_per_request and compile counters."""
     from erlamsa_tpu.services.faas import serve
 
+    opts: dict = {"seed": (1, 2, 3)}
+    if serving is not None:
+        opts["serving"] = serving
+    if capacity is not None:
+        opts["capacity"] = capacity
+    if slots is not None:
+        opts["slots"] = slots
     port = _free_port()
-    srv = serve("127.0.0.1", port, {"seed": (1, 2, 3)}, backend=backend,
+    srv = serve("127.0.0.1", port, opts, backend=backend,
                 batch=64, block=False)
-    url = f"http://127.0.0.1:{port}/erlamsa/erlamsa_esi:fuzz"
+    path = "/erlamsa/erlamsa_esi:fuzz"
 
     lat: list[float] = []
     lat_lock = threading.Lock()
@@ -51,22 +62,32 @@ def faas_load(n_requests: int, concurrency: int, backend: str = "oracle",
     it_lock = threading.Lock()
 
     def worker():
+        # one persistent HTTP/1.1 connection per client thread: the
+        # server keeps Content-Length on every reply, so keep-alive is
+        # safe and the measurement isn't dominated by per-request TCP
+        # handshakes + server thread spawns
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=90)
         while True:
             with it_lock:
                 nxt = next(it, None)
             if nxt is None:
+                conn.close()
                 return
             t0 = time.monotonic()
             try:
-                with urllib.request.urlopen(url, data=payload, timeout=90) as r:
-                    r.read()
-                    # empty bodies are legitimate fuzz results (e.g. a
-                    # line-delete emptying a one-line sample); an error is
-                    # a non-200 or a give-up reply
-                    ok = (r.status == 200
-                          and r.headers.get("erlamsa-status", "ok") != "error")
+                conn.request("POST", path, body=payload)
+                r = conn.getresponse()
+                r.read()
+                # empty bodies are legitimate fuzz results (e.g. a
+                # line-delete emptying a one-line sample); an error is
+                # a non-200 or a give-up reply
+                ok = (r.status == 200
+                      and r.headers.get("erlamsa-status", "ok") != "error")
             except Exception:  # noqa: BLE001 — any failure is an error count
                 ok = False
+                conn.close()
+                conn = http.client.HTTPConnection("127.0.0.1", port,
+                                                  timeout=90)
             dt = time.monotonic() - t0
             with lat_lock:
                 lat.append(dt)
@@ -94,6 +115,13 @@ def faas_load(n_requests: int, concurrency: int, backend: str = "oracle",
     batcher = getattr(srv.RequestHandlerClass, "batcher", None)
     if batcher is not None and hasattr(batcher, "fill_efficiency"):
         out["faas_fill_efficiency"] = round(batcher.fill_efficiency, 3)
+    if batcher is not None and hasattr(batcher, "stats"):
+        st = batcher.stats()
+        out["faas_serving_mode"] = st["mode"]
+        out["faas_slot_fill_efficiency"] = st["fill_efficiency"]
+        out["faas_steps_per_request"] = st["steps_per_request"]
+        out["faas_device_steps"] = st["steps"]
+        out["faas_compiles"] = st["compiles"]
     srv.shutdown()
     srv.server_close()  # release the listening socket, not just the loop
     return out
@@ -185,7 +213,22 @@ def run_all() -> dict:
     n = int(os.environ.get("ERLAMSA_LOAD_N", 10_000))
     conc = int(os.environ.get("ERLAMSA_LOAD_CONC", 200))
     pn = int(os.environ.get("ERLAMSA_LOAD_PROXY_N", 2_000))
-    out = faas_load(n, conc)
+    out = faas_load(n, conc)  # oracle baseline: keys match r01..r05 runs
+    if os.environ.get("ERLAMSA_LOAD_SERVING", "1") != "0":
+        # the device serving engines, both modes, at a bench-sized
+        # working width: the continuous-vs-flush comparison PROFILE.md
+        # tracks (r10). Keys are faas_<mode>_* so one JSON line carries
+        # all three configurations. 256 is the smallest page-aligned
+        # width that holds the 27-byte bench payload — the oracle
+        # baseline works on actual payload bytes, so the device modes
+        # get the narrowest honest compiled shape, not padding waste
+        cap = int(os.environ.get("ERLAMSA_LOAD_CAPACITY", 256))
+        nslots = int(os.environ.get("ERLAMSA_LOAD_SLOTS", 64))
+        for mode in ("flush", "continuous"):
+            r = faas_load(n, conc, backend="tpu", serving=mode,
+                          capacity=cap, slots=nslots)
+            for k, v in r.items():
+                out[k.replace("faas_", f"faas_{mode}_", 1)] = v
     out.update(proxy_stream(pn))
     return out
 

@@ -18,7 +18,10 @@ Rule catalogue (see the rules_* modules for each rule's contract):
                                   under their declared lock
     broad-except                  bare ``except Exception`` needs a reason
     chaos-site-coverage           raw send/recv + durable writes route
-                                  through a chaos fault site
+                                  through a chaos fault site; package-
+                                  wide lints also verify every expected
+                                  site still exists as a literal
+                                  fault_point("<site>")
     unused-import                 imports bound but never referenced
 
 Suppressions are per-line comments::

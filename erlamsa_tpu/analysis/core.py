@@ -63,11 +63,23 @@ class LintConfig:
         "byte_mutators", "line_mutators", "num_mutators", "seq_mutators",
         "utf8_mutators", "payload_mutators", "fuse_mutators", "patterns",
         "lenfield", "crc32", "prng", "sizer", "fused", "scheduler",
+        "slots",
     )
     #: modules whose raw send/recv + durable writes must route through a
     #: chaos fault site (chaos-site-coverage)
     chaos_modules: tuple = ("services/dist.py", "corpus/store.py",
-                            "services/checkpoint.py")
+                            "services/checkpoint.py",
+                            "services/serving.py")
+    #: sites a package-wide lint must find as a literal
+    #: chaos.fault_point("<site>") somewhere in the tree — a refactor
+    #: that drops one silently makes a documented resilience path
+    #: untestable (the chaos.py docstring's site list, kept honest)
+    chaos_expected_sites: tuple = (
+        "dist.send", "dist.recv", "batcher.step", "store.save",
+        "store.seed", "device.step", "arena.spill",
+        "checkpoint.save", "checkpoint.load",
+        "serving.admit", "serving.step",
+    )
 
     def in_scope(self, rel: str, prefixes: tuple) -> bool:
         return any(rel.startswith(p) for p in prefixes)
@@ -345,5 +357,11 @@ def run_lint(paths: Iterable[str], rules: Iterable[str] | None = None,
                     findings.append(dataclasses.replace(
                         f, message=f.message
                         + " (suppression present but gives no reason)"))
+    if "chaos-site-coverage" in selected:
+        # package-level completeness leg of the rule (lazy import: the
+        # rules modules import core, not the other way around)
+        from .rules_resilience import expected_site_findings
+
+        findings.extend(expected_site_findings(mods, config))
     findings.sort(key=lambda f: (f.path, f.line, f.rule))
     return findings

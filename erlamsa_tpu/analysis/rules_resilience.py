@@ -118,6 +118,39 @@ def _functions_with_bodies(tree: ast.Module):
     yield "<module>", top
 
 
+def expected_site_findings(mods: list[Module], config: LintConfig):
+    """Package-level completeness leg of chaos-site-coverage: every site
+    in ``LintConfig.chaos_expected_sites`` must appear as a LITERAL
+    ``chaos.fault_point("<site>")`` somewhere in the linted tree. Fires
+    only on package-wide lints — ``services/chaos.py`` itself must be
+    among the modules — so fixture lints of standalone files don't
+    demand the whole site set. Novel sites are fine; a MISSING expected
+    one means a refactor silently made a documented resilience path
+    untestable."""
+    anchor = next((m for m in mods if m.rel == "services/chaos.py"), None)
+    if anchor is None:
+        return []
+    found: set[str] = set()
+    for mod in mods:
+        for node in ast.walk(mod.tree):
+            if (isinstance(node, ast.Call)
+                    and call_name(node) in FAULT_POINT_CALLS
+                    and node.args
+                    and isinstance(node.args[0], ast.Constant)
+                    and isinstance(node.args[0].value, str)):
+                found.add(node.args[0].value)
+    return [
+        Finding(
+            anchor.path, 1, "chaos-site-coverage",
+            f'expected chaos site `{site}` has no fault_point("{site}") '
+            f"anywhere in the linted tree: a documented resilience path "
+            f"became untestable (update chaos_expected_sites if the site "
+            f"was retired deliberately)",
+        )
+        for site in config.chaos_expected_sites if site not in found
+    ]
+
+
 @rule("chaos-site-coverage")
 def check_chaos_site_coverage(mod: Module, config: LintConfig):
     if not config.in_scope(mod.rel, config.chaos_modules):

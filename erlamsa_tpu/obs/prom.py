@@ -171,6 +171,51 @@ def render(counters: metrics.Counters | None = None) -> str:
         w.sample("erlamsa_arena_bytes_uploaded_total",
                  arena["bytes_uploaded"])
 
+    serving = snap.get("serving")
+    if serving:
+        w.head("erlamsa_batcher_fill_efficiency", "gauge",
+               "Windowed EWMA of per-step slot/batch fill (0..1).")
+        w.sample("erlamsa_batcher_fill_efficiency",
+                 serving["fill_efficiency"], {"mode": serving["mode"]})
+        w.head("erlamsa_serving_steps_total", "counter",
+               "Device steps run by the serving engine.")
+        w.sample("erlamsa_serving_steps_total", serving["steps"],
+                 {"mode": serving["mode"]})
+        w.head("erlamsa_serving_steps_per_request", "gauge",
+               "Device steps per answered request (<1 = batching wins).")
+        w.sample("erlamsa_serving_steps_per_request",
+                 serving["steps_per_request"], {"mode": serving["mode"]})
+        w.head("erlamsa_serving_backlog", "gauge",
+               "Requests admitted but not yet dispatched to the device.")
+        w.sample("erlamsa_serving_backlog", serving["backlog"],
+                 {"mode": serving["mode"]})
+        w.head("erlamsa_serving_compiled_steps", "gauge",
+               "Entries in the compiled-step cache (ops/slots.py).")
+        w.sample("erlamsa_serving_compiled_steps", serving["compiled_steps"])
+        w.head("erlamsa_serving_compiles_total", "counter",
+               "Compiled-step cache misses (XLA compiles paid).")
+        w.sample("erlamsa_serving_compiles_total", serving["compiles"])
+
+    rejected = snap.get("rejected")
+    if rejected:
+        w.head("erlamsa_faas_rejected_total", "counter",
+               "Requests shed by admission control (HTTP 429), by reason.")
+        for reason, n in sorted(rejected.items()):
+            w.sample("erlamsa_faas_rejected_total", n, {"reason": reason})
+
+    tenants = snap.get("tenants")
+    if tenants:
+        w.head("erlamsa_tenant_requests_total", "counter",
+               "Requests served, by tenant.")
+        for tenant, entry in tenants.items():
+            w.sample("erlamsa_tenant_requests_total", entry["served"],
+                     {"tenant": tenant})
+        w.head("erlamsa_tenant_rejected_total", "counter",
+               "Requests shed by admission control, by tenant.")
+        for tenant, entry in tenants.items():
+            w.sample("erlamsa_tenant_rejected_total", entry["rejected"],
+                     {"tenant": tenant})
+
     for hist_name, metric in _HIST_METRICS.items():
         h = c.hists[hist_name].snapshot()
         w.head(metric, "histogram",

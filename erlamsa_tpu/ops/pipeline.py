@@ -461,6 +461,47 @@ def resolve_donate(donate) -> bool:
     return bool(donate)
 
 
+def resolve_priorities(mutator_pri=None, pattern_pri=None,
+                       engine: str = "fused"):
+    """Normalize priority vectors and derive the trace-time enable flags
+    every step builder needs (make_class_fuzzer here, the serving slot
+    steps in ops/slots.py): returns ``(pri, pat_pri, flags)`` with pri /
+    pat_pri as validated int32 numpy arrays and flags the
+    enable_sizer/enable_csum/enable_len/enable_fuse kwargs for
+    fuzz_batch. Static priority knowledge keeps the corresponding scans
+    out of the compiled program entirely."""
+    from .patterns import CS, NUM_PATTERNS, SZ
+    from .registry import code_index
+
+    pri = np.asarray(
+        mutator_pri if mutator_pri is not None else DEFAULT_DEVICE_PRI,
+        np.int32,
+    )
+    pat_pri = np.asarray(
+        pattern_pri if pattern_pri is not None else DEFAULT_PATTERN_PRI_NP,
+        np.int32,
+    )
+    if pri.shape != (NUM_DEVICE_MUTATORS,):
+        raise ValueError(f"mutator_pri must have {NUM_DEVICE_MUTATORS} entries")
+    if pat_pri.shape != (NUM_PATTERNS,):
+        raise ValueError(f"pattern_pri must have {NUM_PATTERNS} entries")
+    if engine not in ENGINES:
+        raise ValueError(f"engine must be one of {ENGINES}, got {engine!r}")
+    flags = {
+        "enable_sizer": bool(pat_pri[SZ] > 0),
+        "enable_csum": bool(pat_pri[CS] > 0),
+        # skip the fused engine's per-round keyed scans when their
+        # mutators can never be picked
+        "enable_len": bool(pri[code_index("len")] > 0),
+        "enable_fuse": bool(
+            pri[code_index("ft")] > 0
+            or pri[code_index("fn")] > 0
+            or pri[code_index("fo")] > 0
+        ),
+    }
+    return pri, pat_pri, flags
+
+
 def make_class_fuzzer(mutator_pri=None, pattern_pri=None,
                       engine: str = "fused", slices=DEFAULT_SLICES,
                       donate=False):
@@ -484,34 +525,11 @@ def make_class_fuzzer(mutator_pri=None, pattern_pri=None,
     runner (fresh bucket panels, fresh score gathers every step), NOT for
     loops that replay the same packed batch (the bench kernel stage).
     """
-    from .patterns import CS, NUM_PATTERNS, SZ
-
-    pri = np.asarray(
-        mutator_pri if mutator_pri is not None else DEFAULT_DEVICE_PRI,
-        np.int32,
-    )
-    pat_pri = np.asarray(
-        pattern_pri if pattern_pri is not None else DEFAULT_PATTERN_PRI_NP,
-        np.int32,
-    )
-    if pri.shape != (NUM_DEVICE_MUTATORS,):
-        raise ValueError(f"mutator_pri must have {NUM_DEVICE_MUTATORS} entries")
-    if pat_pri.shape != (NUM_PATTERNS,):
-        raise ValueError(f"pattern_pri must have {NUM_PATTERNS} entries")
-    if engine not in ENGINES:
-        raise ValueError(f"engine must be one of {ENGINES}, got {engine!r}")
-    enable_sizer = bool(pat_pri[SZ] > 0)
-    enable_csum = bool(pat_pri[CS] > 0)
-    # static mutator-priority knowledge: skip the fused engine's per-round
-    # keyed scans when their mutators can never be picked
-    from .registry import code_index
-
-    enable_len = bool(pri[code_index("len")] > 0)
-    enable_fuse = bool(
-        pri[code_index("ft")] > 0
-        or pri[code_index("fn")] > 0
-        or pri[code_index("fo")] > 0
-    )
+    pri, pat_pri, flags = resolve_priorities(mutator_pri, pattern_pri, engine)
+    enable_sizer = flags["enable_sizer"]
+    enable_csum = flags["enable_csum"]
+    enable_len = flags["enable_len"]
+    enable_fuse = flags["enable_fuse"]
 
     def step(base, case_idx, indices, data, lens, scores, scan_len=None):
         ckey = prng.case_key(base, case_idx)

@@ -118,6 +118,20 @@ def code_index(code: str) -> int:
     return DEVICE_CODES.index(code)
 
 
+def registry_version() -> str:
+    """Stable fingerprint of the device mutator set. Compiled-step caches
+    (ops/slots.py StepCache) key on it so a registry change — a mutator
+    added, removed or reordered, which shifts every weighted pick — can
+    never serve a stale compiled program; checkpoints already stamp the
+    engine for the same reason (services/checkpoint.py)."""
+    import zlib
+
+    return "r%d-%08x" % (
+        NUM_DEVICE_MUTATORS,
+        zlib.crc32(",".join(DEVICE_CODES).encode()),
+    )
+
+
 def predicates(data, n, sizer_any=None):
     """bool[NUM_PREDS] applicability table for one sample.
 

@@ -1,0 +1,266 @@
+"""Slot-step kernels for the serving engines (services/serving.py).
+
+Continuous batching applied to fuzzing (PAPERS.md, Gemma-on-TPU serving
+comparison, arxiv 2605.25645): instead of flushing fixed batches, the
+device holds a SLOT ARRAY — a paged arena (ops/paged.py) where slot ``s``
+owns a fixed run of ``row_pages`` pages — and every device step mutates
+all occupied slots at once. Free slots are masked by an int32 occupancy
+vector, so the compiled shape never changes while requests join and
+leave at step granularity.
+
+PRNG contract (the determinism pin the serving tests enforce): a
+request's byte stream is a pure function of ``(seed, request_id)``,
+derived exactly like the flush batcher derives a sample's stream —
+
+    key_r    = fold_in(case_key(base, 0), rid)
+    scores_r = init_scores(fold_in(fold_in(base, 999), rid), 1)[0]
+
+The case index is pinned at 0 and the sample index is the request id, so
+the SAME request id yields the SAME bytes whether it rides a flush batch
+(make_request_step), a slot step (make_slot_step), or a single-shot
+oracle call — batch composition and slot placement cannot leak in.
+
+STEP_CACHE is the compiled-step cache keyed by (capacity class, batch
+geometry, engine, mutator-registry version): servers warm it at start so
+a cold tenant or a post-reload first request never pays XLA compilation
+on the request path, and a registry change can never reuse a stale
+program.
+"""
+
+from __future__ import annotations
+
+import threading
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import prng
+from .paged import (PAGE, RESERVED_PAGES, TRASH_PAGE, gather_rows, new_arena,
+                    upload_pages)
+from .pipeline import (DEFAULT_SLICES, fuzz_batch, resolve_donate,
+                       resolve_priorities)
+from .registry import registry_version
+from .scheduler import init_scores
+
+
+def request_keys(base, rids):
+    """Per-request PRNG keys: ``fold_in(case_key(base, 0), rid)`` —
+    the flush batcher's derivation with the case counter pinned at 0 and
+    the request id as the sample index."""
+    ckey = prng.case_key(base, 0)
+    return jax.vmap(lambda r: jax.random.fold_in(ckey, r))(rids)
+
+
+def request_scores(base, rids):
+    """Per-request scheduler rows. Each request re-derives its OWN
+    init_scores row from (seed, rid): init_scores draws are a function of
+    the batch shape, so slicing rows out of one batch-sized init would
+    make a request's stream depend on who shared its batch — deriving
+    per request keeps it batch-size independent (pinned by tests)."""
+    k999 = jax.random.fold_in(base, 999)
+    return jax.vmap(lambda r: init_scores(jax.random.fold_in(k999, r), 1)[0])(
+        rids
+    )
+
+
+def _request_fuzz(base, rids, data, lens, pri, pat_pri, engine, flags,
+                  slices, scan_len):
+    keys = request_keys(base, rids)
+    scores = request_scores(base, rids)
+    out, n_out, _scores, _meta = fuzz_batch(
+        keys, data, lens, scores, jnp.asarray(pri), jnp.asarray(pat_pri),
+        engine=engine, slices=slices, scan_len=scan_len, **flags,
+    )
+    return out, n_out
+
+
+def make_request_step(capacity: int, batch: int, mutator_pri=None,
+                      pattern_pri=None, engine: str = "fused",
+                      slices=DEFAULT_SLICES, scan_len: int | None = None,
+                      donate=False):
+    """Flush-mode step over a packed panel (the reworked TpuBatcher):
+
+    step(base, rids, data, lens) -> (data', lens')
+
+    rids: int32[batch] request ids (pad rows carry 0 — their outputs are
+    never read). Scores are derived per request inside the program, so
+    nothing chains between flushes and a device error costs no state."""
+    pri, pat_pri, flags = resolve_priorities(mutator_pri, pattern_pri, engine)
+
+    def step(base, rids, data, lens):
+        if data.shape != (batch, capacity):
+            raise ValueError(
+                f"batch shape {data.shape} != ({batch}, {capacity})"
+            )
+        return _request_fuzz(base, rids, data, lens, pri, pat_pri,
+                             engine, flags, slices, scan_len)
+
+    donate_argnums = (2,) if resolve_donate(donate) else ()
+    return jax.jit(step, donate_argnums=donate_argnums)
+
+
+def make_slot_step(slots: int, row_pages: int, page: int = PAGE,
+                   mutator_pri=None, pattern_pri=None,
+                   engine: str = "fused", slices=DEFAULT_SLICES):
+    """Continuous-mode step over a slot arena:
+
+    step(arena, table, base, rids, lens, occ) -> (data[S, W], lens'[S])
+
+    Gathers every slot's row out of the paged arena (ops/paged.py), runs
+    the mutation kernel over ALL slots at the fixed working width
+    ``W = row_pages * page``, and masks free slots back to their gathered
+    bytes via the int32 occupancy vector ``occ`` — one compiled shape no
+    matter which slots are live. The arena is NOT consumed (requests
+    upload into it between steps)."""
+    pri, pat_pri, flags = resolve_priorities(mutator_pri, pattern_pri, engine)
+    width = row_pages * page
+
+    def step(arena, table, base, rids, lens, occ):
+        rows = gather_rows(arena, table)
+        if rows.shape != (slots, width):
+            raise ValueError(
+                f"slot panel shape {rows.shape} != ({slots}, {width})"
+            )
+        out, n_out = _request_fuzz(base, rids, rows, lens, pri, pat_pri,
+                                   engine, flags, slices, None)
+        keep = occ > 0
+        out = jnp.where(keep[:, None], out, rows)
+        n_out = jnp.where(keep, n_out, lens)
+        return out, n_out
+
+    return jax.jit(step)
+
+
+def slot_table(slots: int, row_pages: int) -> np.ndarray:
+    """The constant int32[slots, row_pages] page table: slot ``s`` owns
+    pages ``RESERVED_PAGES + s*row_pages .. + row_pages`` — a fixed
+    mapping, so the table uploads once and never changes."""
+    base = RESERVED_PAGES + np.arange(slots, dtype=np.int32)[:, None] * row_pages
+    return base + np.arange(row_pages, dtype=np.int32)[None, :]
+
+
+def arena_pages(slots: int, row_pages: int) -> int:
+    return RESERVED_PAGES + slots * row_pages
+
+
+def _next_pow2(n: int) -> int:
+    return 1 if n <= 1 else 1 << (n - 1).bit_length()
+
+
+def upload_slots(arena, table_np: np.ndarray, assignments, page: int = PAGE):
+    """Scatter request payloads into their slots' page runs and return
+    the updated arena. ``assignments`` is ``[(slot, payload bytes)]``;
+    the index vector is pow2-padded with TRASH_PAGE (the corpus arena's
+    admission idiom) so upload traffic compiles O(log) scatter shapes.
+    Not donating: a previous step may still be reading the old arena
+    version from the device queue (inflight > 1)."""
+    row_pages = table_np.shape[1]
+    kp = _next_pow2(len(assignments) * row_pages)
+    idx = np.full(kp, TRASH_PAGE, np.int32)
+    pages = np.zeros((kp, page), np.uint8)
+    pos = 0
+    for slot, payload in assignments:
+        buf = np.frombuffer(payload, np.uint8)
+        pages[pos:pos + row_pages].reshape(-1)[:buf.size] = buf
+        idx[pos:pos + row_pages] = table_np[slot]
+        pos += row_pages
+    return upload_pages(arena, jnp.asarray(idx), jnp.asarray(pages),
+                        donate=False)
+
+
+class StepCache:
+    """Compiled-step cache: one entry per (kind, capacity class, batch
+    geometry, engine, registry version). Entries are warmed on build —
+    the throwaway call right here pays the XLA compile so no request
+    ever does — and shared across engine instances (the cache is a
+    module-level singleton), so a second tenant's server or a reloaded
+    engine at the same geometry hits the cache instead of recompiling.
+    ``compiles`` counts cache misses; tests assert it stays flat across
+    the request path post-warmup."""
+
+    _GUARDED_BY = {"_lock": ("_steps", "compiles", "hits")}
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._steps: dict[tuple, object] = {}
+        self.compiles = 0
+        self.hits = 0
+
+    def _get(self, key, build, warm):
+        with self._lock:
+            fn = self._steps.get(key)
+            if fn is not None:
+                self.hits += 1
+                return fn
+            fn = build()
+            if warm:
+                warm(fn)
+            self._steps[key] = fn
+            self.compiles += 1
+            return fn
+
+    def request_step(self, capacity: int, batch: int, engine: str = "fused",
+                     slices=DEFAULT_SLICES, scan_len: int | None = None,
+                     donate=False):
+        key = ("request", capacity, batch, engine, str(slices), scan_len,
+               resolve_donate(donate), registry_version())
+
+        def build():
+            return make_request_step(capacity, batch, engine=engine,
+                                     slices=slices, scan_len=scan_len,
+                                     donate=donate)
+
+        def warm(step):
+            # host-side arrays, like a real flush's packed panel — see
+            # the slot-step warm below for why the arg kinds must match
+            base = prng.base_key(0)
+            rids = np.zeros(batch, np.int32)
+            data = np.zeros((batch, capacity), np.uint8)
+            lens = np.zeros(batch, np.int32)
+            jax.block_until_ready(step(base, rids, data, lens))
+
+        return self._get(key, build, warm)
+
+    def slot_step(self, slots: int, row_pages: int, page: int = PAGE,
+                  engine: str = "fused", slices=DEFAULT_SLICES):
+        key = ("slot", slots, row_pages, page, engine, str(slices),
+               registry_version())
+
+        def build():
+            return make_slot_step(slots, row_pages, page=page, engine=engine,
+                                  slices=slices)
+
+        def warm(step):
+            arena = new_arena(arena_pages(slots, row_pages), page)
+            table = jnp.asarray(slot_table(slots, row_pages))
+            base = prng.base_key(0)
+            # warm every pow2 upload-chunk shape FIRST (admission
+            # scatters must not compile on the request path either; all
+            # entries target TRASH_PAGE, so live pages stay untouched),
+            # THEN step on the uploaded arena with host-side int vectors
+            # — the exact call sequence a request takes, so the jit fast
+            # path's cache keys (committed-ness included) match and the
+            # first real step is a perfect hit, not a near miss
+            kp = 1
+            while kp <= slots * row_pages:
+                idx = jnp.full((kp,), TRASH_PAGE, jnp.int32)
+                pages = jnp.zeros((kp, page), jnp.uint8)
+                arena = upload_pages(arena, idx, pages, donate=False)
+                kp *= 2
+            zero = np.zeros(slots, np.int32)
+            jax.block_until_ready(
+                step(arena, table, base, zero, zero, zero)
+            )
+
+        return self._get(key, build, warm)
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {"entries": len(self._steps), "compiles": self.compiles,
+                    "hits": self.hits}
+
+
+#: process-wide cache instance — the point is sharing compiled programs
+#: across servers/engines, so there is exactly one
+STEP_CACHE = StepCache()

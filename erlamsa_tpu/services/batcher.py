@@ -40,6 +40,10 @@ class _Req:
     # request up — a request that aged while a batch was in flight
     # flushes immediately instead of waiting another full tick
     t_enq: float = field(default_factory=time.monotonic)
+    # request id: the per-request PRNG stream index (ops/slots.py) —
+    # assigned at admission so a request's bytes are a pure function of
+    # (seed, rid) no matter which flush or slot step carries it
+    rid: int = 0
 
 
 def collect_batch(q: "queue.Queue[_Req]", first: _Req, batch: int,
@@ -108,6 +112,11 @@ class OracleBatcher:
             req.done.set()
             metrics.GLOBAL.record_request(time.monotonic() - req.t_enq)
 
+    def backlog(self) -> int:
+        """Requests queued behind the worker pool (admission-control
+        input — same surface as the device engines)."""
+        return self._q.qsize()
+
     def fuzz(self, data: bytes, opts: dict, timeout: float = 90.0) -> bytes:
         req = _Req(data, opts)
         self._q.put(req)
@@ -133,39 +142,48 @@ class TpuBatcher:
     can't serve a new one anyway, so waiting about one device-step time
     (EWMA-tracked) to fill the next batch costs no extra latency and
     raises fill efficiency; the configured max_latency_ms stays the hard
-    cap so an idle service still answers a lone request promptly."""
+    cap so an idle service still answers a lone request promptly.
+
+    Determinism (r10): keys and scheduler rows derive per REQUEST from
+    (seed, rid) inside the compiled step (ops/slots.py), not per flush —
+    a request's bytes no longer depend on which flush carried it or who
+    shared the batch, and they match the continuous engine
+    (services/serving.py) at the same capacity byte for byte."""
 
     # lock discipline (analysis/rules_threads.py enforces this declaration)
     _GUARDED_BY = {"_overflow_lock": ("_overflow",)}
 
     def __init__(self, batch: int = 256, capacity: int = 16384,
                  max_latency_ms: float = 20.0, seed=None,
-                 max_running_time: float = 30.0, inflight: int = 2):
-        import jax
-
+                 max_running_time: float = 30.0, inflight: int = 2,
+                 warm: bool = False):
         from ..ops import prng
-        from ..ops.pipeline import make_fuzzer
-        from ..ops.scheduler import init_scores
 
         self.batch = batch
         self.capacity = capacity
         self.max_latency = max_latency_ms / 1000.0
         self._q: queue.Queue[_Req] = queue.Queue()
-        # fresh pack per flush + scores chained forward: donation-safe
-        self._step, _ = make_fuzzer(capacity, batch, donate="auto")
+        # per-request keys/scores derive inside the step (ops/slots.py):
+        # nothing chains between flushes, so fresh packs stay
+        # donation-safe and a device error costs no scheduler state.
+        # warm=False keeps construction cheap (first flush pays the
+        # compile); servers pass warm=True so no request ever does
+        self._step = None
+        if warm:
+            self._ensure_step()
         self._base = prng.base_key(seed or gen_urandom_seed())
-        self._init_scores = lambda: init_scores(
-            jax.random.fold_in(self._base, 999), batch
-        )
-        self._scores = self._init_scores()
-        self._case = 0
+        self._rid = 0  # next request id (admission order)
+        self._rid_lock = threading.Lock()
         self._max_running_time = max_running_time
         self._overflow = None  # built lazily on the first oversized request
         self._overflow_lock = threading.Lock()
-        # load metrics (BASELINE config 4): fill efficiency = served /
-        # (flushes * batch) — how full the device batches actually ran
+        # load metrics (BASELINE config 4): cumulative flush/served counts
+        # plus a windowed EWMA of per-flush fill (served/batch) — the
+        # fill_efficiency surfaced in /metrics, meaningful under bursty
+        # load where a cumulative ratio would flatten every burst
         self.flushes = 0
         self.served = 0
+        self._fill = metrics.Ewma(0.2)
         # bounded in-flight pipeline: the semaphore holds one permit per
         # device slot, acquired before a batch is dispatched and released
         # only after the drain has FORCED its results — so at most
@@ -175,13 +193,45 @@ class TpuBatcher:
         self._inflight: queue.Queue = queue.Queue()
         self._slots = threading.Semaphore(max(1, inflight))
         self._step_ewma = 0.0  # EWMA of device step seconds (drain-side)
-        self._scores_dirty = threading.Event()  # drain saw a device error
         supervise("tpu-batcher-flusher", self._flusher)
         supervise("tpu-batcher-drain", self._drain)
 
+    def _ensure_step(self):
+        if self._step is None:
+            from ..ops.slots import STEP_CACHE
+
+            self._step = STEP_CACHE.request_step(self.capacity, self.batch,
+                                                 donate="auto")
+        return self._step
+
     @property
     def fill_efficiency(self) -> float:
-        return self.served / (self.flushes * self.batch) if self.flushes else 0.0
+        """Windowed EWMA of per-flush fill (reqs/batch); 0.0 while cold."""
+        return self._fill.value
+
+    def backlog(self) -> int:
+        """Requests queued behind the flusher (admission-control input)."""
+        return self._q.qsize()
+
+    def stats(self) -> dict:
+        from ..ops.slots import STEP_CACHE
+
+        comp = STEP_CACHE.stats()
+        return {
+            "mode": "flush",
+            "capacity": self.capacity,
+            "width": self.capacity,
+            "slots": self.batch,
+            "steps": self.flushes,
+            "served": self.served,
+            "admitted": self._rid,
+            "backlog": self.backlog(),
+            "fill_efficiency": round(self.fill_efficiency, 4),
+            "steps_per_request": round(self.flushes / self.served, 4)
+            if self.served else 0.0,
+            "compiled_steps": comp["entries"],
+            "compiles": comp["compiles"],
+        }
 
     def _deadline_s(self) -> float:
         """Adaptive collect budget: ~half a device step (clipped to the
@@ -192,6 +242,8 @@ class TpuBatcher:
         return min(self.max_latency, max(self._step_ewma * 0.5, 1e-3))
 
     def _flusher(self):
+        import numpy as np
+
         from ..ops.buffers import pack
 
         while True:
@@ -207,13 +259,12 @@ class TpuBatcher:
                     first.t_enq + self._deadline_s()
                 )
             try:
-                if self._scores_dirty.is_set():
-                    # the drain hit a device error: the chained scores
-                    # future is poisoned — restart the chain
-                    self._scores = self._init_scores()
-                    self._scores_dirty.clear()
+                step = self._ensure_step()
                 seeds = [r.data for r in reqs]
                 pad = [b"\x00"] * (self.batch - len(seeds))
+                # pad rows carry rid 0; their outputs are never read
+                rids = np.zeros(self.batch, np.int32)
+                rids[:len(reqs)] = [r.rid for r in reqs]
                 with trace.span("batcher.pack", reqs=len(reqs)):
                     packed = pack(seeds + pad, capacity=self.capacity)
                 t0 = time.monotonic()
@@ -223,18 +274,15 @@ class TpuBatcher:
                     # attempt: donation invalidates buffers on SUCCESS,
                     # and a dispatch that raised never consumed them
                     chaos.fault_point("batcher.step")
-                    return self._step(
-                        self._base, self._case, packed.data, packed.lens,
-                        self._scores,
-                    )
+                    return step(self._base, rids, packed.data, packed.lens)
 
                 with trace.span("batcher.dispatch", reqs=len(reqs)):
-                    data, lens, self._scores, _meta = STEP_RETRY.call(
+                    data, lens = STEP_RETRY.call(
                         _step_once, site="batcher.step",
                     )
-                self._case += 1
                 self.flushes += 1
                 self.served += len(reqs)
+                self._fill.update(len(reqs) / self.batch)
             except BaseException:  # lint: broad-except-ok must answer stranded requests first
                 # a dispatch error must not strand the collected requests
                 # until their client timeout: answer empty (the
@@ -260,7 +308,6 @@ class TpuBatcher:
             except BaseException:  # lint: broad-except-ok unblock waiters before the restart
                 for r in reqs:
                     r.done.set()
-                self._scores_dirty.set()
                 self._slots.release()
                 raise
             dt = time.monotonic() - t0
@@ -275,6 +322,7 @@ class TpuBatcher:
                 r.done.set()
                 metrics.GLOBAL.record_request(now - r.t_enq)
             self._slots.release()
+            metrics.GLOBAL.record_serving(self.stats())
 
     def fuzz(self, data: bytes, opts: dict, timeout: float = 90.0) -> bytes:
         if len(data) > self.capacity:
@@ -287,6 +335,9 @@ class TpuBatcher:
                 overflow = self._overflow
             return overflow.fuzz(data, opts, timeout)
         req = _Req(data, opts)
+        with self._rid_lock:
+            req.rid = self._rid
+            self._rid += 1
         self._q.put(req)
         if not req.done.wait(timeout):
             return b""
@@ -305,6 +356,6 @@ def make_batcher(backend: str, **kw):
         return TpuBatcher(**{k: v for k, v in kw.items()
                              if k in ("batch", "capacity", "max_latency_ms",
                                       "seed", "max_running_time",
-                                      "inflight")})
+                                      "inflight", "warm")})
     return OracleBatcher(workers=kw.get("workers", 10),
                          max_running_time=kw.get("max_running_time", 30.0))

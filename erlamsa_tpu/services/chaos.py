@@ -38,6 +38,11 @@ Known sites (grep `fault_point(` for the authoritative list):
                      path — outputs must not change (tests pin this)
     checkpoint.load  --state checkpoint read (services/checkpoint.py)
     checkpoint.save  --state checkpoint write (services/checkpoint.py)
+    serving.admit    faas admission control (services/faas.py): an
+                     injected fault sheds the request with a well-formed
+                     HTTP 429 + Retry-After, never a connection abort
+    serving.step     continuous engine's jitted slot step
+                     (services/serving.py)
 
 Injected failures raise ``InjectedFault``, an OSError subclass, so they
 flow through exactly the except-clauses that catch real socket/disk

@@ -122,6 +122,29 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("-M", "--meta", default=None, help="write metadata to path")
     p.add_argument("-r", "--recursive", action="store_true")
     p.add_argument("-H", "--httpsvc", default=None, help="run FaaS at host:port")
+    p.add_argument("--serving", choices=["continuous", "flush"],
+                   default="continuous",
+                   help="FaaS device engine: continuous (default) admits "
+                        "requests into a slot-based in-flight batch at "
+                        "step granularity (services/serving.py); flush "
+                        "keeps the deadline-flushed batcher. Single-"
+                        "request bytes are identical between modes at a "
+                        "fixed -s")
+    p.add_argument("--serving-slots", type=int, default=None, metavar="N",
+                   help="continuous-engine slot count (device rows per "
+                        "step; default 64)")
+    p.add_argument("--capacity", type=int, default=None, metavar="BYTES",
+                   help="serving working width in bytes (default 16384); "
+                        "longer requests overflow to the host oracle")
+    p.add_argument("--queue-cap", type=int, default=1024, metavar="N",
+                   help="FaaS admission backlog bound: requests beyond "
+                        "this shed with HTTP 429 + Retry-After (0 = "
+                        "unbounded)")
+    p.add_argument("--tenant-rate", type=float, default=0.0, metavar="R",
+                   help="per-tenant admission quota in requests/sec "
+                        "(token bucket; 0 = no quotas)")
+    p.add_argument("--tenant-burst", type=float, default=None, metavar="B",
+                   help="per-tenant burst allowance (default 2x rate)")
     p.add_argument("--checkpoint-every", type=int, default=1, metavar="N",
                    help="--state save cadence in cases (fsync per save; "
                         "a crash re-runs at most N-1 deterministic cases)")
@@ -381,6 +404,14 @@ def main(argv=None) -> int:
 
         host, _, port = args.httpsvc.rpartition(":")
         opts["cmanager_store"] = args.cmanager_store
+        opts["serving"] = args.serving
+        if args.serving_slots is not None:
+            opts["slots"] = args.serving_slots
+        if args.capacity is not None:
+            opts["capacity"] = args.capacity
+        opts["queue_cap"] = args.queue_cap
+        opts["tenant_rate"] = args.tenant_rate
+        opts["tenant_burst"] = args.tenant_burst
         return serve(host or "0.0.0.0", int(port), opts, backend=args.backend,
                      batch=args.batch)
     if args.proxy:

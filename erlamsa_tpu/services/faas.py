@@ -4,14 +4,26 @@ Reference: src/erlamsa_httpsvc.erl + src/erlamsa_esi.erl — endpoints
 /erlamsa/erlamsa_esi:fuzz (octet-stream in/out), :json (base64 JSON), and
 :manage (token admin), with fuzzing options in erlamsa-* HTTP headers or
 JSON fields and session auth via the cloud manager. Requests are served
-from the adaptive batcher instead of one process per request.
+from the continuous-batching engine (services/serving.py) by default;
+``--serving flush`` keeps the adaptive flush batcher.
+
+Multi-tenancy (r10): a request's tenant is its auth token (digested — a
+secret must not become a metrics label), an explicit ``erlamsa-tenant``
+header, or "public". Admission control runs BEFORE the device queue:
+per-tenant token-bucket quotas and a bounded backlog shed load with
+HTTP 429 + Retry-After instead of letting p99 collapse, behind the
+``serving.admit`` chaos site so resilience tests can force the rejection
+path. With a ``--corpus`` dir, each tenant's request payloads are
+admitted into its own corpus namespace (``corpus_dir/<tenant>``).
 """
 
 from __future__ import annotations
 
 import base64
 import binascii
+import hashlib
 import json
+import math
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 
@@ -24,9 +36,9 @@ class _FaasServer(ThreadingHTTPServer):
     request_queue_size = 1024
 
 from ..utils.erlrand import parse_seed
-from . import logger
-from .batcher import make_batcher
+from . import chaos, logger, metrics
 from .cmanager import CloudManager
+from .serving import TenantTable, make_engine
 
 
 def _parse_opts(get) -> dict:
@@ -76,11 +88,77 @@ def _parse_header_opts(headers) -> dict:
 
 class _Handler(BaseHTTPRequestHandler):
     server_version = "erlamsa-tpu"
+    # keep-alive: every _reply carries Content-Length, so HTTP/1.1 is
+    # safe and lets load-test harnesses and fuzzing loops reuse one
+    # connection per client instead of paying a TCP handshake + server
+    # thread spawn per request
+    protocol_version = "HTTP/1.1"
     batcher = None
     cmanager: CloudManager | None = None
+    tenants: TenantTable | None = None
+    #: admission backlog bound: requests queued behind the engine beyond
+    #: this are shed with 429 instead of growing queue.Queue unboundedly
+    queue_cap: int = 1024
 
     def log_message(self, fmt, *args):
         logger.log("debug", "faas: " + fmt, *args)
+
+    def _tenant(self, body_req: dict | None = None) -> str:
+        """Tenant identity: the auth token (digested, never the secret
+        itself), an explicit erlamsa-tenant header, or "public"."""
+        body_req = body_req or {}
+        tok = self.headers.get("erlamsa-token") or body_req.get("token")
+        if isinstance(tok, str) and tok:
+            return "tok:" + hashlib.sha256(tok.encode()).hexdigest()[:8]
+        name = self.headers.get("erlamsa-tenant")
+        if isinstance(name, str) and name.strip():
+            return name.strip()[:48]
+        return "public"
+
+    def _admit(self, tenant: str):
+        """Admission control, BEFORE the device queue. Returns None to
+        admit, else ``(retry_after_s, reason)`` for a 429."""
+        try:
+            chaos.fault_point("serving.admit")
+        except OSError:
+            # an injected admission fault sheds exactly like real
+            # pressure — clients must see a well-formed 429, never a
+            # connection abort (tests force this path)
+            return 1.0, "chaos"
+        if self.tenants is not None:
+            retry = self.tenants.admit(tenant)
+            if retry > 0.0:
+                return retry, "quota"
+        backlog = getattr(self.batcher, "backlog", None)
+        if self.queue_cap and backlog is not None \
+                and backlog() >= self.queue_cap:
+            return 1.0, "queue_full"
+        return None
+
+    def _reject(self, tenant: str, reason: str, retry_after: float,
+                is_json: bool, session: str):
+        metrics.GLOBAL.record_rejected(reason)
+        if self.tenants is not None:
+            self.tenants.record(tenant, served=False)
+        headers = {"Retry-After": str(max(1, math.ceil(retry_after)))}
+        if is_json:
+            self._reply(429, json.dumps(
+                {"error": "overloaded", "reason": reason}).encode(),
+                session, ctype="application/json", headers=headers)
+        else:
+            self._reply(429, f"overloaded: {reason}".encode(), session,
+                        headers=headers)
+
+    def _record_served(self, tenant: str, data: bytes):
+        if self.tenants is None:
+            return
+        self.tenants.record(tenant, served=True)
+        store = self.tenants.corpus_for(tenant)
+        if store is not None and data:
+            try:
+                store.add(data, origin=f"faas:{tenant}")
+            except (OSError, ValueError) as e:
+                logger.log("warn", "tenant corpus add failed: %s", e)
 
     def _auth(self, body_req: dict | None = None):
         """Token/session from erlamsa-* headers, or (JSON API) from the
@@ -103,11 +181,14 @@ class _Handler(BaseHTTPRequestHandler):
         return status, session
 
     def _reply(self, code: int, body: bytes, session: str = "",
-               ctype="application/octet-stream"):
+               ctype="application/octet-stream",
+               headers: dict | None = None):
         self.send_response(code)
         self.send_header("Content-Type", ctype)
         self.send_header("Content-Length", str(len(body)))
         self.send_header("erlamsa-status", "ok" if code == 200 else "error")
+        for name, value in (headers or {}).items():
+            self.send_header(name, value)
         if session:
             self.send_header("erlamsa-session", session)
         self.end_headers()
@@ -152,14 +233,26 @@ class _Handler(BaseHTTPRequestHandler):
             except (ValueError, SystemExit) as e:
                 self._reply(400, f"bad erlamsa-* header: {e}".encode())
                 return
+            tenant = self._tenant()
+            shed = self._admit(tenant)
+            if shed is not None:
+                self._reject(tenant, shed[1], shed[0], False, session)
+                return
             out = self.batcher.fuzz(body, opts)
+            self._record_served(tenant, body)
             self._reply(200, out, session)
             return
         if is_json:
             try:
                 data = base64.b64decode(body_req.get("data", ""))
                 opts = _parse_opts(body_req.get)
+                tenant = self._tenant(body_req)
+                shed = self._admit(tenant)
+                if shed is not None:
+                    self._reject(tenant, shed[1], shed[0], True, session)
+                    return
                 out = self.batcher.fuzz(data, opts)
+                self._record_served(tenant, data)
                 self._reply(
                     200,
                     json.dumps({"data": base64.b64encode(out).decode()}).encode(),
@@ -227,9 +320,15 @@ class _Handler(BaseHTTPRequestHandler):
 def serve(host: str, port: int, opts: dict, backend: str = "oracle",
           batch: int = 256, auth_required: bool = False,
           block: bool = True):
-    """Start the FaaS server; returns the server object when block=False."""
+    """Start the FaaS server; returns the server object when block=False.
+
+    Serving mode comes from ``opts["serving"]`` ("continuous" | "flush",
+    default continuous for the tpu backend) — the engine is built, and
+    its compiled step warmed, HERE at server start, so no request pays
+    an XLA compile."""
     from .batcher import service_budget
 
+    serving = opts.get("serving") or "continuous"
     # a per-server handler subclass: batcher/cmanager must not be shared
     # class state, or starting a second service (e.g. one with auth)
     # would silently reconfigure every running server
@@ -237,23 +336,33 @@ def serve(host: str, port: int, opts: dict, backend: str = "oracle",
         "_BoundHandler",
         (_Handler,),
         {
-            "batcher": make_batcher(
-                backend, batch=batch, workers=opts.get("workers", 10),
+            "batcher": make_engine(
+                backend, serving=serving, batch=batch,
+                workers=opts.get("workers", 10),
                 seed=opts.get("seed"),
                 max_running_time=service_budget(opts),
+                warm=opts.get("warm", True),
                 **{k: opts[k] for k in
-                   ("capacity", "max_latency_ms", "inflight")
+                   ("capacity", "max_latency_ms", "inflight", "slots")
                    if opts.get(k) is not None},
             ),
             "cmanager": CloudManager(
                 auth_required=auth_required,
                 store_path=opts.get("cmanager_store"),
             ),
+            "tenants": TenantTable(
+                rate=opts.get("tenant_rate", 0.0),
+                burst=opts.get("tenant_burst"),
+                corpus_dir=opts.get("corpus_dir"),
+            ),
+            "queue_cap": opts.get("queue_cap", 1024),
         },
     )
     srv = _FaasServer((host, port), handler)
-    logger.log("info", "faas listening on %s:%d (backend=%s)", host, port, backend)
+    logger.log("info", "faas listening on %s:%d (backend=%s serving=%s)",
+               host, port, backend, serving)
     print(f"# faas listening on {host}:{port} backend={backend} "
+          f"serving={serving if backend == 'tpu' else 'oracle'} "
           f"admin-token={handler.cmanager.admin_token}", flush=True)
     if not block:
         import threading

@@ -21,6 +21,29 @@ from . import logger
 HIST_NAMES = ("batch_latency", "request_latency", "device_step")
 
 
+class Ewma:
+    """Windowed exponential moving average for gauge-style ratios (slot
+    fill, occupancy): recent behaviour dominates, so bursty load reads
+    as bursty instead of being flattened by a cumulative mean. Updates
+    are single-float stores (GIL-atomic); callers serialize per engine
+    thread, so no lock is carried."""
+
+    __slots__ = ("alpha", "_v")
+
+    def __init__(self, alpha: float = 0.2):
+        self.alpha = float(alpha)
+        self._v: float | None = None
+
+    def update(self, x: float) -> float:
+        v = self._v
+        self._v = x if v is None else self.alpha * x + (1 - self.alpha) * v
+        return self._v
+
+    @property
+    def value(self) -> float:
+        return 0.0 if self._v is None else self._v
+
+
 class Counters:
     """Throughput counters shared by batch runners and services."""
 
@@ -62,6 +85,15 @@ class Counters:
         self.faults: dict[str, int] = {}
         self.events: dict[str, int] = {}
         self.degraded = 0
+        # latest serving-engine snapshot (services/serving.py stats() /
+        # TpuBatcher.stats(): mode/slots/fill_efficiency/steps_per_request/
+        # compiles) — gauge-style, set not summed
+        self.serving: dict | None = None
+        # admission-control sheds by reason (queue_full/quota/chaos) —
+        # the faas_rejected_total counter in /metrics
+        self.rejected: dict[str, int] = {}
+        # per-tenant served/rejected tallies (services/serving.TenantTable)
+        self.tenants: dict[str, dict[str, int]] = {}
         self.t0 = time.perf_counter()
 
     def record_batch(self, n_samples: int, n_bytes: int, device_seconds: float):
@@ -114,6 +146,23 @@ class Counters:
         """Latest arena health snapshot (corpus/arena.py stats())."""
         with self._lock:
             self.arena = dict(stats)
+
+    def record_serving(self, stats: dict):
+        """Latest serving-engine snapshot (continuous or flush)."""
+        with self._lock:
+            self.serving = dict(stats)
+
+    def record_rejected(self, reason: str):
+        """One request shed by admission control (HTTP 429), by reason."""
+        with self._lock:
+            self.rejected[reason] = self.rejected.get(reason, 0) + 1
+
+    def record_tenant(self, tenant: str, served: int = 0, rejected: int = 0):
+        """Per-tenant request accounting (faas multi-tenancy)."""
+        with self._lock:
+            t = self.tenants.setdefault(tenant, {"served": 0, "rejected": 0})
+            t["served"] += served
+            t["rejected"] += rejected
 
     def record_stage(self, name: str, seconds: float):
         """Accumulate wall time for one pipeline stage (schedule, assemble,
@@ -223,6 +272,10 @@ class Counters:
                             for cap, b in sorted(self.buckets.items())},
                 "truncated": self.truncated,
                 "arena": dict(self.arena) if self.arena else None,
+                "serving": dict(self.serving) if self.serving else None,
+                "rejected": dict(self.rejected),
+                "tenants": {t: dict(v)
+                            for t, v in sorted(self.tenants.items())},
             }
 
 

@@ -1,0 +1,480 @@
+"""Continuous-batching serving engine + multi-tenant admission helpers.
+
+The flush batcher (services/batcher.py, kept as the ``--serving flush``
+fallback) makes every request wait for a flush deadline or a full batch,
+then ride one monolithic device step. This engine applies the
+inference-serving playbook (PAPERS.md, arxiv 2605.25645) instead: the
+device holds a SLOT ARRAY — a paged arena where each slot owns a fixed
+page run (ops/slots.py) — and an arriving request scatters into a free
+slot immediately. Every device step runs the mutation kernel over all
+slots at one compiled shape, with the step's occupancy vector masking
+the slots it does not own; finished rows gather out and their slots
+recycle without waiting for the rest of any batch. Under load the step
+cadence IS the batching: whatever arrived while the previous step was
+in flight forms the next step's working set — no deadline to tune, no
+fixed batch to fill.
+
+Determinism: a request's bytes are a pure function of (seed, request_id)
+— the per-request key/scores derivation in ops/slots.py shared with the
+reworked flush batcher — so ``--serving continuous`` and ``--serving
+flush`` answer a given request id identically at the same capacity
+(pinned by tests and the tier1 --serve-smoke leg).
+
+Multi-tenancy (used by services/faas.py): TenantTable hands out
+per-tenant token buckets (quota shedding with Retry-After) and lazily
+opened per-tenant corpus namespaces under the server's --corpus dir.
+"""
+
+from __future__ import annotations
+
+import os
+import queue
+import re
+import threading
+import time
+from collections import deque
+
+from ..obs import trace
+from ..utils.erlrand import gen_urandom_seed
+from . import chaos, logger, metrics
+from .batcher import STEP_RETRY, OracleBatcher, _Req
+from .supervisor import supervise
+
+#: engine-queue sentinel: the drain thread pokes the engine loop after
+#: freeing slots so pending requests never starve waiting for a fresh
+#: arrival to wake the loop
+_POKE = object()
+
+
+class ContinuousEngine:
+    """Slot-based continuous batcher with the same ``fuzz(data, opts,
+    timeout)`` surface as TpuBatcher/OracleBatcher.
+
+    One capacity class: the working width is ``capacity`` rounded up to
+    the arena page size, every slot owns ``width // page`` pages, and
+    requests longer than the width take the oracle escape (full fidelity
+    beats truncation — the flush batcher's overflow rule). The compiled
+    step comes from ops/slots.py STEP_CACHE, warmed in the constructor,
+    so no request ever pays an XLA compile."""
+
+    # lock discipline (analysis/rules_threads.py enforces this declaration)
+    _GUARDED_BY = {
+        "_lock": ("_free", "_pending", "_next_rid", "_busy"),
+        "_overflow_lock": ("_overflow",),
+    }
+
+    def __init__(self, capacity: int = 16384, slots: int = 64, seed=None,
+                 max_running_time: float = 30.0, inflight: int = 1,
+                 page: int | None = None, warm: bool = True):
+        # inflight > 1 overlaps the next step's boarding with the
+        # current step's compute, but co-resident steps SHARE the slot
+        # pool — each can fill at most (slots - the other's occupancy),
+        # and a masked slot still costs full kernel compute at the
+        # fixed compiled shape. Depth 1 keeps every step eligible for
+        # 100% fill, which wins whenever kernel time dominates; raise
+        # it only when the device is fast enough that host-side
+        # boarding, not compute, sets the step cadence.
+        import jax.numpy as jnp
+
+        from ..ops import prng
+        from ..ops import slots as slotops
+        from ..ops.paged import PAGE, new_arena
+
+        self.page = page or PAGE
+        self.capacity = capacity
+        self.width = max(self.page,
+                         ((capacity + self.page - 1) // self.page) * self.page)
+        self.slots = slots
+        self.row_pages = self.width // self.page
+        self._base = prng.base_key(seed or gen_urandom_seed())
+        self._table_np = slotops.slot_table(slots, self.row_pages)
+        self._table = jnp.asarray(self._table_np)
+        self._arena = new_arena(slotops.arena_pages(slots, self.row_pages),
+                                self.page)
+        self._upload = slotops.upload_slots
+        if warm:
+            self.warmup()
+        self._max_running_time = max_running_time
+        self._overflow = None  # built lazily on the first oversized request
+        self._overflow_lock = threading.Lock()
+
+        self._lock = threading.Lock()
+        self._free = list(range(slots))
+        self._pending: deque[_Req] = deque()
+        self._next_rid = 0
+        self._busy = 0  # steps on the device, not yet drained
+        self._q: queue.Queue = queue.Queue()
+        self._inflight: queue.Queue = queue.Queue()
+        self._slots_sem = threading.Semaphore(max(1, inflight))
+        # per-slot device-call inputs; a slot's entries are written only
+        # between its admission and its dispatch (engine thread owns both)
+        import numpy as np
+
+        self._rids = np.zeros(slots, np.int32)
+        self._lens = np.zeros(slots, np.int32)
+        self.steps = 0
+        self.served = 0
+        self.admitted = 0
+        self._fill = metrics.Ewma(0.2)  # per-step slot fill (EWMA, windowed)
+        self._step_s = metrics.Ewma(0.3)  # step wall seconds (EWMA)
+        supervise("serving-engine", self._engine_loop)
+        supervise("serving-drain", self._drain)
+
+    # -- compiled-step cache ------------------------------------------------
+
+    def warmup(self):
+        """Build + warm the compiled slot step (and the pow2 upload-chunk
+        shapes) through the process-wide STEP_CACHE — at server start,
+        never on the request path."""
+        from ..ops import slots as slotops
+
+        self._step = slotops.STEP_CACHE.slot_step(
+            self.slots, self.row_pages, page=self.page
+        )
+
+    @staticmethod
+    def compile_stats() -> dict:
+        """Compiled-step cache counters (shared across engines): tests
+        assert `compiles` stays flat across the request path."""
+        from ..ops import slots as slotops
+
+        return slotops.STEP_CACHE.stats()
+
+    # -- client surface -----------------------------------------------------
+
+    @property
+    def fill_efficiency(self) -> float:
+        """Windowed EWMA of per-step slot fill (occupied/slots)."""
+        return self._fill.value
+
+    def backlog(self) -> int:
+        """Requests admitted but not yet dispatched — what faas admission
+        control bounds (queue depth, not device occupancy)."""
+        with self._lock:
+            waiting = len(self._pending)
+        return self._q.qsize() + waiting
+
+    def stats(self) -> dict:
+        comp = self.compile_stats()
+        return {
+            "mode": "continuous",
+            "capacity": self.capacity,
+            "width": self.width,
+            "slots": self.slots,
+            "steps": self.steps,
+            "served": self.served,
+            "admitted": self.admitted,
+            "backlog": self.backlog(),
+            "fill_efficiency": round(self.fill_efficiency, 4),
+            "steps_per_request": round(self.steps / self.served, 4)
+            if self.served else 0.0,
+            "compiled_steps": comp["entries"],
+            "compiles": comp["compiles"],
+        }
+
+    def fuzz(self, data: bytes, opts: dict, timeout: float = 90.0) -> bytes:
+        if len(data) > self.width:
+            # overflow-to-host escape: full fidelity beats truncation
+            with self._overflow_lock:
+                if self._overflow is None:
+                    self._overflow = OracleBatcher(
+                        workers=2, max_running_time=self._max_running_time
+                    )
+                overflow = self._overflow
+            return overflow.fuzz(data, opts, timeout)
+        req = _Req(data, opts)
+        with self._lock:
+            req.rid = self._next_rid
+            self._next_rid += 1
+            self.admitted += 1
+        self._q.put(req)
+        if not req.done.wait(timeout):
+            # the slot itself is NOT leaked: the drain frees it when the
+            # step completes whether or not anyone still waits
+            return b""
+        return req.result
+
+    # -- engine internals ---------------------------------------------------
+
+    def _engine_loop(self):
+        while True:
+            item = self._q.get()
+            fresh = [] if item is _POKE else [item]
+            while True:
+                try:
+                    nxt = self._q.get_nowait()
+                except queue.Empty:
+                    break
+                if nxt is not _POKE:
+                    fresh.append(nxt)
+            with self._lock:
+                self._pending.extend(fresh)
+            self._pump()
+
+    def _sweep(self):
+        """Fold queued arrivals into _pending without blocking — called
+        at the last moment before slot selection so a step admits
+        everything that arrived while the previous step was in flight."""
+        fresh = []
+        while True:
+            try:
+                nxt = self._q.get_nowait()
+            except queue.Empty:
+                break
+            if nxt is not _POKE:
+                fresh.append(nxt)
+        if fresh:
+            with self._lock:
+                self._pending.extend(fresh)
+
+    def _board(self):
+        """Boarding: while an earlier step still runs on the device,
+        keep folding arrivals into the next step instead of dispatching
+        it part-empty. The device going idle — or the step filling — is
+        the departure signal, so the pipeline self-clocks: step N+1
+        leaves the moment step N's results land, carrying everything
+        that arrived during N's compute. A near-empty extra step costs
+        a full kernel at this fixed compiled shape; boarding costs only
+        the wait that the in-flight semaphore would impose anyway.
+        Bounded by 2x the EWMA step time so a wedged drain cannot park
+        admitted requests forever (STEP_RETRY will surface the fault)."""
+        deadline = time.monotonic() + max(0.05, 2.0 * self._step_s.value)
+        while True:
+            with self._lock:
+                need = len(self._free) - len(self._pending)
+                busy = self._busy
+            remaining = deadline - time.monotonic()
+            if need <= 0 or not busy or remaining <= 0:
+                return
+            try:
+                nxt = self._q.get(timeout=min(0.002, remaining))
+            except queue.Empty:
+                continue
+            if nxt is not _POKE:
+                with self._lock:
+                    self._pending.append(nxt)
+
+    def _pump(self):
+        while True:
+            with self._lock:
+                idle = not self._pending or not self._free
+            if idle:
+                return
+            # bounded in-flight pipeline: one permit per device step,
+            # released only after the drain has FORCED its results.
+            # Acquire BEFORE selecting the step's slots: everything that
+            # arrives while we wait on the in-flight step joins this
+            # step instead of forcing an extra near-empty one — the
+            # step cadence is the coalescing window, nothing to tune.
+            self._slots_sem.acquire()
+            self._sweep()
+            self._board()
+            with self._lock:
+                take = min(len(self._pending), len(self._free))
+                admitted = [(self._free.pop(), self._pending.popleft())
+                            for _ in range(take)]
+            if not admitted:
+                self._slots_sem.release()
+                return
+            try:
+                self._dispatch(admitted)
+            except BaseException:  # lint: broad-except-ok must answer stranded requests first
+                for _slot, r in admitted:
+                    r.done.set()
+                with self._lock:
+                    self._free.extend(s for s, _ in admitted)
+                self._slots_sem.release()
+                raise
+
+    def _dispatch(self, admitted):
+        import numpy as np
+
+        occ = np.zeros(self.slots, np.int32)
+        for slot, r in admitted:
+            self._rids[slot] = r.rid
+            self._lens[slot] = len(r.data)
+            occ[slot] = 1
+        with trace.span("serving.upload", reqs=len(admitted)):
+            self._arena = self._upload(
+                self._arena, self._table_np,
+                [(s, r.data) for s, r in admitted], page=self.page,
+            )
+        t0 = time.monotonic()
+
+        def _step_once():
+            # retry is only sound while inputs survive a failed attempt:
+            # the arena is never donated and a raised dispatch consumed
+            # nothing
+            chaos.fault_point("serving.step")
+            return self._step(self._arena, self._table, self._base,
+                              self._rids, self._lens, occ)
+
+        with trace.span("serving.step", reqs=len(admitted),
+                        width=self.width):
+            out, olens = STEP_RETRY.call(_step_once, site="serving.step")
+        self.steps += 1
+        self._fill.update(len(admitted) / self.slots)
+        with self._lock:
+            self._busy += 1
+        metrics.GLOBAL.record_drain_backlog(self._inflight.qsize() + 1)
+        self._inflight.put((admitted, out, olens, t0))
+
+    def _drain(self):
+        import numpy as np
+
+        while True:
+            admitted, out, olens, t0 = self._inflight.get()
+            try:
+                with trace.span("serving.drain", reqs=len(admitted)):
+                    data = np.asarray(out)
+                    lens = np.asarray(olens)
+            except BaseException:  # lint: broad-except-ok unblock waiters before the restart
+                with self._lock:
+                    self._busy -= 1
+                for _slot, r in admitted:
+                    r.done.set()
+                self._recycle(admitted)
+                raise
+            with self._lock:
+                self._busy -= 1  # results landed: boarding may depart
+            dt = time.monotonic() - t0
+            self._step_s.update(dt)
+            metrics.GLOBAL.record_stage("serving_drain", dt)
+            metrics.GLOBAL.observe("batch_latency", dt)
+            now = time.monotonic()
+            for slot, r in admitted:
+                r.result = bytes(data[slot, :int(lens[slot])])
+                r.done.set()
+                metrics.GLOBAL.record_request(now - r.t_enq)
+            self.served += len(admitted)  # drain thread only
+            self._recycle(admitted)
+            metrics.GLOBAL.record_serving(self.stats())
+
+    def _recycle(self, admitted):
+        with self._lock:
+            self._free.extend(s for s, _ in admitted)
+            has_pending = bool(self._pending)
+        self._slots_sem.release()
+        if has_pending:
+            # wake the engine loop: without a fresh arrival it would
+            # block on the queue while admitted-capable work waits
+            self._q.put(_POKE)
+
+
+def make_engine(backend: str, serving: str = "continuous", **kw):
+    """Engine factory for the service layer: ``(backend, serving)`` ->
+    OracleBatcher | TpuBatcher (flush) | ContinuousEngine."""
+    from .batcher import make_batcher
+
+    if backend == "tpu" and serving == "continuous":
+        return ContinuousEngine(**{k: v for k, v in kw.items()
+                                   if k in ("capacity", "slots", "seed",
+                                            "max_running_time", "inflight",
+                                            "warm")})
+    if serving not in ("continuous", "flush"):
+        raise ValueError(f"unknown serving mode {serving!r}")
+    return make_batcher(backend, **kw)
+
+
+# -- multi-tenant admission ------------------------------------------------
+
+
+class TokenBucket:
+    """Classic token bucket: ``rate`` tokens/s up to ``burst``. take()
+    returns 0.0 on admit, else the seconds until a token accrues (the
+    Retry-After hint). Monotonic clock only — admission timing is
+    load-dependent by nature, never replayed."""
+
+    __slots__ = ("rate", "burst", "tokens", "t")
+
+    def __init__(self, rate: float, burst: float):
+        self.rate = float(rate)
+        self.burst = float(burst)
+        self.tokens = float(burst)
+        self.t = time.monotonic()
+
+    def take(self) -> float:
+        now = time.monotonic()
+        self.tokens = min(self.burst,
+                          self.tokens + (now - self.t) * self.rate)
+        self.t = now
+        if self.tokens >= 1.0:
+            self.tokens -= 1.0
+            return 0.0
+        if self.rate <= 0.0:
+            return 1.0
+        return (1.0 - self.tokens) / self.rate
+
+
+def tenant_slug(tenant: str) -> str:
+    """Filesystem-safe tenant namespace (corpus subdirectory name)."""
+    return re.sub(r"[^A-Za-z0-9_.-]", "_", tenant)[:48] or "_"
+
+
+class TenantTable:
+    """Per-tenant serving state: a token bucket (quota) and a lazily
+    opened corpus namespace under ``corpus_dir/<tenant>``. rate <= 0
+    disables quotas entirely (no buckets are built)."""
+
+    _GUARDED_BY = {"_lock": ("_buckets", "_stores", "_served", "_rejected")}
+
+    def __init__(self, rate: float = 0.0, burst: float | None = None,
+                 corpus_dir: str | None = None):
+        self.rate = float(rate or 0.0)
+        self.burst = float(burst) if burst else max(1.0, 2 * self.rate)
+        self.corpus_dir = corpus_dir
+        self._lock = threading.Lock()
+        self._buckets: dict[str, TokenBucket] = {}
+        self._stores: dict[str, object] = {}
+        self._served: dict[str, int] = {}
+        self._rejected: dict[str, int] = {}
+
+    def admit(self, tenant: str) -> float:
+        """0.0 = admitted; > 0 = shed, with the Retry-After seconds."""
+        if self.rate <= 0.0:
+            return 0.0
+        with self._lock:
+            bucket = self._buckets.get(tenant)
+            if bucket is None:
+                bucket = self._buckets[tenant] = TokenBucket(self.rate,
+                                                             self.burst)
+            return bucket.take()
+
+    def record(self, tenant: str, served: bool):
+        with self._lock:
+            table = self._served if served else self._rejected
+            table[tenant] = table.get(tenant, 0) + 1
+        metrics.GLOBAL.record_tenant(tenant, served=int(served),
+                                     rejected=int(not served))
+
+    def corpus_for(self, tenant: str):
+        """The tenant's CorpusStore namespace, or None when the server
+        has no corpus dir. Open failures log and disable the namespace
+        for this tenant (admission must not 500 on a full disk)."""
+        if not self.corpus_dir:
+            return None
+        with self._lock:
+            store = self._stores.get(tenant)
+            if store is None and tenant not in self._stores:
+                from ..corpus.store import CorpusStore
+
+                try:
+                    store = CorpusStore(
+                        os.path.join(self.corpus_dir, tenant_slug(tenant))
+                    )
+                except (OSError, ValueError) as e:
+                    logger.log("warn",
+                               "tenant corpus %s disabled: %s", tenant, e)
+                    store = None
+                self._stores[tenant] = store
+            return store
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "rate": self.rate,
+                "burst": self.burst,
+                "tenants": sorted(set(self._served) | set(self._rejected)),
+                "served": dict(self._served),
+                "rejected": dict(self._rejected),
+            }

@@ -28,6 +28,12 @@
 # compiled step shape for the arena run, and zero padded bytes wasted
 # (corpus/arena.py + ops/paged.py).
 #
+# scripts/tier1.sh --serve-smoke additionally boots the faas server
+# with the continuous-batching engine (services/serving.py), checks one
+# request answers byte-identically to a flush-mode server at the same
+# seed (the cross-mode determinism pin), then fires 200 concurrent
+# requests and asserts zero errors and zero request-path compiles.
+#
 # The gate starts with fuzzlint (erlamsa_tpu/analysis): pure-AST
 # invariant checks (determinism, device purity, lock discipline,
 # resilience coverage) over the whole package in ~2s. Opt out with
@@ -38,6 +44,7 @@ bench_smoke=0
 chaos_smoke=0
 obs_smoke=0
 arena_smoke=0
+serve_smoke=0
 lint=1
 while [ $# -gt 0 ]; do
   case "$1" in
@@ -45,6 +52,7 @@ while [ $# -gt 0 ]; do
     --chaos-smoke) chaos_smoke=1; shift ;;
     --obs-smoke) obs_smoke=1; shift ;;
     --arena-smoke) arena_smoke=1; shift ;;
+    --serve-smoke) serve_smoke=1; shift ;;
     --lint) lint=1; shift ;;
     --no-lint) lint=0; shift ;;
     *) break ;;
@@ -254,6 +262,85 @@ finally:
 ok = trace_ok and prom_ok
 print(f"OBS_SMOKE={'ok' if ok else 'FAIL'} trace_events={len(xev)} "
       f"trace_ok={trace_ok} prom_ok={prom_ok}")
+sys.exit(0 if ok else 1)
+EOF
+  rc=$?
+fi
+
+if [ $rc -eq 0 ] && [ $serve_smoke -eq 1 ]; then
+  echo "== serve smoke: continuous engine identity + concurrent load =="
+  timeout -k 10 600 env JAX_PLATFORMS=cpu python - <<'EOF'
+import socket, sys, threading, urllib.request
+
+from erlamsa_tpu.ops.slots import STEP_CACHE
+from erlamsa_tpu.services.faas import serve
+
+
+def free_port():
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def boot(mode):
+    port = free_port()
+    srv = serve("127.0.0.1", port,
+                {"seed": (7, 7, 7), "capacity": 256, "slots": 8,
+                 "serving": mode},
+                backend="tpu", batch=8, block=False)
+    return port, srv
+
+
+def post(port, data):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/erlamsa/erlamsa_esi:fuzz", data=data)
+    return urllib.request.urlopen(req, timeout=120).read()
+
+
+# identity FIRST, on fresh servers: request id 0 on each side must
+# answer byte-for-byte identically across serving modes
+cport, csrv = boot("continuous")
+fport, fsrv = boot("flush")
+a = post(cport, b"serve smoke identity payload")
+b = post(fport, b"serve smoke identity payload")
+fsrv.shutdown()
+identical = bool(a) and a == b
+
+# then 200 concurrent requests against the continuous server: zero
+# errors, zero request-path compiles. An EMPTY 200-answer is a
+# legitimate fuzz output (deletion mutators can shrink a short input
+# to nothing, deterministically per request id) — only transport
+# errors and non-200s fail the smoke, and the empty minority is
+# bounded as a give-up tripwire
+compiles0 = STEP_CACHE.stats()["compiles"]
+errors = []
+served = [0]
+nonempty = [0]
+
+
+def client(i):
+    try:
+        if post(cport, b"concurrent load %03d" % i):
+            nonempty[0] += 1
+        served[0] += 1
+    except Exception as e:  # noqa: BLE001 - any failure fails the smoke
+        errors.append((i, repr(e)))
+
+
+threads = [threading.Thread(target=client, args=(i,)) for i in range(200)]
+for t in threads:
+    t.start()
+for t in threads:
+    t.join(300)
+csrv.shutdown()
+compiles = STEP_CACHE.stats()["compiles"] - compiles0
+ok = (identical and not errors and served[0] == 200
+      and nonempty[0] >= 180 and compiles == 0)
+print(f"SERVE_SMOKE={'ok' if ok else 'FAIL'} identical={identical} "
+      f"served={served[0]}/200 nonempty={nonempty[0]} "
+      f"errors={len(errors)} request_path_compiles={compiles}")
+if errors:
+    print("first errors:", errors[:3])
 sys.exit(0 if ok else 1)
 EOF
   rc=$?

@@ -403,6 +403,43 @@ def test_chaos_coverage_suppression_on_preceding_line(tmp_path):
     """, ["chaos-site-coverage"]) == []
 
 
+def _chaos_pkg(tmp_path, sites):
+    """A miniature package tree whose services/chaos.py anchors the
+    package-level expected-site check."""
+    pkg = tmp_path / "erlamsa_tpu" / "services"
+    pkg.mkdir(parents=True)
+    (pkg / "chaos.py").write_text("def fault_point(site):\n    pass\n")
+    body = "".join(f'    chaos.fault_point("{s}")\n' for s in sites)
+    (pkg / "other.py").write_text(
+        "from . import chaos\n\ndef go():\n" + (body or "    pass\n"))
+    return str(tmp_path / "erlamsa_tpu")
+
+
+def test_chaos_expected_sites_missing_is_a_finding(tmp_path):
+    cfg = LintConfig(chaos_modules=(),
+                     chaos_expected_sites=("dist.send", "serving.step"))
+    path = _chaos_pkg(tmp_path, ["dist.send"])
+    f = one_finding(run_lint([path], rules=["chaos-site-coverage"],
+                             config=cfg), "chaos-site-coverage")
+    assert "serving.step" in f.message
+
+
+def test_chaos_expected_sites_all_present_passes(tmp_path):
+    cfg = LintConfig(chaos_modules=(),
+                     chaos_expected_sites=("dist.send", "serving.step"))
+    path = _chaos_pkg(tmp_path, ["dist.send", "serving.step"])
+    assert run_lint([path], rules=["chaos-site-coverage"], config=cfg) == []
+
+
+def test_chaos_expected_sites_skipped_without_anchor(tmp_path):
+    # fixture lints of standalone files never see services/chaos.py, so
+    # they must not demand the whole site set
+    cfg = LintConfig(chaos_modules=(),
+                     chaos_expected_sites=("dist.send", "serving.step"))
+    assert lint_src(tmp_path, "X = 1\n", ["chaos-site-coverage"],
+                    config=cfg) == []
+
+
 # ---- unused-import ------------------------------------------------------
 
 

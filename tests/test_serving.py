@@ -1,0 +1,318 @@
+"""Continuous-batching serving engine (services/serving.py + ops/slots.py).
+
+The load-bearing contract is the determinism pin: a request's bytes are a
+pure function of (seed, request_id), so the SAME sequential request
+stream answers byte-identically from the continuous engine, the flush
+batcher, and a single-shot device step — batch composition, slot
+placement, and pipeline depth (inflight) cannot leak into outputs. The
+rest covers the serving plumbing: slot lifecycle (no double allocation,
+abandoned requests free their slots), the compiled-step cache staying
+flat on the request path, and multi-tenant admission control (quota /
+queue-full / chaos sheds answer HTTP 429 + Retry-After).
+"""
+
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from erlamsa_tpu.services import chaos, metrics
+from erlamsa_tpu.services.batcher import OracleBatcher, TpuBatcher
+from erlamsa_tpu.services.serving import (ContinuousEngine, TenantTable,
+                                          TokenBucket, make_engine,
+                                          tenant_slug)
+
+SEED = (5, 6, 7)
+CAP = 256
+PAYLOADS = [b"serving identity payload one!",
+            b"a shorter second one",
+            b"and the third request's bytes, somewhat longer than both"]
+
+
+def _free_port():
+    import socket
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _single_shot(payloads, seed=SEED, capacity=CAP):
+    """Oracle for the per-request stream: one batch=1 device step per
+    request id, nothing shared between calls."""
+    from erlamsa_tpu.ops import prng
+    from erlamsa_tpu.ops.buffers import pack
+    from erlamsa_tpu.ops.slots import STEP_CACHE
+
+    step = STEP_CACHE.request_step(capacity, 1)
+    base = prng.base_key(seed)
+    outs = []
+    for rid, data in enumerate(payloads):
+        packed = pack([data], capacity=capacity)
+        out, lens = step(base, np.array([rid], np.int32),
+                         packed.data, packed.lens)
+        outs.append(bytes(np.asarray(out)[0, :int(np.asarray(lens)[0])]))
+    return outs
+
+
+def _serve_all(engine, payloads):
+    return [engine.fuzz(p, {}, timeout=300) for p in payloads]
+
+
+def test_continuous_matches_flush_and_single_shot():
+    oracle = _single_shot(PAYLOADS)
+    flush = _serve_all(TpuBatcher(batch=4, capacity=CAP, seed=SEED,
+                                  max_latency_ms=5.0, warm=True), PAYLOADS)
+    cont = _serve_all(ContinuousEngine(capacity=CAP, slots=4, seed=SEED),
+                      PAYLOADS)
+    assert flush == oracle
+    assert cont == oracle
+    assert all(o for o in oracle)  # non-empty answers, not give-ups
+
+
+def test_identity_independent_of_inflight_depth():
+    # pipeline depth is pure scheduling: inflight=1 (serialized) and
+    # inflight=2 (double-buffered) answer identically
+    one = _serve_all(ContinuousEngine(capacity=CAP, slots=4, seed=SEED,
+                                      inflight=1), PAYLOADS)
+    two = _serve_all(ContinuousEngine(capacity=CAP, slots=4, seed=SEED,
+                                      inflight=2), PAYLOADS)
+    assert one == two == _single_shot(PAYLOADS)
+
+
+def test_slot_lifecycle_no_double_allocation():
+    eng = ContinuousEngine(capacity=CAP, slots=4, seed=SEED)
+    results = {}
+
+    def client(i):
+        results[i] = eng.fuzz(b"slot lifecycle %d" % i, {}, timeout=300)
+
+    threads = [threading.Thread(target=client, args=(i,)) for i in range(10)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(300)
+    assert sorted(results) == list(range(10))
+    assert all(isinstance(v, bytes) and v for v in results.values())
+    assert eng.served == 10
+    assert eng.steps >= 3  # 4 slots can't serve 10 in fewer
+    # every slot came home exactly once: full free list, no duplicates
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline and len(eng._free) < eng.slots:
+        time.sleep(0.01)
+    assert sorted(eng._free) == list(range(eng.slots))
+    assert 0.0 < eng.fill_efficiency <= 1.0
+    assert 0.0 < eng.stats()["steps_per_request"] <= 1.0
+
+
+def test_timeout_abandoned_request_frees_slot():
+    eng = ContinuousEngine(capacity=CAP, slots=2, seed=SEED)
+    # timeout=0: the client gives up immediately (empty answer), but the
+    # request still rides a step and the drain must free its slot
+    assert eng.fuzz(b"abandoned request", {}, timeout=0.0) == b""
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline and eng.served < 1:
+        time.sleep(0.01)
+    assert eng.served == 1
+    while time.monotonic() < deadline and len(eng._free) < eng.slots:
+        time.sleep(0.01)
+    assert sorted(eng._free) == list(range(eng.slots))
+    # the freed slot is reusable: a live follow-up request still answers
+    assert eng.fuzz(b"follow-up", {}, timeout=300) != b""
+
+
+def test_compiled_step_cache_flat_on_request_path():
+    from erlamsa_tpu.ops.slots import STEP_CACHE
+
+    eng = ContinuousEngine(capacity=CAP, slots=4, seed=SEED)
+    warm = STEP_CACHE.stats()
+    hits0 = warm["hits"]
+    # a second engine at the same geometry (a second tenant's server)
+    # reuses the compiled step: no new compile, one cache hit
+    eng2 = ContinuousEngine(capacity=CAP, slots=4, seed=(9, 9, 9))
+    after_build = STEP_CACHE.stats()
+    assert after_build["compiles"] == warm["compiles"]
+    assert after_build["hits"] == hits0 + 1
+    # the request path never compiles: counters flat across real traffic
+    for i in range(6):
+        assert eng.fuzz(b"traffic %d" % i, {}, timeout=300)
+        assert eng2.fuzz(b"traffic %d" % i, {}, timeout=300)
+    assert STEP_CACHE.stats()["compiles"] == warm["compiles"]
+    # and the jitted step itself saw exactly one (warmup) trace
+    if hasattr(eng._step, "_cache_size"):
+        assert eng._step._cache_size() == 1
+
+
+def test_continuous_oversized_request_takes_oracle_escape():
+    eng = ContinuousEngine(capacity=CAP, slots=2, seed=SEED)
+    big = bytes(range(256)) * 3  # 768 > width 256
+    out = eng.fuzz(big, {"seed": (1, 2, 3)}, timeout=300)
+    assert out  # answered via the host oracle, not truncated to width
+    assert eng.admitted == 0  # never entered the slot pipeline
+
+
+def test_make_engine_dispatch():
+    assert isinstance(make_engine("tpu", serving="continuous",
+                                  capacity=CAP, slots=4, seed=SEED),
+                      ContinuousEngine)
+    assert isinstance(make_engine("tpu", serving="flush", batch=4,
+                                  capacity=CAP, seed=SEED), TpuBatcher)
+    assert isinstance(make_engine("oracle", serving="continuous",
+                                  workers=1), OracleBatcher)
+    with pytest.raises(ValueError):
+        make_engine("oracle", serving="bogus")
+
+
+def test_ewma_windowed():
+    e = metrics.Ewma(alpha=0.5)
+    assert e.value == 0.0  # cold
+    assert e.update(1.0) == pytest.approx(1.0)  # first sample seeds it
+    assert e.update(0.0) == pytest.approx(0.5)
+    assert e.update(0.0) == pytest.approx(0.25)
+    # recent behaviour dominates: a burst recovers fast
+    for _ in range(8):
+        e.update(1.0)
+    assert e.value > 0.9
+
+
+def test_token_bucket_quota_and_retry_hint():
+    b = TokenBucket(rate=10.0, burst=2.0)
+    assert b.take() == 0.0
+    assert b.take() == 0.0  # burst of 2 admits 2 back-to-back
+    retry = b.take()
+    assert 0.0 < retry <= 0.1  # 10/s -> next token within 100ms
+    b.tokens, b.t = 0.0, time.monotonic() - 1.0  # simulate 1s of accrual
+    assert b.take() == 0.0
+
+
+def test_tenant_slug_sanitizes():
+    assert tenant_slug("tok:ab12cd34") == "tok_ab12cd34"
+    assert tenant_slug("../../etc/passwd") == ".._.._etc_passwd"
+    assert tenant_slug("") == "_"
+    assert len(tenant_slug("x" * 100)) == 48
+
+
+def test_tenant_table_quotas_and_corpus_namespaces(tmp_path):
+    t = TenantTable(rate=1000.0, burst=1.0, corpus_dir=str(tmp_path))
+    assert t.admit("a") == 0.0
+    assert t.admit("a") > 0.0  # burst 1: second request sheds
+    assert t.admit("b") == 0.0  # quotas are per tenant
+    t.record("a", served=True)
+    t.record("a", served=False)
+    assert t.stats()["served"]["a"] == 1
+    assert t.stats()["rejected"]["a"] == 1
+    store = t.corpus_for("a/b")
+    assert store is not None
+    assert (tmp_path / "a_b").is_dir()  # slugged namespace directory
+    assert t.corpus_for("a/b") is store  # cached, one store per tenant
+    # rate<=0 disables quotas entirely
+    assert TenantTable(rate=0.0).admit("anyone") == 0.0
+    # no corpus dir -> no namespace, not an error
+    assert TenantTable(rate=0.0).corpus_for("a") is None
+
+
+# ---- faas admission (HTTP level) ----------------------------------------
+
+
+@pytest.fixture()
+def faas_tpu_server():
+    from erlamsa_tpu.services.faas import serve
+
+    port = _free_port()
+    srv = serve("127.0.0.1", port,
+                {"seed": SEED, "capacity": CAP, "slots": 4},
+                backend="tpu", batch=4, block=False)
+    yield port, srv
+    srv.shutdown()
+
+
+def _post(port, data=b"admission test", headers=None):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/erlamsa/erlamsa_esi:fuzz",
+        data=data, headers=headers or {})
+    return urllib.request.urlopen(req, timeout=60)
+
+
+def test_faas_chaos_admit_sheds_with_429(faas_tpu_server):
+    port, _srv = faas_tpu_server
+    chaos.configure("serving.admit:x1", seed=0)
+    try:
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _post(port)
+        assert ei.value.code == 429
+        assert int(ei.value.headers["Retry-After"]) >= 1
+    finally:
+        chaos.configure(None)
+    # healed: the same request now answers
+    assert _post(port).status == 200
+
+
+def test_faas_quota_and_queue_full_shed_with_429(faas_tpu_server):
+    port, srv = faas_tpu_server
+    handler = srv.RequestHandlerClass
+    rejected0 = dict(metrics.GLOBAL.snapshot()["rejected"])
+
+    # per-tenant quota: burst 1 admits the first, sheds the second
+    old_tenants = handler.tenants
+    handler.tenants = TenantTable(rate=0.001, burst=1.0)
+    try:
+        assert _post(port).status == 200
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _post(port)
+        assert ei.value.code == 429
+        assert int(ei.value.headers["Retry-After"]) >= 1
+        # an unrelated tenant has its own bucket: still admitted
+        assert _post(port, headers={"erlamsa-tenant": "other"}).status == 200
+    finally:
+        handler.tenants = old_tenants
+
+    # bounded admission queue: backlog >= cap sheds BEFORE enqueueing
+    old_cap, old_backlog = handler.queue_cap, handler.batcher.backlog
+    handler.queue_cap, handler.batcher.backlog = 8, lambda: 8
+    try:
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _post(port)
+        assert ei.value.code == 429
+    finally:
+        handler.queue_cap, handler.batcher.backlog = old_cap, old_backlog
+
+    rejected = metrics.GLOBAL.snapshot()["rejected"]
+    assert rejected.get("quota", 0) > rejected0.get("quota", 0)
+    assert rejected.get("queue_full", 0) > rejected0.get("queue_full", 0)
+
+
+def test_metrics_exposition_serving_and_rejections(faas_tpu_server):
+    port, _srv = faas_tpu_server
+    assert _post(port, data=b"metrics exposition seed").status == 200
+    body = urllib.request.urlopen(
+        f"http://127.0.0.1:{port}/metrics", timeout=30).read().decode()
+    assert "erlamsa_batcher_fill_efficiency" in body
+    assert 'erlamsa_serving_steps_total{mode="continuous"}' in body
+    assert "erlamsa_serving_steps_per_request" in body
+    assert "erlamsa_serving_compiles_total" in body
+    # rejection counters appear once anything was shed (prior tests did)
+    if metrics.GLOBAL.snapshot()["rejected"]:
+        assert "erlamsa_faas_rejected_total" in body
+    assert "erlamsa_tenant_requests_total" in body
+
+
+def test_faas_flush_mode_single_request_identity():
+    """--serving continuous and --serving flush answer a single request
+    byte-identically at the same seed (the cross-mode pin, HTTP level)."""
+    from erlamsa_tpu.services.faas import serve
+
+    outs = []
+    for mode in ("continuous", "flush"):
+        port = _free_port()
+        srv = serve("127.0.0.1", port,
+                    {"seed": SEED, "capacity": CAP, "slots": 4,
+                     "serving": mode},
+                    backend="tpu", batch=4, block=False)
+        try:
+            outs.append(_post(port, data=b"cross-mode identity").read())
+        finally:
+            srv.shutdown()
+    assert outs[0] == outs[1] and outs[0]
