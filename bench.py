@@ -236,6 +236,61 @@ def _run_corpus_stage(batch_n: int, seed_len: int, cases: int, t0: float,
     return warm_sps, waste, stats.get("new_hashes", 0), stats
 
 
+def _run_fleet_stage(batch_n: int, seed_len: int, cases: int, t0: float,
+                     shards: int, spec: str | None = None):
+    """Sharded corpus fleet (corpus/fleet.py, `--shards N`): the same
+    mixed-length seed set as the corpus stage, mapped across N per-shard
+    arenas and reduced at the coordinator. At the fixed bench seed every
+    shard count produces byte-identical output, so the samples/s spread
+    across shards isolates coordination cost (one devices means the
+    shards time-share it on this host — the interesting number on a real
+    mesh is linear capacity, here it is the overhead floor).
+
+    `spec` arms a chaos spec for the run (e.g. "shard.step:x1" to kill
+    one shard's first dispatch and measure recovery). Returns
+    (warm_samples_per_sec, stats dict); stats carries the migration log
+    and per-case finish_times the caller derives recovery time from."""
+    import shutil
+    import tempfile
+
+    from erlamsa_tpu.corpus.runner import run_corpus_batch
+    from erlamsa_tpu.services import chaos
+
+    base_seeds = make_seeds(batch_n, seed_len)
+    lengths = [max(64, seed_len >> k) for k in (0, 1, 2, 3, 4)]
+    seeds = [s[: lengths[i % len(lengths)]] for i, s in enumerate(base_seeds)]
+
+    stats: dict = {}
+    tmpdir = tempfile.mkdtemp(prefix="erlamsa_fleet_bench_")
+    try:
+        chaos.configure(spec, seed=1)
+        opts = {
+            "corpus_dir": tmpdir,
+            "corpus": seeds,
+            "feedback": True,
+            "seed": (1, 2, 3),
+            "n": max(2, cases),
+            "output": os.devnull,
+            "_stats": stats,
+            "shards": shards,
+        }
+        rc = run_corpus_batch(opts, batch=batch_n)
+    finally:
+        chaos.configure(None)
+        shutil.rmtree(tmpdir, ignore_errors=True)
+    if rc != 0 or len(stats.get("finish_times", [])) < 2:
+        raise RuntimeError(f"fleet stage failed rc={rc} stats={stats}")
+    ft = stats["finish_times"]
+    warm_sps = batch_n * (len(ft) - 1) / (ft[-1] - ft[0])
+    _phase(
+        f"fleet stage (shards={shards}{', spec=' + spec if spec else ''}): "
+        f"{warm_sps:,.0f} samples/s warm, "
+        f"{len(stats.get('migrations', []))} migration(s), "
+        f"{stats.get('oracle_cases', 0)} oracle case(s)", t0,
+    )
+    return warm_sps, stats
+
+
 def child_main() -> None:
     """The measured run. Writes its JSON record to $ERLAMSA_BENCH_RESULT
     (and stdout); phase timings go to stderr.
@@ -377,6 +432,45 @@ def child_main() -> None:
                 _write_result(line)
         except Exception as e:  # noqa: BLE001 — earlier numbers stand
             _phase(f"corpus stage FAILED: {type(e).__name__}: {e}", t0)
+
+    # fleet stage (r11): the sharded corpus fleet at shards 1/2/4 — the
+    # same shape and seed, byte-identical outputs, so the samples/s
+    # spread is pure coordination overhead on a single-device host —
+    # plus one run with an injected shard kill (shard.step:x1) to
+    # record redistribution + re-admission ("recovery") cost.
+    # ERLAMSA_BENCH_FLEET=0 skips.
+    if os.environ.get("ERLAMSA_BENCH_FLEET", "1") != "0":
+        try:
+            fleet_cases = max(4, ITERS // 3)
+            fleet_sps: dict[str, float] = {}
+            for n_shards in (1, 2, 4):
+                sps_n, _fstats = _run_fleet_stage(
+                    BATCH, SEED_LEN, fleet_cases, t0, shards=n_shards
+                )
+                fleet_sps[str(n_shards)] = round(sps_n, 1)
+            record["fleet_samples_per_sec"] = fleet_sps
+            kill_sps, kstats = _run_fleet_stage(
+                BATCH, SEED_LEN, fleet_cases, t0, shards=4,
+                spec="shard.step:x1"
+            )
+            record["fleet_kill_samples_per_sec"] = round(kill_sps, 1)
+            record["fleet_migrations"] = [
+                m["kind"] for m in kstats.get("migrations", [])
+            ]
+            revoke = next((m["case"] for m in kstats["migrations"]
+                           if m["kind"] == "revoke"), None)
+            readmit = next((m["case"] for m in kstats["migrations"]
+                            if m["kind"] == "readmit"), None)
+            if revoke is not None and readmit is not None:
+                ft = kstats["finish_times"]
+                record["fleet_recovery_cases"] = readmit - revoke
+                record["fleet_recovery_s"] = round(
+                    ft[readmit] - ft[revoke], 3
+                )
+            line = json.dumps(record)
+            _write_result(line)
+        except Exception as e:  # noqa: BLE001 — earlier numbers stand
+            _phase(f"fleet stage FAILED: {type(e).__name__}: {e}", t0)
 
     # service-layer stage (BASELINE configs 4/5): FaaS concurrency +
     # live-proxy stream via bin/load_bench.py. Modest defaults keep the

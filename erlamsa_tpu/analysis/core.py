@@ -79,6 +79,7 @@ class LintConfig:
         "store.seed", "device.step", "arena.spill",
         "checkpoint.save", "checkpoint.load",
         "serving.admit", "serving.step",
+        "shard.step", "shard.migrate", "fleet.reduce",
     )
 
     def in_scope(self, rel: str, prefixes: tuple) -> bool:

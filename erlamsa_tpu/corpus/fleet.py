@@ -1,0 +1,525 @@
+"""Elastic sharded corpus fleet: map seeds across per-shard arenas,
+reduce novelty/energy at a coordinator, survive shard loss by
+redistribution instead of host fallback.
+
+``--shards N`` routes run_corpus_batch here. The closed loop becomes a
+DrJAX-style map/reduce (PAPERS.md, arxiv 2403.07128) per case:
+
+  map     the coordinator draws ONE global schedule (the same
+          counter-keyed EnergyScheduler draw as the single-device
+          runner), partitions the batch's slots by each seed's stable
+          content-hash partition (parallel/shards.py), and every live
+          shard mutates+scores its slice against its OWN paged arena
+          (corpus/arena.py — one DeviceArena per shard, so corpus
+          capacity scales linearly with the fleet).
+  reduce  the coordinator forces every shard's future, merges results
+          by global slot, walks slots 0..batch-1 hashing outputs into
+          one global seen-set (hash-equal offspring arriving from two
+          shards credit energy ONCE), drains the feedback bus, writes
+          outputs and scatters score rows — exactly the single-device
+          finish path, so the scheduler state evolves identically.
+
+Determinism (the headline guarantee): device PRNG streams key on the
+GLOBAL slot index via make_class_fuzzer's ``indices`` argument — a
+sample's bytes are a pure function of (seed, case, slot) no matter which
+shard serves it — and placement is a pure function of the live-shard
+set. So an N-shard run is byte-identical to the 1-shard run at a fixed
+seed, a faulted run is byte-identical to the unfaulted run (migration
+moves WHERE work happens, never WHAT is computed), and replaying the
+recorded chaos spec reproduces the same failures, migrations and bytes.
+tests/test_fleet.py pins all three.
+
+Failure semantics (vs the single-device runner's all-or-nothing host
+fallback): a device error on one shard — real, or an injected
+``shard.step`` fault (services/chaos.py) — revokes that shard's lease
+(breaker records the failure), redistributes its partitions across
+survivors (pure recompute, migration logged), and re-dispatches the
+failed slice on its new owners WITHIN the same case. Losing 1 of N
+shards costs ~1/N capacity, not the device stream. Every
+DEVICE_PROBE_EVERY cases the coordinator probes dead shards; a probe
+success re-admits the shard (its arena is rebuilt lazily — seeds
+re-upload on first dispatch). Only a fleet with ZERO live shards falls
+back to the host oracle, per case, until a probe brings a shard back.
+
+Not yet wired here: --state checkpointing (single-device runner only)
+and the async drain worker (the fleet reduces at case boundaries; shard
+steps still overlap each other via JAX async dispatch within a case).
+"""
+
+from __future__ import annotations
+
+import sys
+import time
+
+import numpy as np
+
+from ..obs import flight, trace
+from ..parallel.shards import FleetPlacement, partition_of
+from ..services import chaos, logger, metrics, out
+from . import feedback as fb
+from .assembler import bucket_capacity
+from .energy import EnergyScheduler
+from .runner import DEVICE_PROBE_EVERY, _out_hash
+from .store import CorpusStore
+
+
+def merge_shard_results(parts) -> dict[int, bytes]:
+    """Reduce-side merge: shard sub-results (each a {global slot: bytes}
+    dict over disjoint slots) into one case-wide results dict. Raises on
+    overlap — two shards claiming one slot is a placement bug, and
+    silently letting the later shard win would make output bytes depend
+    on merge order."""
+    merged: dict[int, bytes] = {}
+    for part in parts:
+        for slot, payload in part.items():
+            if slot in merged:
+                raise RuntimeError(f"fleet reduce: slot {slot} produced "
+                                   f"by two shards")
+            merged[slot] = payload
+    return merged
+
+
+def apply_novelty(store, ids, results, seen_hashes, batch,
+                  tallies=None) -> int:
+    """The reduce step's novelty walk, shared with tests: slots
+    0..batch-1 in order, one GLOBAL seen-set — a hash first seen this
+    case credits energy exactly once no matter how many shards produced
+    hash-equal offspring. Returns the number of new hashes."""
+    new = 0
+    for slot in range(batch):
+        payload = results.get(slot, b"")
+        if tallies is not None:
+            tallies["bytes_out"] += len(payload)
+        h = _out_hash(payload)
+        if h not in seen_hashes:
+            seen_hashes.add(h)
+            new += 1
+            store.apply_event(fb.Event("new_hash", ids[slot]))
+    return new
+
+
+def run_corpus_fleet(opts: dict, batch: int = 1024) -> int:
+    """The --corpus DIR --shards N entry point (see module docstring)."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..constants import CAPACITY_CLASSES
+    from ..oracle.mutations import default_mutations
+    from ..ops import paged, prng
+    from ..ops.buffers import Batch, scan_bound, unpack
+    from ..ops.pipeline import (drain_futures, is_device_error,
+                                make_class_fuzzer, step_async)
+    from ..ops.registry import DEVICE_CODES
+    from ..ops.scheduler import init_scores
+    from .arena import RESERVED_PAGES, ZERO_PAGE, DeviceArena, _next_pow2, \
+        fit_page
+
+    raw_shards = opts.get("shards")
+    n_shards = int(raw_shards if raw_shards is not None else 1)
+    if n_shards < 1:
+        raise ValueError(f"--shards must be >= 1, got {n_shards}")
+    if opts.get("state_path"):
+        print("# fleet: --state checkpointing is single-device only; "
+              "ignoring", file=sys.stderr)
+
+    store = CorpusStore(opts["corpus_dir"])
+    fsck = store.fsck()
+    if fsck["missing"] or fsck["corrupt"] or fsck["orphans"]:
+        print(f"# corpus fsck: {fsck['ok']} ok, {fsck['missing']} missing, "
+              f"{fsck['corrupt']} corrupt, {fsck['orphans']} orphaned",
+              file=sys.stderr)
+    direct = opts.get("corpus")
+    if direct is not None:
+        for s in direct:
+            store.add(s, origin="direct")
+    else:
+        paths = [p for p in (opts.get("paths") or []) if p != "-"]
+        if paths:
+            from ..oracle.gen import _expand_paths
+
+            expanded = (_expand_paths(paths) if opts.get("recursive")
+                        else paths)
+            new, dup, skipped = store.add_paths(expanded)
+            print(f"# corpus: {new} new, {dup} duplicate, "
+                  f"{skipped} skipped -> {len(store)} seeds in store",
+                  file=sys.stderr)
+    if len(store) == 0:
+        print("no corpus (store empty and no readable seeds)",
+              file=sys.stderr)
+        return 1
+
+    selected = dict(opts.get("mutations") or default_mutations())
+    pri = [max(selected.get(code, 0), 0) for code in DEVICE_CODES]
+    if not any(pri):
+        print("none of the selected mutations runs on the TPU backend; "
+              f"device set: {','.join(DEVICE_CODES)}", file=sys.stderr)
+        return 1
+
+    device_max = int(opts.get("device_capacity_max", CAPACITY_CLASSES[-1]))
+    sched = EnergyScheduler(store, opts["seed"])
+    # no donation: shard futures from one case coexist until the reduce
+    # forces them, and a donated buffer consumed by shard A's step must
+    # not alias anything shard B still reads
+    step = make_class_fuzzer(mutator_pri=pri, donate=False)
+    base = prng.base_key(opts["seed"])
+    # host-resident score table: gathered per shard slice at dispatch,
+    # scattered back at the reduce — slices are disjoint by slot, so the
+    # evolution matches the single-device table exactly
+    scores = np.array(init_scores(jax.random.fold_in(base, 999), batch))
+    bus = opts.get("feedback_bus", fb.GLOBAL)
+    consume_feedback = bool(opts.get("feedback"))
+
+    # ONE capacity class over the WHOLE store (never per shard): the
+    # fused engine's streams are a function of the static row width, so
+    # shard-count byte-identity requires every shard to mutate at the
+    # same width the 1-shard run would use
+    sizes = [len(store.get(sid)) for sid in store.ids()]
+    trunc_cap = bucket_capacity(max(sizes), device_max=device_max)
+    page_opt = int(opts.get("arena_page") or paged.PAGE)
+    page = fit_page(page_opt, trunc_cap)
+    if page != page_opt:
+        print(f"# fleet: page size {page_opt} does not fit the "
+              f"{trunc_cap}B capacity class, using {page}", file=sys.stderr)
+    row_pages = trunc_cap // page
+
+    devices = jax.devices()
+    placement = FleetPlacement(n_shards, failure_threshold=1)
+
+    class _Shard:
+        """One lease-holder: a device slot plus its own paged arena,
+        sized for the shard's home partition (fleet capacity scales
+        linearly) with 2x slack for migrated partitions; overflow rides
+        the arena's host-overlay spill path."""
+
+        def __init__(self, shard_id: int):
+            self.id = shard_id
+            self.device = devices[shard_id % len(devices)]
+            home = [sid for sid in store.ids()
+                    if partition_of(sid, n_shards) == shard_id]
+            need = sum(max(1, -(-min(len(store.get(sid)), trunc_cap)
+                               // page)) for sid in home)
+            per_opt = opts.get("arena_pages")  # per-shard when given
+            num_pages = int(per_opt or RESERVED_PAGES + max(64, 2 * need))
+            num_pages = max(num_pages, RESERVED_PAGES + row_pages)
+            with jax.default_device(self.device):
+                self.arena = DeviceArena(num_pages, page=page,
+                                         row_pages=row_pages, donate=False)
+
+    shards = {s: _Shard(s) for s in range(n_shards)}
+
+    n_cases = int(opts.get("n", 1))
+    writer, _mt = out.string_outputs(opts.get("output", "-"))
+    stats = opts.get("_stats")
+    seen_hashes: set[bytes] = set()
+    tallies = {"truncated": 0, "total": 0, "new_hashes": 0, "bytes_out": 0,
+               "oracle_cases": 0, "redispatches": 0}
+    step_shapes: set[tuple] = set()
+
+    def shard_dispatch(shard: _Shard, case: int, slots: list[int],
+                       ids, samples):
+        """Map step for one shard's slice: ensure residency in the
+        shard's arena (idempotent — migrated seeds upload on first
+        touch), build the page table, gather, and dispatch one step
+        keyed on the GLOBAL slot indices. Returns (slots, rows, fut).
+        Raises on device error (incl. injected shard.step faults)."""
+        chaos.fault_point("shard.step")
+        arena = shard.arena
+        sub_ids = [ids[s] for s in slots]
+        sub_samples = [samples[s] for s in slots]
+        rows = len(slots)
+        t_a = time.perf_counter()
+        with jax.default_device(shard.device):
+            with trace.span("fleet.assemble", case=case, shard=shard.id,
+                            rows=rows):
+                for sid, data in zip(sub_ids, sub_samples):
+                    arena.ensure(sid, data, case)
+                arena.flush()
+                arena.maybe_defrag()
+                table, lens, spilled = arena.table_for(sub_ids, sub_samples,
+                                                       tick=case)
+            t_d = time.perf_counter()
+            # pow2 row padding bounds the compiled-shape set exactly like
+            # the bucket assembler: pad rows point at the zero page, get
+            # out-of-range slot indices, and their outputs are discarded
+            rows_p = max(8, _next_pow2(rows))
+            if rows_p > rows:
+                table = np.vstack([table, np.full(
+                    (rows_p - rows, row_pages), ZERO_PAGE, np.int32)])
+                lens = np.concatenate(
+                    [lens, np.zeros(rows_p - rows, np.int32)])
+            data_dev = arena.gather(table)
+            if spilled:
+                k = len(spilled)
+                kp = max(8, _next_pow2(k))
+                rows_idx = np.asarray(
+                    (spilled + [spilled[0]] * (kp - k))[:kp], np.int32)
+                panel = np.zeros((kp, trunc_cap), np.uint8)
+                for j, r in enumerate(spilled):
+                    s = sub_samples[r][:trunc_cap]
+                    panel[j, :len(s)] = np.frombuffer(s, np.uint8)
+                panel[k:] = panel[0]
+                data_dev = data_dev.at[rows_idx].set(panel)
+            idx = np.concatenate([
+                np.asarray(slots, np.int32),
+                batch + np.arange(rows_p - rows, dtype=np.int32),
+            ]).astype(np.int32)
+            gather = np.asarray([slots[j % rows] for j in range(rows_p)],
+                                np.int32)
+            sc_in = scores[gather]
+            sl = scan_bound(int(lens[:rows].max()) if rows else 0,
+                            trunc_cap)
+            step_shapes.add((rows_p, trunc_cap, sl))
+            with trace.span("fleet.dispatch", case=case, shard=shard.id,
+                            rows=rows):
+                fut = step_async(step, base, case, idx, data_dev, lens,
+                                 sc_in, scan_len=sl)
+        t_e = time.perf_counter()
+        metrics.GLOBAL.record_stage("assemble", t_d - t_a)
+        metrics.GLOBAL.record_stage("dispatch", t_e - t_d)
+        return slots, rows, fut
+
+    def probe_shard(shard: _Shard):
+        """One tiny forced op on the shard's device. The shard.step
+        fault point runs first so a still-armed persistent spec keeps
+        probes failing — re-admission happens exactly when the fault
+        clears (same discipline as the single-device runner's probe)."""
+        chaos.fault_point("shard.step")
+        with jax.default_device(shard.device):
+            jnp.zeros(8).block_until_ready()
+
+    def oracle_slots(case: int, ids, slots: list[int]) -> dict[int, bytes]:
+        """Last-resort host serve (fleet fully down): deterministic per
+        (seed, case, slot) — same stream as the single-device runner's
+        degraded mode, so even total-loss runs replay."""
+        from ..oracle.engine import fuzz as oracle_fuzz
+
+        a1, a2, a3 = opts["seed"]
+        muta = opts.get("mutations") or default_mutations()
+        results: dict[int, bytes] = {}
+        t_w = time.perf_counter()
+        with trace.span("fleet.oracle_fallback", case=case):
+            for slot in slots:
+                data = store.get(ids[slot])[:device_max]
+                results[slot] = oracle_fuzz(
+                    data, seed=(a1 + case, a2 + slot, a3), mutations=muta)
+        metrics.GLOBAL.record_stage("oracle_fallback",
+                                    time.perf_counter() - t_w)
+        return results
+
+    def revoke_shard(shard_id: int, case: int, err) -> dict:
+        """Lease revocation + redistribution. The shard.migrate fault
+        point guards the migration apply: an injected fault here forces
+        one idempotent re-apply (the assignment recompute is pure), so
+        the path is injectable without ever leaving partitions
+        unowned — outputs must not change (tests pin this)."""
+        logger.log("warning", "fleet: shard %d lost at case %d (%s) — "
+                   "redistributing its partitions", shard_id, case, err)
+        metrics.GLOBAL.record_event("shard_lost")
+        entry = placement.revoke(shard_id, case)
+        try:
+            chaos.fault_point("shard.migrate")
+        except OSError:
+            metrics.GLOBAL.record_event("shard_migrate_retry")
+            entry = {**entry, "retried": True}
+            placement.migrations[-1] = entry
+        flight.GLOBAL.note("shard_migration", migration="revoke",
+                           shard=shard_id, case=case, epoch=entry["epoch"],
+                           moved={str(k): v
+                                  for k, v in entry["moved"].items()})
+        metrics.GLOBAL.record_fleet(placement.snapshot())
+        return entry
+
+    def try_readmit(shard_id: int, case: int) -> bool:
+        """Probe a dead shard; on success re-grant its lease. The
+        shard.migrate fault point guards the re-grant — an injected
+        fault cancels re-admission (the shard stays dead until the next
+        probe window), exercising the probe-again path."""
+        try:
+            probe_shard(shards[shard_id])
+        except Exception:  # lint: broad-except-ok probe failure = shard still down
+            return False
+        try:
+            chaos.fault_point("shard.migrate")
+        except OSError:
+            metrics.GLOBAL.record_event("shard_readmit_aborted")
+            return False
+        # the old arena tensor died with the device: rebuild empty; its
+        # seeds re-upload lazily at the next dispatch that needs them
+        with jax.default_device(shards[shard_id].device):
+            shards[shard_id].arena.reset()
+        entry = placement.readmit(shard_id, case)
+        logger.log("warning", "fleet: shard %d re-admitted at case %d — "
+                   "taking its partitions back", shard_id, case)
+        metrics.GLOBAL.record_event("shard_readmitted")
+        flight.GLOBAL.note("shard_migration", migration="readmit",
+                           shard=shard_id, case=case, epoch=entry["epoch"],
+                           moved={str(k): v
+                                  for k, v in entry["moved"].items()})
+        metrics.GLOBAL.record_fleet(placement.snapshot())
+        return True
+
+    metrics.GLOBAL.record_fleet(placement.snapshot())
+    t0 = time.perf_counter()
+    probe_at = 0
+    case = 0
+    while case < n_cases:
+        # -- re-admission probes (case-counter gated, like the runner) --
+        if placement.dead() and case >= probe_at:
+            probe_at = case + DEVICE_PROBE_EVERY
+            for s in placement.dead():
+                try_readmit(s, case)
+
+        t_s = time.perf_counter()
+        with trace.span("fleet.schedule", case=case):
+            ids = sched.schedule(case, batch)
+            samples = [store.get(sid) for sid in ids]
+        metrics.GLOBAL.record_stage("schedule", time.perf_counter() - t_s)
+        if stats is not None:
+            stats.setdefault("schedules", []).append(list(ids))
+        trunc = sum(len(s) > trunc_cap for s in samples)
+        if trunc:
+            tallies["truncated"] += trunc
+            metrics.GLOBAL.record_truncated(trunc)
+
+        # -- map: partition slots by lease, dispatch shard slices ------
+        by_shard: dict[int, list[int]] = {}
+        host_slots: list[int] = []
+        for slot, sid in enumerate(ids):
+            owner = placement.owner_of(partition_of(sid, n_shards))
+            if owner is None:
+                host_slots.append(slot)
+            else:
+                by_shard.setdefault(owner, []).append(slot)
+        pending = sorted(by_shard.items())
+        launched: list[tuple[list[int], int, object]] = []
+        t_map = time.perf_counter()
+        try:
+            while pending:
+                shard_id, slots = pending.pop(0)
+                try:
+                    launched.append(shard_dispatch(shards[shard_id], case,
+                                                   slots, ids, samples))
+                except Exception as e:  # lint: broad-except-ok re-raised below unless is_device_error
+                    if not is_device_error(e):
+                        raise
+                    revoke_shard(shard_id, case, e)
+                    # the failed slice re-partitions onto its new owners
+                    # and re-dispatches WITHIN this case — same global
+                    # slot indices, so the re-served bytes are identical
+                    tallies["redispatches"] += 1
+                    requeue: dict[int, list[int]] = {}
+                    for slot in slots:
+                        owner = placement.owner_of(
+                            partition_of(ids[slot], n_shards))
+                        if owner is None:
+                            host_slots.append(slot)
+                        else:
+                            requeue.setdefault(owner, []).append(slot)
+                    merged = dict(pending)
+                    for owner, sl in requeue.items():
+                        merged[owner] = sorted(merged.get(owner, []) + sl)
+                    pending = sorted(merged.items())
+        except BaseException:  # lint: broad-except-ok re-raised after settling in-flight futures
+            # a non-device error mid-map must not strand the survivors'
+            # in-flight futures: settle them before unwinding
+            drain_futures(f for _sl, _r, f in launched)
+            raise
+        if host_slots:
+            tallies["oracle_cases"] += 1
+            logger.log("warning", "fleet: no live shards at case %d — "
+                       "host oracle serves %d slot(s)", case,
+                       len(host_slots))
+
+        # -- reduce: force futures, merge by slot, fold feedback in ----
+        try:
+            chaos.fault_point("fleet.reduce")
+        except OSError:
+            # the merge below is pure over futures the coordinator
+            # already owns: an injected reduce fault costs one logged
+            # re-apply, never data loss — outputs must not change
+            metrics.GLOBAL.record_event("fleet_reduce_retry")
+        parts: list[dict[int, bytes]] = []
+        t_r = time.perf_counter()
+        for slots, rows, fut in launched:
+            with trace.span("fleet.drain", case=case, rows=rows):
+                new_data, new_lens, new_sc, meta = fut.result()
+                outs = unpack(Batch(new_data[:rows], new_lens[:rows]))
+            parts.append({slot: outs[j] for j, slot in enumerate(slots)})
+            scores[np.asarray(slots, np.int32)] = new_sc[:rows]
+            applied = meta.applied[:rows].ravel()
+            applied = applied[applied >= 0]
+            if applied.size:
+                counts = np.bincount(applied, minlength=len(DEVICE_CODES))
+                for mi in np.nonzero(counts)[0]:
+                    metrics.GLOBAL.record_mutator(
+                        DEVICE_CODES[mi], applied=True, n=int(counts[mi]))
+        if host_slots:
+            parts.append(oracle_slots(case, ids, host_slots))
+        results = merge_shard_results(parts)
+        drain_s = time.perf_counter() - t_r
+        metrics.GLOBAL.record_stage("drain_wait", drain_s)
+        device_s = drain_s + (t_r - t_map)
+        metrics.GLOBAL.observe("batch_latency", device_s)
+
+        t_h = time.perf_counter()
+        before = tallies["bytes_out"]
+        with trace.span("fleet.hash", case=case):
+            tallies["new_hashes"] += apply_novelty(
+                store, ids, results, seen_hashes, batch, tallies)
+        tallies["total"] += len(results)
+        metrics.GLOBAL.record_stage("hash", time.perf_counter() - t_h)
+        metrics.GLOBAL.record_batch(len(results),
+                                    tallies["bytes_out"] - before, device_s)
+        if consume_feedback:
+            credit = sorted(set(ids))
+            for ev in bus.drain():
+                store.apply_event(ev, credit=credit)
+                logger.log("decision", "fleet: %s event from %s -> "
+                           "energy feedback", ev.kind, ev.source or "?")
+        t_o = time.perf_counter()
+        with trace.span("fleet.write", case=case):
+            for slot in range(batch):
+                payload = results.get(slot, b"")
+                if writer is not None:
+                    writer(case * batch + slot, payload, [])
+                else:
+                    sys.stdout.buffer.write(payload)
+        metrics.GLOBAL.record_stage("write", time.perf_counter() - t_o)
+        if stats is not None:
+            stats.setdefault("finish_times", []).append(time.perf_counter())
+        case += 1
+
+    store.save()
+    dt = time.perf_counter() - t0
+    metrics.GLOBAL.record_pipeline_wall(dt)
+    metrics.GLOBAL.record_fleet(placement.snapshot())
+    for shard in shards.values():
+        metrics.GLOBAL.record_arena(shard.arena.stats())
+    total, new_hashes = tallies["total"], tallies["new_hashes"]
+    if tallies["truncated"]:
+        print(f"# {tallies['truncated']} scheduled samples exceeded the "
+              f"fleet capacity class ({trunc_cap}B) and were truncated",
+              file=sys.stderr)
+    if stats is not None:
+        stats.update(total=total, dt=dt, batch=batch,
+                     new_hashes=new_hashes, pipeline="fleet",
+                     layout="fleet", shards=n_shards,
+                     fleet=placement.snapshot(),
+                     migrations=list(placement.migrations),
+                     oracle_cases=tallies["oracle_cases"],
+                     redispatches=tallies["redispatches"],
+                     step_shapes=sorted(step_shapes),
+                     arenas={s: sh.arena.stats()
+                             for s, sh in shards.items()},
+                     store_stats=store.stats())
+    logger.log("info", "corpus fleet (%d shards, %d live): %d samples in "
+               "%.2fs (%.0f samples/s), %d novel hashes, %d migration(s)",
+               n_shards, len(placement.live()), total, dt,
+               total / max(dt, 1e-9), new_hashes,
+               len(placement.migrations))
+    print(f"# {total} samples, {dt:.2f}s, {total / max(dt, 1e-9):.0f} "
+          f"samples/s (fleet, {n_shards} shards, {len(placement.live())} "
+          f"live), {new_hashes} novel hashes, "
+          f"{len(placement.migrations)} migration(s), "
+          f"{tallies['oracle_cases']} oracle case(s)", file=sys.stderr)
+    return 0

@@ -178,7 +178,17 @@ def run_corpus_batch(opts: dict, batch: int = 1024) -> int:
     from ..oracle.mutations import default_mutations
     from ..ops import prng
     from ..ops.buffers import Batch, scan_bound, unpack
-    from ..ops.pipeline import is_device_error, make_class_fuzzer, step_async
+    from ..ops.pipeline import (drain_futures, is_device_error,
+                                make_class_fuzzer, step_async)
+
+    shards = opts.get("shards")
+    if shards is not None:
+        # --shards N routes the whole run through the elastic fleet
+        # coordinator (corpus/fleet.py): per-shard arenas, breaker-aware
+        # placement, live redistribution on shard loss
+        from .fleet import run_corpus_fleet
+
+        return run_corpus_fleet(opts, batch=batch)
     from ..ops.registry import DEVICE_CODES
     from ..ops.scheduler import init_scores
     from ..services.checkpoint import (load_corpus_energies, load_state,
@@ -429,43 +439,50 @@ def run_corpus_batch(opts: dict, batch: int = 1024) -> int:
         launched = []
         scores_out = scores_in
         assemble_s = dispatch_s = 0.0
-        for plan in plans:
-            t_a = time.perf_counter()
-            with trace.span("corpus.assemble", case=case,
-                            capacity=plan.capacity):
-                b = materialize(plan, samples)
-            t_d = time.perf_counter()
-            chaos.fault_point("device.step")
-            # keys derive from the SLOT position (0..batch-1) so a
-            # sample's stream is a pure function of (seed, case, slot)
-            # no matter how the buckets partition the batch; pad rows get
-            # out-of-range indices — their outputs are discarded
-            idx = np.concatenate([
-                b.slots, batch + np.arange(b.pad_rows, dtype=np.int32)
-            ]).astype(np.int32)
-            gather = b.slots[np.arange(b.rows_padded) % b.rows]
-            sc_in = (jnp.take(scores_out, gather, axis=0) if use_async
-                     else scores_out[gather])
-            sl = scan_bound(int(b.lens[:b.rows].max()), b.capacity)
-            tallies["bytes_uploaded"] += (b.data.nbytes + b.lens.nbytes
-                                          + idx.nbytes)
-            step_shapes.add((b.rows_padded, b.capacity, sl))
-            with trace.span("corpus.dispatch", case=case,
-                            capacity=b.capacity, rows=b.rows):
-                fut = step_async(
-                    step, base, case, idx, b.data, b.lens, sc_in,
-                    scan_len=sl,
-                )
-            if use_async:
-                scores_out = scores_out.at[jnp.asarray(b.slots)].set(
-                    fut.scores[:b.rows]
-                )
-            else:
-                scores_out[b.slots] = np.asarray(fut.scores)[:b.rows]
-            launched.append((b, fut))
-            t_e = time.perf_counter()
-            assemble_s += t_d - t_a
-            dispatch_s += t_e - t_d
+        try:
+            for plan in plans:
+                t_a = time.perf_counter()
+                with trace.span("corpus.assemble", case=case,
+                                capacity=plan.capacity):
+                    b = materialize(plan, samples)
+                t_d = time.perf_counter()
+                chaos.fault_point("device.step")
+                # keys derive from the SLOT position (0..batch-1) so a
+                # sample's stream is a pure function of (seed, case, slot)
+                # no matter how the buckets partition the batch; pad rows get
+                # out-of-range indices — their outputs are discarded
+                idx = np.concatenate([
+                    b.slots, batch + np.arange(b.pad_rows, dtype=np.int32)
+                ]).astype(np.int32)
+                gather = b.slots[np.arange(b.rows_padded) % b.rows]
+                sc_in = (jnp.take(scores_out, gather, axis=0) if use_async
+                         else scores_out[gather])
+                sl = scan_bound(int(b.lens[:b.rows].max()), b.capacity)
+                tallies["bytes_uploaded"] += (b.data.nbytes + b.lens.nbytes
+                                              + idx.nbytes)
+                step_shapes.add((b.rows_padded, b.capacity, sl))
+                with trace.span("corpus.dispatch", case=case,
+                                capacity=b.capacity, rows=b.rows):
+                    fut = step_async(
+                        step, base, case, idx, b.data, b.lens, sc_in,
+                        scan_len=sl,
+                    )
+                if use_async:
+                    scores_out = scores_out.at[jnp.asarray(b.slots)].set(
+                        fut.scores[:b.rows]
+                    )
+                else:
+                    scores_out[b.slots] = np.asarray(fut.scores)[:b.rows]
+                launched.append((b, fut))
+                t_e = time.perf_counter()
+                assemble_s += t_d - t_a
+                dispatch_s += t_e - t_d
+        except BaseException:  # lint: broad-except-ok re-raised after settling in-flight futures
+            # a fault on bucket K's dispatch must not strand buckets
+            # 1..K-1's in-flight futures: settle them before the
+            # device-loss path (or the caller) touches device state
+            drain_futures(fut for _b, fut in launched)
+            raise
         metrics.GLOBAL.record_stage("assemble", assemble_s)
         metrics.GLOBAL.record_stage("dispatch", dispatch_s)
         return ids, launched, scores_out, dispatch_s
@@ -627,8 +644,6 @@ def run_corpus_batch(opts: dict, batch: int = 1024) -> int:
         jnp.zeros(8).block_until_ready()
 
     def _discard_work(work):
-        from ..ops.pipeline import drain_futures
-
         drain_futures(fut for _b, fut in work.launched)
 
     if use_async:

@@ -171,6 +171,31 @@ def render(counters: metrics.Counters | None = None) -> str:
         w.sample("erlamsa_arena_bytes_uploaded_total",
                  arena["bytes_uploaded"])
 
+    fleet = snap.get("fleet")
+    if fleet:
+        w.head("erlamsa_fleet_shards", "gauge",
+               "Configured corpus fleet shard count.")
+        w.sample("erlamsa_fleet_shards", fleet["shards"])
+        w.head("erlamsa_fleet_live_shards", "gauge",
+               "Shards currently holding a lease (breaker closed).")
+        w.sample("erlamsa_fleet_live_shards", fleet["live"])
+        w.head("erlamsa_fleet_epoch", "counter",
+               "Lease epoch: bumps on every revoke/readmit migration.")
+        w.sample("erlamsa_fleet_epoch", fleet["epoch"])
+        w.head("erlamsa_fleet_migrations_total", "counter",
+               "Partition migrations applied (revokes + readmits).")
+        w.sample("erlamsa_fleet_migrations_total", fleet["migrations"])
+        w.head("erlamsa_fleet_shard_partitions", "gauge",
+               "Partitions currently leased, by shard.")
+        for sid, lease in sorted(fleet["leases"].items()):
+            w.sample("erlamsa_fleet_shard_partitions",
+                     len(lease["partitions"]), {"shard": sid})
+        w.head("erlamsa_fleet_shard_live", "gauge",
+               "1 while the shard holds a live lease, by shard.")
+        for sid, lease in sorted(fleet["leases"].items()):
+            w.sample("erlamsa_fleet_shard_live",
+                     1 if lease["live"] else 0, {"shard": sid})
+
     serving = snap.get("serving")
     if serving:
         w.head("erlamsa_batcher_fill_efficiency", "gauge",

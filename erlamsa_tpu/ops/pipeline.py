@@ -96,10 +96,12 @@ def is_device_error(exc: BaseException) -> bool:
     (corpus/runner.py): a device error triggers the host-oracle fallback;
     anything else propagates as a real bug.
 
-    Injected ``device.step`` faults (services/chaos.py) count as device
-    errors by contract — that is exactly the failure they simulate."""
+    Injected ``device.step`` / ``shard.step`` faults (services/chaos.py)
+    count as device errors by contract — that is exactly the failure
+    they simulate (the corpus runner's single device, one fleet shard's
+    device)."""
     site = getattr(exc, "site", None)
-    if site == "device.step":
+    if site in ("device.step", "shard.step"):
         return True
     try:
         from jax.errors import JaxRuntimeError

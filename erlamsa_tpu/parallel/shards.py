@@ -1,0 +1,165 @@
+"""Shard placement for the elastic corpus fleet: stable content-hash
+partitions, breaker-aware leases, deterministic redistribution.
+
+The fleet coordinator (corpus/fleet.py) expresses the closed corpus loop
+as a DrJAX-style map/reduce (PAPERS.md, arxiv 2403.07128): the *map*
+step shards scheduled seeds across devices and mutates+scores each slice
+locally; the *reduce* step merges novelty/energy deltas at the
+coordinator. This module is the pure-host placement half — importable
+without jax, property-testable on any box (tests/test_fleet.py):
+
+- ``partition_of`` — a seed's home partition is a stable function of its
+  content hash (the store's sha256 seed id), never of arrival order or
+  shard count changes mid-run. Partition count == shard count, so at
+  full strength every shard serves exactly its home partition.
+- ``FleetPlacement`` — the lease table. Each shard is an endpoint in a
+  services/resilience.py ``HealthTable`` (per-shard CircuitBreaker +
+  EWMA score: the PR 5 machinery, finally pointed at corpus state). The
+  partition→shard assignment is a *pure function of the live-shard set*
+  (``assign_partitions``): a live shard owns its home partition, dead
+  shards' partitions round-robin across survivors in partition order.
+  That purity is the replay contract — a faulted run's placement history
+  is fully determined by (chaos spec, case counter), so the migration
+  log is a derived artifact, not load-bearing state.
+
+Determinism: no wall clock, no entropy. Breakers are built with
+``reset_timeout=0.0`` so OPEN→HALF_OPEN never waits on a clock; the
+coordinator gates re-admission probes by its *case counter*
+(DEVICE_PROBE_EVERY), the same discipline as the single-device runner.
+The HealthTable's pick() rng is seeded constant — the fleet never calls
+pick() (placement is computed, not drawn), the table is there for
+breaker state and /metrics.
+"""
+
+from __future__ import annotations
+
+import random
+
+from ..services.resilience import HealthTable
+
+
+def partition_of(seed_id: str, n_partitions: int) -> int:
+    """Home partition of a seed: the first 8 hex digits of its content
+    hash (corpus/store.seed_id_for, sha256) mod the partition count.
+    Stable across runs, processes and shard deaths — migration moves
+    partitions between shards, never seeds between partitions."""
+    if n_partitions < 1:
+        raise ValueError(f"need >= 1 partition, got {n_partitions}")
+    return int(seed_id[:8], 16) % n_partitions
+
+
+def assign_partitions(n_shards: int, live: set) -> dict[int, int | None]:
+    """partition -> owning shard, as a pure function of the live set.
+
+    A live shard owns its home partition. Dead shards' partitions are
+    dealt round-robin across the sorted survivors, in partition order —
+    so losing shard k of N costs the survivors ~1/(N-1) extra load each,
+    and any two coordinators with the same live set agree on placement
+    without talking. With no survivors every partition maps to None (the
+    coordinator's host-oracle last resort)."""
+    survivors = sorted(live)
+    owner: dict[int, int | None] = {}
+    dealt = 0
+    for p in range(n_shards):
+        if p in live:
+            owner[p] = p
+        elif survivors:
+            owner[p] = survivors[dealt % len(survivors)]
+            dealt += 1
+        else:
+            owner[p] = None
+    return owner
+
+
+class FleetPlacement:
+    """Lease table for one fleet run: which shard serves which partition,
+    with per-shard breaker/health state and a migration log.
+
+    Single-threaded by design — owned by the coordinator's dispatch
+    loop, like the arena allocator (corpus/arena.py docstring)."""
+
+    def __init__(self, n_shards: int, failure_threshold: int = 1):
+        if n_shards < 1:
+            raise ValueError(f"need >= 1 shard, got {n_shards}")
+        self.n_shards = int(n_shards)
+        self.epoch = 0  # bumps on every lease change (revoke/readmit)
+        # constant-seeded rng: pick() is never used for placement (see
+        # module docstring); the table carries breaker + health state
+        self.health = HealthTable(random.Random(0),
+                                  failure_threshold=failure_threshold,
+                                  reset_timeout=0.0)
+        self._live: set[int] = set(range(self.n_shards))
+        for s in range(self.n_shards):
+            self.health.touch(s)
+        self._owner = assign_partitions(self.n_shards, self._live)
+        self.migrations: list[dict] = []
+
+    # -- queries ---------------------------------------------------------
+
+    def live(self) -> list[int]:
+        return sorted(self._live)
+
+    def dead(self) -> list[int]:
+        return sorted(set(range(self.n_shards)) - self._live)
+
+    def is_live(self, shard: int) -> bool:
+        return shard in self._live
+
+    def owner_of(self, partition: int) -> int | None:
+        """The shard currently leasing `partition` (None: fleet down)."""
+        return self._owner[partition]
+
+    def partitions_of(self, shard: int) -> list[int]:
+        return [p for p, s in self._owner.items() if s == shard]
+
+    # -- transitions -----------------------------------------------------
+
+    def _migrate(self, case: int, kind: str, shard: int) -> dict:
+        """Recompute the assignment from the new live set and log the
+        delta. Returns the migration entry (also appended to the log)."""
+        old = self._owner
+        self._owner = assign_partitions(self.n_shards, self._live)
+        moved = {p: s for p, s in self._owner.items() if old[p] != s}
+        self.epoch += 1
+        entry = {"case": int(case), "epoch": self.epoch, "kind": kind,
+                 "shard": int(shard), "moved": moved}
+        self.migrations.append(entry)
+        return entry
+
+    def revoke(self, shard: int, case: int) -> dict:
+        """Shard lost (device error): record the breaker failure, drop it
+        from the live set, redistribute its partitions across survivors.
+        Returns the migration entry ({'moved': {partition: new_owner}})."""
+        self.health.report(shard, ok=False)
+        self._live.discard(shard)
+        return self._migrate(case, "revoke", shard)
+
+    def readmit(self, shard: int, case: int) -> dict:
+        """Probe succeeded: the shard rejoins and takes its home
+        partition(s) back (plus any round-robin share of other dead
+        shards' partitions the pure assignment deals it)."""
+        self.health.report(shard, ok=True)
+        self._live.add(shard)
+        return self._migrate(case, "readmit", shard)
+
+    # -- observability ---------------------------------------------------
+
+    def snapshot(self) -> dict:
+        """Gauge-style fleet state for metrics.record_fleet / the flight
+        recorder: lease table, per-shard breaker snapshots, epoch."""
+        health = self.health.stats()
+        return {
+            "shards": self.n_shards,
+            "live": len(self._live),
+            "epoch": self.epoch,
+            "migrations": len(self.migrations),
+            "leases": {
+                str(s): {
+                    "live": s in self._live,
+                    "partitions": self.partitions_of(s),
+                    "breaker": health.get(str(s), {}).get("state", "?"),
+                    "score": health.get(str(s), {}).get("score", 0.0),
+                }
+                for s in range(self.n_shards)
+            },
+        }

@@ -43,6 +43,18 @@ Known sites (grep `fault_point(` for the authoritative list):
                      HTTP 429 + Retry-After, never a connection abort
     serving.step     continuous engine's jitted slot step
                      (services/serving.py)
+    shard.step       one fleet shard's dispatch or re-admission probe
+                     (corpus/fleet.py): an injected fault revokes the
+                     shard's lease and redistributes its partitions
+                     across survivors — outputs must not change
+    shard.migrate    lease migration apply (corpus/fleet.py): on the
+                     revoke path an injected fault forces one idempotent
+                     re-apply (outputs unchanged); on the re-admission
+                     path it cancels the re-grant — the shard stays dead
+                     until the next probe window
+    fleet.reduce     the fleet coordinator's per-case merge
+                     (corpus/fleet.py): an injected fault costs one
+                     logged re-apply of the pure merge, never data loss
 
 Injected failures raise ``InjectedFault``, an OSError subclass, so they
 flow through exactly the except-clauses that catch real socket/disk

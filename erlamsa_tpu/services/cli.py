@@ -200,6 +200,14 @@ def build_parser() -> argparse.ArgumentParser:
                         "addressed through a page table — one compiled "
                         "step, ~zero padded waste, each seed crosses "
                         "PCIe once (corpus/arena.py)")
+    p.add_argument("--shards", type=int, default=None, metavar="N",
+                   help="elastic sharded corpus fleet (corpus/fleet.py): "
+                        "partition seeds across N per-shard arenas by "
+                        "content hash, merge novelty/energy at a "
+                        "coordinator. Byte-identical to --shards 1 at a "
+                        "fixed -s; a lost shard redistributes across "
+                        "survivors instead of falling back to the host "
+                        "(default: single-device runner)")
     p.add_argument("--arena-pages", type=int, default=None, metavar="N",
                    help="arena page count (default: 2x the pages the "
                         "store needs, min 64 — eviction/spill handle "
@@ -357,6 +365,7 @@ def main(argv=None) -> int:
         "feedback": args.feedback,
         "pipeline": args.pipeline,
         "layout": args.layout,
+        "shards": args.shards,
         "arena_pages": args.arena_pages,
         "arena_page": args.arena_page,
         "output": args.output,

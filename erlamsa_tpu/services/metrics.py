@@ -85,6 +85,10 @@ class Counters:
         self.faults: dict[str, int] = {}
         self.events: dict[str, int] = {}
         self.degraded = 0
+        # latest fleet placement snapshot (parallel/shards.py
+        # FleetPlacement.snapshot(): shards/live/epoch/migrations plus
+        # per-shard lease + breaker state) — gauge-style, set not summed
+        self.fleet: dict | None = None
         # latest serving-engine snapshot (services/serving.py stats() /
         # TpuBatcher.stats(): mode/slots/fill_efficiency/steps_per_request/
         # compiles) — gauge-style, set not summed
@@ -146,6 +150,12 @@ class Counters:
         """Latest arena health snapshot (corpus/arena.py stats())."""
         with self._lock:
             self.arena = dict(stats)
+
+    def record_fleet(self, stats: dict):
+        """Latest fleet placement snapshot (corpus/fleet.py): leases,
+        per-shard breaker state, migration epoch."""
+        with self._lock:
+            self.fleet = dict(stats)
 
     def record_serving(self, stats: dict):
         """Latest serving-engine snapshot (continuous or flush)."""
@@ -272,6 +282,7 @@ class Counters:
                             for cap, b in sorted(self.buckets.items())},
                 "truncated": self.truncated,
                 "arena": dict(self.arena) if self.arena else None,
+                "fleet": dict(self.fleet) if self.fleet else None,
                 "serving": dict(self.serving) if self.serving else None,
                 "rejected": dict(self.rejected),
                 "tenants": {t: dict(v)

@@ -169,6 +169,17 @@ class CircuitBreaker:
             self._failures = 0
             self._probing = False
 
+    def reset(self):
+        """Forget everything: CLOSED, zero failures, no probe in flight.
+        The eviction path (HealthTable.drop_stale) calls this so a
+        dropped endpoint that later re-registers — or any caller still
+        holding the NodeHealth — never resurrects a stale open breaker
+        and sits out a cool-down it no longer owes."""
+        with self._lock:
+            self._state = CLOSED
+            self._failures = 0
+            self._probing = False
+
     def record_failure(self):
         with self._lock:
             self._maybe_half_open()
@@ -253,13 +264,20 @@ class HealthTable:
 
     def drop_stale(self, max_age: float) -> list:
         """Remove endpoints silent for more than max_age (the keepalive
-        eviction); returns the dropped endpoints."""
+        eviction); returns the dropped endpoints. Each dropped node's
+        breaker is reset on the way out: staleness is an eviction, not a
+        failure verdict, so a re-admitted endpoint starts CLOSED instead
+        of inheriting an open circuit from its previous life."""
         now = time.monotonic()
         with self._lock:
             dead = [k for k, h in self._nodes.items()
                     if now - h.last_seen > max_age]
             for k in dead:
+                # table lock -> breaker lock matches report()'s nesting
+                self._nodes[k].breaker.reset()
                 del self._nodes[k]
+        for _ in dead:  # outside the lock: metrics/flight own their locks
+            metrics.GLOBAL.record_event("dropped_stale")
         return dead
 
     def report(self, endpoint, ok: bool):

@@ -28,6 +28,15 @@
 # compiled step shape for the arena run, and zero padded bytes wasted
 # (corpus/arena.py + ops/paged.py).
 #
+# scripts/tier1.sh --fleet-smoke additionally runs a tiny corpus batch
+# through the sharded fleet (corpus/fleet.py) three times on the CPU
+# host — 1 shard, 2 shards, and 2 shards with one injected shard kill
+# (ERLAMSA_FAULTS="shard.step:x1") — and asserts the fleet contract:
+# all three output streams byte-identical (PRNG streams key on the
+# GLOBAL slot, so shard count and migration never change bytes), the
+# kill redistributed within the case (no host-oracle fallback), and the
+# revoke/readmit migrations landed in the run stats.
+#
 # scripts/tier1.sh --serve-smoke additionally boots the faas server
 # with the continuous-batching engine (services/serving.py), checks one
 # request answers byte-identically to a flush-mode server at the same
@@ -44,6 +53,7 @@ bench_smoke=0
 chaos_smoke=0
 obs_smoke=0
 arena_smoke=0
+fleet_smoke=0
 serve_smoke=0
 lint=1
 while [ $# -gt 0 ]; do
@@ -52,6 +62,7 @@ while [ $# -gt 0 ]; do
     --chaos-smoke) chaos_smoke=1; shift ;;
     --obs-smoke) obs_smoke=1; shift ;;
     --arena-smoke) arena_smoke=1; shift ;;
+    --fleet-smoke) fleet_smoke=1; shift ;;
     --serve-smoke) serve_smoke=1; shift ;;
     --lint) lint=1; shift ;;
     --no-lint) lint=0; shift ;;
@@ -264,6 +275,65 @@ print(f"OBS_SMOKE={'ok' if ok else 'FAIL'} trace_events={len(xev)} "
       f"trace_ok={trace_ok} prom_ok={prom_ok}")
 sys.exit(0 if ok else 1)
 EOF
+  rc=$?
+fi
+
+if [ $rc -eq 0 ] && [ $fleet_smoke -eq 1 ]; then
+  echo "== fleet smoke: shard-count identity + injected shard kill =="
+  timeout -k 10 600 env JAX_PLATFORMS=cpu python - <<'EOF2'
+import os, shutil, sys, tempfile
+
+from erlamsa_tpu.corpus.runner import run_corpus_batch
+from erlamsa_tpu.services import chaos
+
+SEEDS = [bytes([65 + i]) * (30 * (i + 1)) for i in range(6)]
+
+
+def one_run(root, shards, spec=None):
+    chaos.configure(spec, seed=7)
+    outdir = os.path.join(root, "out")
+    os.makedirs(outdir)
+    stats = {}
+    rc = run_corpus_batch(
+        {
+            "corpus_dir": os.path.join(root, "corpus"),
+            "corpus": SEEDS,
+            "feedback": True,
+            "seed": (7, 7, 7),
+            "n": 3,
+            "output": os.path.join(outdir, "%n.out"),
+            "shards": shards,
+            "_stats": stats,
+        },
+        batch=8,
+    )
+    chaos.configure(None)
+    blob = b""
+    for f in sorted(os.listdir(outdir), key=lambda s: int(s.split(".")[0])):
+        blob += open(os.path.join(outdir, f), "rb").read()
+    return rc, blob, stats
+
+
+root = tempfile.mkdtemp(prefix="tier1_fleet_smoke_")
+try:
+    rc1, blob1, st1 = one_run(os.path.join(root, "s1"), 1)
+    rc2, blob2, st2 = one_run(os.path.join(root, "s2"), 2)
+    rc3, blob3, st3 = one_run(os.path.join(root, "kill"), 2,
+                              spec="shard.step:x1")
+finally:
+    shutil.rmtree(root, ignore_errors=True)
+kinds = [m["kind"] for m in st3["migrations"]]
+ok = (rc1 == rc2 == rc3 == 0 and blob1
+      and blob2 == blob1 and blob3 == blob1
+      and st2["oracle_cases"] == 0 and st2["migrations"] == []
+      and st3["oracle_cases"] == 0 and st3["redispatches"] >= 1
+      and kinds[:1] == ["revoke"] and "readmit" in kinds)
+print(f"FLEET_SMOKE={'ok' if ok else 'FAIL'} bytes={len(blob1)} "
+      f"identical_2shard={blob2 == blob1} identical_kill={blob3 == blob1} "
+      f"migrations={kinds} oracle_cases={st3['oracle_cases']} "
+      f"redispatches={st3['redispatches']}")
+sys.exit(0 if ok else 1)
+EOF2
   rc=$?
 fi
 

@@ -1,0 +1,324 @@
+"""Sharded corpus fleet tests: pure placement properties
+(parallel/shards.py), reduce-side merge/dedupe invariants, and the
+end-to-end guarantees corpus/fleet.py makes — N-shard byte-identity at a
+fixed seed, live redistribution on an injected shard kill, and
+deterministic replay of faulted runs from the recorded chaos spec.
+
+Fast chaos tests use pre-compile faults (shard.step fires before any
+engine compile), so total-loss paths run in well under a second on CPU;
+anything that pays an engine compile is @pytest.mark.slow."""
+
+import os
+
+import pytest
+
+from erlamsa_tpu.corpus import feedback as fb
+from erlamsa_tpu.corpus.fleet import apply_novelty, merge_shard_results
+from erlamsa_tpu.corpus.store import CorpusStore
+from erlamsa_tpu.obs import flight
+from erlamsa_tpu.parallel.shards import (FleetPlacement, assign_partitions,
+                                         partition_of)
+from erlamsa_tpu.services import chaos, metrics
+from erlamsa_tpu.services.resilience import CLOSED, HALF_OPEN, OPEN
+
+SEED = (7, 7, 7)  # the pinned fleet replay seed
+#: six seeds of distinct sizes so the schedule exercises several
+#: partitions and the capacity class is driven by the largest
+SEEDS = [bytes([65 + i]) * (30 * (i + 1)) for i in range(6)]
+
+
+@pytest.fixture(autouse=True)
+def _chaos_disarmed():
+    """Chaos state is process-global; every test starts and ends clean."""
+    chaos.configure(None)
+    yield
+    chaos.configure(None)
+    metrics.GLOBAL.set_degraded(False)
+
+
+# ---- partitioning (pure, jax-free) --------------------------------------
+
+
+def test_partition_of_is_stable_content_hash():
+    sid = "deadbeef" + "0" * 56
+    assert partition_of(sid, 4) == int("deadbeef", 16) % 4
+    # stable: same id, same partition, every call
+    assert partition_of(sid, 4) == partition_of(sid, 4)
+    assert partition_of(sid, 1) == 0
+    with pytest.raises(ValueError):
+        partition_of(sid, 0)
+
+
+def test_assign_partitions_full_strength_is_identity():
+    assert assign_partitions(4, {0, 1, 2, 3}) == {0: 0, 1: 1, 2: 2, 3: 3}
+
+
+def test_assign_partitions_deals_dead_round_robin():
+    # shards 1 and 2 dead: their partitions deal round-robin across the
+    # sorted survivors, in partition order
+    assert assign_partitions(4, {0, 3}) == {0: 0, 1: 0, 2: 3, 3: 3}
+    # pure function of the live set: any coordinator agrees
+    assert assign_partitions(4, {0, 3}) == assign_partitions(4, {3, 0})
+    # single survivor takes everything
+    assert assign_partitions(3, {1}) == {0: 1, 1: 1, 2: 1}
+
+
+def test_assign_partitions_empty_live_maps_to_none():
+    assert assign_partitions(3, set()) == {0: None, 1: None, 2: None}
+
+
+def test_placement_revoke_redistributes_and_opens_breaker():
+    p = FleetPlacement(4, failure_threshold=1)
+    assert p.live() == [0, 1, 2, 3] and p.epoch == 0
+    entry = p.revoke(1, case=3)
+    assert entry["kind"] == "revoke" and entry["case"] == 3
+    assert entry["epoch"] == 1 and entry["moved"] == {1: 0}
+    assert p.dead() == [1] and p.owner_of(1) == 0
+    snap = p.snapshot()
+    assert snap["live"] == 3 and snap["migrations"] == 1
+    assert snap["leases"]["1"]["live"] is False
+    # reset_timeout=0.0 means OPEN cools to HALF_OPEN the moment the
+    # state is read (no wall-clock waits in the fleet) — either way the
+    # breaker recorded the failure and is no longer CLOSED
+    assert snap["leases"]["1"]["breaker"] in (OPEN, HALF_OPEN)
+    assert snap["leases"]["0"]["breaker"] == CLOSED
+    # survivor 0 now leases its home partition plus the dead shard's
+    assert sorted(snap["leases"]["0"]["partitions"]) == [0, 1]
+
+
+def test_placement_readmit_restores_home_partition():
+    p = FleetPlacement(4, failure_threshold=1)
+    p.revoke(2, case=0)
+    entry = p.readmit(2, case=4)
+    assert entry["kind"] == "readmit" and entry["moved"] == {2: 2}
+    assert p.live() == [0, 1, 2, 3] and p.epoch == 2
+    assert p.snapshot()["leases"]["2"]["breaker"] == CLOSED
+    assert [m["kind"] for m in p.migrations] == ["revoke", "readmit"]
+
+
+def test_placement_total_loss_then_single_survivor():
+    p = FleetPlacement(2, failure_threshold=1)
+    p.revoke(0, case=0)
+    p.revoke(1, case=0)
+    assert p.live() == [] and all(
+        p.owner_of(q) is None for q in range(2))
+    p.readmit(1, case=4)
+    assert p.owner_of(0) == 1 and p.owner_of(1) == 1
+
+
+def test_fleet_snapshot_renders_in_prom_text():
+    from erlamsa_tpu.obs import prom
+
+    p = FleetPlacement(3, failure_threshold=1)
+    p.revoke(1, case=0)
+    metrics.GLOBAL.record_fleet(p.snapshot())
+    text = prom.render()
+    assert "erlamsa_fleet_shards 3" in text
+    assert "erlamsa_fleet_live_shards 2" in text
+    assert 'erlamsa_fleet_shard_live{shard="1"} 0' in text
+
+
+# ---- reduce-side merge + dedupe (pure, jax-free) ------------------------
+
+
+def test_merge_shard_results_rejects_slot_overlap():
+    assert merge_shard_results([{0: b"a"}, {1: b"b"}]) == {0: b"a",
+                                                          1: b"b"}
+    with pytest.raises(RuntimeError):
+        merge_shard_results([{0: b"a"}, {0: b"b"}])
+
+
+def test_reduce_dedupe_credits_hash_equal_offspring_once(tmp_path):
+    """ISSUE satellite: hash-equal offspring arriving from two shards
+    must credit new-hash energy exactly once — the reduce walks slots
+    0..batch-1 against one GLOBAL seen-set."""
+    store = CorpusStore(str(tmp_path / "c"))
+    sid_a, _ = store.add(b"seed aaaa", origin="direct")
+    sid_b, _ = store.add(b"seed bbbb", origin="direct")
+    ids = [sid_a, sid_b, sid_b, sid_a]
+    # slots 0 and 2 carry the SAME payload, as if two shards produced
+    # hash-equal offspring from different source seeds
+    results = {0: b"same offspring", 1: b"unique one",
+               2: b"same offspring", 3: b"unique two"}
+    new = apply_novelty(store, ids, results, set(), batch=4)
+    assert new == 3  # the duplicate payload counted once
+    # the credit landed on slot 0's source seed; slot 2's seed saw only
+    # its own unique payload — never a second credit for the duplicate
+    assert store.meta(sid_a)["events"].get("new_hash", 0) == 2
+    assert store.meta(sid_b)["events"].get("new_hash", 0) == 1
+
+
+def test_reduce_dedupe_is_global_across_cases(tmp_path):
+    store = CorpusStore(str(tmp_path / "c"))
+    sid, _ = store.add(b"seed", origin="direct")
+    seen = set()
+    assert apply_novelty(store, [sid], {0: b"x"}, seen, batch=1) == 1
+    # the same payload next case is no longer novel
+    assert apply_novelty(store, [sid], {0: b"x"}, seen, batch=1) == 0
+    assert store.meta(sid)["events"]["new_hash"] == 1
+
+
+# ---- end-to-end harness -------------------------------------------------
+
+
+def _run_fleet(tmp_path, tag, shards, spec=None, n=3, batch=8,
+               opts_extra=None):
+    """One fleet (or, with shards=None, single-device runner) corpus run
+    into per-case output files; returns (rc, concatenated bytes, stats)."""
+    from erlamsa_tpu.corpus.runner import run_corpus_batch
+
+    chaos.configure(spec, seed=SEED[0])
+    outdir = tmp_path / f"out-{tag}"
+    outdir.mkdir()
+    stats: dict = {}
+    opts = {
+        "corpus_dir": str(tmp_path / f"corpus-{tag}"),
+        "corpus": list(SEEDS),
+        "seed": SEED,
+        "n": n,
+        "feedback": True,
+        "output": str(outdir / "%n.out"),
+        "_stats": stats,
+    }
+    if shards is not None:
+        opts["shards"] = shards
+    if opts_extra:
+        opts.update(opts_extra)
+    rc = run_corpus_batch(opts, batch=batch)
+    chaos.configure(None)
+    blob = b""
+    for name in sorted(os.listdir(outdir),
+                       key=lambda s: int(s.split(".")[0])):
+        with open(outdir / name, "rb") as f:
+            blob += f.read()
+    return rc, blob, stats
+
+
+# ---- end-to-end: total loss + chaos sites (fast — pre-compile faults) ---
+
+
+def test_fleet_total_loss_serves_oracle_and_replays(tmp_path):
+    """Persistent shard.step faults kill every shard before any compile:
+    the fleet completes per-case from the host oracle (the only path to
+    the host fallback), the kills are visible in metrics + the flight
+    ring, and the faulted run replays byte-for-byte from the spec."""
+    ring_before = len(flight.GLOBAL._ring)
+    rc, blob, stats = _run_fleet(tmp_path, "kill", shards=2,
+                                 spec="shard.step:*")
+    assert rc == 0 and blob
+    assert stats["oracle_cases"] == stats["total"] // stats["batch"]
+    assert stats["fleet"]["live"] == 0 and stats["fleet"]["shards"] == 2
+    assert [m["kind"] for m in stats["migrations"]] == ["revoke", "revoke"]
+    snap = metrics.GLOBAL.snapshot()
+    assert snap["fleet"]["live"] == 0
+    assert snap["resilience"]["events"].get("shard_lost", 0) >= 2
+    assert snap["resilience"]["faults"].get("shard.step", 0) >= 2
+    notes = [e for e in list(flight.GLOBAL._ring)[ring_before:]
+             if e.get("kind") == "shard_migration"]
+    assert len(notes) >= 2
+    assert all(n["migration"] == "revoke" for n in notes)
+    # replay: same spec + seed reproduces the same failures and bytes
+    rc2, blob2, stats2 = _run_fleet(tmp_path, "kill2", shards=2,
+                                    spec="shard.step:*")
+    assert rc2 == 0 and blob2 == blob
+    assert stats2["migrations"] == stats["migrations"]
+
+
+def test_fleet_migrate_fault_forces_idempotent_reapply(tmp_path):
+    """A shard.migrate fault on the revoke path costs one logged
+    re-apply of the pure assignment — partitions are never left
+    unowned and output bytes do not change."""
+    rc, blob, stats = _run_fleet(tmp_path, "mig", shards=2,
+                                 spec="shard.step:*,shard.migrate:*")
+    rc2, blob2, _ = _run_fleet(tmp_path, "nomig", shards=2,
+                               spec="shard.step:*")
+    assert rc == rc2 == 0 and blob == blob2
+    assert all(m.get("retried") for m in stats["migrations"])
+    ev = metrics.GLOBAL.snapshot()["resilience"]["events"]
+    assert ev.get("shard_migrate_retry", 0) >= 2
+
+
+def test_fleet_reduce_fault_retries_without_data_loss(tmp_path):
+    """A fleet.reduce fault costs one logged re-apply of the pure
+    merge — outputs are unchanged vs the same run without the fault."""
+    rc, blob, _ = _run_fleet(tmp_path, "red", shards=2,
+                             spec="shard.step:*,fleet.reduce:x1")
+    rc2, blob2, _ = _run_fleet(tmp_path, "nored", shards=2,
+                               spec="shard.step:*")
+    assert rc == rc2 == 0 and blob == blob2
+    ev = metrics.GLOBAL.snapshot()["resilience"]["events"]
+    assert ev.get("fleet_reduce_retry", 0) >= 1
+
+
+def test_fleet_rejects_bad_shard_count(tmp_path):
+    with pytest.raises(ValueError):
+        _run_fleet(tmp_path, "bad", shards=0)
+
+
+# ---- end-to-end: byte-identity + live redistribution (compile tier) -----
+
+
+@pytest.mark.slow
+def test_fleet_shard_count_byte_identity(tmp_path):
+    """ISSUE acceptance: at a fixed seed the output byte stream is
+    independent of shard count AND identical to the single-device
+    runner — device PRNG streams key on the GLOBAL slot index, so
+    partitioning moves where work happens, never what is computed."""
+    rc0, base, _ = _run_fleet(tmp_path, "runner", shards=None,
+                              opts_extra={"pipeline": "sync",
+                                          "layout": "arena"})
+    blobs = {}
+    for n_shards in (1, 2, 4):
+        rc, blob, stats = _run_fleet(tmp_path, f"s{n_shards}",
+                                     shards=n_shards)
+        assert rc == 0 and stats["oracle_cases"] == 0
+        assert stats["migrations"] == []
+        blobs[n_shards] = blob
+    assert rc0 == 0
+    assert blobs[1] == base
+    assert blobs[2] == base
+    assert blobs[4] == base
+
+
+@pytest.mark.slow
+def test_fleet_kill_one_of_four_redistributes_and_replays(tmp_path):
+    """ISSUE acceptance: an injected kill of one shard revokes its
+    lease, redistributes its partition across the 3 survivors WITHIN
+    the case (no host-oracle fallback), re-admits the shard at the next
+    probe window, and the whole faulted run is byte-identical both to
+    the clean run and to its own replay from the recorded spec."""
+    rc0, clean, _ = _run_fleet(tmp_path, "clean", shards=4, n=4)
+    ring_before = len(flight.GLOBAL._ring)
+    rc, blob, stats = _run_fleet(tmp_path, "faulted", shards=4, n=4,
+                                 spec="shard.step:x1")
+    assert rc0 == rc == 0
+    assert blob == clean  # migration moved work, not bytes
+    assert stats["oracle_cases"] == 0  # survivors served — no host path
+    assert stats["redispatches"] >= 1
+    kinds = [m["kind"] for m in stats["migrations"]]
+    assert kinds == ["revoke", "readmit"]
+    assert stats["fleet"]["live"] == 4  # re-admitted by the end
+    snap = metrics.GLOBAL.snapshot()
+    assert snap["resilience"]["events"].get("shard_lost", 0) >= 1
+    assert snap["resilience"]["events"].get("shard_readmitted", 0) >= 1
+    notes = [e for e in list(flight.GLOBAL._ring)[ring_before:]
+             if e.get("kind") == "shard_migration"]
+    assert [n["migration"] for n in notes] == ["revoke", "readmit"]
+    # replay from the recorded chaos spec: same failures, same
+    # migrations, same bytes
+    rc2, blob2, stats2 = _run_fleet(tmp_path, "replay", shards=4, n=4,
+                                    spec="shard.step:x1")
+    assert rc2 == 0 and blob2 == blob
+    assert stats2["migrations"] == stats["migrations"]
+
+
+@pytest.mark.slow
+def test_fleet_capacity_class_is_global(tmp_path):
+    """The capacity class is computed over the WHOLE store, never per
+    shard: a fleet whose largest seed lives on one shard still mutates
+    every slice at the same row width (one step shape per scan bound),
+    which is what makes shard-count identity possible at all."""
+    rc, _, stats = _run_fleet(tmp_path, "cap", shards=4, n=2)
+    assert rc == 0
+    widths = {shape[1] for shape in stats["step_shapes"]}
+    assert len(widths) == 1

@@ -245,8 +245,31 @@ def test_health_table_drop_stale():
     t.touch("a")
     time.sleep(0.05)
     t.touch("b")
+    before = metrics.GLOBAL.snapshot()["resilience"]["events"]
     assert set(t.drop_stale(0.03)) == {"a"}
     assert t.endpoints() == ["b"]
+    # eviction is counted in the resilience block
+    ev = metrics.GLOBAL.snapshot()["resilience"]["events"]
+    assert ev.get("dropped_stale", 0) == before.get("dropped_stale", 0) + 1
+
+
+def test_health_table_drop_stale_resets_breaker():
+    """Staleness is an eviction, not a failure verdict: a dropped
+    endpoint's breaker is reset on the way out, so a caller still
+    holding the NodeHealth (or a later re-registration racing the old
+    record) never inherits a stale open circuit."""
+    import random
+
+    t = HealthTable(random.Random(1), failure_threshold=1,
+                    reset_timeout=30.0)
+    t.touch("a")
+    t.report("a", False)
+    held = t._nodes["a"]  # a caller keeping the record across eviction
+    assert held.breaker.state == OPEN
+    time.sleep(0.05)
+    t.touch("b")
+    assert t.drop_stale(0.03) == ["a"]
+    assert held.breaker.state == CLOSED and held.breaker.allow()
 
 
 # ---- durable checkpoint -------------------------------------------------
@@ -468,11 +491,18 @@ def test_transparent_faults_byte_identical(tmp_path):
 
 
 @pytest.mark.slow
-def test_device_recovery_resumes_pipeline(tmp_path):
+@pytest.mark.parametrize("pipeline", ["async", "sync"])
+def test_device_recovery_resumes_pipeline(tmp_path, pipeline):
     """A transient device fault degrades, then a probe brings the device
-    pipeline back (device_recovered) and the run still completes."""
-    rc, blob = _run_corpus(tmp_path, "recover", spec="device.step:x1",
-                           n=8)
+    pipeline back (device_recovered) and the run still completes.
+
+    Regression pin (both pipelines, async especially): a successful
+    DEVICE_PROBE_EVERY probe must CLEAR the degraded flag — recovery
+    that leaves degraded=1 in /metrics turns every dashboard red for
+    the rest of the run."""
+    metrics.GLOBAL.set_degraded(False)
+    rc, blob = _run_corpus(tmp_path, f"recover-{pipeline}",
+                           spec="device.step:x1", n=8, pipeline=pipeline)
     assert rc == 0 and blob
     res = metrics.GLOBAL.snapshot()["resilience"]
     assert res["events"].get("device_lost", 0) >= 1
