@@ -236,6 +236,73 @@ def _run_corpus_stage(batch_n: int, seed_len: int, cases: int, t0: float,
     return warm_sps, waste, stats.get("new_hashes", 0), stats
 
 
+def _mixed_seeds(count: int) -> list[bytes]:
+    """Deterministic mixed-size corpus for the ragged-arena stage:
+    ~70% <= 256B, ~25% <= 4KB, ~5% <= 64KB — the real-world size skew
+    the r12 capacity classes exist for. Lengths are chosen so the auto
+    class set resolves to exactly {256, 4096, 65536} under the default
+    growth slack; contents are distinct per index so store dedup keeps
+    every seed."""
+    seeds = []
+    for i in range(count):
+        r = i % 20
+        if r < 14:
+            n = 64 + (i * 17) % 65  # <= 128 -> 256B class
+        elif r < 19:
+            n = 300 + (i * 131) % 1749  # <= 2048 -> 4KB class
+        else:
+            n = 17000 + (i * 977) % 15769  # <= 32768 -> 64KB class
+        m = i * 31 + 7
+        seeds.append(bytes((j * m + i) % 251 for j in range(n)))
+    return seeds
+
+
+def _run_mixed_arena_stage(batch_n: int, cases: int, t0: float,
+                           classes_spec, tag: str):
+    """The r12 ragged-arena scenario: a mixed-size corpus through the
+    paged arena at `classes_spec` (None = auto-derived per-bucket
+    classes, an explicit single width = the r9 one-class arena). The
+    interesting spread is bytes GATHERED per sample: one width pays the
+    widest row for every seed, capacity classes pay each seed's own
+    bucket width. Returns (warm_samples_per_sec, stats)."""
+    import shutil
+    import tempfile
+
+    from erlamsa_tpu.corpus.runner import run_corpus_batch
+
+    seeds = _mixed_seeds(max(batch_n, 40))
+    stats: dict = {}
+    tmpdir = tempfile.mkdtemp(prefix="erlamsa_mixed_bench_")
+    try:
+        opts = {
+            "corpus_dir": tmpdir,
+            "corpus": seeds,
+            "feedback": True,
+            "seed": (1, 2, 3),
+            "n": max(2, cases),
+            "output": os.devnull,
+            "_stats": stats,
+            "pipeline": "async",
+            "layout": "arena",
+            "arena_classes": classes_spec,
+        }
+        rc = run_corpus_batch(opts, batch=batch_n)
+    finally:
+        shutil.rmtree(tmpdir, ignore_errors=True)
+    if rc != 0 or len(stats.get("finish_times", [])) < 2:
+        raise RuntimeError(f"mixed arena stage failed rc={rc} stats={stats}")
+    ft = stats["finish_times"]
+    warm_sps = batch_n * (len(ft) - 1) / (ft[-1] - ft[0])
+    gps = stats["arena"]["bytes_gathered"] / max(stats["total"], 1)
+    _phase(
+        f"mixed-arena stage ({tag}): {warm_sps:,.0f} samples/s warm, "
+        f"classes={sorted(stats['arena']['classes'])} "
+        f"gathered/sample={gps:,.0f}B "
+        f"uploaded={stats.get('bytes_uploaded', 0):,}B", t0,
+    )
+    return warm_sps, stats
+
+
 def _run_fleet_stage(batch_n: int, seed_len: int, cases: int, t0: float,
                      shards: int, spec: str | None = None):
     """Sharded corpus fleet (corpus/fleet.py, `--shards N`): the same
@@ -432,6 +499,48 @@ def child_main() -> None:
                 _write_result(line)
         except Exception as e:  # noqa: BLE001 — earlier numbers stand
             _phase(f"corpus stage FAILED: {type(e).__name__}: {e}", t0)
+
+    # mixed-size arena stage (r12): the same mixed-size corpus (70%
+    # <=256B / 25% <=4KB / 5% <=64KB) through the ragged arena with
+    # auto capacity classes vs the r9-style single-width arena. The
+    # headline is bytes gathered per sample: one width pays the widest
+    # resident row for EVERY seed; classes pay each seed's own bucket
+    # width, at no samples/s cost. ERLAMSA_BENCH_MIXED=0 skips.
+    if os.environ.get("ERLAMSA_BENCH_MIXED", "1") != "0":
+        try:
+            mcases = max(2, ITERS // 3)
+            r_sps, r_st = _run_mixed_arena_stage(BATCH, mcases, t0,
+                                                 None, "ragged")
+            s_sps, s_st = _run_mixed_arena_stage(BATCH, mcases, t0,
+                                                 "65536", "single-class")
+            r_g = r_st["arena"]["bytes_gathered"] / max(r_st["total"], 1)
+            s_g = s_st["arena"]["bytes_gathered"] / max(s_st["total"], 1)
+            record["mixed_ragged_samples_per_sec"] = round(r_sps, 1)
+            record["mixed_single_class_samples_per_sec"] = round(s_sps, 1)
+            record["mixed_ragged_gather_bytes_per_sample"] = round(r_g, 1)
+            record["mixed_single_class_gather_bytes_per_sample"] = round(
+                s_g, 1)
+            record["mixed_gather_reduction"] = round(s_g / r_g, 1) \
+                if r_g else 0.0
+            record["mixed_ragged_upload_bytes_per_sample"] = round(
+                r_st["bytes_uploaded"] / max(r_st["total"], 1), 1)
+            page_sz = r_st["arena"]["page_size"]
+            record["mixed_class_report"] = {
+                cap: {
+                    "rows": r_st["buckets"].get(int(cap), {}).get("rows", 0),
+                    "gather_bytes_per_sample": int(cap),
+                    "upload_bytes_per_seed": (
+                        c["pages"] * page_sz // max(c["resident_seeds"], 1)
+                    ),
+                    "resident_seeds": c["resident_seeds"],
+                }
+                for cap, c in sorted(r_st["arena"]["classes"].items(),
+                                     key=lambda kv: int(kv[0]))
+            }
+            line = json.dumps(record)
+            _write_result(line)
+        except Exception as e:  # noqa: BLE001 — earlier numbers stand
+            _phase(f"mixed-arena stage FAILED: {type(e).__name__}: {e}", t0)
 
     # fleet stage (r11): the sharded corpus fleet at shards 1/2/4 — the
     # same shape and seed, byte-identical outputs, so the samples/s

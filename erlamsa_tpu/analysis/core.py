@@ -76,7 +76,7 @@ class LintConfig:
     #: untestable (the chaos.py docstring's site list, kept honest)
     chaos_expected_sites: tuple = (
         "dist.send", "dist.recv", "batcher.step", "store.save",
-        "store.seed", "device.step", "arena.spill",
+        "store.seed", "device.step", "arena.spill", "arena.adopt",
         "checkpoint.save", "checkpoint.load",
         "serving.admit", "serving.step",
         "shard.step", "shard.migrate", "fleet.reduce",

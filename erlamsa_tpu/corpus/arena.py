@@ -9,45 +9,58 @@ through an int32 page table, the Ragged Paged Attention layout
 (PAPERS.md, arxiv 2604.15464) applied to the corpus:
 
   * `PageAllocator` — pure-host bookkeeping: a free list of page ids,
-    per-seed page runs, pin counts (a pinned run is referenced by the
-    case being assembled and must not be evicted), LRU eviction by
-    last-scheduled case, and defrag compaction that renumbers live
-    pages toward the front of the arena for gather locality.
-  * `DeviceArena` — the allocator plus the device tensor: `ensure()`
-    admits a seed's bytes as zero-padded pages (ONE upload per seed,
-    pow2-chunked so admission compiles O(log) programs), `table_for()`
-    builds a batch's page table + true-length vector, `gather()` pulls
-    the working buffer for the mutation step, `adopt()` scatters
-    device-resident output rows back in as new runs without a host
-    round trip, and `reset()` rebuilds after device loss.
+    per-seed page runs tagged with a CAPACITY CLASS, pin counts (a
+    pinned run is referenced by the case being assembled and must not
+    be evicted), class-aware LRU eviction by last-scheduled case, and
+    defrag compaction that renumbers live pages toward the front of the
+    arena grouped by class for gather locality.
+  * `DeviceArena` — the allocator plus the device tensor, with RAGGED
+    rows over ONE physical page size: a small ascending set of capacity
+    classes (``classes=(256, 4096, 65536)``-style), each with its own
+    page-table width, so a case's gather reads only a row's live pages
+    instead of padding every seed to the widest resident one. `ensure()`
+    admits a seed's bytes into the smallest class that fits (ONE upload
+    per seed, pow2-chunked), `tables_for()` builds one page table PER
+    CLASS for a scheduled batch, `gather()` pulls a class's working
+    buffer, and `adopt_pending()` scatters interesting offspring
+    straight from a step's device-resident OUTPUT buffer into free
+    pages of the right class (ops/paged.adopt_rows) — only content
+    hashes and lengths ever cross PCIe for adopted seeds.
 
 Spill-to-host: when the arena cannot hold a scheduled seed (pages
 exhausted even after eviction, or an injected ``arena.spill`` chaos
 fault), the seed stays host-resident for that case — its table row
 points at the zero page and the runner overlays the row from host
 bytes. Spills cost one extra upload but never change output bytes; the
-chaos test pins that transparency.
+chaos test pins that transparency. Device-side adoption has the same
+contract behind the ``arena.adopt`` site: a faulted adoption batch
+falls back to the host-upload path (the store listener already queued
+the seed), byte-identically.
 
 Determinism: page ids depend only on the deterministic call sequence
-(alloc order, eviction order by (last_used, seed id), LIFO free-list
-reuse) — no clocks, no thread timing. The `tick` every call takes is
-the case counter, so at a fixed -s two runs allocate identically.
+(alloc order, eviction order by (class preference, last_used, seed id),
+LIFO free-list reuse) — no clocks, no thread timing. The `tick` every
+call takes is the case counter, so at a fixed -s two runs allocate
+identically.
 
 Threading: the allocator and the device tensor are owned by the main
 dispatch thread. Only the admission queue (`enqueue`, fed by the store
-listener from service threads) is shared, and it is lock-guarded.
+listener from service threads) and the adoption queue (`enqueue_adopt`,
+fed by the drain worker) are shared, and they are lock-guarded.
 """
 
 from __future__ import annotations
 
-from typing import Callable, Sequence
+from typing import Callable, NamedTuple, Sequence
 
+import math
 import threading
 
 import numpy as np
 
 from ..obs import trace
 from ..services import chaos
+from .assembler import bucket_capacity
 
 #: re-exported reserved-page convention (ops/paged.py is jax-importing;
 #: the allocator half of this module must stay importable without it)
@@ -63,10 +76,10 @@ def _next_pow2(n: int) -> int:
 def fit_page(page: int, cap: int) -> int:
     """Largest power of two <= `page` that divides the row capacity
     `cap`. A page that does not divide the capacity would make resident
-    rows (row_pages * page wide) narrower than the truncation cap —
-    lengths past the row width, spill overlays with mismatched shapes —
-    so the runner rounds the requested page through this before
-    building the arena. Always >= 1 (1 divides everything)."""
+    rows narrower than their class cap — lengths past the row width,
+    spill overlays with mismatched shapes — so the runner rounds the
+    requested page through this before building the arena. Always >= 1
+    (1 divides everything)."""
     if page <= 0:
         raise ValueError(f"page size must be positive, got {page}")
     if cap <= 0:
@@ -74,6 +87,56 @@ def fit_page(page: int, cap: int) -> int:
     page = min(int(page), int(cap))
     # pow2 floor of the request, then the largest pow2 dividing cap
     return min(1 << (page.bit_length() - 1), cap & -cap)
+
+
+def fit_page_classes(page: int, classes: Sequence[int]) -> int:
+    """fit_page against a whole class set: the page must divide EVERY
+    class width, so fit against their gcd."""
+    g = 0
+    for c in classes:
+        g = math.gcd(g, int(c))
+    return fit_page(page, g)
+
+
+def resolve_classes(spec, sizes: Sequence[int],
+                    device_max: int) -> tuple[int, ...]:
+    """Resolve an ``--arena-classes`` spec into the run's ascending
+    capacity-class tuple.
+
+    None/"auto" derives the exact set of bucket capacities the stored
+    seeds occupy — every seed then mutates at the same width the bucket
+    assembler would give it, so arena==buckets byte-identity holds by
+    construction. An explicit spec ("256,4096,65536" or a sequence of
+    ints) is honored as given, clamped to the device cap; seeds whose
+    bucket capacity falls between two classes route UP to the next
+    class (a wider row changes that seed's stream vs buckets — the
+    documented trade for a bounded compiled-shape set)."""
+    if spec in (None, "", "auto"):
+        caps = {bucket_capacity(n, device_max=device_max) for n in sizes}
+        if not caps:
+            caps = {bucket_capacity(0, device_max=device_max)}
+        return tuple(sorted(caps))
+    if isinstance(spec, str):
+        parts = [p for p in spec.replace(",", " ").split() if p]
+        spec = [int(p) for p in parts]
+    caps = sorted({min(int(c), int(device_max)) for c in spec})
+    if not caps or caps[0] <= 0:
+        raise ValueError(f"arena classes must be positive, got {spec!r}")
+    return tuple(caps)
+
+
+class ClassTable(NamedTuple):
+    """One capacity class's slice of a scheduled batch: the per-class
+    page table tables_for() builds. `rows` are positions in the
+    scheduled list (schedule order preserved); `spilled` are indices
+    INTO `rows` (local) whose seeds ride the host-overlay path."""
+
+    cls: int  # class index into DeviceArena.classes
+    capacity: int  # class width in bytes
+    rows: np.ndarray  # int32[k] positions in the scheduled batch
+    table: np.ndarray  # int32[k, capacity // page]
+    lens: np.ndarray  # int32[k] true lengths
+    spilled: list  # local indices into rows
 
 
 class PageAllocator:
@@ -97,9 +160,14 @@ class PageAllocator:
         self._lens: dict[str, int] = {}
         self._pins: dict[str, int] = {}
         self._last_used: dict[str, int] = {}
+        self._cls: dict[str, int] = {}
         self.evictions = 0
         self.defrags = 0
         self.frees_since_defrag = 0
+        # per-class counters (class index -> count), carried across
+        # device-loss resets like evictions/defrags
+        self.class_evictions: dict[int, int] = {}
+        self.class_defrag_moves: dict[int, int] = {}
 
     # -- queries ---------------------------------------------------------
 
@@ -118,16 +186,29 @@ class PageAllocator:
     def length(self, sid: str) -> int:
         return self._lens[sid]
 
+    def cls_of(self, sid: str) -> int:
+        return self._cls[sid]
+
     def occupancy(self) -> float:
         usable = self.num_pages - RESERVED_PAGES
         return 1.0 - len(self._free) / usable if usable else 0.0
 
+    def class_usage(self) -> dict[int, tuple[int, int]]:
+        """class index -> (resident seeds, pages held)."""
+        usage: dict[int, tuple[int, int]] = {}
+        for sid, cls in self._cls.items():
+            seeds, pages = usage.get(cls, (0, 0))
+            usage[cls] = (seeds + 1, pages + len(self._runs[sid]))
+        return usage
+
     # -- alloc/free/pin --------------------------------------------------
 
-    def alloc(self, sid: str, nbytes: int, tick: int) -> list[int] | None:
+    def alloc(self, sid: str, nbytes: int, tick: int,
+              cls: int = 0) -> list[int] | None:
         """Reserve a page run for `sid` (None if the free list is too
         short — the caller evicts or spills). nbytes is the TRUE length;
-        the run covers ceil(nbytes/page) pages."""
+        the run covers ceil(nbytes/page) pages. `cls` tags the run's
+        capacity class for class-aware eviction/defrag accounting."""
         if sid in self._runs:
             raise ValueError(f"seed {sid} already resident")
         need = self.pages_for(nbytes)
@@ -138,6 +219,7 @@ class PageAllocator:
         self._lens[sid] = int(nbytes)
         self._pins[sid] = 0
         self._last_used[sid] = int(tick)
+        self._cls[sid] = int(cls)
         return pages
 
     def free(self, sid: str) -> int:
@@ -146,6 +228,7 @@ class PageAllocator:
             raise ValueError(f"seed {sid} is pinned ({self._pins[sid]})")
         pages = self._runs.pop(sid)
         del self._lens[sid], self._pins[sid], self._last_used[sid]
+        del self._cls[sid]
         self._free.extend(pages)
         self.frees_since_defrag += len(pages)
         return len(pages)
@@ -165,35 +248,51 @@ class PageAllocator:
 
     # -- eviction / defrag -----------------------------------------------
 
-    def evict_for(self, need: int) -> list[str]:
+    def evict_for(self, need: int, prefer_cls: int | None = None) -> list[str]:
         """Free least-recently-scheduled unpinned runs until `need`
-        pages are available (or no candidates remain). Ties break on
-        seed id so eviction order is replayable. Returns evicted sids."""
+        pages are available (or no candidates remain). With
+        `prefer_cls`, same-class victims go first — big-class churn then
+        cannibalizes its own class before destroying a hot small-class
+        working set. Ties break on seed id so eviction order is
+        replayable. Returns evicted sids."""
         evicted: list[str] = []
         while len(self._free) < need:
             victims = sorted(
                 (sid for sid, p in self._pins.items() if p == 0),
-                key=lambda sid: (self._last_used[sid], sid),
+                key=lambda sid: (
+                    0 if prefer_cls is None or self._cls[sid] == prefer_cls
+                    else 1,
+                    self._last_used[sid], sid,
+                ),
             )
             if not victims:
                 break
+            cls = self._cls[victims[0]]
             self.free(victims[0])
             evicted.append(victims[0])
+            self.class_evictions[cls] = self.class_evictions.get(cls, 0) + 1
         self.evictions += len(evicted)
         return evicted
 
     def defrag(self) -> np.ndarray:
         """Compact live runs toward the front of the arena and return
         the int32[num_pages] source map for ops/paged.permute_pages
-        (new_arena[i] = old_arena[src[i]]). Runs are renumbered in
-        ascending order of their current first page, so relative layout
-        is preserved and the move is deterministic."""
+        (new_arena[i] = old_arena[src[i]]). Runs are renumbered grouped
+        by class, then in ascending order of their current first page —
+        each class's gathers walk one contiguous region after the move,
+        and the order is deterministic."""
         src = np.arange(self.num_pages, dtype=np.int32)
         nxt = RESERVED_PAGES
-        for sid in sorted(self._runs, key=lambda s: self._runs[s][0]):
+        for sid in sorted(self._runs,
+                          key=lambda s: (self._cls[s], self._runs[s][0])):
             old = self._runs[sid]
             new = list(range(nxt, nxt + len(old)))
             src[new] = old
+            moved = sum(1 for o, n in zip(old, new) if o != n)
+            if moved:
+                cls = self._cls[sid]
+                self.class_defrag_moves[cls] = (
+                    self.class_defrag_moves.get(cls, 0) + moved)
             self._runs[sid] = new
             nxt += len(old)
         self._free = list(range(self.num_pages - 1, nxt - 1, -1))
@@ -215,32 +314,71 @@ class PageAllocator:
 
 class DeviceArena:
     """The allocator married to the device tensor. All methods except
-    `enqueue` are main-thread-only (module docstring)."""
+    `enqueue` and `enqueue_adopt` are main-thread-only (module
+    docstring)."""
 
-    _GUARDED_BY = {"_lock": ("_pending",)}
+    _GUARDED_BY = {"_lock": ("_pending", "_adopt_q")}
 
     def __init__(self, num_pages: int, page: int | None = None,
-                 row_pages: int = 1, donate="auto"):
+                 row_pages: int = 1, donate="auto",
+                 classes: Sequence[int] | None = None,
+                 classify: Callable[[int], int] | None = None):
         from ..ops import paged
 
         self._paged = paged
         self.alloc = PageAllocator(num_pages, page or paged.PAGE)
         self.page = self.alloc.page
-        # every gathered row spans row_pages pages: the run's ONE
-        # working-buffer width. Seeds longer than this are truncated at
-        # admission (the same clamp the bucket path applies at its
-        # device cap; metrics.record_truncated counts them)
-        self.row_pages = int(row_pages)
-        self.width = self.page * self.row_pages
+        # capacity classes: ascending row widths over the ONE physical
+        # page size. The legacy single-width constructor (row_pages=N)
+        # is the degenerate one-class arena; `classify` maps a sample
+        # length to the capacity it WANTS (default: the raw length — the
+        # corpus runner passes bucket_capacity so class routing matches
+        # the bucket assembler's slack exactly), and class_for() picks
+        # the smallest class that satisfies it
+        if classes is None:
+            classes = (self.page * int(row_pages),)
+        classes = tuple(sorted({int(c) for c in classes}))
+        if not classes or classes[0] <= 0:
+            raise ValueError(f"capacity classes must be positive, "
+                             f"got {classes}")
+        for c in classes:
+            if c % self.page:
+                raise ValueError(f"class width {c} is not a multiple of "
+                                 f"the {self.page}B page")
+        self.classes = classes
+        self.class_pages = tuple(c // self.page for c in classes)
+        self.row_pages = self.class_pages[-1]
+        self.width = classes[-1]
+        self._classify = classify
         self._arena = paged.new_arena(num_pages, self.page)
         self._donate = donate
         self._staged_idx: list[int] = []
         self._staged_pages: list[np.ndarray] = []
         self._lock = threading.Lock()
         self._pending: list[str] = []
+        self._adopt_q: list[tuple] = []
         self.spills = 0
         self.uploads = 0
         self.bytes_uploaded = 0
+        self.bytes_gathered = 0
+        self.truncated = 0
+        self.adopted = 0
+        self.adopt_skips = 0
+        self.adopt_faults = 0
+        self.class_adopted: dict[int, int] = {}
+
+    # -- class routing ---------------------------------------------------
+
+    def class_for(self, nbytes: int) -> int:
+        """Smallest class whose width satisfies the sample's wanted
+        capacity (classify(nbytes), default the raw length). A sample
+        wanting more than the top class routes there and is truncated at
+        admission — the ONLY case the truncated counter fires."""
+        want = self._classify(nbytes) if self._classify else int(nbytes)
+        for i, cap in enumerate(self.classes):
+            if cap >= want:
+                return i
+        return len(self.classes) - 1
 
     # -- admission -------------------------------------------------------
 
@@ -272,17 +410,25 @@ class DeviceArena:
 
     def ensure(self, sid: str, data: bytes, tick: int) -> bool:
         """Make `sid` resident (True) or report a spill (False). Bytes
-        are clamped to the row width and paged out zero-padded, so a
-        gathered row matches a packed panel row exactly."""
+        land in the smallest class that fits (longer samples route UP a
+        class, never silently down) and are paged out zero-padded, so a
+        gathered row matches a packed panel row exactly. Only samples
+        beyond the TOP class are clamped, and counted."""
         if self.alloc.resident(sid):
             self.alloc.touch(sid, tick)
             return True
         if self._spill_forced():
             self.spills += 1
             return False
-        data = data[:self.width]
+        cls = self.class_for(len(data))
+        cap = self.classes[cls]
+        if len(data) > cap:
+            # only possible at the top class: class_for routes anything
+            # smaller up to a class that holds it whole
+            self.truncated += 1
+        data = data[:cap]
         need = self.alloc.pages_for(len(data))
-        pages = self.alloc.alloc(sid, len(data), tick)
+        pages = self.alloc.alloc(sid, len(data), tick, cls=cls)
         if pages is None:
             # close the staging window BEFORE evicting: a seed staged
             # earlier in this window (bulk admission is unpinned) may be
@@ -292,8 +438,8 @@ class DeviceArena:
             # nondeterministic on TPU/GPU (silent seed-byte corruption)
             self.flush()
             with trace.span("corpus.arena.evict", need=need):
-                self.alloc.evict_for(need)
-            pages = self.alloc.alloc(sid, len(data), tick)
+                self.alloc.evict_for(need, prefer_cls=cls)
+            pages = self.alloc.alloc(sid, len(data), tick, cls=cls)
         if pages is None:
             self.spills += 1
             return False
@@ -330,34 +476,42 @@ class DeviceArena:
 
     # -- batch addressing ------------------------------------------------
 
-    def table_for(self, sids: Sequence[str], samples: Sequence[bytes],
-                  tick: int):
-        """Build one case's page table. Returns (table int32[B, P],
-        lens int32[B], spilled rows). Every resident run is pinned while
-        the table is built so a later row's eviction cannot steal its
-        pages, then unpinned — the gather dispatch order makes the table
-        safe to use after unpinning (uploads queue behind the gather)."""
-        rows = len(sids)
-        table = np.full((rows, self.row_pages), ZERO_PAGE, np.int32)
-        lens = np.zeros(rows, np.int32)
-        spilled: list[int] = []
+    def tables_for(self, sids: Sequence[str], samples: Sequence[bytes],
+                   tick: int) -> list[ClassTable]:
+        """Build one case's page tables, one per capacity class in
+        ascending width order — the ragged view: each class's gather
+        reads only its rows' live pages. Every resident run is pinned
+        while the tables are built so a later row's eviction cannot
+        steal its pages, then unpinned — the gather dispatch order makes
+        the tables safe to use after unpinning (uploads queue behind the
+        gathers)."""
+        groups: dict[int, dict] = {}
         pinned: list[str] = []
         try:
-            with trace.span("corpus.arena.alloc", rows=rows, tick=tick):
+            with trace.span("corpus.arena.alloc", rows=len(sids),
+                            tick=tick):
                 for r, (sid, data) in enumerate(zip(sids, samples)):
                     if self.ensure(sid, data, tick):
-                        # the allocator's recorded length is
-                        # authoritative: for store seeds it equals the
-                        # clamped sample length, and adopted seeds
-                        # (device-only bytes) have no host sample at all
-                        lens[r] = self.alloc.length(sid)
+                        # the allocator's recorded class/length are
+                        # authoritative: for store seeds they match the
+                        # routed sample, and adopted seeds (device-only
+                        # bytes) have no host sample at all
+                        cls = self.alloc.cls_of(sid)
+                        n = self.alloc.length(sid)
                         run = self.alloc.run(sid)
-                        table[r, :len(run)] = run
                         self.alloc.pin(sid)
                         pinned.append(sid)
                     else:
-                        lens[r] = min(len(data), self.width)
-                        spilled.append(r)
+                        cls = self.class_for(len(data))
+                        n = min(len(data), self.classes[cls])
+                        run = None
+                    g = groups.setdefault(cls, {"rows": [], "lens": [],
+                                                "runs": [], "spilled": []})
+                    if run is None:
+                        g["spilled"].append(len(g["rows"]))
+                    g["rows"].append(r)
+                    g["lens"].append(n)
+                    g["runs"].append(run)
                 self.flush()
         finally:
             # unconditional unpin: an ensure()/flush() escape (e.g. an
@@ -365,19 +519,150 @@ class DeviceArena:
             # the rest of the run
             for sid in pinned:
                 self.alloc.unpin(sid)
-        return table, lens, spilled
+        out = []
+        for cls in sorted(groups):
+            g = groups[cls]
+            k = len(g["rows"])
+            table = np.full((k, self.class_pages[cls]), ZERO_PAGE, np.int32)
+            for j, run in enumerate(g["runs"]):
+                if run is not None:
+                    table[j, :len(run)] = run
+            out.append(ClassTable(
+                cls=cls, capacity=self.classes[cls],
+                rows=np.asarray(g["rows"], np.int32), table=table,
+                lens=np.asarray(g["lens"], np.int32),
+                spilled=g["spilled"],
+            ))
+        return out
 
-    def gather(self, table: np.ndarray):
-        """Device gather: uint8[B, row_pages*page] working buffer."""
+    def table_for(self, sids: Sequence[str], samples: Sequence[bytes],
+                  tick: int):
+        """Single-table view over tables_for(): one int32[B, row_pages]
+        table at the arena's WIDEST class (short rows end in ZERO_PAGE
+        entries), lens int32[B], and spilled row positions — the legacy
+        r9 addressing, still used by callers that mutate every row at
+        one width (slot pools, tests)."""
+        groups = self.tables_for(sids, samples, tick)
+        rows = len(sids)
+        table = np.full((rows, self.row_pages), ZERO_PAGE, np.int32)
+        lens = np.zeros(rows, np.int32)
+        spilled: list[int] = []
+        for g in groups:
+            for j, r in enumerate(g.rows):
+                table[r, :g.table.shape[1]] = g.table[j]
+                lens[r] = g.lens[j]
+            spilled.extend(int(g.rows[j]) for j in g.spilled)
+        return table, lens, sorted(spilled)
+
+    def gather(self, table):
+        """Device gather: uint8[B, P*page] working buffer for an
+        int32[B, P] page table (a ClassTable's, possibly row-padded, or
+        the legacy full-width table)."""
+        table = np.asarray(table, np.int32)
+        self.bytes_gathered += int(table.shape[0] * table.shape[1]
+                                   * self.page)
         with trace.span("corpus.arena.gather", rows=int(table.shape[0])):
             return self._paged.gather_rows(self._arena, table)
 
+    # -- offspring adoption ----------------------------------------------
+
+    def enqueue_adopt(self, sid: str, length: int, src, row: int):
+        """Queue an interesting offspring for device-side adoption:
+        `src` is the step's device-resident OUTPUT buffer uint8[B, W]
+        (any class width), `row` the offspring's row in it. Thread-safe
+        (the drain worker calls this as it hashes); the scatter itself
+        happens on the main thread in adopt_pending(). The host-upload
+        fallback (the store listener's enqueue) stays armed: a
+        successful adoption turns that upload into a no-op — ensure()
+        sees the sid resident — a failed or chaos-faulted one lets the
+        upload proceed, so output bytes never depend on which path won."""
+        with self._lock:
+            self._adopt_q.append((sid, int(length), src, int(row)))
+
+    def adopt_pending(self, tick: int) -> int:
+        """Scatter every queued offspring into free pages of its class —
+        the admission path where only hashes and lengths crossed PCIe.
+        Returns the number adopted; seeds the allocator cannot place
+        (even after class-preferring eviction) are skipped and ride the
+        host path instead."""
+        with self._lock:
+            q, self._adopt_q = self._adopt_q, []
+        if not q:
+            return 0
+        try:
+            chaos.fault_point("arena.adopt")
+        except OSError:
+            # injected adoption fault: drop the device path for this
+            # batch — the seeds stay queued for the host-upload fallback
+            # and the output stream must not change (tests pin this)
+            self.adopt_faults += len(q)
+            return 0
+        groups: dict[int, tuple[object, list]] = {}
+        adopted = 0
+        pinned: list[str] = []
+        try:
+            for sid, length, src, row in q:
+                if self.alloc.resident(sid):
+                    continue
+                width = int(src.shape[1])
+                if width % self.page:
+                    raise ValueError(f"adopt source rows are {width}B, "
+                                     f"not a multiple of the "
+                                     f"{self.page}B page")
+                cls = self.class_for(length)
+                n = min(length, self.classes[cls], width)
+                need = self.alloc.pages_for(n)
+                pages = self.alloc.alloc(sid, n, tick, cls=cls)
+                if pages is None:
+                    # same alias discipline as ensure(): close the
+                    # staging window before eviction can recycle a
+                    # staged page
+                    self.flush()
+                    with trace.span("corpus.arena.evict", need=need):
+                        self.alloc.evict_for(need, prefer_cls=cls)
+                    pages = self.alloc.alloc(sid, n, tick, cls=cls)
+                if pages is None:
+                    self.adopt_skips += 1
+                    continue
+                # pinned until the scatter lands: a later entry's
+                # eviction re-using these pages in the SAME scatter
+                # would alias indices (nondeterministic on TPU/GPU)
+                self.alloc.pin(sid)
+                pinned.append(sid)
+                _src, entries = groups.setdefault(id(src), (src, []))
+                entries.append((row, pages, n))
+                self.class_adopted[cls] = self.class_adopted.get(cls, 0) + 1
+                adopted += 1
+            for src, entries in groups.values():
+                k = len(entries)
+                kp = _next_pow2(k)
+                run_pages = int(src.shape[1]) // self.page
+                rows = np.zeros(kp, np.int32)
+                lens = np.zeros(kp, np.int32)
+                table = np.full((kp, run_pages), TRASH_PAGE, np.int32)
+                for j, (row, pages, n) in enumerate(entries):
+                    rows[j] = row
+                    lens[j] = n
+                    table[j, :len(pages)] = pages
+                with trace.span("corpus.arena.adopt", rows=k, padded=kp):
+                    self._arena = self._paged.adopt_rows(
+                        self._arena, src, rows, table, lens,
+                        donate=self._donate,
+                    )
+        finally:
+            for sid in pinned:
+                self.alloc.unpin(sid)
+        self.adopted += adopted
+        return adopted
+
     def adopt(self, sids: Sequence[str], data, lens: Sequence[int],
               tick: int) -> list[str]:
-        """Scatter device-resident output rows (uint8[B, row_pages*page])
-        back into the arena as new runs — the admission path that never
-        crosses PCIe. Rows whose run cannot be allocated are skipped and
-        returned (the caller may fall back to host-side ensure())."""
+        """Host-driven adoption of a full output panel (uint8[B, width]
+        at the TOP class width): scatter rows back in as new runs.
+        Rows whose run cannot be allocated are skipped and returned
+        (the caller may fall back to host-side ensure()). The hot paths
+        use enqueue_adopt()/adopt_pending() instead — this remains for
+        direct callers that already hold a panel."""
         rows, width = data.shape
         if width != self.width:
             raise ValueError(f"adopt rows are {width}B, arena rows "
@@ -387,8 +672,9 @@ class DeviceArena:
         for r, sid in enumerate(sids):
             if self.alloc.resident(sid):
                 continue
-            pages = self.alloc.alloc(sid, min(int(lens[r]), self.width),
-                                     tick)
+            n = min(int(lens[r]), self.width)
+            pages = self.alloc.alloc(sid, n, tick,
+                                     cls=self.class_for(n))
             if pages is None:
                 skipped.append(sid)
                 continue
@@ -421,18 +707,41 @@ class DeviceArena:
     def reset(self):
         """Device-loss recovery: drop every run and rebuild an empty
         arena tensor (the old one died with the device). Cumulative
-        counters survive — evictions/defrags carry into the fresh
-        allocator so the Prometheus counters (type: counter) never go
-        backwards; the runner re-seeds from the store."""
+        counters survive — evictions/defrags and the per-class tallies
+        carry into the fresh allocator so the Prometheus counters
+        (type: counter) never go backwards; the runner re-seeds from the
+        store. Queued adoptions die with the device (their source
+        buffers are gone) — those seeds re-upload via the host path."""
         old = self.alloc
         self.alloc = PageAllocator(old.num_pages, self.page)
         self.alloc.evictions = old.evictions
         self.alloc.defrags = old.defrags
+        self.alloc.class_evictions = dict(old.class_evictions)
+        self.alloc.class_defrag_moves = dict(old.class_defrag_moves)
         self._staged_idx, self._staged_pages = [], []
+        with self._lock:
+            self._adopt_q = []
         self._arena = self._paged.new_arena(self.alloc.num_pages, self.page)
 
     def stats(self) -> dict:
         s = self.alloc.stats()
+        usable = self.alloc.num_pages - RESERVED_PAGES
+        usage = self.alloc.class_usage()
+        classes = {}
+        for i, cap in enumerate(self.classes):
+            seeds, pages = usage.get(i, (0, 0))
+            classes[str(cap)] = {
+                "pages": pages,
+                "resident_seeds": seeds,
+                "occupancy": round(pages / usable, 4) if usable else 0.0,
+                "evictions": self.alloc.class_evictions.get(i, 0),
+                "defrag_moves": self.alloc.class_defrag_moves.get(i, 0),
+                "adopted": self.class_adopted.get(i, 0),
+            }
         s.update(spills=self.spills, uploads=self.uploads,
-                 bytes_uploaded=self.bytes_uploaded)
+                 bytes_uploaded=self.bytes_uploaded,
+                 bytes_gathered=self.bytes_gathered,
+                 truncated=self.truncated, adopted=self.adopted,
+                 adopt_skips=self.adopt_skips,
+                 adopt_faults=self.adopt_faults, classes=classes)
         return s

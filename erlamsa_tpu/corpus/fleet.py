@@ -80,11 +80,13 @@ def merge_shard_results(parts) -> dict[int, bytes]:
 
 
 def apply_novelty(store, ids, results, seen_hashes, batch,
-                  tallies=None) -> int:
+                  tallies=None, on_novel=None) -> int:
     """The reduce step's novelty walk, shared with tests: slots
     0..batch-1 in order, one GLOBAL seen-set — a hash first seen this
     case credits energy exactly once no matter how many shards produced
-    hash-equal offspring. Returns the number of new hashes."""
+    hash-equal offspring. `on_novel(slot, payload)` fires per new hash
+    in the same slot order (the fleet's offspring-adoption hook).
+    Returns the number of new hashes."""
     new = 0
     for slot in range(batch):
         payload = results.get(slot, b"")
@@ -95,6 +97,8 @@ def apply_novelty(store, ids, results, seen_hashes, batch,
             seen_hashes.add(h)
             new += 1
             store.apply_event(fb.Event("new_hash", ids[slot]))
+            if on_novel is not None:
+                on_novel(slot, payload)
     return new
 
 
@@ -111,8 +115,8 @@ def run_corpus_fleet(opts: dict, batch: int = 1024) -> int:
                                 make_class_fuzzer, step_async)
     from ..ops.registry import DEVICE_CODES
     from ..ops.scheduler import init_scores
-    from .arena import RESERVED_PAGES, ZERO_PAGE, DeviceArena, _next_pow2, \
-        fit_page
+    from .arena import RESERVED_PAGES, DeviceArena, _next_pow2, \
+        fit_page_classes, resolve_classes
 
     raw_shards = opts.get("shards")
     n_shards = int(raw_shards if raw_shards is not None else 1)
@@ -169,18 +173,26 @@ def run_corpus_fleet(opts: dict, batch: int = 1024) -> int:
     bus = opts.get("feedback_bus", fb.GLOBAL)
     consume_feedback = bool(opts.get("feedback"))
 
-    # ONE capacity class over the WHOLE store (never per shard): the
+    # ONE capacity-class SET over the WHOLE store (never per shard): the
     # fused engine's streams are a function of the static row width, so
-    # shard-count byte-identity requires every shard to mutate at the
-    # same width the 1-shard run would use
+    # shard-count byte-identity requires every shard to mutate a seed at
+    # the same class width the 1-shard run would use — each shard then
+    # runs one ragged step per class present in its slice
     sizes = [len(store.get(sid)) for sid in store.ids()]
-    trunc_cap = bucket_capacity(max(sizes), device_max=device_max)
+    classes = resolve_classes(opts.get("arena_classes"), sizes, device_max)
+    trunc_cap = classes[-1]
     page_opt = int(opts.get("arena_page") or paged.PAGE)
-    page = fit_page(page_opt, trunc_cap)
+    page = fit_page_classes(page_opt, classes)
     if page != page_opt:
-        print(f"# fleet: page size {page_opt} does not fit the "
-              f"{trunc_cap}B capacity class, using {page}", file=sys.stderr)
-    row_pages = trunc_cap // page
+        print(f"# fleet: page size {page_opt} does not fit the capacity "
+              f"classes {classes}, using {page}", file=sys.stderr)
+    # offspring adoption (--adopt): the reduce's novelty walk adds novel
+    # outputs to the store (layout-independent decision, capped per
+    # case); when the producing shard still owns the new seed's home
+    # partition, the bytes adopt device-side out of that shard's output
+    # buffer — other placements upload lazily at first schedule
+    adopt_on = bool(opts.get("adopt"))
+    adopt_cap = int(opts.get("adopt_cap") or 64)
 
     devices = jax.devices()
     placement = FleetPlacement(n_shards, failure_threshold=1)
@@ -200,10 +212,13 @@ def run_corpus_fleet(opts: dict, batch: int = 1024) -> int:
                                // page)) for sid in home)
             per_opt = opts.get("arena_pages")  # per-shard when given
             num_pages = int(per_opt or RESERVED_PAGES + max(64, 2 * need))
-            num_pages = max(num_pages, RESERVED_PAGES + row_pages)
+            num_pages = max(num_pages, RESERVED_PAGES + classes[0] // page)
             with jax.default_device(self.device):
-                self.arena = DeviceArena(num_pages, page=page,
-                                         row_pages=row_pages, donate=False)
+                self.arena = DeviceArena(
+                    num_pages, page=page, donate=False, classes=classes,
+                    classify=lambda n: bucket_capacity(
+                        n, device_max=device_max),
+                )
 
     shards = {s: _Shard(s) for s in range(n_shards)}
 
@@ -212,71 +227,85 @@ def run_corpus_fleet(opts: dict, batch: int = 1024) -> int:
     stats = opts.get("_stats")
     seen_hashes: set[bytes] = set()
     tallies = {"truncated": 0, "total": 0, "new_hashes": 0, "bytes_out": 0,
-               "oracle_cases": 0, "redispatches": 0}
+               "oracle_cases": 0, "redispatches": 0, "offspring": 0}
     step_shapes: set[tuple] = set()
 
     def shard_dispatch(shard: _Shard, case: int, slots: list[int],
                        ids, samples):
-        """Map step for one shard's slice: ensure residency in the
-        shard's arena (idempotent — migrated seeds upload on first
-        touch), build the page table, gather, and dispatch one step
-        keyed on the GLOBAL slot indices. Returns (slots, rows, fut).
-        Raises on device error (incl. injected shard.step faults)."""
+        """Map step for one shard's slice: adopt queued offspring,
+        ensure residency in the shard's arena (idempotent — migrated
+        seeds upload on first touch), build one page table PER CAPACITY
+        CLASS, and dispatch one ragged step per class keyed on the
+        GLOBAL slot indices. Returns a list of (global slots, rows, fut)
+        entries, one per class present in the slice. Raises on device
+        error (incl. injected shard.step faults)."""
         chaos.fault_point("shard.step")
         arena = shard.arena
         sub_ids = [ids[s] for s in slots]
         sub_samples = [samples[s] for s in slots]
-        rows = len(slots)
         t_a = time.perf_counter()
+        launched_here: list[tuple[list[int], int, object]] = []
         with jax.default_device(shard.device):
             with trace.span("fleet.assemble", case=case, shard=shard.id,
-                            rows=rows):
+                            rows=len(slots)):
+                if adopt_on:
+                    arena.adopt_pending(tick=case)
                 for sid, data in zip(sub_ids, sub_samples):
                     arena.ensure(sid, data, case)
                 arena.flush()
                 arena.maybe_defrag()
-                table, lens, spilled = arena.table_for(sub_ids, sub_samples,
-                                                       tick=case)
+                groups = arena.tables_for(sub_ids, sub_samples, tick=case)
             t_d = time.perf_counter()
-            # pow2 row padding bounds the compiled-shape set exactly like
-            # the bucket assembler: pad rows point at the zero page, get
-            # out-of-range slot indices, and their outputs are discarded
-            rows_p = max(8, _next_pow2(rows))
-            if rows_p > rows:
-                table = np.vstack([table, np.full(
-                    (rows_p - rows, row_pages), ZERO_PAGE, np.int32)])
-                lens = np.concatenate(
-                    [lens, np.zeros(rows_p - rows, np.int32)])
-            data_dev = arena.gather(table)
-            if spilled:
-                k = len(spilled)
-                kp = max(8, _next_pow2(k))
-                rows_idx = np.asarray(
-                    (spilled + [spilled[0]] * (kp - k))[:kp], np.int32)
-                panel = np.zeros((kp, trunc_cap), np.uint8)
-                for j, r in enumerate(spilled):
-                    s = sub_samples[r][:trunc_cap]
-                    panel[j, :len(s)] = np.frombuffer(s, np.uint8)
-                panel[k:] = panel[0]
-                data_dev = data_dev.at[rows_idx].set(panel)
-            idx = np.concatenate([
-                np.asarray(slots, np.int32),
-                batch + np.arange(rows_p - rows, dtype=np.int32),
-            ]).astype(np.int32)
-            gather = np.asarray([slots[j % rows] for j in range(rows_p)],
-                                np.int32)
-            sc_in = scores[gather]
-            sl = scan_bound(int(lens[:rows].max()) if rows else 0,
-                            trunc_cap)
-            step_shapes.add((rows_p, trunc_cap, sl))
-            with trace.span("fleet.dispatch", case=case, shard=shard.id,
-                            rows=rows):
-                fut = step_async(step, base, case, idx, data_dev, lens,
-                                 sc_in, scan_len=sl)
+            try:
+                for g in groups:
+                    k = int(g.rows.shape[0])
+                    # pow2 cyclic row padding bounds the compiled-shape
+                    # set exactly like the bucket assembler: pad rows
+                    # repeat real rows, get out-of-range slot indices,
+                    # and their outputs are discarded
+                    kp = max(8, _next_pow2(k))
+                    pad = np.arange(kp, dtype=np.int32) % k
+                    table_p = g.table[pad]
+                    lens_p = g.lens[pad]
+                    data_dev = arena.gather(table_p)
+                    if g.spilled:
+                        ks = len(g.spilled)
+                        ksp = max(8, _next_pow2(ks))
+                        rows_idx = np.asarray(
+                            (g.spilled + [g.spilled[0]] * (ksp - ks))[:ksp],
+                            np.int32)
+                        panel = np.zeros((ksp, g.capacity), np.uint8)
+                        for j, r in enumerate(g.spilled):
+                            s = sub_samples[int(g.rows[r])][:g.capacity]
+                            panel[j, :len(s)] = np.frombuffer(s, np.uint8)
+                        panel[ks:] = panel[0]
+                        data_dev = data_dev.at[rows_idx].set(panel)
+                    g_slots = [slots[int(r)] for r in g.rows]
+                    idx = np.concatenate([
+                        np.asarray(g_slots, np.int32),
+                        batch + np.arange(kp - k, dtype=np.int32),
+                    ]).astype(np.int32)
+                    gather = np.asarray(
+                        [g_slots[j % k] for j in range(kp)], np.int32)
+                    sc_in = scores[gather]
+                    sl = scan_bound(int(g.lens.max()), g.capacity)
+                    step_shapes.add((kp, g.capacity, sl))
+                    with trace.span("fleet.dispatch", case=case,
+                                    shard=shard.id, rows=k,
+                                    capacity=g.capacity):
+                        fut = step_async(step, base, case, idx, data_dev,
+                                         lens_p, sc_in, scan_len=sl)
+                    launched_here.append((g_slots, k, fut))
+            except BaseException:  # lint: broad-except-ok re-raised after settling in-flight futures
+                # a fault on class K's dispatch must not strand this
+                # shard's earlier class futures: settle them before the
+                # revoke/redispatch path (or the caller) unwinds
+                drain_futures(f for _sl, _r, f in launched_here)
+                raise
         t_e = time.perf_counter()
         metrics.GLOBAL.record_stage("assemble", t_d - t_a)
         metrics.GLOBAL.record_stage("dispatch", t_e - t_d)
-        return slots, rows, fut
+        return launched_here
 
     def probe_shard(shard: _Shard):
         """One tiny forced op on the shard's device. The shard.step
@@ -391,14 +420,17 @@ def run_corpus_fleet(opts: dict, batch: int = 1024) -> int:
             else:
                 by_shard.setdefault(owner, []).append(slot)
         pending = sorted(by_shard.items())
-        launched: list[tuple[list[int], int, object]] = []
+        # (shard_id, global slots, rows, fut) per dispatched class group
+        launched: list[tuple[int, list[int], int, object]] = []
         t_map = time.perf_counter()
         try:
             while pending:
                 shard_id, slots = pending.pop(0)
                 try:
-                    launched.append(shard_dispatch(shards[shard_id], case,
-                                                   slots, ids, samples))
+                    launched.extend(
+                        (shard_id, *entry)
+                        for entry in shard_dispatch(shards[shard_id], case,
+                                                    slots, ids, samples))
                 except Exception as e:  # lint: broad-except-ok re-raised below unless is_device_error
                     if not is_device_error(e):
                         raise
@@ -422,7 +454,7 @@ def run_corpus_fleet(opts: dict, batch: int = 1024) -> int:
         except BaseException:  # lint: broad-except-ok re-raised after settling in-flight futures
             # a non-device error mid-map must not strand the survivors'
             # in-flight futures: settle them before unwinding
-            drain_futures(f for _sl, _r, f in launched)
+            drain_futures(f for _sh, _sl, _r, f in launched)
             raise
         if host_slots:
             tallies["oracle_cases"] += 1
@@ -439,12 +471,19 @@ def run_corpus_fleet(opts: dict, batch: int = 1024) -> int:
             # re-apply, never data loss — outputs must not change
             metrics.GLOBAL.record_event("fleet_reduce_retry")
         parts: list[dict[int, bytes]] = []
+        # slot -> (producing shard, device output buffer, row): adoption
+        # sources for the novelty walk below (arena output buffers are
+        # never donated in the fleet, so holding them here is safe)
+        devsrc: dict[int, tuple] = {}
         t_r = time.perf_counter()
-        for slots, rows, fut in launched:
+        for shard_id, slots, rows, fut in launched:
             with trace.span("fleet.drain", case=case, rows=rows):
                 new_data, new_lens, new_sc, meta = fut.result()
                 outs = unpack(Batch(new_data[:rows], new_lens[:rows]))
             parts.append({slot: outs[j] for j, slot in enumerate(slots)})
+            if adopt_on:
+                for j, slot in enumerate(slots):
+                    devsrc[slot] = (shard_id, new_data, j)
             scores[np.asarray(slots, np.int32)] = new_sc[:rows]
             applied = meta.applied[:rows].ravel()
             applied = applied[applied >= 0]
@@ -463,9 +502,34 @@ def run_corpus_fleet(opts: dict, batch: int = 1024) -> int:
 
         t_h = time.perf_counter()
         before = tallies["bytes_out"]
+        case_adopted = [0]
+
+        def on_novel(slot, payload):
+            """Offspring adoption at the reduce: the store decides
+            (dedup by content hash, capped per case); the bytes adopt
+            device-side only when the producing shard owns the new
+            seed's home partition — any other placement uploads lazily
+            at its first schedule."""
+            if not payload or case_adopted[0] >= adopt_cap:
+                return
+            sid_new, added = store.add(payload, origin="offspring")
+            if not added:
+                return
+            case_adopted[0] += 1
+            tallies["offspring"] += 1
+            ent = devsrc.get(slot)
+            if ent is None:
+                return
+            shard_id, src, row = ent
+            if (placement.owner_of(partition_of(sid_new, n_shards))
+                    == shard_id):
+                shards[shard_id].arena.enqueue_adopt(
+                    sid_new, len(payload), src, row)
+
         with trace.span("fleet.hash", case=case):
             tallies["new_hashes"] += apply_novelty(
-                store, ids, results, seen_hashes, batch, tallies)
+                store, ids, results, seen_hashes, batch, tallies,
+                on_novel=on_novel if adopt_on else None)
         tallies["total"] += len(results)
         metrics.GLOBAL.record_stage("hash", time.perf_counter() - t_h)
         metrics.GLOBAL.record_batch(len(results),
@@ -508,6 +572,7 @@ def run_corpus_fleet(opts: dict, batch: int = 1024) -> int:
                      migrations=list(placement.migrations),
                      oracle_cases=tallies["oracle_cases"],
                      redispatches=tallies["redispatches"],
+                     offspring=tallies["offspring"],
                      step_shapes=sorted(step_shapes),
                      arenas={s: sh.arena.stats()
                              for s, sh in shards.items()},

@@ -204,6 +204,14 @@ def run_corpus_batch(opts: dict, batch: int = 1024) -> int:
         raise ValueError(f"layout must be one of {LAYOUTS}, "
                          f"got {layout!r}")
     use_arena = layout == "arena"
+    # --adopt: novel outputs join the corpus as first-class seeds (capped
+    # per case). The DECISION is layout-independent — first never-seen
+    # hash wins, in slot order — so buckets and arena grow identical
+    # stores at a fixed -s; the arena layout additionally adopts the
+    # bytes device-side (DeviceArena.adopt_pending) so only hashes and
+    # lengths cross PCIe for adopted offspring.
+    adopt_on = bool(opts.get("adopt"))
+    adopt_cap = int(opts.get("adopt_cap") or 64)
 
     store = CorpusStore(opts["corpus_dir"])
     # recovery fsck: a previous crash can leave corpus.json and seeds/
@@ -268,41 +276,52 @@ def run_corpus_batch(opts: dict, batch: int = 1024) -> int:
 
     if use_arena:
         from ..ops import paged
-        from .arena import RESERVED_PAGES, DeviceArena, fit_page
+        from .arena import (RESERVED_PAGES, DeviceArena, fit_page_classes,
+                            resolve_classes)
 
-        # ONE working width for the whole run: the capacity class of the
-        # largest stored seed. The fused engine's streams are a function
-        # of the static row width (ops/pipeline.py ENGINE VERSION
-        # NOTES), so arena==buckets byte-identity holds exactly when the
-        # bucket path would place every seed in this same class — the
-        # configuration the tests pin and README documents.
+        # RAGGED rows over one physical page size: a small ascending set
+        # of capacity classes, each with its own page table and compiled
+        # step shape (--arena-classes; "auto" derives the exact bucket
+        # capacities of the stored seeds). The fused engine's streams
+        # are a function of the static row width (ops/pipeline.py ENGINE
+        # VERSION NOTES), so arena==buckets byte-identity holds exactly
+        # when every seed's class equals its bucket capacity — the auto
+        # configuration, which the tests pin and README documents.
         sizes = [len(store.get(sid)) for sid in store.ids()]
         if not sizes:
             print("no corpus seeds to page into the arena",
                   file=sys.stderr)
             return 1
-        trunc_cap = bucket_capacity(max(sizes), device_max=device_max)
+        classes = resolve_classes(opts.get("arena_classes"), sizes,
+                                  device_max)
+        trunc_cap = classes[-1]
         page_opt = int(opts.get("arena_page") or paged.PAGE)
-        # the page must divide the capacity class exactly — otherwise
-        # row_pages*page < trunc_cap and resident rows come up narrower
-        # than the truncation cap (shape mismatch on any spill overlay)
-        page = fit_page(page_opt, trunc_cap)
+        # the page must divide every class width exactly — otherwise a
+        # class's rows come up narrower than its capacity (shape
+        # mismatch on any spill overlay)
+        page = fit_page_classes(page_opt, classes)
         if page != page_opt:
             print(f"# arena: page size {page_opt} does not fit the "
-                  f"{trunc_cap}B capacity class, using {page}",
+                  f"capacity classes {classes}, using {page}",
                   file=sys.stderr)
-        row_pages = trunc_cap // page
         # max(1, ...) matches PageAllocator.pages_for: a zero-length
         # seed still occupies one page
         need = sum(max(1, -(-min(n, trunc_cap) // page)) for n in sizes)
         num_pages = int(opts.get("arena_pages")
                         or RESERVED_PAGES + max(64, 2 * need))
-        num_pages = max(num_pages, RESERVED_PAGES + row_pages)
-        arena = DeviceArena(num_pages, page=page, row_pages=row_pages,
-                            donate="auto" if use_async else False)
+        num_pages = max(num_pages, RESERVED_PAGES + classes[0] // page)
+        # class routing mirrors the bucket assembler's slack exactly: a
+        # seed WANTS its bucket capacity and lands in the smallest class
+        # that satisfies it (longer routes UP, never silently down)
+        arena = DeviceArena(
+            num_pages, page=page, donate="auto" if use_async else False,
+            classes=classes,
+            classify=lambda n: bucket_capacity(n, device_max=device_max),
+        )
         _seed_arena(tick=-1)
-        # store-admission hook: seeds added mid-run (faas/monitors)
-        # queue here and upload at the next case boundary
+        # store-admission hook: seeds added mid-run (faas/monitors,
+        # adopted offspring) queue here and upload at the next case
+        # boundary — unless device-side adoption already landed them
         store.listener = arena.enqueue
 
     n_cases = opts.get("n", 1)
@@ -345,7 +364,7 @@ def run_corpus_batch(opts: dict, batch: int = 1024) -> int:
     bucket_stats: dict[int, dict] = {}
     # tallies the drain worker owns in async mode (main reads after join)
     tallies = {"truncated": 0, "total": 0, "new_hashes": 0,
-               "bytes_uploaded": 0}
+               "bytes_uploaded": 0, "offspring": 0}
     # distinct (rows, capacity, scan_len) triples the jitted step saw —
     # the compiled-program count the arena drives to O(1)
     step_shapes: set[tuple] = set()
@@ -358,60 +377,97 @@ def run_corpus_batch(opts: dict, batch: int = 1024) -> int:
         scores = np.array(scores)
 
     def _dispatch_arena(case, ids, samples, scores_in):
-        """Arena layout's dispatch: build the page table (the cheap host
-        half riding the async pipeline's assemble slot), gather the
-        working buffer out of the device arena, and run ONE uniform
-        (batch, width) step — no per-class panels, no per-case seed
-        re-upload. Spilled rows (arena full / injected arena.spill
+        """Arena layout's dispatch: adopt queued offspring and admit
+        queued seeds, then build one page table PER CAPACITY CLASS (the
+        cheap host half riding the async pipeline's assemble slot) and
+        run one ragged step per class — each gather reads only its rows'
+        live pages, no padding to the widest resident seed, no per-case
+        seed re-upload. Spilled rows (arena full / injected arena.spill
         fault) are overlaid from host bytes, which costs an upload but
-        never changes output bytes."""
+        never changes output bytes. Slot keying, row padding, score
+        gather/scatter and scan bounds mirror the bucket path row for
+        row, so arena==buckets byte-identity holds whenever class caps
+        equal bucket caps."""
         t_a = time.perf_counter()
-        with trace.span("corpus.assemble", case=case, capacity=trunc_cap):
+        with trace.span("corpus.assemble", case=case):
+            if adopt_on:
+                arena.adopt_pending(tick=case)
             arena.drain_pending(store.get, tick=case)
             arena.maybe_defrag()
-            table, lens, spilled = arena.table_for(ids, samples, tick=case)
+            groups = arena.tables_for(ids, samples, tick=case)
         t_d = time.perf_counter()
-        chaos.fault_point("device.step")
-        data = arena.gather(table)
-        if spilled:
-            # pow2-padded overlay rows keep the compiled set bounded;
-            # padding repeats the first spilled row — idempotent, the
-            # same bytes land twice
-            k = len(spilled)
-            kp = max(8, 1 << (k - 1).bit_length())
-            rows_idx = np.asarray(
-                (spilled + [spilled[0]] * (kp - k))[:kp], np.int32)
-            panel = np.zeros((kp, trunc_cap), np.uint8)
-            for j, r in enumerate(spilled):
-                s = samples[r][:trunc_cap]
-                panel[j, :len(s)] = np.frombuffer(s, np.uint8)
-            panel[k:] = panel[0]
-            data = data.at[rows_idx].set(panel)
-            tallies["bytes_uploaded"] += panel.nbytes + rows_idx.nbytes
-        idx = np.arange(batch, dtype=np.int32)
-        sl = scan_bound(int(lens.max()) if batch else 0, trunc_cap)
-        # fresh score gather (like the bucket path) so donation never
-        # consumes the live table while the drain may still read it
-        sc_in = (jnp.take(scores_in, jnp.asarray(idx), axis=0)
-                 if use_async else scores_in[idx])
-        tallies["bytes_uploaded"] += (table.nbytes + lens.nbytes
-                                      + idx.nbytes)
-        step_shapes.add((batch, trunc_cap, sl))
-        with trace.span("corpus.dispatch", case=case, capacity=trunc_cap,
-                        rows=batch):
-            fut = step_async(step, base, case, idx, data, lens, sc_in,
-                             scan_len=sl)
-        scores_out = fut.scores if use_async else np.asarray(fut.scores)
-        # shape-only placeholder panel: process_case never reads bucket
-        # data (outputs come from the future), and holding the donated
-        # working buffer in the work item would pin device memory
-        b = Bucket(capacity=trunc_cap, slots=idx,
-                   data=np.zeros((batch, 0), np.uint8), lens=lens,
-                   rows=batch, padded_bytes_wasted=0)
-        t_e = time.perf_counter()
+        launched = []
+        scores_out = scores_in
+        dispatch_s = 0.0
+        try:
+            for g in groups:
+                t_g = time.perf_counter()
+                chaos.fault_point("device.step")
+                k = int(g.rows.shape[0])
+                kp = max(8, 1 << (k - 1).bit_length())
+                # cyclic row padding, exactly like materialize(): pad
+                # rows repeat real rows (shape-valid, outputs discarded)
+                pad = np.arange(kp, dtype=np.int32) % k
+                table_p = g.table[pad]
+                lens_p = g.lens[pad]
+                data = arena.gather(table_p)
+                if g.spilled:
+                    # pow2-padded overlay rows keep the compiled set
+                    # bounded; padding repeats the first spilled row —
+                    # idempotent, the same bytes land twice
+                    ks = len(g.spilled)
+                    ksp = max(8, 1 << (ks - 1).bit_length())
+                    rows_idx = np.asarray(
+                        (g.spilled + [g.spilled[0]] * (ksp - ks))[:ksp],
+                        np.int32)
+                    panel = np.zeros((ksp, g.capacity), np.uint8)
+                    for j, r in enumerate(g.spilled):
+                        s = samples[int(g.rows[r])][:g.capacity]
+                        panel[j, :len(s)] = np.frombuffer(s, np.uint8)
+                    panel[ks:] = panel[0]
+                    data = data.at[rows_idx].set(panel)
+                    tallies["bytes_uploaded"] += (panel.nbytes
+                                                  + rows_idx.nbytes)
+                # keys derive from the SLOT position (0..batch-1), pad
+                # rows get out-of-range indices — identical to the
+                # bucket path's contract
+                idx = np.concatenate([
+                    g.rows, batch + np.arange(kp - k, dtype=np.int32)
+                ]).astype(np.int32)
+                gather = g.rows[pad]
+                sc_in = (jnp.take(scores_out, jnp.asarray(gather), axis=0)
+                         if use_async else scores_out[gather])
+                sl = scan_bound(int(g.lens.max()), g.capacity)
+                tallies["bytes_uploaded"] += (table_p.nbytes
+                                              + lens_p.nbytes + idx.nbytes)
+                step_shapes.add((kp, g.capacity, sl))
+                with trace.span("corpus.dispatch", case=case,
+                                capacity=g.capacity, rows=k):
+                    fut = step_async(step, base, case, idx, data, lens_p,
+                                     sc_in, scan_len=sl)
+                if use_async:
+                    scores_out = scores_out.at[jnp.asarray(g.rows)].set(
+                        fut.scores[:k]
+                    )
+                else:
+                    scores_out[g.rows] = np.asarray(fut.scores)[:k]
+                # shape-only placeholder panel: process_case never reads
+                # bucket data (outputs come from the future), and
+                # holding the donated working buffer in the work item
+                # would pin device memory
+                b = Bucket(capacity=g.capacity, slots=g.rows,
+                           data=np.zeros((k, 0), np.uint8), lens=g.lens,
+                           rows=k, padded_bytes_wasted=0)
+                launched.append((b, fut))
+                dispatch_s += time.perf_counter() - t_g
+        except BaseException:  # lint: broad-except-ok re-raised after settling in-flight futures
+            # a fault on class K's dispatch must not strand the earlier
+            # classes' in-flight futures (mirrors the bucket path)
+            drain_futures(fut for _b, fut in launched)
+            raise
         metrics.GLOBAL.record_stage("assemble", t_d - t_a)
-        metrics.GLOBAL.record_stage("dispatch", t_e - t_d)
-        return ids, [(b, fut)], scores_out, t_e - t_d
+        metrics.GLOBAL.record_stage("dispatch", dispatch_s)
+        return ids, launched, scores_out, dispatch_s
 
     def dispatch_case(case, scores_in):
         """Schedule, assemble and dispatch every bucket of one case.
@@ -499,15 +555,22 @@ def run_corpus_batch(opts: dict, batch: int = 1024) -> int:
 
     drain: _DrainWorker | None = None
 
-    def finish_case(case, ids, results, ckpt_scores, device_seconds):
+    def finish_case(case, ids, results, ckpt_scores, device_seconds,
+                    devsrc=None):
         """The order-dependent tail every case runs — hashing (slot walk
-        0..batch-1, identical in sync/async/degraded), energy events, bus
-        drain, writes and checkpointing — shared by the device drain path
-        and the degraded oracle path."""
+        0..batch-1, identical in sync/async/degraded), offspring
+        adoption, energy events, bus drain, writes and checkpointing —
+        shared by the device drain path and the degraded oracle path.
+
+        `devsrc` maps slot -> (device output buffer, row) when the
+        outputs are still device-resident (arena layout): an adopted
+        offspring then queues for DeviceArena.adopt_pending and its
+        payload bytes never cross back over PCIe."""
         # novelty feedback: a never-seen output hash is the cheap
         # stand-in for new coverage — the source seed earns energy
         t_h = time.perf_counter()
         case_bytes = 0
+        case_adopted = 0
         with trace.span("corpus.hash", case=case):
             for slot in range(batch):
                 payload = results.get(slot, b"")
@@ -517,6 +580,20 @@ def run_corpus_batch(opts: dict, batch: int = 1024) -> int:
                     seen_hashes.add(h)
                     tallies["new_hashes"] += 1
                     store.apply_event(fb.Event("new_hash", ids[slot]))
+                    if adopt_on and payload and case_adopted < adopt_cap:
+                        # the store decides (dedup by content hash);
+                        # store.add fires the arena's listener, and the
+                        # device path below turns that host upload into
+                        # a no-op when the scatter wins
+                        sid_new, added = store.add(payload,
+                                                   origin="offspring")
+                        if added:
+                            case_adopted += 1
+                            tallies["offspring"] += 1
+                            if devsrc is not None and slot in devsrc:
+                                src, row = devsrc[slot]
+                                arena.enqueue_adopt(sid_new, len(payload),
+                                                    src, row)
         tallies["total"] += len(results)
         metrics.GLOBAL.record_stage("hash", time.perf_counter() - t_h)
         metrics.GLOBAL.record_batch(len(results), case_bytes,
@@ -569,6 +646,11 @@ def run_corpus_batch(opts: dict, batch: int = 1024) -> int:
         in async mode."""
         case, ids, launched = work.case, work.ids, work.launched
         results: dict[int, bytes] = {}
+        # slot -> (device output buffer, row): the adoption source map.
+        # Holding new_data here keeps the output buffers alive until the
+        # next case's adopt_pending() scatter — they are never donated.
+        devsrc: dict[int, tuple] | None = (
+            {} if (adopt_on and use_arena) else None)
         t_w = time.perf_counter()
         for b, fut in launched:
             with trace.span("corpus.drain", case=case, capacity=b.capacity):
@@ -576,6 +658,8 @@ def run_corpus_batch(opts: dict, batch: int = 1024) -> int:
                 outs = unpack(Batch(new_data[:b.rows], new_lens[:b.rows]))
             for j, slot in enumerate(b.slots):
                 results[int(slot)] = outs[j]
+                if devsrc is not None:
+                    devsrc[int(slot)] = (new_data, j)
             # per-mutator applied counters (registry rows, device side)
             applied = meta.applied[:b.rows].ravel()
             applied = applied[applied >= 0]
@@ -603,7 +687,7 @@ def run_corpus_batch(opts: dict, batch: int = 1024) -> int:
         metrics.GLOBAL.observe("batch_latency",
                                work.dispatch_s + drain_wait_s)
         finish_case(case, ids, results, work.scores,
-                    work.dispatch_s + drain_wait_s)
+                    work.dispatch_s + drain_wait_s, devsrc=devsrc)
 
     def _scores_to_host(sc):
         """Pull the score table off a possibly-dead device; if even the
@@ -759,6 +843,7 @@ def run_corpus_batch(opts: dict, batch: int = 1024) -> int:
                      buckets=bucket_stats, new_hashes=new_hashes,
                      pipeline=pipeline, layout=layout,
                      bytes_uploaded=bytes_up,
+                     offspring=tallies["offspring"],
                      step_shapes=sorted(step_shapes),
                      store_stats=store.stats())
         if arena is not None:
@@ -769,12 +854,18 @@ def run_corpus_batch(opts: dict, batch: int = 1024) -> int:
                new_hashes)
     waste = sum(b["padded_bytes_wasted"] for b in bucket_stats.values())
     rows = sum(b["rows"] for b in bucket_stats.values())
+    adopt_note = ""
+    if adopt_on:
+        dev_adopted = arena.stats()["adopted"] if arena is not None else 0
+        adopt_note = (f", {tallies['offspring']} offspring adopted "
+                      f"({dev_adopted} device-side)")
     print(
         f"# {total} samples, {dt:.2f}s, {total / max(dt, 1e-9):.0f} "
         f"samples/s ({pipeline} pipeline, {layout} layout), "
         f"{new_hashes} novel hashes, {len(bucket_stats)} buckets, "
         f"{waste / max(rows, 1):.0f} padded bytes wasted/sample, "
-        f"{bytes_up / max(total, 1):.0f} bytes uploaded/sample",
+        f"{bytes_up / max(total, 1):.0f} bytes uploaded/sample"
+        f"{adopt_note}",
         file=sys.stderr,
     )
     return 0

@@ -170,6 +170,55 @@ def render(counters: metrics.Counters | None = None) -> str:
                "Bytes uploaded into arena pages at admission.")
         w.sample("erlamsa_arena_bytes_uploaded_total",
                  arena["bytes_uploaded"])
+        if "bytes_gathered" in arena:
+            w.head("erlamsa_arena_bytes_gathered_total", "counter",
+                   "Bytes gathered out of live arena pages into step "
+                   "working buffers.")
+            w.sample("erlamsa_arena_bytes_gathered_total",
+                     arena["bytes_gathered"])
+        if "adopted" in arena:
+            w.head("erlamsa_arena_adopted_total", "counter",
+                   "Offspring adopted device-side (payload never "
+                   "crossed PCIe).")
+            w.sample("erlamsa_arena_adopted_total", arena["adopted"])
+        # per-capacity-class health (ragged arena only: absent keys mean
+        # a pre-ragged snapshot and must not render as zeros)
+        classes = arena.get("classes")
+        if classes:
+            w.head("erlamsa_arena_class_pages", "gauge",
+                   "Arena pages held by resident seeds, by capacity "
+                   "class.")
+            for cap, cc in classes.items():
+                w.sample("erlamsa_arena_class_pages", cc["pages"],
+                         {"class": cap})
+            w.head("erlamsa_arena_class_resident_seeds", "gauge",
+                   "Seeds resident in the arena, by capacity class.")
+            for cap, cc in classes.items():
+                w.sample("erlamsa_arena_class_resident_seeds",
+                         cc["resident_seeds"], {"class": cap})
+            w.head("erlamsa_arena_class_occupancy", "gauge",
+                   "Fraction of allocatable arena pages held by each "
+                   "capacity class.")
+            for cap, cc in classes.items():
+                w.sample("erlamsa_arena_class_occupancy",
+                         cc["occupancy"], {"class": cap})
+            w.head("erlamsa_arena_class_evictions_total", "counter",
+                   "Seed runs evicted from the arena, by capacity "
+                   "class.")
+            for cap, cc in classes.items():
+                w.sample("erlamsa_arena_class_evictions_total",
+                         cc["evictions"], {"class": cap})
+            w.head("erlamsa_arena_class_defrag_moves_total", "counter",
+                   "Seed runs moved by defrag compactions, by capacity "
+                   "class.")
+            for cap, cc in classes.items():
+                w.sample("erlamsa_arena_class_defrag_moves_total",
+                         cc["defrag_moves"], {"class": cap})
+            w.head("erlamsa_arena_class_adopted_total", "counter",
+                   "Offspring adopted device-side, by capacity class.")
+            for cap, cc in classes.items():
+                w.sample("erlamsa_arena_class_adopted_total",
+                         cc["adopted"], {"class": cap})
 
     fleet = snap.get("fleet")
     if fleet:

@@ -71,6 +71,20 @@ def _upload(arena, idx, pages):
     return arena.at[idx].set(pages)
 
 
+def _adopt(arena, src, rows, table, lens):
+    # fused offspring adoption: pick rows out of a step's OUTPUT buffer,
+    # zero-mask each past its true length (arena bytes beyond a run must
+    # be zero, exactly like an uploaded seed's partial-page padding),
+    # and scatter the masked pages at the freshly allocated table ids —
+    # one device op, no host round trip for the payload bytes
+    picked = src[rows]
+    k, width = picked.shape
+    mask = jnp.arange(width, dtype=jnp.int32)[None, :] < lens[:, None]
+    picked = jnp.where(mask, picked, jnp.uint8(0))
+    run = table.shape[1]
+    return arena.at[table].set(picked.reshape(k, run, -1))
+
+
 def _permute(arena, src):
     return arena[src]
 
@@ -78,6 +92,8 @@ def _permute(arena, src):
 _gather_j = jax.jit(_gather)
 _scatter_j = jax.jit(_scatter, donate_argnums=0)
 _scatter_nd = jax.jit(_scatter)
+_adopt_j = jax.jit(_adopt, donate_argnums=0)
+_adopt_nd = jax.jit(_adopt)
 _upload_j = jax.jit(_upload, donate_argnums=0)
 _upload_nd = jax.jit(_upload)
 _permute_j = jax.jit(_permute, donate_argnums=0)
@@ -100,6 +116,21 @@ def scatter_rows(arena, table, data, donate="auto"):
     gathered). The caller's arena handle is consumed when donating."""
     f = _scatter_j if resolve_donate(donate) else _scatter_nd
     return f(arena, table, data)
+
+
+def adopt_rows(arena, src, rows, table, lens, donate="auto"):
+    """Device-resident offspring adoption in one fused op.
+
+    uint8[num_pages, PAGE] arena, uint8[B, W] step-output buffer `src`,
+    int32[k] row picks, int32[k, W // PAGE] destination page table,
+    int32[k] true lengths -> updated arena. Row j of the scatter is
+    ``src[rows[j]]`` zero-masked past ``lens[j]``; table entries past a
+    row's run target TRASH_PAGE (pad rows use rows=0 / lens=0 and a
+    full-TRASH table row). Only `src` (already device-resident) and the
+    tiny index vectors feed the op — the payload never crosses PCIe.
+    `src` is never donated (the drain may still unpack it)."""
+    f = _adopt_j if resolve_donate(donate) else _adopt_nd
+    return f(arena, src, rows, table, lens)
 
 
 def upload_pages(arena, idx, pages, donate="auto"):

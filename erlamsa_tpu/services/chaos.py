@@ -36,6 +36,10 @@ Known sites (grep `fault_point(` for the authoritative list):
     arena.spill      paged-arena admission (corpus/arena.py): an injected
                      fault forces the seed onto the host-overlay spill
                      path — outputs must not change (tests pin this)
+    arena.adopt      device-resident offspring adoption (corpus/arena.py):
+                     an injected fault drops the pending adoption batch,
+                     so the offspring upload lazily from the host store
+                     instead — outputs must not change (tests pin this)
     checkpoint.load  --state checkpoint read (services/checkpoint.py)
     checkpoint.save  --state checkpoint write (services/checkpoint.py)
     serving.admit    faas admission control (services/faas.py): an

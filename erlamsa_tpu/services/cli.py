@@ -216,6 +216,20 @@ def build_parser() -> argparse.ArgumentParser:
                    help="arena page size in bytes (default 256, the "
                         "device lane width; must divide the run's "
                         "working width)")
+    p.add_argument("--arena-classes", default=None, metavar="SPEC",
+                   help="arena capacity classes: comma-separated byte "
+                        "widths (e.g. '256,4096,65536') or 'auto' "
+                        "(default) to derive them from the stored seed "
+                        "sizes. Each seed rides the smallest class that "
+                        "holds it whole — short seeds stop paying the "
+                        "widest row's gather/compute (corpus/arena.py)")
+    p.add_argument("--adopt", action="store_true",
+                   help="device-resident offspring adoption: interesting "
+                        "offspring scatter straight from the step's "
+                        "output buffer into free arena pages of the "
+                        "right class, so only content hashes and "
+                        "lengths cross PCIe (requires --layout arena; "
+                        "outputs stay byte-identical at a fixed -s)")
     p.add_argument("--state", default=None,
                    help="checkpoint file (.npz) for stop/resume of batch runs")
     p.add_argument("--node", default=None, help="join a parent node host:port")
@@ -368,6 +382,8 @@ def main(argv=None) -> int:
         "shards": args.shards,
         "arena_pages": args.arena_pages,
         "arena_page": args.arena_page,
+        "arena_classes": args.arena_classes,
+        "adopt": args.adopt,
         "output": args.output,
         "verbose": args.verbose,
         "meta_path": args.meta,

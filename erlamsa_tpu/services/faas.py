@@ -343,7 +343,8 @@ def serve(host: str, port: int, opts: dict, backend: str = "oracle",
                 max_running_time=service_budget(opts),
                 warm=opts.get("warm", True),
                 **{k: opts[k] for k in
-                   ("capacity", "max_latency_ms", "inflight", "slots")
+                   ("capacity", "max_latency_ms", "inflight", "slots",
+                    "classes")
                    if opts.get(k) is not None},
             ),
             "cmanager": CloudManager(

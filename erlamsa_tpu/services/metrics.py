@@ -150,6 +150,17 @@ class Counters:
         """Latest arena health snapshot (corpus/arena.py stats())."""
         with self._lock:
             self.arena = dict(stats)
+        # outside the lock: the flight ring has its own lock. One
+        # class-mix breadcrumb per snapshot so a post-mortem shows how
+        # the ragged arena's capacity classes were actually populated.
+        classes = stats.get("classes")
+        if classes:
+            flight.GLOBAL.note(
+                "arena_class_mix",
+                mix={cap: c["resident_seeds"]
+                     for cap, c in classes.items()},
+                adopted=stats.get("adopted", 0),
+            )
 
     def record_fleet(self, stats: dict):
         """Latest fleet placement snapshot (corpus/fleet.py): leases,

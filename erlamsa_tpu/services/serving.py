@@ -46,16 +46,55 @@ from .supervisor import supervise
 _POKE = object()
 
 
+class _Pool:
+    """One capacity class's slice of the slot array: a contiguous range
+    of global slot ids [start, start+slots) backed by its OWN paged
+    arena, table and compiled step at this class's width. Engine-thread
+    owned (admission/dispatch); the drain only reads start/width."""
+
+    __slots__ = ("capacity", "width", "row_pages", "start", "slots",
+                 "table_np", "table", "arena", "step", "rids", "lens")
+
+    def __init__(self, capacity: int, page: int, start: int, slots: int):
+        import jax.numpy as jnp
+        import numpy as np
+
+        from ..ops import slots as slotops
+        from ..ops.paged import new_arena
+
+        self.capacity = capacity
+        self.width = max(page, ((capacity + page - 1) // page) * page)
+        self.row_pages = self.width // page
+        self.start = start
+        self.slots = slots
+        self.table_np = slotops.slot_table(slots, self.row_pages)
+        self.table = jnp.asarray(self.table_np)
+        self.arena = new_arena(slotops.arena_pages(slots, self.row_pages),
+                               page)
+        # per-slot device-call inputs; a slot's entries are written only
+        # between its admission and its dispatch (engine thread owns both)
+        self.rids = np.zeros(slots, np.int32)
+        self.lens = np.zeros(slots, np.int32)
+
+
 class ContinuousEngine:
     """Slot-based continuous batcher with the same ``fuzz(data, opts,
     timeout)`` surface as TpuBatcher/OracleBatcher.
 
-    One capacity class: the working width is ``capacity`` rounded up to
-    the arena page size, every slot owns ``width // page`` pages, and
-    requests longer than the width take the oracle escape (full fidelity
-    beats truncation — the flush batcher's overflow rule). The compiled
-    step comes from ops/slots.py STEP_CACHE, warmed in the constructor,
-    so no request ever pays an XLA compile."""
+    Capacity classes: by default one class — the working width is
+    ``capacity`` rounded up to the arena page size and every slot owns
+    ``width // page`` pages. With ``classes=(256, 4096, ...)`` the slot
+    array splits into per-class POOLS (ragged rows over one page size,
+    like the corpus arena): a request boards a slot of the smallest
+    class that holds it whole, rides that class's compiled step, and
+    short requests stop paying the widest row's gather/compute.
+    Routing is by LENGTH ONLY — never by load — so a request's bytes
+    stay a pure function of (seed, request_id, class width) and equal
+    the single-shot oracle at that capacity. Requests longer than the
+    top width take the oracle escape (full fidelity beats truncation —
+    the flush batcher's overflow rule). Compiled steps come from
+    ops/slots.py STEP_CACHE, warmed in the constructor, so no request
+    ever pays an XLA compile."""
 
     # lock discipline (analysis/rules_threads.py enforces this declaration)
     _GUARDED_BY = {
@@ -65,7 +104,8 @@ class ContinuousEngine:
 
     def __init__(self, capacity: int = 16384, slots: int = 64, seed=None,
                  max_running_time: float = 30.0, inflight: int = 1,
-                 page: int | None = None, warm: bool = True):
+                 page: int | None = None, warm: bool = True,
+                 classes=None):
         # inflight > 1 overlaps the next step's boarding with the
         # current step's compute, but co-resident steps SHARE the slot
         # pool — each can fill at most (slots - the other's occupancy),
@@ -74,24 +114,38 @@ class ContinuousEngine:
         # 100% fill, which wins whenever kernel time dominates; raise
         # it only when the device is fast enough that host-side
         # boarding, not compute, sets the step cadence.
-        import jax.numpy as jnp
-
         from ..ops import prng
         from ..ops import slots as slotops
-        from ..ops.paged import PAGE, new_arena
+        from ..ops.paged import PAGE
 
         self.page = page or PAGE
-        self.capacity = capacity
-        self.width = max(self.page,
-                         ((capacity + self.page - 1) // self.page) * self.page)
+        caps = (sorted({int(c) for c in classes}) if classes
+                else [int(capacity)])
+        if caps[0] <= 0:
+            raise ValueError(f"capacity classes must be positive, "
+                             f"got {caps}")
+        if slots < len(caps):
+            raise ValueError(f"{slots} slot(s) cannot cover {len(caps)} "
+                             f"capacity classes")
+        self.capacity = caps[-1]
         self.slots = slots
-        self.row_pages = self.width // self.page
         self._base = prng.base_key(seed or gen_urandom_seed())
-        self._table_np = slotops.slot_table(slots, self.row_pages)
-        self._table = jnp.asarray(self._table_np)
-        self._arena = new_arena(slotops.arena_pages(slots, self.row_pages),
-                                self.page)
         self._upload = slotops.upload_slots
+        # slots split evenly across pools, remainder to the SMALLEST
+        # class (short requests dominate real traffic); global slot id
+        # -> owning pool via _pool_of so the free list stays one flat
+        # list of global ids
+        per = slots // len(caps)
+        self._pools: list[_Pool] = []
+        self._pool_of: list[int] = []
+        start = 0
+        for i, cap in enumerate(caps):
+            cnt = per + (slots - per * len(caps) if i == 0 else 0)
+            self._pools.append(_Pool(cap, self.page, start, cnt))
+            self._pool_of.extend([i] * cnt)
+            start += cnt
+        self.width = self._pools[-1].width
+        self.row_pages = self._pools[-1].row_pages
         if warm:
             self.warmup()
         self._max_running_time = max_running_time
@@ -106,12 +160,6 @@ class ContinuousEngine:
         self._q: queue.Queue = queue.Queue()
         self._inflight: queue.Queue = queue.Queue()
         self._slots_sem = threading.Semaphore(max(1, inflight))
-        # per-slot device-call inputs; a slot's entries are written only
-        # between its admission and its dispatch (engine thread owns both)
-        import numpy as np
-
-        self._rids = np.zeros(slots, np.int32)
-        self._lens = np.zeros(slots, np.int32)
         self.steps = 0
         self.served = 0
         self.admitted = 0
@@ -123,14 +171,17 @@ class ContinuousEngine:
     # -- compiled-step cache ------------------------------------------------
 
     def warmup(self):
-        """Build + warm the compiled slot step (and the pow2 upload-chunk
-        shapes) through the process-wide STEP_CACHE — at server start,
-        never on the request path."""
+        """Build + warm every pool's compiled slot step (and the pow2
+        upload-chunk shapes) through the process-wide STEP_CACHE — at
+        server start, never on the request path."""
         from ..ops import slots as slotops
 
-        self._step = slotops.STEP_CACHE.slot_step(
-            self.slots, self.row_pages, page=self.page
-        )
+        for pool in self._pools:
+            pool.step = slotops.STEP_CACHE.slot_step(
+                pool.slots, pool.row_pages, page=self.page
+            )
+        # single-class alias kept for introspection/back-compat
+        self._step = self._pools[-1].step
 
     @staticmethod
     def compile_stats() -> dict:
@@ -156,7 +207,7 @@ class ContinuousEngine:
 
     def stats(self) -> dict:
         comp = self.compile_stats()
-        return {
+        out = {
             "mode": "continuous",
             "capacity": self.capacity,
             "width": self.width,
@@ -171,6 +222,12 @@ class ContinuousEngine:
             "compiled_steps": comp["entries"],
             "compiles": comp["compiles"],
         }
+        if len(self._pools) > 1:
+            out["classes"] = {
+                str(p.capacity): {"slots": p.slots, "width": p.width}
+                for p in self._pools
+            }
+        return out
 
     def fuzz(self, data: bytes, opts: dict, timeout: float = 90.0) -> bytes:
         if len(data) > self.width:
@@ -270,9 +327,25 @@ class ContinuousEngine:
             self._sweep()
             self._board()
             with self._lock:
-                take = min(len(self._pending), len(self._free))
-                admitted = [(self._free.pop(), self._pending.popleft())
-                            for _ in range(take)]
+                # route each pending request to its LENGTH-selected pool
+                # and admit FIFO among the servable: a request whose
+                # pool is full waits (never rides a wider class — bytes
+                # must stay a pure function of (seed, rid, class width),
+                # not of load)
+                by_pool: list[list[int]] = [[] for _ in self._pools]
+                for s in self._free:
+                    by_pool[self._pool_of[s]].append(s)
+                admitted = []
+                keep: deque[_Req] = deque()
+                while self._pending:
+                    r = self._pending.popleft()
+                    pi = self._route(len(r.data))
+                    if by_pool[pi]:
+                        admitted.append((by_pool[pi].pop(), r))
+                    else:
+                        keep.append(r)
+                self._pending = keep
+                self._free = [s for lst in by_pool for s in lst]
             if not admitted:
                 self._slots_sem.release()
                 return
@@ -286,48 +359,68 @@ class ContinuousEngine:
                 self._slots_sem.release()
                 raise
 
+    def _route(self, n: int) -> int:
+        """Pool index for a request of n bytes: the smallest class that
+        holds it whole. fuzz() already diverted anything over the top
+        width to the oracle escape."""
+        for i, pool in enumerate(self._pools):
+            if n <= pool.width:
+                return i
+        return len(self._pools) - 1
+
     def _dispatch(self, admitted):
         import numpy as np
 
-        occ = np.zeros(self.slots, np.int32)
+        groups: dict[int, list] = {}
         for slot, r in admitted:
-            self._rids[slot] = r.rid
-            self._lens[slot] = len(r.data)
-            occ[slot] = 1
-        with trace.span("serving.upload", reqs=len(admitted)):
-            self._arena = self._upload(
-                self._arena, self._table_np,
-                [(s, r.data) for s, r in admitted], page=self.page,
-            )
+            groups.setdefault(self._pool_of[slot], []).append((slot, r))
         t0 = time.monotonic()
+        parts = {}
+        for pi in sorted(groups):
+            pool = self._pools[pi]
+            part = groups[pi]
+            occ = np.zeros(pool.slots, np.int32)
+            for slot, r in part:
+                local = slot - pool.start
+                pool.rids[local] = r.rid
+                pool.lens[local] = len(r.data)
+                occ[local] = 1
+            with trace.span("serving.upload", reqs=len(part)):
+                pool.arena = self._upload(
+                    pool.arena, pool.table_np,
+                    [(s - pool.start, r.data) for s, r in part],
+                    page=self.page,
+                )
 
-        def _step_once():
-            # retry is only sound while inputs survive a failed attempt:
-            # the arena is never donated and a raised dispatch consumed
-            # nothing
-            chaos.fault_point("serving.step")
-            return self._step(self._arena, self._table, self._base,
-                              self._rids, self._lens, occ)
+            def _step_once(pool=pool, occ=occ):
+                # retry is only sound while inputs survive a failed
+                # attempt: the arena is never donated and a raised
+                # dispatch consumed nothing
+                chaos.fault_point("serving.step")
+                return pool.step(pool.arena, pool.table, self._base,
+                                 pool.rids, pool.lens, occ)
 
-        with trace.span("serving.step", reqs=len(admitted),
-                        width=self.width):
-            out, olens = STEP_RETRY.call(_step_once, site="serving.step")
-        self.steps += 1
-        self._fill.update(len(admitted) / self.slots)
+            with trace.span("serving.step", reqs=len(part),
+                            width=pool.width):
+                out, olens = STEP_RETRY.call(_step_once,
+                                             site="serving.step")
+            self.steps += 1
+            self._fill.update(len(part) / pool.slots)
+            parts[pi] = (out, olens)
         with self._lock:
             self._busy += 1
         metrics.GLOBAL.record_drain_backlog(self._inflight.qsize() + 1)
-        self._inflight.put((admitted, out, olens, t0))
+        self._inflight.put((admitted, parts, t0))
 
     def _drain(self):
         import numpy as np
 
         while True:
-            admitted, out, olens, t0 = self._inflight.get()
+            admitted, parts, t0 = self._inflight.get()
             try:
                 with trace.span("serving.drain", reqs=len(admitted)):
-                    data = np.asarray(out)
-                    lens = np.asarray(olens)
+                    hosted = {pi: (np.asarray(out), np.asarray(olens))
+                              for pi, (out, olens) in parts.items()}
             except BaseException:  # lint: broad-except-ok unblock waiters before the restart
                 with self._lock:
                     self._busy -= 1
@@ -343,7 +436,10 @@ class ContinuousEngine:
             metrics.GLOBAL.observe("batch_latency", dt)
             now = time.monotonic()
             for slot, r in admitted:
-                r.result = bytes(data[slot, :int(lens[slot])])
+                pi = self._pool_of[slot]
+                data, lens = hosted[pi]
+                local = slot - self._pools[pi].start
+                r.result = bytes(data[local, :int(lens[local])])
                 r.done.set()
                 metrics.GLOBAL.record_request(now - r.t_enq)
             self.served += len(admitted)  # drain thread only
@@ -370,7 +466,7 @@ def make_engine(backend: str, serving: str = "continuous", **kw):
         return ContinuousEngine(**{k: v for k, v in kw.items()
                                    if k in ("capacity", "slots", "seed",
                                             "max_running_time", "inflight",
-                                            "warm")})
+                                            "warm", "classes")})
     if serving not in ("continuous", "flush"):
         raise ValueError(f"unknown serving mode {serving!r}")
     return make_batcher(backend, **kw)

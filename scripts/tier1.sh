@@ -22,10 +22,13 @@
 # serve Prometheus text with throughput counters and latency histogram
 # buckets (erlamsa_tpu/obs).
 #
-# scripts/tier1.sh --arena-smoke additionally runs a tiny corpus batch
-# under BOTH memory layouts (--layout buckets|arena) and asserts the
-# paged-arena contract: byte-identical output streams, exactly ONE
-# compiled step shape for the arena run, and zero padded bytes wasted
+# scripts/tier1.sh --arena-smoke additionally runs a tiny MIXED-SIZE
+# corpus batch (two capacity classes) under BOTH memory layouts
+# (--layout buckets|arena) with device-resident offspring adoption
+# enabled, and asserts the ragged-arena contract: byte-identical output
+# streams, exactly the two class widths among the arena run's compiled
+# step shapes, zero padded bytes wasted, fewer bytes uploaded than the
+# buckets run, and at least one offspring adopted device-side
 # (corpus/arena.py + ops/paged.py).
 #
 # scripts/tier1.sh --fleet-smoke additionally runs a tiny corpus batch
@@ -173,15 +176,21 @@ EOF
 fi
 
 if [ $rc -eq 0 ] && [ $arena_smoke -eq 1 ]; then
-  echo "== arena smoke: paged layout must match buckets byte-for-byte =="
+  echo "== arena smoke: ragged paged layout must match buckets byte-for-byte =="
   timeout -k 10 600 env JAX_PLATFORMS=cpu python - <<'EOF'
 import os, shutil, sys, tempfile
 
 from erlamsa_tpu.corpus.runner import run_corpus_batch
+from erlamsa_tpu.services import metrics
 
-# mixed LENGTHS, one capacity class (len*slack <= 256): the configuration
-# where arena==buckets byte-identity is the pinned contract (README)
-SEEDS = [bytes([65 + i]) * (20 * (i + 1)) for i in range(6)]
+# mixed LENGTHS spanning TWO capacity classes (256B and 1KB): the
+# ragged arena derives its classes from the stored seed sizes, so each
+# seed rides a step at exactly its bucket capacity and arena==buckets
+# byte-identity is the pinned contract (README). Adoption is on for
+# BOTH runs (the adoption decision is layout-independent) so the arena
+# leg also exercises device-resident offspring.
+SEEDS = [bytes([65 + i]) * (20 * (i + 1)) for i in range(6)] \
+    + [b"\x81" * 300, b"\x82" * 420]
 
 
 def one_run(root, layout):
@@ -194,10 +203,11 @@ def one_run(root, layout):
             "corpus": SEEDS,
             "feedback": True,
             "seed": (9, 9, 9),
-            "n": 2,
+            "n": 3,
             "output": os.path.join(outdir, "%n.out"),
             "pipeline": "async",
             "layout": layout,
+            "adopt": True,
             "_stats": stats,
         },
         batch=8,
@@ -215,12 +225,17 @@ try:
 finally:
     shutil.rmtree(root, ignore_errors=True)
 waste = sum(b["padded_bytes_wasted"] for b in st_a["buckets"].values())
+widths = sorted({w for (_, w, _) in st_a["step_shapes"]})
+arena_snap = metrics.GLOBAL.snapshot().get("arena") or {}
+adopted = arena_snap.get("adopted", 0)
 ok = (rc_b == rc_a == 0 and blob_b and blob_a == blob_b
-      and len(st_a["step_shapes"]) == 1 and waste == 0
-      and st_a["bytes_uploaded"] < st_b["bytes_uploaded"])
+      and widths == [256, 1024] and waste == 0
+      and st_a["bytes_uploaded"] < st_b["bytes_uploaded"]
+      and st_a["offspring"] > 0 and adopted > 0)
 print(f"ARENA_SMOKE={'ok' if ok else 'FAIL'} identical={blob_a == blob_b} "
-      f"step_shapes={len(st_a['step_shapes'])} padded_waste={waste} "
-      f"upload_bytes={st_a['bytes_uploaded']}/{st_b['bytes_uploaded']}")
+      f"class_widths={widths} padded_waste={waste} "
+      f"upload_bytes={st_a['bytes_uploaded']}/{st_b['bytes_uploaded']} "
+      f"offspring={st_a['offspring']} device_adopted={adopted}")
 sys.exit(0 if ok else 1)
 EOF
   rc=$?

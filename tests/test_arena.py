@@ -1,8 +1,9 @@
 """Paged device-resident corpus arena (corpus/arena.py + ops/paged.py):
 allocator properties, page-table gather/scatter round-trips on the CPU
-backend, arena health metrics/exposition, and the (slow-marked)
+backend, ragged capacity-class routing + device-resident offspring
+adoption, arena health metrics/exposition, and the (slow-marked)
 end-to-end contracts — arena==buckets byte-identity at a fixed -s and
-transparency of injected ``arena.spill`` chaos faults."""
+transparency of injected ``arena.spill``/``arena.adopt`` chaos faults."""
 
 import os
 
@@ -10,7 +11,8 @@ import numpy as np
 import pytest
 
 from erlamsa_tpu.corpus.arena import (RESERVED_PAGES, TRASH_PAGE, ZERO_PAGE,
-                                      DeviceArena, PageAllocator, fit_page)
+                                      DeviceArena, PageAllocator, fit_page,
+                                      fit_page_classes, resolve_classes)
 from erlamsa_tpu.services import chaos, metrics
 
 # ---- allocator properties ----------------------------------------------
@@ -322,6 +324,178 @@ def test_arena_enqueue_drains_pending():
     assert ar.uploads == 1  # one pow2-padded chunk, not one per seed
 
 
+# ---- capacity classes (ragged rows) -------------------------------------
+
+
+def test_resolve_classes_auto_derives_bucket_caps():
+    # auto: the exact bucket capacities the stored seeds occupy, so
+    # every seed mutates at the width the bucket assembler would use
+    from erlamsa_tpu.corpus.assembler import bucket_capacity
+
+    sizes = [20, 40, 120, 300, 420]
+    got = resolve_classes(None, sizes, device_max=65536)
+    assert got == tuple(sorted({bucket_capacity(n, device_max=65536)
+                                for n in sizes}))
+    assert resolve_classes("auto", sizes, 65536) == got
+    # empty store still yields one class
+    assert len(resolve_classes(None, [], 65536)) == 1
+    # explicit specs: parsed, deduped, sorted, clamped to the device cap
+    assert resolve_classes("512,256,512", [], 65536) == (256, 512)
+    assert resolve_classes([256, 4096], [], 1024) == (256, 1024)
+    with pytest.raises(ValueError):
+        resolve_classes("0,256", [], 65536)
+    # page must divide every class width: gcd-based fit
+    assert fit_page_classes(256, (256, 4096, 65536)) == 256
+    assert fit_page_classes(256, (96, 256)) == 32
+
+
+def test_arena_class_routing_longer_sample_routes_up():
+    """Satellite regression: a sample longer than a class capacity must
+    route UP to the next class (or spill), never silently truncate; the
+    truncated counter fires ONLY for rows over the top class."""
+    ar = DeviceArena(num_pages=64, page=8, classes=(16, 32), donate=False)
+    assert ar.classes == (16, 32) and ar.width == 32
+    assert ar.ensure("short", b"a" * 10, tick=0)  # fits class 16
+    assert ar.ensure("mid", b"b" * 20, tick=0)  # 20 > 16: routes UP
+    assert ar.ensure("big", b"c" * 50, tick=0)  # over top class: clamped
+    ar.flush()
+    assert ar.alloc.cls_of("short") == 0
+    assert ar.alloc.cls_of("mid") == 1
+    assert ar.alloc.cls_of("big") == 1
+    assert ar.truncated == 1  # ONLY the genuinely over-max row
+    # the routed-up row keeps its full bytes
+    groups = ar.tables_for(["short", "mid", "big"],
+                           [b"a" * 10, b"b" * 20, b"c" * 50], tick=1)
+    assert [g.capacity for g in groups] == [16, 32]
+    g16, g32 = groups
+    assert g16.rows.tolist() == [0] and g16.lens.tolist() == [10]
+    assert g32.rows.tolist() == [1, 2] and g32.lens.tolist() == [20, 32]
+    got16 = np.asarray(ar.gather(g16.table))
+    got32 = np.asarray(ar.gather(g32.table))
+    assert got16.shape == (1, 16) and got32.shape == (2, 32)
+    assert bytes(got16[0][:10]) == b"a" * 10 and not got16[0][10:].any()
+    assert bytes(got32[0][:20]) == b"b" * 20 and not got32[0][20:].any()
+    assert bytes(got32[1]) == b"c" * 32
+
+
+def test_arena_single_class_table_for_unchanged():
+    # the legacy one-class constructor is the degenerate ragged arena:
+    # table_for still hands back one full-width table
+    ar = DeviceArena(num_pages=32, page=8, row_pages=2, donate=False)
+    assert ar.classes == (16,)
+    ar.ensure("s1", b"abcd", tick=0)
+    ar.flush()
+    table, lens, spilled = ar.table_for(["s1"], [b"abcd"], tick=1)
+    assert table.shape == (1, 2) and lens.tolist() == [4] and spilled == []
+
+
+# ---- device-resident offspring adoption ---------------------------------
+
+
+def _adopt_src(rows, width, fill):
+    """A fake step-output buffer: row r is fill[r] repeated, with
+    GARBAGE past every offspring's true length — adoption must mask it."""
+    import jax.numpy as jnp
+
+    buf = np.zeros((rows, width), np.uint8)
+    for r, b in enumerate(fill):
+        buf[r, :] = b  # deliberately nonzero across the full width
+    return jnp.asarray(buf)
+
+
+def test_arena_adopt_pending_roundtrip_per_class():
+    ar = DeviceArena(num_pages=64, page=8, classes=(16, 32), donate=False)
+    src16 = _adopt_src(2, 16, [0x41, 0x42])  # a class-16 step's output
+    src32 = _adopt_src(2, 32, [0x43, 0x44])  # a class-32 step's output
+    ar.enqueue_adopt("o1", 10, src16, 0)  # -> class 16
+    ar.enqueue_adopt("o2", 20, src32, 1)  # -> class 32
+    ar.enqueue_adopt("o3", 30, src32, 0)  # -> class 32, same src batch
+    assert ar.adopt_pending(tick=0) == 3
+    assert ar.adopted == 3 and ar.bytes_uploaded == 0  # nothing crossed PCIe
+    assert ar.alloc.cls_of("o1") == 0 and ar.alloc.cls_of("o2") == 1
+    groups = ar.tables_for(["o1", "o2", "o3"], [b"", b"", b""], tick=1)
+    assert [g.capacity for g in groups] == [16, 32]
+    got16 = np.asarray(ar.gather(groups[0].table))
+    got32 = np.asarray(ar.gather(groups[1].table))
+    # bytes match the source rows up to the true length, ZERO beyond it
+    # (the src garbage past lens must never reach the arena)
+    assert bytes(got16[0][:10]) == b"\x41" * 10 and not got16[0][10:].any()
+    assert groups[1].rows.tolist() == [1, 2]
+    assert bytes(got32[0][:20]) == b"\x44" * 20 and not got32[0][20:].any()
+    assert bytes(got32[1][:30]) == b"\x43" * 30 and not got32[1][30:].any()
+    # a successful adoption makes the host-upload fallback a no-op
+    assert ar.ensure("o1", b"\x41" * 10, tick=2)
+    assert ar.uploads == 0
+    st = ar.stats()
+    assert st["adopted"] == 3
+    assert st["classes"]["16"]["adopted"] == 1
+    assert st["classes"]["32"]["adopted"] == 2
+
+
+def test_arena_adopt_into_full_class_evicts_same_class_first():
+    # exactly TWO class-16 runs fit beyond the reserved pages
+    ar = DeviceArena(num_pages=RESERVED_PAGES + 4, page=8, classes=(16,),
+                     donate=False)
+    assert ar.ensure("old", b"x" * 16, tick=0)
+    assert ar.ensure("new", b"y" * 16, tick=1)
+    ar.flush()
+    src = _adopt_src(1, 16, [0x5A])
+    ar.enqueue_adopt("kid", 12, src, 0)
+    assert ar.adopt_pending(tick=2) == 1
+    # the LRU same-class victim made room; the adoptee is resident
+    assert not ar.alloc.resident("old")
+    assert ar.alloc.resident("new") and ar.alloc.resident("kid")
+    assert ar.stats()["classes"]["16"]["evictions"] == 1
+    table, lens, spilled = ar.table_for(["kid"], [b""], tick=3)
+    assert spilled == [] and lens.tolist() == [12]
+    assert bytes(np.asarray(ar.gather(table))[0][:12]) == b"\x5a" * 12
+
+
+def test_arena_adopt_skips_when_no_room_and_counts():
+    ar = DeviceArena(num_pages=RESERVED_PAGES + 2, page=8, classes=(16,),
+                     donate=False)
+    assert ar.ensure("pinned", b"p" * 16, tick=0)
+    ar.flush()
+    ar.alloc.pin("pinned")  # eviction cannot free anything
+    src = _adopt_src(1, 16, [0x7E])
+    ar.enqueue_adopt("kid", 8, src, 0)
+    assert ar.adopt_pending(tick=1) == 0
+    assert ar.adopt_skips == 1 and not ar.alloc.resident("kid")
+    # the host-upload fallback still lands the seed later
+    ar.alloc.unpin("pinned")
+    assert ar.ensure("kid", b"\x7e" * 8, tick=2)
+
+
+def test_arena_adopt_chaos_fault_drops_batch_to_host_path():
+    chaos.configure("arena.adopt:x1", seed=3)
+    try:
+        ar = DeviceArena(num_pages=64, page=8, classes=(16,), donate=False)
+        src = _adopt_src(1, 16, [0x66])
+        ar.enqueue_adopt("kid", 8, src, 0)
+        assert ar.adopt_pending(tick=0) == 0  # injected fault: batch dropped
+        assert ar.adopt_faults == 1 and ar.adopted == 0
+        assert not ar.alloc.resident("kid")
+        # the fallback path (store-listener upload) still works, and a
+        # later adoption round heals
+        ar.enqueue_adopt("kid", 8, src, 0)
+        assert ar.adopt_pending(tick=1) == 1
+        assert ar.alloc.resident("kid")
+    finally:
+        chaos.configure(None)
+
+
+def test_arena_reset_drops_queued_adoptions():
+    ar = DeviceArena(num_pages=64, page=8, classes=(16,), donate=False)
+    src = _adopt_src(1, 16, [0x31])
+    ar.enqueue_adopt("kid", 8, src, 0)
+    ar.class_adopted[0] = 5  # pretend prior churn
+    ar.adopted = 5
+    ar.reset()
+    # queued sources died with the device; counters never go backwards
+    assert ar.adopt_pending(tick=1) == 0
+    assert ar.adopted == 5 and ar.stats()["classes"]["16"]["adopted"] == 5
+
+
 # ---- metrics / exposition ----------------------------------------------
 
 
@@ -362,6 +536,44 @@ def test_prom_arena_golden_exposition():
         assert expected in lines, f"missing: {expected}"
     # without an arena snapshot the gauges are absent, not zero
     assert "erlamsa_arena_pages" not in prom.render(metrics.Counters())
+
+
+def test_prom_arena_class_exposition_and_flight_breadcrumb():
+    from erlamsa_tpu.obs import flight, prom
+
+    c = metrics.Counters()
+    c.record_arena({"pages": 128, "page_size": 256, "pages_free": 64,
+                    "occupancy": 0.5, "resident_seeds": 9,
+                    "evictions": 1, "defrags": 0, "spills": 0,
+                    "uploads": 2, "bytes_uploaded": 4096,
+                    "bytes_gathered": 123456, "adopted": 7,
+                    "adopt_skips": 0, "adopt_faults": 0,
+                    "classes": {
+                        "256": {"pages": 40, "resident_seeds": 6,
+                                "occupancy": 0.3175, "evictions": 1,
+                                "defrag_moves": 2, "adopted": 5},
+                        "4096": {"pages": 22, "resident_seeds": 3,
+                                 "occupancy": 0.1746, "evictions": 0,
+                                 "defrag_moves": 0, "adopted": 2},
+                    }})
+    lines = prom.render(c).splitlines()
+    for expected in [
+        "erlamsa_arena_bytes_gathered_total 123456",
+        "erlamsa_arena_adopted_total 7",
+        'erlamsa_arena_class_pages{class="256"} 40',
+        'erlamsa_arena_class_pages{class="4096"} 22',
+        'erlamsa_arena_class_resident_seeds{class="256"} 6',
+        'erlamsa_arena_class_occupancy{class="4096"} 0.1746',
+        'erlamsa_arena_class_evictions_total{class="256"} 1',
+        'erlamsa_arena_class_defrag_moves_total{class="256"} 2',
+        'erlamsa_arena_class_adopted_total{class="4096"} 2',
+    ]:
+        assert expected in lines, f"missing: {expected}"
+    # a ragged snapshot drops one class-mix breadcrumb in the recorder
+    assert any(e.get("kind") == "arena_class_mix"
+               and e.get("mix", {}).get("256") == 6
+               and e.get("adopted") == 7
+               for e in list(flight.GLOBAL._ring))
 
 
 def test_store_listener_fires_for_new_seeds_only(tmp_path):
@@ -443,6 +655,75 @@ def test_runner_arena_spill_chaos_transparent(tmp_path):
     assert outs_f == outs_c
     assert st_f["arena"]["spills"] == 4
     assert st_c["arena"]["spills"] == 0
+
+
+#: mixed LENGTHS spanning TWO capacity classes (256B and 1KB): the
+#: ragged arena derives one class per occupied bucket capacity, so every
+#: seed mutates at its bucket width and identity extends to mixed-size
+#: corpora — the r12 tentpole contract
+_TWO_CLASS_SEEDS = _ONE_CLASS_SEEDS + [b"\x91" * 300, b"\x92" * 420]
+
+
+@pytest.mark.slow
+def test_runner_ragged_arena_buckets_bit_identical(tmp_path):
+    """Acceptance (r12): a mixed-size corpus spanning two capacity
+    classes produces byte-identical output under --layout arena and
+    --layout buckets, with one compiled width per class, zero padded
+    waste, and fewer bytes uploaded — at BOTH the auto-derived and an
+    explicit equivalent class configuration."""
+    st_b, outs_b = _run_corpus("buckets", str(tmp_path / "rb"),
+                               str(tmp_path / "ob"), _TWO_CLASS_SEEDS)
+    st_a, outs_a = _run_corpus("arena", str(tmp_path / "ra"),
+                               str(tmp_path / "oa"), _TWO_CLASS_SEEDS)
+    assert outs_b == outs_a
+    assert st_b["new_hashes"] == st_a["new_hashes"] > 0
+    widths = sorted({w for (_, w, _) in st_a["step_shapes"]})
+    assert widths == [256, 1024]
+    assert all(b["padded_bytes_wasted"] == 0
+               for b in st_a["buckets"].values())
+    assert st_a["bytes_uploaded"] < st_b["bytes_uploaded"]
+    # per-class health is reported
+    cls = st_a["arena"]["classes"]
+    assert set(cls) == {"256", "1024"}
+    assert all(c["resident_seeds"] > 0 for c in cls.values())
+    # second configuration: the same classes given explicitly
+    st_e, outs_e = _run_corpus("arena", str(tmp_path / "re"),
+                               str(tmp_path / "oe"), _TWO_CLASS_SEEDS,
+                               arena_classes="256,1024")
+    assert outs_e == outs_a
+
+
+@pytest.mark.slow
+def test_runner_adoption_identity_zero_upload_and_chaos(tmp_path):
+    """Acceptance (r12): with --adopt, interesting offspring scatter
+    straight from the step's output buffer into arena pages — outputs
+    stay byte-identical to buckets+adopt (the adoption DECISION is
+    layout-independent), steady-state host->device traffic is the
+    initial seeding only, and injected arena.adopt faults fall back to
+    the host-upload path without changing a byte."""
+    st_b, outs_b = _run_corpus("buckets", str(tmp_path / "rb"),
+                               str(tmp_path / "ob"), _TWO_CLASS_SEEDS,
+                               adopt=True)
+    st_a, outs_a = _run_corpus("arena", str(tmp_path / "ra"),
+                               str(tmp_path / "oa"), _TWO_CLASS_SEEDS,
+                               adopt=True)
+    assert outs_a == outs_b
+    assert st_a["offspring"] == st_b["offspring"] > 0
+    ar = st_a["arena"]
+    assert ar["adopted"] > 0 and ar["adopt_faults"] == 0
+    # the zero-upload contract: every post-seeding admission was an
+    # adoption, so exactly ONE upload chunk (the initial corpus) ever
+    # crossed PCIe
+    assert ar["uploads"] == 1
+    # chaos leg: every adoption batch faulted -> all offspring ride the
+    # host-upload fallback; bytes must not change, uploads must grow
+    st_c, outs_c = _run_corpus("arena", str(tmp_path / "rc"),
+                               str(tmp_path / "oc"), _TWO_CLASS_SEEDS,
+                               adopt=True, chaos_spec="arena.adopt:x99")
+    assert outs_c == outs_a
+    assert st_c["arena"]["adopt_faults"] > 0
+    assert st_c["arena"]["adopted"] == 0
+    assert st_c["arena"]["uploads"] > ar["uploads"]
 
 
 @pytest.mark.slow

@@ -313,12 +313,17 @@ def test_fleet_kill_one_of_four_redistributes_and_replays(tmp_path):
 
 
 @pytest.mark.slow
-def test_fleet_capacity_class_is_global(tmp_path):
-    """The capacity class is computed over the WHOLE store, never per
-    shard: a fleet whose largest seed lives on one shard still mutates
-    every slice at the same row width (one step shape per scan bound),
-    which is what makes shard-count identity possible at all."""
+def test_fleet_capacity_classes_are_global(tmp_path):
+    """The capacity-class set is computed over the WHOLE store, never
+    per shard: a fleet whose largest seed lives on one shard still
+    mutates every slice at the same per-class row widths (the same
+    compiled shape set on every shard), which is what makes shard-count
+    identity possible at all. With the ragged arena the set is the
+    bucket capacities the stored seeds occupy — not one width."""
+    from erlamsa_tpu.corpus.assembler import bucket_capacity
+
     rc, _, stats = _run_fleet(tmp_path, "cap", shards=4, n=2)
     assert rc == 0
     widths = {shape[1] for shape in stats["step_shapes"]}
-    assert len(widths) == 1
+    assert widths == {bucket_capacity(len(s)) for s in SEEDS}
+    assert len(widths) == 2  # SEEDS span two classes by construction

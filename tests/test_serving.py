@@ -154,6 +154,41 @@ def test_continuous_oversized_request_takes_oracle_escape():
     assert eng.admitted == 0  # never entered the slot pipeline
 
 
+def test_multiclass_engine_routes_by_length_and_matches_single_shot():
+    # capacity classes: each request rides the smallest class that holds
+    # it whole, and answers byte-identically to the single-shot oracle
+    # AT THAT CLASS CAPACITY with its global request id — routing is by
+    # length only, so load can never leak into bytes
+    from erlamsa_tpu.ops import prng
+    from erlamsa_tpu.ops.buffers import pack
+    from erlamsa_tpu.ops.slots import STEP_CACHE
+
+    payloads = [b"s" * 40, b"L" * 300, b"m" * 200, b"H" * 500, b"t" * 16]
+    eng = ContinuousEngine(slots=4, seed=SEED, classes=(256, 512))
+    outs = _serve_all(eng, payloads)
+    base = prng.base_key(SEED)
+    for rid, (data, got) in enumerate(zip(payloads, outs)):
+        cap = 256 if len(data) <= 256 else 512
+        step = STEP_CACHE.request_step(cap, 1)
+        packed = pack([data], capacity=cap)
+        out, lens = step(base, np.array([rid], np.int32),
+                         packed.data, packed.lens)
+        want = bytes(np.asarray(out)[0, :int(np.asarray(lens)[0])])
+        assert got == want and got
+    st = eng.stats()
+    assert st["classes"]["256"]["slots"] == 2
+    assert st["classes"]["512"]["width"] == 512
+    assert st["capacity"] == 512 and eng.width == 512
+    # over the TOP class -> oracle escape, never truncated
+    assert eng.fuzz(bytes(range(256)) * 3, {}, timeout=300)
+    assert eng.admitted == len(payloads)  # the escape never boarded
+    # slots all came home across both pools
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline and len(eng._free) < eng.slots:
+        time.sleep(0.01)
+    assert sorted(eng._free) == list(range(eng.slots))
+
+
 def test_make_engine_dispatch():
     assert isinstance(make_engine("tpu", serving="continuous",
                                   capacity=CAP, slots=4, seed=SEED),
