@@ -134,16 +134,20 @@ def _run_stage(jax, base, batch_n: int, seed_len: int, capacity: int,
             os.environ.pop("ERLAMSA_PALLAS", None)
 
 
-def _run_full_set_stage(batch_n: int, seed_len: int, cases: int, t0: float):
+def _run_full_set_stage(batch_n: int, seed_len: int, cases: int, t0: float,
+                        struct: str = "off"):
     """The honest product number: end-to-end throughput with the FULL
     reference mutator set at default weights — device mutators ride
-    fuzz_batch, the structured tail (sgm/js/ab/ad/tree/fuse/len/b64/uri/
-    zip) routes through the hybrid dispatcher's host oracle pool, exactly
-    the services/batchrunner.py path a `--backend tpu` CLI run takes.
+    fuzz_batch; with struct="off" the structured tail (sgm/js/tree/b64/
+    uri/zip) routes through the hybrid dispatcher's host oracle pool,
+    exactly the services/batchrunner.py path a `--backend tpu` CLI run
+    takes. struct="device" arms the r13 span-splice kernels
+    (--struct-kernels): the tree/js/sgm/b64/uri codes run on device and
+    only zip (plus overflow) may still touch the host.
 
-    Returns (warm_samples_per_sec, host_routed_fraction). Warm = the first
-    case (which pays trace+compile) is dropped via the runner's per-case
-    finish timestamps; needs cases >= 2.
+    Returns (warm_samples_per_sec, host_routed_fraction, stats). Warm =
+    the first case (which pays trace+compile) is dropped via the runner's
+    per-case finish timestamps; needs cases >= 2.
     """
     from erlamsa_tpu.services.batchrunner import run_tpu_batch
 
@@ -154,6 +158,7 @@ def _run_full_set_stage(batch_n: int, seed_len: int, cases: int, t0: float):
         "n": max(2, cases),
         "output": os.devnull,
         "_stats": stats,
+        "struct": struct,
     }
     rc = run_tpu_batch(opts, batch=batch_n)
     if rc != 0 or len(stats.get("finish_times", [])) < 2:
@@ -162,10 +167,10 @@ def _run_full_set_stage(batch_n: int, seed_len: int, cases: int, t0: float):
     warm_sps = batch_n * (len(ft) - 1) / (ft[-1] - ft[0])
     host_frac = stats["host_total"] / max(stats["total"], 1)
     _phase(
-        f"full-set stage: {warm_sps:,.0f} samples/s warm, "
+        f"full-set stage (struct={struct}): {warm_sps:,.0f} samples/s warm, "
         f"{host_frac:.1%} host-routed", t0,
     )
-    return warm_sps, host_frac
+    return warm_sps, host_frac, stats
 
 
 def _run_corpus_stage(batch_n: int, seed_len: int, cases: int, t0: float,
@@ -431,7 +436,7 @@ def child_main() -> None:
     # full-set stage below is the end-to-end product number (default
     # weights, host pool busy). Device record stays banked if this fails.
     try:
-        full_sps, host_frac = _run_full_set_stage(
+        full_sps, host_frac, _fstats = _run_full_set_stage(
             BATCH, SEED_LEN, max(2, ITERS // 3), t0
         )
         record["full_set_samples_per_sec"] = round(full_sps, 1)
@@ -440,6 +445,34 @@ def child_main() -> None:
         _write_result(line)
     except Exception as e:  # noqa: BLE001 — device number still stands
         _phase(f"full-set stage FAILED: {type(e).__name__}: {e}", t0)
+
+    # struct-engine stage (r13): the SAME full-set shape with
+    # --struct-kernels armed — tree/js/sgm/b64/uri ride the device
+    # span-splice kernels (ops/tree_mutators.py), so the host tail
+    # collapses to zip+overflow. Recorded against the struct-off full-set
+    # number above (the retired host tail) and against the device-subset
+    # headline (the ISSUE target: full set within 15% of device-subset).
+    # ERLAMSA_BENCH_STRUCT=0 skips.
+    if os.environ.get("ERLAMSA_BENCH_STRUCT", "1") != "0":
+        try:
+            struct_sps, struct_host_frac, sstats = _run_full_set_stage(
+                BATCH, SEED_LEN, max(2, ITERS // 3), t0, struct="device"
+            )
+            record["struct_samples_per_sec"] = round(struct_sps, 1)
+            record["struct_host_routed_frac"] = round(struct_host_frac, 4)
+            record["struct_upload_bytes_per_sample"] = round(
+                sstats.get("struct_bytes_uploaded", 0)
+                / max(sstats.get("total", 1), 1), 1
+            )
+            if "full_set_samples_per_sec" in record:
+                record["struct_vs_full_set"] = round(
+                    struct_sps / full_sps, 3) if full_sps else 0.0
+            record["struct_vs_device_subset"] = round(
+                struct_sps / sps, 3) if sps else 0.0
+            line = json.dumps(record)
+            _write_result(line)
+        except Exception as e:  # noqa: BLE001 — earlier numbers stand
+            _phase(f"struct stage FAILED: {type(e).__name__}: {e}", t0)
 
     # corpus-mode stage: the feedback engine on a mixed-length seed set,
     # with per-bucket padded-bytes-wasted so the bucketing win over the

@@ -64,6 +64,10 @@ class LintConfig:
         "utf8_mutators", "payload_mutators", "fuse_mutators", "patterns",
         "lenfield", "crc32", "prng", "sizer", "fused", "scheduler",
         "slots",
+        # r13 struct span-splice kernels; ops/structure.py stays OUT on
+        # purpose — its key-led host_struct_fuzz is the numpy oracle and
+        # coerces draws with int() by design
+        "tree_mutators",
     )
     #: modules whose raw send/recv + durable writes must route through a
     #: chaos fault site (chaos-site-coverage)

@@ -125,6 +125,14 @@ def run_corpus_fleet(opts: dict, batch: int = 1024) -> int:
     if opts.get("state_path"):
         print("# fleet: --state checkpointing is single-device only; "
               "ignoring", file=sys.stderr)
+    if str(opts.get("struct") or "off") != "off":
+        # the struct overlay (ops/structure.py) is routed per scheduled
+        # case against one arena; sharding it means per-shard span panels
+        # and a merged routing draw — not built yet, so the fleet runs
+        # the plain device set rather than silently diverging from the
+        # single-device struct stream
+        print("# fleet: --struct overlay is single-device only; ignoring",
+              file=sys.stderr)
 
     store = CorpusStore(opts["corpus_dir"])
     fsck = store.fsck()

@@ -358,13 +358,58 @@ def run_corpus_batch(opts: dict, batch: int = 1024) -> int:
                   file=sys.stderr)
             return 0
 
+    # r13 struct engine (--struct): the closed-loop runner gains the
+    # span-splice mutators as a routed per-case overlay. A one-pass
+    # tokenizer runs at store ADMISSION (seeds now, offspring when
+    # store.add fires the chained listener below — adoption re-tokenizes
+    # for free), the StructRouter picks a deterministic routed subset per
+    # case (neutral device mass — the live score table stays
+    # device-resident, and forcing it per case would add a sync), and the
+    # routed rows ride one extra vmapped step ('device') or the numpy
+    # span-oracle ('host', the parity path). Outputs stay sync==async
+    # byte-identical: routing is a pure function of (seed, case,
+    # scheduled samples) and overlay order is slot order.
+    struct_mode = str(opts.get("struct") or "off")
+    if struct_mode not in ("off", "host", "device"):
+        raise ValueError(f"struct must be one of off/host/device, "
+                         f"got {struct_mode!r}")
+    struct_router = None
+    struct_step = None
+    span_cache = None
+    from ..ops import registry as _registry
+    from ..ops import structure as stm
+
+    _struct_flag_before = _registry.struct_kernels_enabled()
+    if struct_mode != "off":
+        _registry.set_struct_kernels(True)
+        span_cache = stm.SpanCache()
+        struct_router = stm.StructRouter(opts["seed"], selected)
+        if struct_mode == "device":
+            from ..ops.tree_mutators import make_struct_step
+
+            struct_step = make_struct_step()
+        # chain the admission listener (after the arena installed its
+        # own): every seed that enters the store — initial corpus,
+        # monitors, adopted offspring — gets its span table the moment
+        # its bytes are known
+        _prev_listener = store.listener
+
+        def _struct_admit(sid, _prev=_prev_listener):
+            span_cache.note(sid, store.get(sid))
+            if _prev is not None:
+                _prev(sid)
+
+        store.listener = _struct_admit
+        for sid in store.ids():
+            span_cache.note(sid, store.get(sid))
+
     writer, _mt = out.string_outputs(opts.get("output", "-"))
     stats = opts.get("_stats")  # caller-owned dict for measured numbers
     seen_hashes: set[bytes] = set()
     bucket_stats: dict[int, dict] = {}
     # tallies the drain worker owns in async mode (main reads after join)
     tallies = {"truncated": 0, "total": 0, "new_hashes": 0,
-               "bytes_uploaded": 0, "offspring": 0}
+               "bytes_uploaded": 0, "offspring": 0, "struct_routed": 0}
     # distinct (rows, capacity, scan_len) triples the jitted step saw —
     # the compiled-program count the arena drives to O(1)
     step_shapes: set[tuple] = set()
@@ -469,6 +514,68 @@ def run_corpus_batch(opts: dict, batch: int = 1024) -> int:
         metrics.GLOBAL.record_stage("dispatch", dispatch_s)
         return ids, launched, scores_out, dispatch_s
 
+    def _dispatch_struct(case, ids, samples):
+        """Route and dispatch this case's struct overlay: returns
+        ([(slot, code_idx)], work) where work is the in-flight device
+        (out, lens, applied) triple ('device', JAX async dispatch) or an
+        already-computed {slot: bytes} dict ('host'). Oversized samples
+        (> trunc_cap) never struct-route — the bucket path truncates
+        them and the span table describes the UNtruncated bytes."""
+        if struct_router is None:
+            return [], None
+        struct_router.prepare(samples, span_cache, keys=ids)
+        excl = np.asarray([len(s) > trunc_cap for s in samples], bool)
+        codes = struct_router.route(case, excluded=excl)
+        routed = [(slot, int(c)) for slot, c in enumerate(codes) if c >= 0]
+        if not routed:
+            return [], None
+        tallies["struct_routed"] += len(routed)
+        caps = np.asarray(
+            [bucket_capacity(len(samples[slot]), device_max=trunc_cap)
+             for slot, _ in routed], np.int32)
+        if struct_step is None:
+            res = {}
+            for (slot, ci), cap in zip(routed, caps):
+                nd, cnt = span_cache.get(ids[slot], samples[slot])
+                key = stm.struct_sample_key(base, case, slot)
+                res[slot] = stm.host_struct_fuzz(key, samples[slot], nd,
+                                                 int(cnt), ci, int(cap))
+            return routed, res
+        # pow2-padded panel of just the routed rows (the scheduled set
+        # changes every case, so unlike the batchrunner's resident panel
+        # the routed BYTES ride along — still a ~8%-of-batch upload, not
+        # a per-sample host round-trip); pad rows carry code -1
+        k = len(routed)
+        kp = max(8, 1 << (k - 1).bit_length())
+        width = int(caps.max())
+        panel = np.zeros((kp, width), np.uint8)
+        lens = np.zeros(kp, np.int32)
+        nds = np.zeros((kp, stm.SPAN_NODES, 4), np.int32)
+        cnts = np.zeros(kp, np.int32)
+        caps_p = np.full(kp, width, np.int32)
+        caps_p[:k] = caps
+        slots_arr = np.concatenate([
+            np.asarray([slot for slot, _ in routed], np.int32),
+            batch + np.arange(kp - k, dtype=np.int32),
+        ])
+        cds = np.concatenate([
+            np.asarray([c for _, c in routed], np.int32),
+            np.full(kp - k, -1, np.int32),
+        ])
+        for p, (slot, _c) in enumerate(routed):
+            raw = samples[slot]
+            panel[p, :len(raw)] = np.frombuffer(raw, np.uint8)
+            lens[p] = len(raw)
+            nds[p], cnts[p] = span_cache.get(ids[slot], raw)
+        tallies["bytes_uploaded"] += (panel.nbytes + lens.nbytes
+                                      + nds.nbytes + cnts.nbytes
+                                      + caps_p.nbytes + slots_arr.nbytes
+                                      + cds.nbytes)
+        with trace.span("corpus.struct_dispatch", case=case, rows=k):
+            work = struct_step(base, case, slots_arr, panel, lens, nds,
+                               cnts, caps_p, cds)
+        return routed, work
+
     def dispatch_case(case, scores_in):
         """Schedule, assemble and dispatch every bucket of one case.
 
@@ -489,8 +596,14 @@ def run_corpus_batch(opts: dict, batch: int = 1024) -> int:
         if trunc:
             tallies["truncated"] += trunc
             metrics.GLOBAL.record_truncated(trunc)
+        # struct overlay dispatches FIRST so its device work overlaps the
+        # bucket/arena assembly below (JAX async dispatch)
+        struct_rows, struct_work = _dispatch_struct(case, ids, samples)
         if use_arena:
-            return _dispatch_arena(case, ids, samples, scores_in)
+            ids, launched, scores_out, dispatch_s = _dispatch_arena(
+                case, ids, samples, scores_in)
+            return (ids, launched, scores_out, dispatch_s, struct_rows,
+                    struct_work)
 
         launched = []
         scores_out = scores_in
@@ -541,17 +654,21 @@ def run_corpus_batch(opts: dict, batch: int = 1024) -> int:
             raise
         metrics.GLOBAL.record_stage("assemble", assemble_s)
         metrics.GLOBAL.record_stage("dispatch", dispatch_s)
-        return ids, launched, scores_out, dispatch_s
+        return ids, launched, scores_out, dispatch_s, struct_rows, struct_work
 
     class _CaseWork:
-        __slots__ = ("case", "ids", "launched", "scores", "dispatch_s")
+        __slots__ = ("case", "ids", "launched", "scores", "dispatch_s",
+                     "struct_rows", "struct_work")
 
-        def __init__(self, case, ids, launched, scores, dispatch_s):
+        def __init__(self, case, ids, launched, scores, dispatch_s,
+                     struct_rows=(), struct_work=None):
             self.case = case
             self.ids = ids
             self.launched = launched
             self.scores = scores
             self.dispatch_s = dispatch_s
+            self.struct_rows = struct_rows
+            self.struct_work = struct_work
 
     drain: _DrainWorker | None = None
 
@@ -598,6 +715,7 @@ def run_corpus_batch(opts: dict, batch: int = 1024) -> int:
         metrics.GLOBAL.record_stage("hash", time.perf_counter() - t_h)
         metrics.GLOBAL.record_batch(len(results), case_bytes,
                                     device_seconds)
+        metrics.GLOBAL.record_routed_total(len(results))
 
         # external feedback (monitors/proxy/faas) folds in at the case
         # boundary; anonymous events credit this case's seeds
@@ -681,6 +799,34 @@ def run_corpus_batch(opts: dict, batch: int = 1024) -> int:
             metrics.GLOBAL.record_bucket(
                 b.capacity, b.rows, b.pad_rows, b.padded_bytes_wasted
             )
+        # struct overlay lands AFTER the device-set outputs (routed rows
+        # rode the bucket step too; their device-set output is replaced,
+        # mirroring the batchrunner's host-overwrite contract). Overlaid
+        # slots leave devsrc: their adopted offspring go through the
+        # store listener's host upload, not the device-set output buffer
+        # (which holds the WRONG bytes for them).
+        if work.struct_rows:
+            if struct_step is not None:
+                s_out, s_lens, s_app = work.struct_work
+                out_np = np.asarray(s_out)
+                lens_np = np.asarray(s_lens)
+                app_np = np.asarray(s_app)
+                for p, (slot, ci) in enumerate(work.struct_rows):
+                    results[slot] = bytes(out_np[p, :int(lens_np[p])])
+                    if devsrc is not None:
+                        devsrc.pop(slot, None)
+                    metrics.GLOBAL.record_mutator(
+                        stm.STRUCT_CODES[ci],
+                        applied=int(app_np[p]) >= 0)
+            else:
+                for slot, ci in work.struct_rows:
+                    payload = work.struct_work[slot]
+                    results[slot] = payload
+                    if devsrc is not None:
+                        devsrc.pop(slot, None)
+                    metrics.GLOBAL.record_mutator(
+                        stm.STRUCT_CODES[ci],
+                        applied=payload != store.get(ids[slot]))
         drain_wait_s = time.perf_counter() - t_w
         metrics.GLOBAL.record_stage("drain_wait", drain_wait_s)
         # dispatch + drain_wait bounds the device-batch turnaround
@@ -717,6 +863,9 @@ def run_corpus_batch(opts: dict, batch: int = 1024) -> int:
                 )
         metrics.GLOBAL.record_stage("oracle_fallback",
                                     time.perf_counter() - t_w)
+        # the whole case host-routed (struct overlay included — degraded
+        # mode trades the device stream for availability)
+        metrics.GLOBAL.record_host_routed("degraded", len(ids))
         return results
 
     def _probe_device():
@@ -748,12 +897,12 @@ def run_corpus_batch(opts: dict, batch: int = 1024) -> int:
                         # N's energy events must land before schedule N+1
                         # draws
                         drain.wait_done(case - 1)
-                    ids, launched, scores, dispatch_s = dispatch_case(
-                        case, scores
-                    )
+                    (ids, launched, scores, dispatch_s, s_rows,
+                     s_work) = dispatch_case(case, scores)
                     if stats is not None:
                         stats.setdefault("schedules", []).append(list(ids))
-                    work = _CaseWork(case, ids, launched, scores, dispatch_s)
+                    work = _CaseWork(case, ids, launched, scores, dispatch_s,
+                                     struct_rows=s_rows, struct_work=s_work)
                     if drain is not None:
                         drain.submit(work)
                     else:
@@ -824,6 +973,9 @@ def run_corpus_batch(opts: dict, batch: int = 1024) -> int:
             # abandon, not close: close re-raises the drain error and
             # would mask the exception already unwinding through here
             drain.abandon()
+        # process-global flag: later runs in this process (tests, bench
+        # stages) must see their own routing split
+        _registry.set_struct_kernels(_struct_flag_before)
 
     store.save()
     dt = time.perf_counter() - t0
@@ -845,6 +997,8 @@ def run_corpus_batch(opts: dict, batch: int = 1024) -> int:
                      bytes_uploaded=bytes_up,
                      offspring=tallies["offspring"],
                      step_shapes=sorted(step_shapes),
+                     struct=struct_mode,
+                     struct_routed=tallies["struct_routed"],
                      store_stats=store.stats())
         if arena is not None:
             stats["arena"] = arena.stats()

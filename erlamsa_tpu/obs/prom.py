@@ -129,6 +129,16 @@ def render(counters: metrics.Counters | None = None) -> str:
         w.sample("erlamsa_mutator_failed_total", entry["failed"],
                  {"code": code})
 
+    w.head("erlamsa_host_routed_total", "counter",
+           "Samples served by the host engine instead of the device, by "
+           "mutator code (overflow = past the device budget). With "
+           "--struct-kernels only zip/overflow should appear.")
+    for code, n in snap["host_routed"].items():
+        w.sample("erlamsa_host_routed_total", n, {"code": code})
+    w.head("erlamsa_host_tail_pct", "gauge",
+           "Percent of routed samples served by the host engine.")
+    w.sample("erlamsa_host_tail_pct", snap["host_tail_pct"])
+
     w.head("erlamsa_bucket_rows_total", "counter",
            "Rows assembled, by capacity bucket.")
     for cap, b in snap["buckets"].items():

@@ -34,6 +34,8 @@ TAG_FUSE = 0x0B  # device fuse jump-pair scan (ops/fuse_mutators.py)
 TAG_TABLE = 0x0C  # payload-table row draws (ops/payload_mutators.py)
 TAG_SCHED = 0x0D  # corpus energy-schedule draws (corpus/energy.py keeps a
 #                   jax-free copy; tests pin the two equal)
+TAG_STRUCT = 0x0E  # struct span-splice draws (ops/structure.py host oracle
+#                    and ops/tree_mutators.py device kernels share them)
 
 
 def base_key(seed: tuple[int, int, int] | int) -> jax.Array:

@@ -113,23 +113,54 @@ HOST_CODES: dict[str, int] = {
 
 ALL_CODES = DEVICE_CODES + tuple(HOST_CODES)
 
+# r13: the struct span-splice engine (ops/structure.py +
+# ops/tree_mutators.py) can take every host code except zip device-side.
+# The flag is process-global on purpose, like payloads.configure(): the
+# compiled-step caches and checkpoints key on registry_version(), which
+# folds the ACTIVE routing split in (see below), so flipping the flag can
+# never alias a stale compiled entry.
+STRUCT_DEVICE_CODES = ("tr2", "td", "ts1", "tr", "ts2", "js", "sgm",
+                       "b64", "uri")
+_struct_kernels = False
+
+
+def set_struct_kernels(on: bool) -> None:
+    """Route the struct codes to the device span-splice kernels
+    (``--struct-kernels``). Call before building fuzzers/steps — like
+    payloads.configure(), the routing split is baked into compiled-step
+    cache keys via registry_version()."""
+    global _struct_kernels
+    _struct_kernels = bool(on)
+
+
+def struct_kernels_enabled() -> bool:
+    return _struct_kernels
+
+
+def active_host_codes() -> tuple[str, ...]:
+    """The codes that still host-route under the current flag state —
+    all of HOST_CODES by default, zip alone with struct kernels on."""
+    if _struct_kernels:
+        return tuple(c for c in HOST_CODES if c not in STRUCT_DEVICE_CODES)
+    return tuple(HOST_CODES)
+
 
 def code_index(code: str) -> int:
     return DEVICE_CODES.index(code)
 
 
 def registry_version() -> str:
-    """Stable fingerprint of the device mutator set. Compiled-step caches
-    (ops/slots.py StepCache) key on it so a registry change — a mutator
-    added, removed or reordered, which shifts every weighted pick — can
-    never serve a stale compiled program; checkpoints already stamp the
-    engine for the same reason (services/checkpoint.py)."""
+    """Stable fingerprint of the device mutator set AND the host/device
+    routing split. Compiled-step caches (ops/slots.py StepCache) key on
+    it so a registry change — a mutator added, removed or reordered,
+    which shifts every weighted pick, or a code moving across the
+    host/device split (the --struct-kernels flip) — can never serve a
+    stale compiled program; checkpoints already stamp the engine for the
+    same reason (services/checkpoint.py)."""
     import zlib
 
-    return "r%d-%08x" % (
-        NUM_DEVICE_MUTATORS,
-        zlib.crc32(",".join(DEVICE_CODES).encode()),
-    )
+    split = ",".join(DEVICE_CODES) + "|" + ",".join(active_host_codes())
+    return "r%d-%08x" % (NUM_DEVICE_MUTATORS, zlib.crc32(split.encode()))
 
 
 def predicates(data, n, sizer_any=None):

@@ -203,6 +203,9 @@ class StepCache:
     def request_step(self, capacity: int, batch: int, engine: str = "fused",
                      slices=DEFAULT_SLICES, scan_len: int | None = None,
                      donate=False):
+        # registry_version() also fingerprints the host/device routing
+        # split (r13): a --struct-kernels flip between serving sessions
+        # can never alias a compiled step built under the other split
         key = ("request", capacity, batch, engine, str(slices), scan_len,
                resolve_donate(donate), registry_version())
 

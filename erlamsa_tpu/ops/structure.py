@@ -1,0 +1,461 @@
+"""Span-table tokenizer + host span-oracle for the structured mutators.
+
+The host mutator tail (sgm js tr2 td ts1 tr ts2 b64 uri — everything in
+HOST_CODES except zip) kept ~8% of full-set samples off the device: each
+routed sample paid a host round-trip through the sequential oracle
+engines. This module retires that tail the way the r5 device moves did:
+re-express the structured mutators as *span splices* over a fixed-shape
+table that is computed ONCE per seed on the host.
+
+``tokenize()`` is a one-pass scanner over the same delimiter event set as
+the tree oracle (models/treeops.py _DELIMS): bracket pairs () [] <> {}
+and symmetric quotes " '. It emits up to SPAN_NODES completed nodes as
+int32[SPAN_NODES, 4] rows ``(start, end, depth, kind)`` in document
+order — JSON objects/arrays/strings and SGML tags share the layout (kind
+is the opener byte). Unclosed frames and unmatched closers degrade to
+literals (the oracle's partial_parse flattens them the same way), and
+openers deeper than MAX_DEPTH are literals too — both fallback paths are
+pinned by tests/test_struct_kernels.py.
+
+Two implementations consume the table with IDENTICAL counter-keyed
+draws (threefry is backend-deterministic, so a draw computed host-side
+equals the same draw inside a jitted kernel):
+
+  * the numpy span-oracle here (``host_struct_fuzz``) — the reference
+    semantics and the ``--struct host`` parity path, and
+  * the vmapped device kernels (ops/tree_mutators.py) — the
+    ``--struct-kernels`` throughput path.
+
+The parity suite pins them byte-identical per mutator; the tier1
+``--struct-smoke`` leg pins a full batchrunner run identical across the
+flag flip. Routing (StructRouter) is a pure function of
+(seed, case, scheduler scores), so host and device modes route — and
+therefore draw — identically.
+
+zip stays host-routed (central-directory rewrite is inherently
+sequential); with struct kernels on it is the ONLY remaining host code.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+SPAN_NODES = 64  # fixed table height; later-starting nodes beyond it drop
+MAX_DEPTH = 32  # openers deeper than this are literals (overflow fallback)
+
+#: struct mutator codes in device switch-branch order; keep stable
+#: (ops/tree_mutators.py branch index == this order).
+STRUCT_CODES = ("tr2", "td", "ts1", "tr", "ts2", "js", "sgm", "b64", "uri")
+NUM_STRUCT = len(STRUCT_CODES)
+
+#: mixing constant for the struct routing RNG stream ("STUC")
+ROUTE_SALT = 0x53545543
+
+# delimiter event set — models/treeops.py _DELIMS minus the symmetric
+# quotes, which get their own literal-interior scan below
+_OPENERS = {40: 41, 91: 93, 60: 62, 123: 125}
+_QUOTES = (34, 39)
+
+_JSON_KINDS = (123, 91, 34)  # { [ " — the node kinds the js mutator edits
+_TAG_KIND = 60  # < — the sgm mutator's node kind
+
+_B64_WS = (9, 10, 13, 32)
+_B64_ALPHA = (b"ABCDEFGHIJKLMNOPQRSTUVWXYZ"
+              b"abcdefghijklmnopqrstuvwxyz0123456789+/")
+_HEX_UC = b"0123456789ABCDEF"
+
+# base64 decode LUT: char -> 6-bit value; '=' and invalid bytes decode 0
+# (tolerant on purpose — the router validates, the kernel just splices,
+# and host/device share the same tolerance so parity holds regardless)
+B64_DEC = np.zeros(256, np.int32)
+for _i, _c in enumerate(_B64_ALPHA):
+    B64_DEC[_c] = _i
+B64_ENC = np.frombuffer(_B64_ALPHA, np.uint8).astype(np.int32)
+
+# js payload gadgets (spirit of models/jsonfmt.py UNSERIALIZE_PAYLOADS),
+# packed like ops/payloads.py: uint8[rows, JS_PAY_W] + lengths
+JS_PAYLOADS = (b"null", b"true", b"-1", b"1e309", b"[]", b"{}",
+               b'"\\u0000"', b'{"__proto__":{}}')
+JS_PAY_W = 16
+N_JS_PAYLOADS = len(JS_PAYLOADS)
+JS_PAY_TABLE = np.zeros((N_JS_PAYLOADS, JS_PAY_W), np.uint8)
+JS_PAY_LENS = np.zeros(N_JS_PAYLOADS, np.int32)
+for _r, _p in enumerate(JS_PAYLOADS):
+    JS_PAY_TABLE[_r, :len(_p)] = np.frombuffer(_p, np.uint8)
+    JS_PAY_LENS[_r] = len(_p)
+
+
+def tokenize(raw: bytes) -> tuple[np.ndarray, int]:
+    """One-pass span scan: ``(nodes int32[SPAN_NODES, 4], count)``.
+
+    nodes[i] = (start, end, depth, kind): ``raw[start:end]`` spans the
+    node including both delimiters, depth is the enclosing-bracket depth
+    at open time (0 = top level), kind is the opener byte. Document
+    order (sorted by start, outermost first at equal start). Quote spans
+    have literal interiors: no bracket inside an open quote opens a
+    node, mirroring the oracle's quote handling. Unclosed frames are
+    dropped (their already-closed children stay — partial_parse's
+    flatten-into-parent), unmatched closers and depth-overflow openers
+    are literals.
+    """
+    nodes: list[tuple[int, int, int, int]] = []
+    stack: list[tuple[int, int]] = []  # (opener byte, start index)
+    quote = 0
+    qstart = 0
+    for i, b in enumerate(raw):
+        if quote:
+            if b == quote:
+                nodes.append((qstart, i + 1, len(stack), quote))
+                quote = 0
+            continue
+        if b in _QUOTES:
+            quote = b
+            qstart = i
+            continue
+        closer = _OPENERS.get(b)
+        if closer is not None:
+            if len(stack) < MAX_DEPTH:
+                stack.append((b, i))
+            continue
+        if stack and b == _OPENERS[stack[-1][0]]:
+            ob, os_ = stack.pop()
+            nodes.append((os_, i + 1, len(stack), ob))
+    nodes.sort(key=lambda t: (t[0], -t[1]))
+    cnt = min(len(nodes), SPAN_NODES)
+    table = np.zeros((SPAN_NODES, 4), np.int32)
+    if cnt:
+        table[:cnt] = np.asarray(nodes[:cnt], np.int32)
+    return table, cnt
+
+
+def applicability(raw: bytes, nodes: np.ndarray, cnt: int) -> np.ndarray:
+    """bool[NUM_STRUCT]: can struct code c plausibly change this sample.
+    The span-table analogue of services/hybrid.py row_applicable — but
+    honest, because it reads the actual table the kernels will splice."""
+    kinds = nodes[:cnt, 3]
+    s, e = nodes[:cnt, 0], nodes[:cnt, 1]
+    has_pair = cnt >= 2
+    # a strict parent/child pair exists (tr needs one)
+    has_nest = bool(
+        cnt >= 2
+        and ((s[:, None] < s[None, :]) & (e[None, :] <= e[:, None])).any()
+    )
+    json_node = bool(np.isin(kinds, _JSON_KINDS).any())
+    stripped = raw[:64].lstrip()
+    looks_json = stripped[:1] in (b"{", b"[", b'"') or stripped[:1].isdigit()
+    chunk = raw.strip()
+    maybe_b64 = False
+    if len(chunk) > 6 and len(chunk) % 4 == 0:
+        import base64
+        import binascii
+
+        try:
+            base64.b64decode(chunk, validate=True)
+            maybe_b64 = True
+        except (binascii.Error, ValueError):
+            pass
+    return np.asarray([
+        cnt >= 1,  # tr2
+        cnt >= 1,  # td
+        has_pair,  # ts1
+        has_nest,  # tr
+        has_pair,  # ts2
+        json_node and looks_json,  # js
+        bool((kinds == _TAG_KIND).any()),  # sgm
+        maybe_b64,  # b64
+        b"://" in raw,  # uri
+    ], bool)
+
+
+def struct_sample_key(base, case_idx: int, slot: int):
+    """Per-sample struct key: base -> TAG_STRUCT -> case -> slot. The
+    device step derives the identical chain inside the kernel
+    (ops/tree_mutators.py), so draws match bit for bit."""
+    import jax
+
+    from . import prng
+
+    return jax.random.fold_in(
+        jax.random.fold_in(prng.sub(base, prng.TAG_STRUCT), case_idx), slot
+    )
+
+
+def _d(key, j: int, n: int) -> int:
+    """Draw j of this sample: uniform in [0, n), 0 when n <= 0. The
+    device kernels compute the same fold_in/rand pair on-device."""
+    import jax
+
+    from . import prng
+
+    return int(prng.rand(jax.random.fold_in(key, j), int(n)))
+
+
+# --- host span-oracle (numpy reference semantics) -----------------------
+
+
+def _mut_tr2(key, raw, nd, cnt, cap):
+    i = _d(key, 0, cnt)
+    s, e = int(nd[i, 0]), int(nd[i, 1])
+    return raw[:s] + raw[s:e] + raw[s:]
+
+
+def _mut_td(key, raw, nd, cnt, cap):
+    i = _d(key, 0, cnt)
+    s, e = int(nd[i, 0]), int(nd[i, 1])
+    return raw[:s] + raw[e:]
+
+
+def _pick_two(key, cnt):
+    a = _d(key, 0, cnt)
+    b = _d(key, 1, cnt - 1)
+    if b >= a:
+        b += 1
+    return a, b
+
+
+def _mut_ts1(key, raw, nd, cnt, cap):
+    if cnt < 2:
+        return None
+    a, b = _pick_two(key, cnt)
+    sa, ea = int(nd[a, 0]), int(nd[a, 1])
+    sb, eb = int(nd[b, 0]), int(nd[b, 1])
+    return raw[:sa] + raw[sb:eb] + raw[ea:]
+
+
+def _mut_ts2(key, raw, nd, cnt, cap):
+    if cnt < 2:
+        return None
+    a, b = _pick_two(key, cnt)
+    sa, ea = int(nd[a, 0]), int(nd[a, 1])
+    sb, eb = int(nd[b, 0]), int(nd[b, 1])
+    if sa > sb:
+        sa, ea, sb, eb = sb, eb, sa, ea
+    if eb <= ea:  # nested: inner span replaces the outer
+        return raw[:sa] + raw[sb:eb] + raw[ea:]
+    # disjoint: swap the two spans in place
+    return raw[:sa] + raw[sb:eb] + raw[ea:sb] + raw[sa:ea] + raw[eb:]
+
+
+def _mut_tr(key, raw, nd, cnt, cap):
+    if cnt < 2:
+        return None
+    s, e = nd[:cnt, 0], nd[:cnt, 1]
+    desc = (s[:, None] < s[None, :]) & (e[None, :] <= e[:, None])
+    ccnt = desc.sum(1)
+    pidx = np.nonzero(ccnt > 0)[0]
+    if pidx.size == 0:
+        return None
+    p = int(pidx[_d(key, 0, pidx.size)])
+    kids = np.nonzero(desc[p])[0]
+    c = int(kids[_d(key, 1, kids.size)])
+    reps = 2 + _d(key, 2, 7)
+    sp, ep = int(s[p]), int(e[p])
+    sc, ec = int(s[c]), int(e[c])
+    pre, suf = raw[sp:sc], raw[ec:ep]
+    unit = max(len(pre) + len(suf), 1)
+    k = max(1, min(reps, 1 + max(0, cap - len(raw)) // unit))
+    return raw[:sp] + pre * k + raw[sc:ec] + suf * k + raw[ep:]
+
+
+def _mut_js(key, raw, nd, cnt, cap):
+    jidx = np.nonzero(np.isin(nd[:cnt, 3], _JSON_KINDS))[0]
+    if jidx.size == 0:
+        return None
+    op = _d(key, 0, 3)
+    i = int(jidx[_d(key, 1, jidx.size)])
+    s, e = int(nd[i, 0]), int(nd[i, 1])
+    if op == 0:  # duplicate the node in place
+        return raw[:s] + raw[s:e] + raw[s:]
+    if op == 1:  # delete the node
+        return raw[:s] + raw[e:]
+    r = _d(key, 2, N_JS_PAYLOADS)  # splice a gadget before the node
+    return raw[:s] + JS_PAYLOADS[r] + raw[s:]
+
+
+def _mut_sgm(key, raw, nd, cnt, cap):
+    tidx = np.nonzero(nd[:cnt, 3] == _TAG_KIND)[0]
+    if tidx.size == 0:
+        return None
+    op = _d(key, 0, 3)
+    if op == 2 and tidx.size < 2:
+        op = 0
+    ai = _d(key, 1, tidx.size)
+    a = int(tidx[ai])
+    sa, ea = int(nd[a, 0]), int(nd[a, 1])
+    if op == 0:
+        return raw[:sa] + raw[sa:ea] + raw[sa:]
+    if op == 1:
+        return raw[:sa] + raw[ea:]
+    bi = _d(key, 2, tidx.size - 1)
+    if bi >= ai:
+        bi += 1
+    b = int(tidx[bi])
+    sb, eb = int(nd[b, 0]), int(nd[b, 1])
+    return raw[:sa] + raw[sb:eb] + raw[ea:]
+
+
+def _mut_b64(key, raw, nd, cnt, cap):
+    w0, w1 = 0, len(raw)
+    while w0 < w1 and raw[w0] in _B64_WS:
+        w0 += 1
+    while w1 > w0 and raw[w1 - 1] in _B64_WS:
+        w1 -= 1
+    m = w1 - w0
+    if m < 8 or m % 4:
+        return None
+    npad = int(raw[w1 - 1] == 61) + int(raw[w1 - 2] == 61)
+    dec_len = m // 4 * 3 - npad
+    pos = _d(key, 0, dec_len)
+    xv = 1 + _d(key, 1, 255)
+    g, off = divmod(pos, 3)
+    base = w0 + 4 * g
+    q = raw[base:base + 4]
+    v = [int(B64_DEC[c]) for c in q]
+    trip = (v[0] << 18) | (v[1] << 12) | (v[2] << 6) | v[3]
+    byts = [(trip >> 16) & 255, (trip >> 8) & 255, trip & 255]
+    byts[off] ^= xv
+    trip2 = (byts[0] << 16) | (byts[1] << 8) | byts[2]
+    enc = [int(B64_ENC[(trip2 >> sh) & 63]) for sh in (18, 12, 6, 0)]
+    outq = bytes(61 if q[j] == 61 else enc[j] for j in range(4))
+    return raw[:base] + outq + raw[base + 4:]
+
+
+def _mut_uri(key, raw, nd, cnt, cap):
+    p = raw.find(b"://")
+    if p < 0 or p + 3 >= len(raw):
+        return None
+    start = p + 3
+    pos = start + _d(key, 0, len(raw) - start)
+    c = raw[pos]
+    esc = bytes((37, _HEX_UC[c >> 4], _HEX_UC[c & 15]))
+    return raw[:pos] + esc + raw[pos + 1:]
+
+
+_HOST_MUTATORS = (_mut_tr2, _mut_td, _mut_ts1, _mut_tr, _mut_ts2,
+                  _mut_js, _mut_sgm, _mut_b64, _mut_uri)
+
+
+def host_struct_fuzz(key, raw: bytes, nodes: np.ndarray, cnt: int,
+                     code_idx: int, cap: int) -> bytes:
+    """Reference execution of one struct mutation: the numpy mirror of
+    the device kernel branch ``code_idx``, truncated to ``cap`` exactly
+    like the device buffer width caps the kernel output."""
+    if code_idx < 0 or code_idx >= NUM_STRUCT:
+        return raw
+    if code_idx < 7 and cnt <= 0:  # span mutators need at least one node
+        return raw
+    res = _HOST_MUTATORS[code_idx](key, raw, nodes, cnt, cap)
+    if res is None:
+        return raw
+    return res[:cap]
+
+
+# --- span cache + routing ------------------------------------------------
+
+
+class SpanCache:
+    """Host-side span-table cache keyed by seed id (or corpus index).
+
+    ``note()`` tokenizes once per key — the runner wires it into the
+    store's admission listener so arena seeds AND adopted offspring get
+    their tables the moment their bytes are known (adoption re-tokenizes
+    the drained payload; only the ~1KB table rides along with the next
+    upload, never the seed bytes again)."""
+
+    def __init__(self):
+        self._tables: dict = {}
+
+    def note(self, key, raw: bytes) -> None:
+        if key not in self._tables:
+            self._tables[key] = tokenize(raw)
+
+    def get(self, key, raw: bytes) -> tuple[np.ndarray, int]:
+        t = self._tables.get(key)
+        if t is None:
+            t = tokenize(raw)
+            self._tables[key] = t
+        return t
+
+    def drop(self, key) -> None:
+        self._tables.pop(key, None)
+
+    def __len__(self) -> int:
+        return len(self._tables)
+
+
+class StructRouter:
+    """Sample-level struct routing: which samples leave the plain device
+    stream this case, and which struct code mutates them.
+
+    A pure function of (seed, case, scheduler scores): the RNG is
+    counter-keyed like services/hybrid.py's split, the struct mass is
+    static priority * NEUTRAL_SCORE over span-table applicability, and
+    the device mass comes from the live scheduler scores — so the
+    ``--struct host`` parity path and the ``--struct-kernels`` device
+    path route (and draw) identically, which is what makes the on/off
+    byte-identity smoke possible."""
+
+    NEUTRAL_SCORE = 6.0
+
+    def __init__(self, seed, selected: dict[str, int]):
+        from .registry import DEVICE_CODES
+
+        self.seed = seed
+        self.weights = np.asarray(
+            [max(selected.get(c, 0), 0) * self.NEUTRAL_SCORE
+             for c in STRUCT_CODES], np.float64)
+        self.device_pri = np.asarray(
+            [max(selected.get(c, 0), 0) for c in DEVICE_CODES], np.float64)
+        self._appl: np.ndarray | None = None
+        self._appl_for = None
+
+    def prepare(self, samples: list[bytes], cache: SpanCache,
+                keys=None) -> None:
+        """Precompute the bool[B, NUM_STRUCT] applicability matrix (and
+        warm the span cache). keys: per-sample cache keys; defaults to
+        the sample index."""
+        rows = []
+        for i, raw in enumerate(samples):
+            k = keys[i] if keys is not None else i
+            nd, cnt = cache.get(k, raw)
+            rows.append(applicability(raw, nd, cnt))
+        self._appl = np.asarray(rows, bool).reshape(len(samples), NUM_STRUCT)
+        self._appl_for = samples
+
+    def applicable_any(self) -> np.ndarray:
+        """bool[B]: at least one struct code can touch this sample — the
+        rows worth packing into the resident struct source panel."""
+        if self._appl is None:
+            raise RuntimeError("StructRouter.applicable_any before prepare()")
+        return self._appl.any(axis=1)
+
+    def route(self, case_idx: int, device_scores=None,
+              excluded=None) -> np.ndarray:
+        """int32[B]: struct-code index per sample, -1 = stays in the
+        plain device stream. `excluded` rows (zip/overflow samples the
+        hybrid already host-routed) never struct-route."""
+        appl = self._appl
+        if appl is None:
+            raise RuntimeError("StructRouter.route before prepare()")
+        n = appl.shape[0]
+        seed_ints = (list(self.seed) if isinstance(self.seed, tuple)
+                     else [int(self.seed)])
+        rng = np.random.default_rng([*seed_ints, case_idx, ROUTE_SALT])
+        r_route = rng.random(n)
+        r_code = rng.random(n)
+        sm = appl @ self.weights
+        if device_scores is not None:
+            dm = np.asarray(device_scores, np.float64) @ self.device_pri
+        else:
+            dm = np.full(n, self.NEUTRAL_SCORE * self.device_pri.sum())
+        total = sm + dm
+        probs = np.where(total > 0, sm / np.maximum(total, 1e-9), 0.0)
+        routed = (r_route < probs) & (sm > 0)
+        if excluded is not None:
+            routed &= ~np.asarray(excluded, bool)
+        # weighted code pick among this sample's applicable struct rows
+        w = appl * self.weights
+        cw = np.cumsum(w, axis=1)
+        target = (r_code * sm)[:, None]
+        pick = np.argmax(cw > target, axis=1)
+        return np.where(routed, pick, -1).astype(np.int32)

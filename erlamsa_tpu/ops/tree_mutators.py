@@ -1,0 +1,333 @@
+"""Device struct kernels: the span-table mutators as vmapped splices.
+
+Each branch mirrors one numpy reference mutator in ops/structure.py —
+same draw plan (fold_in-indexed, so draws are position-keyed, never
+sequential), same splice geometry, same fallback guards — and the parity
+suite (tests/test_struct_kernels.py) pins the two byte-identical per
+mutator. The whole struct tail then rides ONE jitted vmapped step per
+case instead of a host round-trip per sample.
+
+Branch order == structure.STRUCT_CODES; keep stable (the router emits
+indices into it, and a reorder would shift every routed sample's draw).
+
+Geometry notes: every mutator is expressed as an output-index -> input-
+index map (the same gather shape the fused splice engine uses), so a
+kernel is O(L) gathers regardless of node count; node picks are ordinal
+selections over the span table's boolean masks (cumsum + argmax), so the
+table never leaves the device once uploaded.
+"""
+
+from __future__ import annotations
+
+from functools import lru_cache
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from . import prng
+from . import structure as st
+
+
+@lru_cache(maxsize=None)
+def _js_tables():
+    """Device-resident payload gadget table, built once per process
+    (utf8_mutators.funny_tables idiom: concrete even under a trace)."""
+    with jax.ensure_compile_time_eval():
+        return jnp.asarray(st.JS_PAY_TABLE), jnp.asarray(st.JS_PAY_LENS)
+
+
+@lru_cache(maxsize=None)
+def _b64_tables():
+    with jax.ensure_compile_time_eval():
+        return jnp.asarray(st.B64_DEC), jnp.asarray(st.B64_ENC)
+
+
+@lru_cache(maxsize=None)
+def _hex_table():
+    import numpy as np
+
+    with jax.ensure_compile_time_eval():
+        return jnp.asarray(np.frombuffer(b"0123456789ABCDEF", np.uint8))
+
+
+def _f(key, j):
+    return jax.random.fold_in(key, j)
+
+
+def _gather(row, src):
+    return jnp.take(row, jnp.clip(src, 0, row.shape[0] - 1))
+
+
+def _nth_true(mask, t):
+    """Index of the (t+1)-th True in a bool[N] mask (ordinal select)."""
+    order = jnp.cumsum(mask.astype(jnp.int32)) - 1
+    return jnp.argmax(mask & (order == t)).astype(jnp.int32)
+
+
+def _insert_self(row, n, s, ln, cap):
+    """Duplicate row[s:s+ln] in place at s."""
+    t = jnp.arange(row.shape[0], dtype=jnp.int32)
+    src = jnp.where(t < s + ln, t, t - ln)
+    return _gather(row, src), jnp.clip(n + ln, 0, cap)
+
+
+def _delete(row, n, s, ln, cap):
+    t = jnp.arange(row.shape[0], dtype=jnp.int32)
+    src = jnp.where(t < s, t, t + ln)
+    return _gather(row, src), jnp.clip(n - ln, 0, cap)
+
+
+def _replace(row, n, sa, ea, sb, eb, cap):
+    """Replace row[sa:ea] with row[sb:eb]."""
+    lb = eb - sb
+    t = jnp.arange(row.shape[0], dtype=jnp.int32)
+    src = jnp.where(
+        t < sa, t,
+        jnp.where(t < sa + lb, sb + (t - sa), ea + (t - sa - lb)))
+    return _gather(row, src), jnp.clip(n - (ea - sa) + lb, 0, cap)
+
+
+def _two(key, cnt):
+    """Two distinct node ordinals, the reference's a/b draw pair."""
+    a = prng.rand(_f(key, 0), cnt)
+    b = prng.rand(_f(key, 1), cnt - 1)
+    return a, b + (b >= a)
+
+
+def _node(nd, i):
+    return nd[i, 0], nd[i, 1]
+
+
+# --- branches (key, row, n, nd, cnt, cap) -> (row, n, ok) ---------------
+
+
+def k_tr2(key, row, n, nd, cnt, cap):
+    i = prng.rand(_f(key, 0), cnt)
+    s, e = _node(nd, i)
+    out, n2 = _insert_self(row, n, s, e - s, cap)
+    return out, n2, cnt > 0
+
+
+def k_td(key, row, n, nd, cnt, cap):
+    i = prng.rand(_f(key, 0), cnt)
+    s, e = _node(nd, i)
+    out, n2 = _delete(row, n, s, e - s, cap)
+    return out, n2, cnt > 0
+
+
+def k_ts1(key, row, n, nd, cnt, cap):
+    a, b = _two(key, cnt)
+    sa, ea = _node(nd, a)
+    sb, eb = _node(nd, b)
+    out, n2 = _replace(row, n, sa, ea, sb, eb, cap)
+    return out, n2, cnt >= 2
+
+
+def k_tr(key, row, n, nd, cnt, cap):
+    num = nd.shape[0]
+    i = jnp.arange(num, dtype=jnp.int32)
+    valid = i < cnt
+    s, e = nd[:, 0], nd[:, 1]
+    desc = ((s[:, None] < s[None, :]) & (e[None, :] <= e[:, None])
+            & valid[:, None] & valid[None, :])
+    ccnt = desc.sum(1)
+    is_par = ccnt > 0
+    ok = jnp.any(is_par)
+    p = _nth_true(is_par, prng.rand(_f(key, 0), is_par.sum()))
+    c = _nth_true(desc[p], prng.rand(_f(key, 1), ccnt[p]))
+    reps = 2 + prng.rand(_f(key, 2), 7)
+    sp, ep = s[p], e[p]
+    sc, ec = s[c], e[c]
+    pre, suf = sc - sp, ep - ec
+    unit = jnp.maximum(pre + suf, 1)
+    k = jnp.maximum(
+        jnp.minimum(reps, 1 + jnp.maximum(cap - n, 0) // unit), 1)
+    a0 = sp
+    a1 = a0 + k * pre
+    a2 = a1 + (ec - sc)
+    a3 = a2 + k * suf
+    t = jnp.arange(row.shape[0], dtype=jnp.int32)
+    src = jnp.where(
+        t < a0, t,
+        jnp.where(t < a1, sp + (t - a0) % jnp.maximum(pre, 1),
+                  jnp.where(t < a2, sc + (t - a1),
+                            jnp.where(t < a3,
+                                      ec + (t - a2) % jnp.maximum(suf, 1),
+                                      ep + (t - a3)))))
+    n2 = jnp.clip(n + (k - 1) * (pre + suf), 0, cap)
+    return _gather(row, src), n2, ok
+
+
+def k_ts2(key, row, n, nd, cnt, cap):
+    a, b = _two(key, cnt)
+    sa, ea = _node(nd, a)
+    sb, eb = _node(nd, b)
+    # order by start so "nested" means b inside a
+    swap = sa > sb
+    sa, sb = jnp.where(swap, sb, sa), jnp.where(swap, sa, sb)
+    ea, eb = jnp.where(swap, eb, ea), jnp.where(swap, ea, eb)
+    nested = eb <= ea
+    rep_out, rep_n = _replace(row, n, sa, ea, sb, eb, cap)
+    l1 = eb - sb
+    l2 = sb - ea
+    b1 = sa + l1
+    b2 = b1 + l2
+    b3 = b2 + (ea - sa)
+    t = jnp.arange(row.shape[0], dtype=jnp.int32)
+    src = jnp.where(
+        t < sa, t,
+        jnp.where(t < b1, sb + (t - sa),
+                  jnp.where(t < b2, ea + (t - b1),
+                            jnp.where(t < b3, sa + (t - b2), t))))
+    dis_out = _gather(row, src)
+    out = jnp.where(nested, rep_out, dis_out)
+    n2 = jnp.where(nested, rep_n, n)
+    return out, n2, cnt >= 2
+
+
+def k_js(key, row, n, nd, cnt, cap):
+    num = nd.shape[0]
+    i = jnp.arange(num, dtype=jnp.int32)
+    kind = nd[:, 3]
+    jm = (i < cnt) & ((kind == 123) | (kind == 91) | (kind == 34))
+    jcnt = jm.sum()
+    ok = jcnt > 0
+    op = prng.rand(_f(key, 0), 3)
+    pick = _nth_true(jm, prng.rand(_f(key, 1), jcnt))
+    s, e = _node(nd, pick)
+    r = prng.rand(_f(key, 2), st.N_JS_PAYLOADS)
+    pay_tab, pay_lens = _js_tables()
+    plen = pay_lens[r]
+
+    def dup(_):
+        return _insert_self(row, n, s, e - s, cap)
+
+    def dele(_):
+        return _delete(row, n, s, e - s, cap)
+
+    def payload(_):
+        t = jnp.arange(row.shape[0], dtype=jnp.int32)
+        base = _gather(row, jnp.where(t < s, t, t - plen))
+        ins = pay_tab[r][jnp.clip(t - s, 0, st.JS_PAY_W - 1)]
+        out = jnp.where((t >= s) & (t < s + plen), ins, base)
+        return out, jnp.clip(n + plen, 0, cap)
+
+    out, n2 = lax.switch(op, (dup, dele, payload), None)
+    return out, n2, ok
+
+
+def k_sgm(key, row, n, nd, cnt, cap):
+    num = nd.shape[0]
+    i = jnp.arange(num, dtype=jnp.int32)
+    tm = (i < cnt) & (nd[:, 3] == st._TAG_KIND)
+    tcnt = tm.sum()
+    ok = tcnt > 0
+    op = prng.rand(_f(key, 0), 3)
+    op = jnp.where((op == 2) & (tcnt < 2), 0, op)
+    ai = prng.rand(_f(key, 1), tcnt)
+    a = _nth_true(tm, ai)
+    sa, ea = _node(nd, a)
+    bi = prng.rand(_f(key, 2), tcnt - 1)
+    b = _nth_true(tm, bi + (bi >= ai))
+    sb, eb = _node(nd, b)
+
+    def dup(_):
+        return _insert_self(row, n, sa, ea - sa, cap)
+
+    def dele(_):
+        return _delete(row, n, sa, ea - sa, cap)
+
+    def repl(_):
+        return _replace(row, n, sa, ea, sb, eb, cap)
+
+    out, n2 = lax.switch(op, (dup, dele, repl), None)
+    return out, n2, ok
+
+
+def k_b64(key, row, n, nd, cnt, cap):
+    length = row.shape[0]
+    t = jnp.arange(length, dtype=jnp.int32)
+    ws = (row == 9) | (row == 10) | (row == 13) | (row == 32)
+    nonws = (t < n) & ~ws
+    any_nonws = jnp.any(nonws)
+    w0 = jnp.argmax(nonws).astype(jnp.int32)
+    w1 = (length - jnp.argmax(nonws[::-1])).astype(jnp.int32)
+    m = w1 - w0
+    ok = any_nonws & (m >= 8) & (m % 4 == 0)
+    npad = ((_gather(row, w1 - 1) == 61).astype(jnp.int32)
+            + (_gather(row, w1 - 2) == 61).astype(jnp.int32))
+    dec_len = m // 4 * 3 - npad
+    pos = prng.rand(_f(key, 0), dec_len)
+    xv = 1 + prng.rand(_f(key, 1), 255)
+    g = pos // 3
+    off = pos % 3
+    start = w0 + 4 * g
+    dec_lut, enc_lut = _b64_tables()
+    q = jnp.stack([_gather(row, start + j) for j in range(4)]).astype(
+        jnp.int32)
+    v = dec_lut[q]
+    trip = (v[0] << 18) | (v[1] << 12) | (v[2] << 6) | v[3]
+    byts = jnp.stack([(trip >> 16) & 255, (trip >> 8) & 255, trip & 255])
+    byts = byts.at[off].set(byts[off] ^ xv)
+    trip2 = (byts[0] << 16) | (byts[1] << 8) | byts[2]
+    enc = jnp.stack([enc_lut[(trip2 >> 18) & 63], enc_lut[(trip2 >> 12) & 63],
+                     enc_lut[(trip2 >> 6) & 63], enc_lut[trip2 & 63]])
+    outq = jnp.where(q == 61, 61, enc).astype(jnp.uint8)
+    in_q = (t >= start) & (t < start + 4)
+    qv = outq[jnp.clip(t - start, 0, 3)]
+    out = jnp.where(in_q, qv, row)
+    return out, n, ok
+
+
+def k_uri(key, row, n, nd, cnt, cap):
+    t = jnp.arange(row.shape[0], dtype=jnp.int32)
+    match = ((row == 58) & (_gather(row, t + 1) == 47)
+             & (_gather(row, t + 2) == 47) & (t + 2 < n))
+    ok = jnp.any(match)
+    start = jnp.argmax(match).astype(jnp.int32) + 3
+    ok = ok & (start < n)
+    pos = start + prng.rand(_f(key, 0), n - start)
+    c = _gather(row, pos).astype(jnp.int32)
+    hx = _hex_table()
+    out = _gather(row, jnp.where(t < pos, t, t - 2))
+    out = jnp.where(t == pos, jnp.uint8(37), out)
+    out = jnp.where(t == pos + 1, hx[c >> 4], out)
+    out = jnp.where(t == pos + 2, hx[c & 15], out)
+    return out, jnp.clip(n + 2, 0, cap), ok
+
+
+#: branch order == structure.STRUCT_CODES; keep stable
+STRUCT_KERNELS = (k_tr2, k_td, k_ts1, k_tr, k_ts2, k_js, k_sgm, k_b64,
+                  k_uri)
+
+
+def struct_step(base, case_idx, idx, data, lens, spans, cnts, caps, codes):
+    """One case's struct tail as a single vmapped device call.
+
+    idx: int32[B] SLOT positions (the same keying contract as the class
+    steps — a sample's struct stream is a pure function of (seed, case,
+    slot)); codes: int32[B] STRUCT_CODES indices, -1 = passthrough (pad
+    rows and unrouted samples). caps: int32[B] per-sample output cap —
+    per-sample, NOT the panel width, so output bytes don't depend on how
+    rows were grouped into panels. Returns (data, lens, applied)."""
+    ckey = jax.random.fold_in(prng.sub(base, prng.TAG_STRUCT), case_idx)
+
+    def one(slot, row, n, nd, cnt, cap, code):
+        key = jax.random.fold_in(ckey, slot)
+        out, n2, ok = lax.switch(
+            jnp.clip(code, 0, st.NUM_STRUCT - 1), STRUCT_KERNELS,
+            key, row, n, nd, cnt, cap)
+        keep = (code >= 0) & ok
+        out = jnp.where(keep, out, row)
+        n2 = jnp.where(keep, n2, n)
+        applied = jnp.where(keep, code, -1)
+        return out, n2, applied
+
+    return jax.vmap(one)(idx, data, lens, spans, cnts, caps, codes)
+
+
+def make_struct_step():
+    """Jitted struct step; retraced per (B, L) panel shape like
+    make_class_fuzzer."""
+    return jax.jit(struct_step)

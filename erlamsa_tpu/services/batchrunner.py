@@ -138,8 +138,80 @@ def run_tpu_batch(opts: dict, batch: int = 1024) -> int:
         return 1
     from .batcher import service_budget
 
-    hybrid = HybridDispatcher(list(selected.items()), opts["seed"],
+    # r13 struct engine: --struct {off,host,device} (--struct-kernels =
+    # device). host/device route IDENTICALLY and draw from the same
+    # counter-keyed streams, so their outputs are byte-identical — host
+    # is the parity/debug path, device the throughput path. Either way
+    # the struct codes leave the hybrid's host set (zip stays), and the
+    # registry fingerprint follows the split (registry_version()).
+    from ..ops import registry as _registry
+    from ..ops import structure as stm
+
+    struct_mode = str(opts.get("struct") or "off")
+    if struct_mode not in ("off", "host", "device"):
+        raise ValueError(
+            f"struct must be one of off/host/device, got {struct_mode!r}")
+    _struct_flag_before = _registry.struct_kernels_enabled()
+    _registry.set_struct_kernels(struct_mode != "off")
+    hybrid_sel = (selected if struct_mode == "off" else
+                  {c: p for c, p in selected.items()
+                   if c not in stm.STRUCT_CODES})
+    hybrid = HybridDispatcher(list(hybrid_sel.items()), opts["seed"],
                               max_running_time=service_budget(opts))
+
+    # struct source panel: tokenize each DISTINCT seed once (SpanCache),
+    # pack the struct-applicable, non-overflow rows into one fixed-width
+    # buffer, and (device mode) upload it ONCE — per case only row
+    # indices and code picks cross PCIe, the seed bytes and span tables
+    # are already resident. Host mode keeps the same numpy arrays and
+    # serves the routed rows from the span-oracle on the host pool.
+    router = None
+    struct_step = None
+    src_dev = None
+    struct_ids: list[int] = []
+    pos_of: dict[int, int] = {}
+    # host->device transfer ledger for the struct engine: the one-time
+    # resident panel upload plus the per-case routing vectors
+    struct_bytes = {"uploaded": 0}
+    if struct_mode != "off":
+        span_cache = stm.SpanCache()
+        router = stm.StructRouter(opts["seed"], selected)
+        router.prepare(corpus, span_cache,
+                       keys=[i % len(seeds) for i in range(batch)])
+        appl_any = router.applicable_any()
+        struct_ids = [i for i in range(batch)
+                      if appl_any[i] and i not in overflow_set]
+        if struct_ids:
+            s_caps = np.asarray(
+                [capacity_for(len(corpus[i])) for i in struct_ids],
+                np.int32)
+            width = int(s_caps.max())
+            src = np.zeros((len(struct_ids), width), np.uint8)
+            s_lens = np.zeros(len(struct_ids), np.int32)
+            s_nds = np.zeros((len(struct_ids), stm.SPAN_NODES, 4), np.int32)
+            s_cnts = np.zeros(len(struct_ids), np.int32)
+            for r, i in enumerate(struct_ids):
+                raw = corpus[i]
+                src[r, :len(raw)] = np.frombuffer(raw, np.uint8)
+                s_lens[r] = len(raw)
+                s_nds[r], s_cnts[r] = span_cache.get(i % len(seeds), raw)
+            pos_of = {i: r for r, i in enumerate(struct_ids)}
+            if struct_mode == "device":
+                import jax.numpy as jnp
+
+                from ..ops.tree_mutators import make_struct_step
+
+                struct_step = make_struct_step()
+                src_dev = jnp.asarray(src)
+                s_lens_dev = jnp.asarray(s_lens)
+                s_nds_dev = jnp.asarray(s_nds)
+                s_cnts_dev = jnp.asarray(s_cnts)
+                s_caps_dev = jnp.asarray(s_caps)
+                struct_bytes["uploaded"] += (
+                    src.nbytes + s_lens.nbytes + s_nds.nbytes
+                    + s_cnts.nbytes + s_caps.nbytes)
+        else:
+            router = None  # nothing struct-applicable in this corpus
 
     # one jitted class step, retraced per (B_cls, capacity) shape; keys are
     # derived from the ORIGINAL corpus index, so per-sample streams don't
@@ -190,6 +262,7 @@ def run_tpu_batch(opts: dict, batch: int = 1024) -> int:
         if start_case >= n_cases:
             print(f"# run already complete ({start_case}/{n_cases} cases)",
                   file=sys.stderr)
+            _registry.set_struct_kernels(_struct_flag_before)
             return 0
 
     if overflow_idx:
@@ -229,6 +302,24 @@ def run_tpu_batch(opts: dict, batch: int = 1024) -> int:
         host_fut: object
         of_fut: object
         scores_after: object
+        # struct overlay: [(slot, code_idx)] routed this case, plus the
+        # in-flight work — device-mode (out, lens, applied) arrays (JAX
+        # async dispatch) or the host-pool future of {slot: bytes}
+        struct_rows: list
+        struct_work: object
+
+    def fuzz_struct_host(case_idx: int, routed: list) -> dict[int, bytes]:
+        """--struct host: the span-oracle serves the routed rows with the
+        same counter-keyed draws the device kernels compute — the parity
+        baseline the --struct-smoke leg compares --struct-kernels to."""
+        res = {}
+        for i, ci in routed:
+            r = pos_of[i]
+            key = stm.struct_sample_key(base, case_idx, i)
+            res[i] = stm.host_struct_fuzz(key, corpus[i], s_nds[r],
+                                          int(s_cnts[r]), ci,
+                                          int(s_caps[r]))
+        return res
 
     writer, _mt = out.string_outputs(opts.get("output", "-"))
     total = 0
@@ -245,8 +336,21 @@ def run_tpu_batch(opts: dict, batch: int = 1024) -> int:
         """Dispatch one case: split on the previous case's scores (a tiny
         forced sync), device steps async, host/overflow work on threads.
         Nothing here waits for the device data."""
-        host_mask = hybrid.split(case, corpus,
-                                 device_scores=np.asarray(scores_in))
+        scores_np = np.asarray(scores_in)
+        host_mask = hybrid.split(case, corpus, device_scores=scores_np)
+        # struct routing sees the same live scores; hybrid-routed and
+        # overflow rows are excluded so one sample never lands in two
+        # host-side result sets (overlay order would otherwise matter)
+        struct_rows: list = []
+        struct_work = None
+        if router is not None:
+            excl = host_mask.copy()
+            for i in overflow_idx:
+                excl[i] = True
+            codes_all = router.route(case, device_scores=scores_np,
+                                     excluded=excl)
+            struct_rows = [(i, int(codes_all[i])) for i in struct_ids
+                           if codes_all[i] >= 0]
         class_outputs = []
         scores_out = scores_in
         for cls, (idx, packed, cls_scan) in class_batches.items():
@@ -256,6 +360,27 @@ def run_tpu_batch(opts: dict, batch: int = 1024) -> int:
             )
             class_outputs.append((idx, new_data, new_lens, new_cls_scores))
             scores_out = scores_out.at[idx].set(new_cls_scores)
+        if struct_rows:
+            if struct_step is not None:
+                # pow2-padded row gather out of the RESIDENT panel: only
+                # these int32 vectors cross PCIe per case. Pad rows carry
+                # code -1 (kernel passthrough, output discarded).
+                k = len(struct_rows)
+                kp = max(8, 1 << (k - 1).bit_length())
+                sel = np.asarray([pos_of[i] for i, _ in struct_rows]
+                                 + [0] * (kp - k), np.int32)
+                slots = np.asarray([i for i, _ in struct_rows]
+                                   + [0] * (kp - k), np.int32)
+                cds = np.asarray([c for _, c in struct_rows]
+                                 + [-1] * (kp - k), np.int32)
+                struct_work = struct_step(
+                    base, case, slots, src_dev[sel], s_lens_dev[sel],
+                    s_nds_dev[sel], s_cnts_dev[sel], s_caps_dev[sel], cds)
+                struct_bytes["uploaded"] += (sel.nbytes + slots.nbytes
+                                             + cds.nbytes)
+            else:
+                struct_work = host_pool.submit(fuzz_struct_host, case,
+                                               struct_rows)
         host_idx = [(i, corpus[i]) for i in np.nonzero(host_mask)[0]
                     if i not in overflow_set]
         host_fut = (host_pool.submit(hybrid.fuzz_host, case, host_idx,
@@ -264,18 +389,42 @@ def run_tpu_batch(opts: dict, batch: int = 1024) -> int:
         of_fut = (host_pool.submit(fuzz_overflow, case)
                   if overflow_idx else None)
         return _Launched(case, class_outputs, host_idx, host_fut, of_fut,
-                         scores_out)
+                         scores_out, struct_rows, struct_work)
 
     def finish(pend: "_Launched"):
         """Unpack + write one launched case (device of the NEXT case is
         already running — this is the overlap)."""
         nonlocal total, host_total
-        case, class_outputs, host_idx, host_fut, of_fut, scores_after = pend
+        (case, class_outputs, host_idx, host_fut, of_fut, scores_after,
+         struct_rows, struct_work) = pend
+        from . import metrics
+
         results: dict[int, bytes] = {}
         for idx, new_data, new_lens, _nsc in class_outputs:
             outs = unpack(Batch(new_data, new_lens))
             for j, i in enumerate(idx):
                 results[int(i)] = outs[j]
+        # per-case host-tail ledger: {code: samples the host served}
+        routed_codes: dict[str, int] = {}
+        if struct_rows:
+            if struct_step is not None:
+                s_out, s_lens_o, s_applied = struct_work
+                out_np = np.asarray(s_out)
+                lens_np = np.asarray(s_lens_o)
+                app_np = np.asarray(s_applied)
+                for p, (i, ci) in enumerate(struct_rows):
+                    results[i] = bytes(out_np[p, :int(lens_np[p])])
+                    metrics.GLOBAL.record_mutator(
+                        stm.STRUCT_CODES[ci], applied=int(app_np[p]) >= 0)
+            else:
+                struct_results = struct_work.result()
+                for i, ci in struct_rows:
+                    payload = struct_results[i]
+                    results[i] = payload
+                    code = stm.STRUCT_CODES[ci]
+                    metrics.GLOBAL.record_mutator(
+                        code, applied=payload != corpus[i])
+                    routed_codes[code] = routed_codes.get(code, 0) + 1
         # the overlapped next case's split already ran and saw host scores
         # through case-1; checkpoint that same pre-outcome state so a
         # resumed run's split(case+1) routes identically to this one
@@ -286,8 +435,19 @@ def run_tpu_batch(opts: dict, batch: int = 1024) -> int:
             # score outcomes apply HERE, in case order — the overlapped
             # next case's split must see a deterministic routing state
             hybrid.apply_outcomes(host_metas)
+            for meta in host_metas:
+                used = [v for t, v in (e for e in meta
+                                       if isinstance(e, tuple) and len(e) == 2)
+                        if t == "used"]
+                code = used[0] if used else "none"
+                routed_codes[code] = routed_codes.get(code, 0) + 1
         if of_fut is not None:
             results.update(of_fut.result())
+            routed_codes["overflow"] = (routed_codes.get("overflow", 0)
+                                        + len(overflow_idx))
+        metrics.GLOBAL.record_routed_total(batch)
+        for code, n in sorted(routed_codes.items()):
+            metrics.GLOBAL.record_host_routed(code, n)
         for i in range(batch):
             payload = results.get(i, b"")
             if writer is not None:
@@ -296,6 +456,10 @@ def run_tpu_batch(opts: dict, batch: int = 1024) -> int:
                 sys.stdout.buffer.write(payload)
         total += len(results)
         host_total += len(host_idx) + len(overflow_idx)
+        if struct_rows and struct_step is None:
+            # --struct host serves the routed rows on the host pool — an
+            # honest host-tail count for the parity path
+            host_total += len(struct_rows)
         if stats is not None:
             # per-case completion timestamps: callers that measure warm
             # throughput (bench full-set stage) drop the first case's
@@ -330,14 +494,23 @@ def run_tpu_batch(opts: dict, batch: int = 1024) -> int:
     finally:
         host_pool.shutdown(wait=False, cancel_futures=True)
         hybrid.close()
+        # process-global flag: restore so later runs in this process (a
+        # struct-off bench stage, tests) see their own routing split
+        _registry.set_struct_kernels(_struct_flag_before)
     dt = time.perf_counter() - t0
     if stats is not None:
-        stats.update(total=total, host_total=host_total, dt=dt, batch=batch)
+        stats.update(total=total, host_total=host_total, dt=dt, batch=batch,
+                     struct=struct_mode,
+                     struct_bytes_uploaded=struct_bytes["uploaded"])
     logger.log("info", "tpu backend: %d samples in %.2fs (%.0f samples/s)",
                total, dt, total / max(dt, 1e-9))
+    struct_note = ""
+    if struct_mode != "off":
+        struct_note = (f", struct={struct_mode} "
+                       f"({len(struct_ids)} rows resident)")
     print(
         f"# {total} samples ({host_total} host-routed), {dt:.2f}s, "
-        f"{total / max(dt, 1e-9):.0f} samples/s",
+        f"{total / max(dt, 1e-9):.0f} samples/s{struct_note}",
         file=sys.stderr,
     )
     return 0

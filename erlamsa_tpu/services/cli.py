@@ -223,6 +223,19 @@ def build_parser() -> argparse.ArgumentParser:
                         "sizes. Each seed rides the smallest class that "
                         "holds it whole — short seeds stop paying the "
                         "widest row's gather/compute (corpus/arena.py)")
+    p.add_argument("--struct", choices=["off", "host", "device"],
+                   default="off",
+                   help="structured-format engine (ops/structure.py): "
+                        "route the span-splice mutators (tr2 td ts1 tr "
+                        "ts2 js sgm b64 uri) through the one-pass span "
+                        "tokenizer instead of the host oracle tail. "
+                        "'device' runs them as vmapped kernels "
+                        "(ops/tree_mutators.py) — zip is then the only "
+                        "host-routed code; 'host' is the byte-identical "
+                        "numpy parity path; 'off' (default) keeps the "
+                        "legacy hybrid routing")
+    p.add_argument("--struct-kernels", action="store_true",
+                   help="shorthand for --struct device")
     p.add_argument("--adopt", action="store_true",
                    help="device-resident offspring adoption: interesting "
                         "offspring scatter straight from the step's "
@@ -384,6 +397,7 @@ def main(argv=None) -> int:
         "arena_page": args.arena_page,
         "arena_classes": args.arena_classes,
         "adopt": args.adopt,
+        "struct": "device" if args.struct_kernels else args.struct,
         "output": args.output,
         "verbose": args.verbose,
         "meta_path": args.meta,

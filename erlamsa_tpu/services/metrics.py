@@ -93,6 +93,15 @@ class Counters:
         # TpuBatcher.stats(): mode/slots/fill_efficiency/steps_per_request/
         # compiles) — gauge-style, set not summed
         self.serving: dict | None = None
+        # host-tail accounting (r13): per-code samples that left the
+        # device stream for the host oracle, and the routed-sample total
+        # they are a fraction of. With --struct-kernels the host_routed
+        # keys should collapse to {"zip"} (+"overflow" for samples past
+        # the device budget) — the erlamsa_host_routed_total counter and
+        # host_tail_pct gauge in /metrics make the tail observable.
+        self.host_routed: dict[str, int] = {}
+        self.host_samples = 0
+        self.routed_samples = 0
         # admission-control sheds by reason (queue_full/quota/chaos) —
         # the faas_rejected_total counter in /metrics
         self.rejected: dict[str, int] = {}
@@ -123,6 +132,24 @@ class Counters:
         with self._lock:
             entry = self.mutators.setdefault(code, [0, 0])
             entry[0 if applied else 1] += n
+
+    def record_host_routed(self, code: str, n: int = 1):
+        """`n` samples left the device stream and were served by the
+        host engine under mutator `code` ("overflow" = full-oracle escape
+        for samples past the device budget). Breadcrumbed per call —
+        callers aggregate per case, so the flight ring sees one note per
+        (case, code), not one per sample."""
+        with self._lock:
+            self.host_routed[code] = self.host_routed.get(code, 0) + n
+            self.host_samples += n
+        # outside the lock: the flight ring has its own lock
+        flight.GLOBAL.note("host_routed", code=code, count=n)
+
+    def record_routed_total(self, n: int):
+        """`n` samples were routed this case (device + host) — the
+        denominator of host_tail_pct."""
+        with self._lock:
+            self.routed_samples += n
 
     def record_bucket(self, capacity: int, rows: int, pad_rows: int,
                       padded_bytes_wasted: int):
@@ -289,6 +316,12 @@ class Counters:
                     code: {"applied": a, "failed": f}
                     for code, (a, f) in sorted(self.mutators.items())
                 },
+                "host_routed": dict(sorted(self.host_routed.items())),
+                "host_samples": self.host_samples,
+                "routed_samples": self.routed_samples,
+                "host_tail_pct": round(
+                    100.0 * self.host_samples / self.routed_samples, 3
+                ) if self.routed_samples else 0.0,
                 "buckets": {cap: dict(b)
                             for cap, b in sorted(self.buckets.items())},
                 "truncated": self.truncated,

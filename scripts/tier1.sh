@@ -46,6 +46,16 @@
 # seed (the cross-mode determinism pin), then fires 200 concurrent
 # requests and asserts zero errors and zero request-path compiles.
 #
+# scripts/tier1.sh --struct-smoke additionally runs one full-set batch
+# over a structured corpus (JSON/XML/base64/URI seeds) twice — --struct
+# host (the numpy span-oracle) and --struct device (the vmapped
+# tree-splice kernels, ops/tree_mutators.py) — at the same seed, and
+# asserts the r13 struct-engine contract: byte-identical output
+# streams, struct rows actually resident on device, and the device
+# run's host-routed tail restricted to {zip, overflow} (with
+# --struct-kernels at most one of the 38 reference codes may still
+# route to the host).
+#
 # The gate starts with fuzzlint (erlamsa_tpu/analysis): pure-AST
 # invariant checks (determinism, device purity, lock discipline,
 # resilience coverage) over the whole package in ~2s. Opt out with
@@ -58,6 +68,7 @@ obs_smoke=0
 arena_smoke=0
 fleet_smoke=0
 serve_smoke=0
+struct_smoke=0
 lint=1
 while [ $# -gt 0 ]; do
   case "$1" in
@@ -67,6 +78,7 @@ while [ $# -gt 0 ]; do
     --arena-smoke) arena_smoke=1; shift ;;
     --fleet-smoke) fleet_smoke=1; shift ;;
     --serve-smoke) serve_smoke=1; shift ;;
+    --struct-smoke) struct_smoke=1; shift ;;
     --lint) lint=1; shift ;;
     --no-lint) lint=0; shift ;;
     *) break ;;
@@ -426,6 +438,70 @@ print(f"SERVE_SMOKE={'ok' if ok else 'FAIL'} identical={identical} "
       f"errors={len(errors)} request_path_compiles={compiles}")
 if errors:
     print("first errors:", errors[:3])
+sys.exit(0 if ok else 1)
+EOF
+  rc=$?
+fi
+
+if [ $rc -eq 0 ] && [ $struct_smoke -eq 1 ]; then
+  echo "== struct smoke: device tree-splice kernels must match the span-oracle =="
+  timeout -k 10 600 env JAX_PLATFORMS=cpu python - <<'EOF'
+import os, shutil, sys, tempfile
+
+from erlamsa_tpu.services import metrics
+from erlamsa_tpu.services.batchrunner import run_tpu_batch
+
+# structured seeds so the tokenizer finds spans for every struct code:
+# JSON (tr2/td/ts1/tr/ts2/js), XML-ish tags (sgm), base64 runs (b64),
+# percent-escaped URIs (uri), plus one plain-bytes seed that should
+# route through the ordinary device mutators untouched
+SEEDS = [
+    b'{"user": {"name": "ada", "tags": ["a", "b", "c"]}, "n": 42}',
+    b'[[1, 2, 3], [4, 5, 6], {"k": [7, 8]}]',
+    b"<doc><a>alpha</a><b>beta</b><a>gamma</a></doc>",
+    b"prefix aGVsbG8gc3RydWN0dXJlZCB3b3JsZA== suffix",
+    b"GET /p%20q?x=%41%42%43&y=%7b1%7d HTTP/1.1",
+    b"plain old unstructured bytes " * 3,
+]
+
+
+def one_run(root, mode):
+    outdir = os.path.join(root, "out")
+    os.makedirs(outdir)
+    stats = {}
+    rc = run_tpu_batch(
+        {
+            "corpus": SEEDS,
+            "seed": (13, 13, 13),
+            "n": 3,
+            "output": os.path.join(outdir, "%n.out"),
+            "struct": mode,
+            "_stats": stats,
+        },
+        batch=12,
+    )
+    blob = b""
+    for f in sorted(os.listdir(outdir), key=lambda s: int(s.split(".")[0])):
+        blob += open(os.path.join(outdir, f), "rb").read()
+    return rc, blob, stats
+
+
+root = tempfile.mkdtemp(prefix="tier1_struct_smoke_")
+try:
+    rc_d, blob_d, st_d = one_run(os.path.join(root, "device"), "device")
+    # snapshot BEFORE the host run: the span-oracle run below routes the
+    # struct codes to the host on purpose and would pollute the tail
+    tail = dict(metrics.GLOBAL.snapshot()["host_routed"])
+    rc_h, blob_h, st_h = one_run(os.path.join(root, "host"), "host")
+finally:
+    shutil.rmtree(root, ignore_errors=True)
+stray = sorted(set(tail) - {"zip", "overflow"})
+ok = (rc_d == rc_h == 0 and blob_d and blob_h == blob_d
+      and st_d.get("struct_bytes_uploaded", 0) > 0 and not stray)
+print(f"STRUCT_SMOKE={'ok' if ok else 'FAIL'} identical={blob_h == blob_d} "
+      f"bytes={len(blob_d)} "
+      f"struct_upload_bytes={st_d.get('struct_bytes_uploaded')} "
+      f"device_host_tail={tail} stray_codes={stray}")
 sys.exit(0 if ok else 1)
 EOF
   rc=$?

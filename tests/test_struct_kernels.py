@@ -1,0 +1,318 @@
+"""Parity suite for the struct span-splice engine (r13).
+
+Pins three things:
+
+1. Per-mutator byte identity: every device kernel branch
+   (ops/tree_mutators.py) produces EXACTLY the bytes of its numpy
+   reference (ops/structure.py host_struct_fuzz) for the same
+   (seed, case, slot) key — across JSON, SGML, malformed, truncated,
+   base64, URI and binary inputs, including the nesting-depth overflow
+   and unmatched-bracket fallback paths.
+2. Tokenizer invariants: fixed shape, document order, balanced spans,
+   literal quote interiors, graceful truncation.
+3. Router determinism + registry fingerprinting of the routing split.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import jax  # noqa: E402
+
+from erlamsa_tpu.ops import prng  # noqa: E402
+from erlamsa_tpu.ops import structure as st  # noqa: E402
+from erlamsa_tpu.ops import tree_mutators as tm  # noqa: E402
+
+JSON_DOC = b'{"a": [1, 2, {"b": "xy"}], "c": {"d": [true, null]}}'
+SGML_DOC = (b"<html><body><p>hi</p><div class='x'><b>deep</b></div>"
+            b"</body></html>")
+MALFORMED = b'{"open": [1, 2, <tag> "unclosed'
+TRUNCATED = JSON_DOC[:23]
+UNMATCHED = b"]]}} closers first ((( [nested"
+DEEP = b"(" * 48 + b"x" + b")" * 48  # nesting past MAX_DEPTH=32
+B64_DOC = b"  aGVsbG8gd29ybGQhIQ==  "
+B64_NOPAD = b"aGVsbG8gd29ybGQh"
+URI_DOC = b"GET http://example.com/a?q=1 HTTP/1.0"
+PLAIN = b"no structure here, just text"
+BINARY = bytes(range(256))
+EMPTY = b""
+
+ALL_INPUTS = [JSON_DOC, SGML_DOC, MALFORMED, TRUNCATED, UNMATCHED, DEEP,
+              B64_DOC, B64_NOPAD, URI_DOC, PLAIN, BINARY, EMPTY]
+
+
+# --- tokenizer -----------------------------------------------------------
+
+
+def test_tokenize_shape_and_order():
+    nd, cnt = st.tokenize(JSON_DOC)
+    assert nd.shape == (st.SPAN_NODES, 4) and nd.dtype == np.int32
+    assert cnt > 0
+    starts = nd[:cnt, 0]
+    assert (np.diff(starts) >= 0).all()  # document order
+    for s, e, d, k in nd[:cnt]:
+        assert 0 <= s < e <= len(JSON_DOC)
+        assert JSON_DOC[s] == k  # kind is the opener byte
+        assert d >= 0
+
+
+def test_tokenize_balanced_pairs():
+    nd, cnt = st.tokenize(b"{[x](y)}")
+    spans = {(int(s), int(e)) for s, e, _, _ in nd[:cnt]}
+    assert (0, 8) in spans and (1, 4) in spans and (4, 7) in spans
+
+
+def test_tokenize_quote_interior_is_literal():
+    nd, cnt = st.tokenize(b'"{[(" (a)')
+    spans = [(int(s), int(e), int(k)) for s, e, _, k in nd[:cnt]]
+    assert (0, 5, 34) in spans  # the quote span
+    assert (6, 9, 40) in spans  # the paren AFTER the quote
+    # no node opened by the brackets inside the quote
+    assert not any(s in (1, 2, 3) for s, _e, _k in spans)
+
+
+def test_tokenize_unmatched_and_unclosed_fallback():
+    nd, cnt = st.tokenize(UNMATCHED)
+    # the leading closers are literals; the unclosed '(((' and '[' drop
+    for s, e, _d, _k in nd[:cnt]:
+        assert UNMATCHED[s:e].startswith((b"(", b"[", b"{", b"<", b'"', b"'"))
+    nd2, cnt2 = st.tokenize(b"(a(b)")
+    spans = {(int(s), int(e)) for s, e, _, _ in nd2[:cnt2]}
+    assert spans == {(2, 5)}  # inner closed node survives its unclosed parent
+
+
+def test_tokenize_depth_overflow_fallback():
+    nd, cnt = st.tokenize(DEEP)
+    # only MAX_DEPTH frames are tracked; deeper openers are literals, so
+    # the innermost MAX_DEPTH pairs close against the tracked frames
+    assert cnt == st.MAX_DEPTH
+    assert all(k == 40 for k in nd[:cnt, 3])
+
+
+def test_tokenize_truncation_cap():
+    raw = b"()" * (st.SPAN_NODES + 20)
+    nd, cnt = st.tokenize(raw)
+    assert cnt == st.SPAN_NODES
+    assert (np.diff(nd[:cnt, 0]) >= 0).all()
+
+
+# --- per-mutator device/host parity -------------------------------------
+
+
+def _device_one(code_idx: int, raw: bytes, seed=(11, 22, 33), case=4,
+                slot=9, capacity=512):
+    base = prng.base_key(seed)
+    nd, cnt = st.tokenize(raw)
+    cap = min(capacity, 2 * max(len(raw), 8))
+    width = max(cap, 8)
+    data = np.zeros((1, width), np.uint8)
+    data[0, :len(raw)] = np.frombuffer(raw, np.uint8)
+    step = tm.make_struct_step()
+    out, lens, applied = step(
+        base, case, np.asarray([slot], np.int32), data,
+        np.asarray([len(raw)], np.int32), nd[None], np.asarray([cnt]),
+        np.asarray([cap], np.int32), np.asarray([code_idx], np.int32))
+    got = bytes(np.asarray(out)[0][:int(lens[0])])
+    key = st.struct_sample_key(base, case, slot)
+    want = st.host_struct_fuzz(key, raw, nd, cnt, code_idx, cap)
+    return got, want, int(applied[0])
+
+
+@pytest.mark.parametrize("code", st.STRUCT_CODES)
+@pytest.mark.parametrize("doc_idx", range(len(ALL_INPUTS)))
+def test_kernel_matches_host_oracle(code, doc_idx):
+    raw = ALL_INPUTS[doc_idx]
+    ci = st.STRUCT_CODES.index(code)
+    for case in (0, 3):
+        for slot in (0, 17):
+            got, want, applied = _device_one(ci, raw, case=case, slot=slot)
+            assert got == want, (
+                f"{code} diverged on input {doc_idx} case={case} "
+                f"slot={slot}: device={got!r} host={want!r}")
+            if applied < 0:
+                assert got == raw[:len(got)] or got == raw
+
+
+@pytest.mark.parametrize("code", st.STRUCT_CODES)
+def test_kernel_changes_applicable_input(code):
+    """Each mutator actually mutates at least one (input, key) it claims
+    applicability for — guards against a passthrough-everywhere kernel
+    trivially passing parity."""
+    ci = st.STRUCT_CODES.index(code)
+    changed = False
+    for raw in ALL_INPUTS:
+        nd, cnt = st.tokenize(raw)
+        if not st.applicability(raw, nd, cnt)[ci]:
+            continue
+        for slot in range(6):
+            got, want, applied = _device_one(ci, raw, slot=slot)
+            assert got == want
+            if applied >= 0 and got != raw:
+                changed = True
+    assert changed, f"{code} never changed any applicable input"
+
+
+def test_batched_step_matches_per_sample():
+    """One vmapped panel == per-sample kernel calls (keys are slot-keyed,
+    not panel-position-keyed)."""
+    docs = [JSON_DOC, SGML_DOC, URI_DOC, B64_DOC[2:-2]]
+    codes = [0, 6, 8, 7]
+    slots = [5, 2, 11, 7]
+    base = prng.base_key((1, 2, 3))
+    width = 256
+    data = np.zeros((4, width), np.uint8)
+    nds = np.zeros((4, st.SPAN_NODES, 4), np.int32)
+    cnts = np.zeros(4, np.int32)
+    lens = np.zeros(4, np.int32)
+    caps = np.full(4, width, np.int32)
+    for i, raw in enumerate(docs):
+        data[i, :len(raw)] = np.frombuffer(raw, np.uint8)
+        nds[i], cnts[i] = st.tokenize(raw)
+        lens[i] = len(raw)
+    step = tm.make_struct_step()
+    out, olens, _ = step(base, 2, np.asarray(slots, np.int32), data, lens,
+                         nds, cnts, caps, np.asarray(codes, np.int32))
+    for i, raw in enumerate(docs):
+        key = st.struct_sample_key(base, 2, slots[i])
+        want = st.host_struct_fuzz(key, raw, nds[i], int(cnts[i]), codes[i],
+                                   int(caps[i]))
+        assert bytes(np.asarray(out)[i][:int(olens[i])]) == want
+
+
+def test_negative_code_is_passthrough():
+    base = prng.base_key((1, 2, 3))
+    raw = JSON_DOC
+    nd, cnt = st.tokenize(raw)
+    data = np.zeros((1, 128), np.uint8)
+    data[0, :len(raw)] = np.frombuffer(raw, np.uint8)
+    step = tm.make_struct_step()
+    out, lens, applied = step(
+        base, 0, np.asarray([0], np.int32), data,
+        np.asarray([len(raw)], np.int32), nd[None], np.asarray([cnt]),
+        np.asarray([128], np.int32), np.asarray([-1], np.int32))
+    assert bytes(np.asarray(out)[0][:int(lens[0])]) == raw
+    assert int(applied[0]) == -1
+
+
+# --- router + registry fingerprint --------------------------------------
+
+
+def _default_selected():
+    from erlamsa_tpu.ops.registry import DEVICE_MUTATORS, HOST_CODES
+
+    sel = {m.code: m.default_pri for m in DEVICE_MUTATORS}
+    sel.update(HOST_CODES)
+    return sel
+
+
+def test_router_deterministic_and_applicability_gated():
+    samples = [JSON_DOC, PLAIN, SGML_DOC, URI_DOC, BINARY, EMPTY] * 4
+    cache = st.SpanCache()
+    r1 = st.StructRouter((1, 2, 3), _default_selected())
+    r1.prepare(samples, cache)
+    r2 = st.StructRouter((1, 2, 3), _default_selected())
+    r2.prepare(samples, cache)
+    a = r1.route(7)
+    assert (a == r2.route(7)).all()
+    assert not (a == r1.route(8)).all() or (a < 0).all()
+    # a sample with zero applicable struct mass never routes
+    for i, raw in enumerate(samples):
+        nd, cnt = cache.get(i, raw)
+        if not st.applicability(raw, nd, cnt).any():
+            assert a[i] == -1
+        if a[i] >= 0:
+            assert st.applicability(raw, nd, cnt)[a[i]]
+
+
+def test_router_excluded_rows_never_route():
+    samples = [JSON_DOC] * 8
+    r = st.StructRouter((9, 9, 9), _default_selected())
+    r.prepare(samples, st.SpanCache())
+    excl = np.zeros(8, bool)
+    excl[::2] = True
+    codes = r.route(1, excluded=excl)
+    assert (codes[::2] == -1).all()
+
+
+def test_registry_version_fingerprints_routing_split():
+    from erlamsa_tpu.ops import registry
+
+    v_off = registry.registry_version()
+    try:
+        registry.set_struct_kernels(True)
+        v_on = registry.registry_version()
+    finally:
+        registry.set_struct_kernels(False)
+    assert v_on != v_off
+    assert registry.registry_version() == v_off
+    # the struct flag moves every code except zip off the host set
+    registry.set_struct_kernels(True)
+    try:
+        assert registry.active_host_codes() == ("zip",)
+    finally:
+        registry.set_struct_kernels(False)
+    assert set(st.STRUCT_CODES) | {"zip"} == set(registry.HOST_CODES)
+
+
+def test_span_cache_reuses_and_retokenizes():
+    cache = st.SpanCache()
+    cache.note("sid1", JSON_DOC)
+    nd, cnt = cache.get("sid1", b"ignored - cached")
+    nd2, cnt2 = st.tokenize(JSON_DOC)
+    assert cnt == cnt2 and (nd == nd2).all()
+    cache.drop("sid1")
+    nd3, cnt3 = cache.get("sid1", SGML_DOC)  # adoption path: re-tokenize
+    assert cnt3 == st.tokenize(SGML_DOC)[1]
+
+
+def test_struct_key_chain_matches_device_derivation():
+    base = prng.base_key((4, 5, 6))
+    k_host = st.struct_sample_key(base, 3, 12)
+    ck = jax.random.fold_in(prng.sub(base, prng.TAG_STRUCT), 3)
+    k_dev = jax.random.fold_in(ck, 12)
+    assert (jax.random.key_data(k_host) == jax.random.key_data(k_dev)).all()
+
+
+def test_struct_code_order_pinned_across_modules():
+    # the registry's routing split, the host oracle and the device
+    # lax.switch all index the same tuple — a reorder in any one of them
+    # silently remaps every routed draw
+    from erlamsa_tpu.ops import registry
+
+    assert registry.STRUCT_DEVICE_CODES == st.STRUCT_CODES
+    assert len(tm.STRUCT_KERNELS) == len(st.STRUCT_CODES)
+
+
+# --- end-to-end batch identity (the tier1 --struct-smoke contract) -------
+
+
+@pytest.mark.slow
+def test_batchrunner_struct_host_device_identity(tmp_path):
+    from erlamsa_tpu.services.batchrunner import run_tpu_batch
+
+    seeds = [JSON_DOC, SGML_DOC, B64_DOC, URI_DOC, PLAIN]
+
+    def one(mode):
+        outdir = tmp_path / mode
+        outdir.mkdir()
+        stats = {}
+        rc = run_tpu_batch(
+            {"corpus": seeds, "seed": (13, 13, 13), "n": 2,
+             "output": str(outdir / "%n.out"), "struct": mode,
+             "_stats": stats},
+            batch=8,
+        )
+        assert rc == 0
+        blob = b"".join(
+            p.read_bytes()
+            for p in sorted(outdir.iterdir(), key=lambda p: int(p.stem))
+        )
+        return blob, stats
+
+    blob_h, _ = one("host")
+    blob_d, st_d = one("device")
+    assert blob_h and blob_d == blob_h
+    assert st_d["struct_bytes_uploaded"] > 0
