@@ -309,7 +309,8 @@ def _run_mixed_arena_stage(batch_n: int, cases: int, t0: float,
 
 
 def _run_fleet_stage(batch_n: int, seed_len: int, cases: int, t0: float,
-                     shards: int, spec: str | None = None):
+                     shards: int, spec: str | None = None,
+                     nodes: list | None = None, state: bool = False):
     """Sharded corpus fleet (corpus/fleet.py, `--shards N`): the same
     mixed-length seed set as the corpus stage, mapped across N per-shard
     arenas and reduced at the coordinator. At the fixed bench seed every
@@ -319,7 +320,10 @@ def _run_fleet_stage(batch_n: int, seed_len: int, cases: int, t0: float,
     mesh is linear capacity, here it is the overhead floor).
 
     `spec` arms a chaos spec for the run (e.g. "shard.step:x1" to kill
-    one shard's first dispatch and measure recovery). Returns
+    one shard's first dispatch and measure recovery). `nodes` routes
+    the first len(nodes) shard ids to remote workers (r14 cross-host
+    path; loopback on this host); `state` enables the per-case fleet
+    checkpoint so its cost shows up in the warm rate. Returns
     (warm_samples_per_sec, stats dict); stats carries the migration log
     and per-case finish_times the caller derives recovery time from."""
     import shutil
@@ -345,7 +349,10 @@ def _run_fleet_stage(batch_n: int, seed_len: int, cases: int, t0: float,
             "output": os.devnull,
             "_stats": stats,
             "shards": shards,
+            "fleet_nodes": nodes,
         }
+        if state:
+            opts["state_path"] = os.path.join(tmpdir, "state.npz")
         rc = run_corpus_batch(opts, batch=batch_n)
     finally:
         chaos.configure(None)
@@ -613,6 +620,61 @@ def child_main() -> None:
             _write_result(line)
         except Exception as e:  # noqa: BLE001 — earlier numbers stand
             _phase(f"fleet stage FAILED: {type(e).__name__}: {e}", t0)
+
+    # dist-fleet stage (r14): the cross-host fleet over two loopback
+    # workers (in-process ParentServers — same box, so the number
+    # isolates transport + fencing overhead vs the local 2-shard run),
+    # plus a checkpointed run to price the per-case fleet checkpoint.
+    # ERLAMSA_BENCH_DIST_FLEET=0 skips (default on: it rides the fleet
+    # stage's warm caches).
+    if os.environ.get("ERLAMSA_BENCH_DIST_FLEET", "1") != "0":
+        try:
+            from erlamsa_tpu.services.dist import ParentServer
+
+            fleet_cases = max(4, ITERS // 3)
+            workers = [ParentServer(0, {"seed": (1, 2, 3)}).serve(
+                block=False) for _ in range(2)]
+            try:
+                nodes = [f"127.0.0.1:{w._srv.getsockname()[1]}"
+                         for w in workers]
+
+                def warm(shards, nodes=None, state=False):
+                    # pass 1 pays the per-class compiles; pass 2 is the
+                    # measured warm rate (each config compiles its own
+                    # donate/no-donate step variants, so without the
+                    # warmup pass the first-run compiles would swamp the
+                    # transport/checkpoint deltas this stage isolates)
+                    _run_fleet_stage(BATCH, SEED_LEN, fleet_cases, t0,
+                                     shards=shards, nodes=nodes,
+                                     state=state)
+                    sps, _ = _run_fleet_stage(
+                        BATCH, SEED_LEN, fleet_cases, t0, shards=shards,
+                        nodes=nodes, state=state)
+                    return sps
+
+                loc_sps = warm(shards=2)
+                rem_sps = warm(shards=None, nodes=nodes)
+                ckpt_sps = warm(shards=None, nodes=nodes, state=True)
+            finally:
+                for w in workers:
+                    w.stop()
+            record["dist_fleet_local2_samples_per_sec"] = round(loc_sps, 1)
+            record["dist_fleet_remote2_samples_per_sec"] = round(rem_sps, 1)
+            record["dist_fleet_remote2_ckpt_samples_per_sec"] = round(
+                ckpt_sps, 1)
+            # NOT a transport number: local shards dispatch through
+            # per-shard arenas (page admission for every novel
+            # offspring), remote workers re-pack payload panels
+            # directly — the ratio prices that path difference, with
+            # loopback JSON transport included on the remote side
+            record["dist_fleet_remote_vs_local"] = round(
+                rem_sps / loc_sps, 2) if loc_sps else None
+            record["dist_fleet_ckpt_overhead"] = round(
+                1.0 - ckpt_sps / rem_sps, 3) if rem_sps else None
+            line = json.dumps(record)
+            _write_result(line)
+        except Exception as e:  # noqa: BLE001 — earlier numbers stand
+            _phase(f"dist-fleet stage FAILED: {type(e).__name__}: {e}", t0)
 
     # service-layer stage (BASELINE configs 4/5): FaaS concurrency +
     # live-proxy stream via bin/load_bench.py. Modest defaults keep the

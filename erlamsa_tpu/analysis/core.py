@@ -84,6 +84,7 @@ class LintConfig:
         "checkpoint.save", "checkpoint.load",
         "serving.admit", "serving.step",
         "shard.step", "shard.migrate", "fleet.reduce",
+        "dist.shard.send", "dist.shard.recv", "fleet.checkpoint",
     )
 
     def in_scope(self, rel: str, prefixes: tuple) -> bool:

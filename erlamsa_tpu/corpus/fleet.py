@@ -41,15 +41,43 @@ success re-admits the shard (its arena is rebuilt lazily — seeds
 re-upload on first dispatch). Only a fleet with ZERO live shards falls
 back to the host oracle, per case, until a probe brings a shard back.
 
-Not yet wired here: --state checkpointing (single-device runner only)
-and the async drain worker (the fleet reduces at case boundaries; shard
-steps still overlap each other via JAX async dispatch within a case).
+Cross-host fleet (r14): ``--fleet-nodes host:port,...`` makes the FIRST
+len(nodes) shard ids remote — each one's per-case dispatch runs on a
+WorkerNode over the services/dist.py shard protocol (lease / step /
+revoke / probe, each lease carrying a fencing epoch from
+FleetPlacement.lease_epoch_of). The worker is stateless: the lease ships
+the step config (seed, mutator pri, capacity classes, device_max,
+batch), every step ships the slice's bytes, and ``run_remote_slice``
+reproduces the coordinator's local recipe — same class grouping, same
+pow2 cyclic padding, same GLOBAL slot keys — so remote-N == local-N ==
+1-shard byte-identity holds by construction. Remote failures
+(RemoteShardError: connect/timeout/protocol/fenced) flow through the
+SAME revoke/redispatch/readmit path as a local device loss; a stale
+(fenced) reply is rejected by validate_shard_reply and never merged.
+
+``--state`` (r14): the coordinator checkpoints per-case — scores, the
+global seen-hash set, corpus energies, the placement fencing epoch and
+the resolved capacity classes (services/checkpoint.save_fleet_state:
+crc32, fsync-before-rename, .bak fallback) — after the case's outputs
+are written and before the next schedule, mirroring the single-device
+finish_case order. A killed coordinator resumes mid-campaign
+byte-identically; resuming bumps the placement epoch past the saved one
+so every pre-crash lease is fenced. A checkpoint from a different run
+(seed/shape/shard-count mismatch) is quarantined to ``.bak``, never
+silently overwritten.
+
+Still single-device only: the async drain worker (the fleet reduces at
+case boundaries) and the --struct overlay (a hard error here, not a
+silent ignore).
 """
 
 from __future__ import annotations
 
+import os
 import sys
+import threading
 import time
+from types import SimpleNamespace
 
 import numpy as np
 
@@ -102,6 +130,126 @@ def apply_novelty(store, ids, results, seen_hashes, batch,
     return new
 
 
+# worker-side compiled-step cache: one make_class_fuzzer per mutator-pri
+# tuple, shared across leases/steps (compiling per step would dominate)
+_REMOTE_STEPS: dict[tuple, object] = {}
+_REMOTE_LOCK = threading.Lock()
+
+
+def _remote_step_for(pri: tuple):
+    from ..ops.pipeline import make_class_fuzzer
+
+    with _REMOTE_LOCK:
+        step = _REMOTE_STEPS.get(pri)
+        if step is None:
+            step = make_class_fuzzer(mutator_pri=list(pri), donate=False)
+            _REMOTE_STEPS[pri] = step
+        return step
+
+
+def run_remote_slice(seed, case: int, batch: int, slots, payloads,
+                     score_rows, pri, classes, device_max: int):
+    """Worker-side executor for one remote shard's per-case slice
+    (called by services/dist.ShardHost under a validated lease).
+
+    Mirrors the coordinator's local dispatch recipe byte-exactly, minus
+    the arena: rows group by capacity class (smallest class holding
+    bucket_capacity(len, device_max), longer samples truncate at the top
+    class), each group pads to a pow2 row count cyclically, panels are
+    zero-padded seed bytes (identical to a gathered arena row), and the
+    PRNG keys on the GLOBAL slot indices shipped in the request — so the
+    bytes are a pure function of (seed, case, slot), whatever host
+    serves them. Returns (outs, score_rows, applied_rows, shapes), all
+    aligned with `slots` order except `shapes` (one (kp, capacity,
+    scan_len) per dispatched class group)."""
+    from ..ops import prng
+    from ..ops.buffers import Batch, scan_bound, unpack
+    from ..ops.pipeline import drain_futures, step_async
+    from .arena import _next_pow2
+
+    classes = tuple(int(c) for c in classes)
+    base = prng.base_key(tuple(int(x) for x in seed))
+    step = _remote_step_for(tuple(int(x) for x in pri))
+    groups: dict[int, list[int]] = {}
+    for r, p in enumerate(payloads):
+        want = bucket_capacity(len(p), device_max=int(device_max))
+        cls = next((i for i, cap in enumerate(classes) if cap >= want),
+                   len(classes) - 1)
+        groups.setdefault(cls, []).append(r)
+    launched: list[tuple] = []
+    try:
+        for cls in sorted(groups):
+            rows = groups[cls]
+            cap = classes[cls]
+            k = len(rows)
+            kp = max(8, _next_pow2(k))
+            panel = np.zeros((kp, cap), np.uint8)
+            lens = np.zeros(kp, np.int32)
+            for j in range(kp):
+                p = payloads[rows[j % k]][:cap]
+                panel[j, :len(p)] = np.frombuffer(p, np.uint8)
+                lens[j] = len(p)
+            g_slots = [int(slots[r]) for r in rows]
+            idx = np.concatenate([
+                np.asarray(g_slots, np.int32),
+                int(batch) + np.arange(kp - k, dtype=np.int32),
+            ]).astype(np.int32)
+            sc_in = np.asarray(
+                [score_rows[rows[j % k]] for j in range(kp)], np.int32)
+            sl = scan_bound(int(lens[:k].max()), cap)
+            fut = step_async(step, base, int(case), idx, panel, lens,
+                             sc_in, scan_len=sl)
+            launched.append((rows, k, cap, sl, kp, fut))
+    except BaseException:  # lint: broad-except-ok re-raised after settling in-flight futures
+        drain_futures(f for *_g, f in launched)
+        raise
+    outs: list[bytes] = [b""] * len(slots)
+    sc_out = [[int(x) for x in row] for row in score_rows]
+    applied: list[list[int]] = [[] for _ in range(len(slots))]
+    shapes: list[tuple] = []
+    for rows, k, cap, sl, kp, fut in launched:
+        new_data, new_lens, new_sc, meta = fut.result()
+        group_outs = unpack(Batch(new_data[:k], new_lens[:k]))
+        for j, r in enumerate(rows):
+            outs[r] = group_outs[j]
+            sc_out[r] = [int(x) for x in new_sc[j]]
+            applied[r] = [int(x) for x in meta.applied[j]]
+        shapes.append((kp, cap, sl))
+    return outs, sc_out, applied, shapes
+
+
+class _RemoteResult:
+    """A completed remote step dressed in the StepFuture protocol
+    (ops/pipeline.py: block/ready/result) so the reduce forces local and
+    remote entries through ONE code path. data+lens are rebuilt so
+    buffers.unpack reproduces the worker's bytes exactly; applied rows
+    pad with -1 (the 'inactive round' convention the mutator-metrics
+    walk already filters)."""
+
+    def __init__(self, outs, sc_rows, applied_rows):
+        k = len(outs)
+        data = np.zeros((k, max([len(o) for o in outs] + [1])), np.uint8)
+        lens = np.zeros(k, np.int32)
+        for j, o in enumerate(outs):
+            data[j, :len(o)] = np.frombuffer(o, np.uint8)
+            lens[j] = len(o)
+        width = max([len(a) for a in applied_rows] + [1])
+        app = np.full((k, width), -1, np.int32)
+        for j, a in enumerate(applied_rows):
+            app[j, :len(a)] = a
+        self._res = (data, lens, np.asarray(sc_rows, np.int32),
+                     SimpleNamespace(applied=app))
+
+    def block(self):
+        return self
+
+    def ready(self) -> bool:
+        return True
+
+    def result(self):
+        return self._res
+
+
 def run_corpus_fleet(opts: dict, batch: int = 1024) -> int:
     """The --corpus DIR --shards N entry point (see module docstring)."""
     import jax
@@ -118,21 +266,41 @@ def run_corpus_fleet(opts: dict, batch: int = 1024) -> int:
     from .arena import RESERVED_PAGES, DeviceArena, _next_pow2, \
         fit_page_classes, resolve_classes
 
+    from ..services.checkpoint import (load_fleet_state,
+                                       quarantine_mismatch,
+                                       save_fleet_state)
+    from ..services.dist import (RemoteShard, RemoteShardError,
+                                 new_campaign_token)
+
     raw_shards = opts.get("shards")
-    n_shards = int(raw_shards if raw_shards is not None else 1)
+    fleet_nodes: list[tuple[str, int]] = []
+    for spec in (opts.get("fleet_nodes") or []):
+        host, _, port = str(spec).rpartition(":")
+        if not host or not port.isdigit():
+            raise ValueError(
+                f"--fleet-nodes entry {spec!r} is not host:port")
+        fleet_nodes.append((host, int(port)))
+    # --fleet-nodes alone sizes the fleet to the worker list; --shards N
+    # with M <= N nodes runs a mixed fleet (M remote + N-M local shards)
+    n_shards = int(raw_shards if raw_shards is not None
+                   else (len(fleet_nodes) or 1))
     if n_shards < 1:
         raise ValueError(f"--shards must be >= 1, got {n_shards}")
-    if opts.get("state_path"):
-        print("# fleet: --state checkpointing is single-device only; "
-              "ignoring", file=sys.stderr)
+    if len(fleet_nodes) > n_shards:
+        raise ValueError(
+            f"--fleet-nodes names {len(fleet_nodes)} workers but --shards "
+            f"is {n_shards}; drop --shards to size the fleet from the "
+            f"node list, or raise it to at least the node count")
     if str(opts.get("struct") or "off") != "off":
         # the struct overlay (ops/structure.py) is routed per scheduled
         # case against one arena; sharding it means per-shard span panels
-        # and a merged routing draw — not built yet, so the fleet runs
-        # the plain device set rather than silently diverging from the
-        # single-device struct stream
-        print("# fleet: --struct overlay is single-device only; ignoring",
-              file=sys.stderr)
+        # and a merged routing draw — not built. A hard error beats the
+        # old printed notice: nobody should believe struct kernels ran
+        # fleet-wide when they didn't.
+        raise ValueError(
+            "--struct is single-device only: the span-splice overlay "
+            "routes against one arena. Drop --shards/--fleet-nodes to "
+            "run the struct overlay, or drop --struct to run the fleet.")
 
     store = CorpusStore(opts["corpus_dir"])
     fsck = store.fsck()
@@ -181,13 +349,58 @@ def run_corpus_fleet(opts: dict, batch: int = 1024) -> int:
     bus = opts.get("feedback_bus", fb.GLOBAL)
     consume_feedback = bool(opts.get("feedback"))
 
+    # -- fleet checkpoint (--state): resume or start fresh -------------
+    n_cases = int(opts.get("n", 1))
+    state_path = opts.get("state_path")
+    ckpt_every = max(1, int(opts.get("checkpoint_every", 1)))
+    start_case = 0
+    resume_seen: set[bytes] = set()
+    resume_epoch = None
+    classes_override = None
+    if state_path and os.path.exists(state_path):
+        st = load_fleet_state(state_path)
+        if st is None:
+            print("# fleet checkpoint unreadable (or not a fleet "
+                  "checkpoint), starting fresh", file=sys.stderr)
+        elif (st["seed"] != tuple(opts["seed"])
+                or st["scores"].shape != scores.shape
+                or st["n_shards"] != n_shards):
+            # a checkpoint from a DIFFERENT run is evidence, not trash:
+            # quarantine it to .bak instead of burying it under this
+            # run's first save (tests pin both paths)
+            quarantine_mismatch(state_path)
+            print("# fleet checkpoint mismatch (seed/shape/shards), "
+                  "starting fresh (original kept as .bak)",
+                  file=sys.stderr)
+        else:
+            start_case = st["case_idx"]
+            scores[:] = st["scores"]
+            resume_seen = st["seen"]
+            if st["energies"]:
+                store.restore_energies(st["energies"])
+            resume_epoch = st["epoch"]
+            classes_override = st["classes"]
+            print(f"# fleet resumed at case {start_case} "
+                  f"({len(st['seen'])} seen hashes, "
+                  f"{len(st['energies'])} seed energies, "
+                  f"placement epoch > {resume_epoch})", file=sys.stderr)
+    if start_case >= n_cases:
+        print(f"# run already complete ({start_case}/{n_cases} cases)",
+              file=sys.stderr)
+        return 0
+
     # ONE capacity-class SET over the WHOLE store (never per shard): the
     # fused engine's streams are a function of the static row width, so
     # shard-count byte-identity requires every shard to mutate a seed at
     # the same class width the 1-shard run would use — each shard then
-    # runs one ragged step per class present in its slice
+    # runs one ragged step per class present in its slice. A RESUMED run
+    # restores the checkpointed set: the reloaded store already holds
+    # adopted offspring, so re-deriving from it would change row widths —
+    # and therefore bytes — relative to the uninterrupted run.
     sizes = [len(store.get(sid)) for sid in store.ids()]
-    classes = resolve_classes(opts.get("arena_classes"), sizes, device_max)
+    classes = (classes_override if classes_override is not None
+               else resolve_classes(opts.get("arena_classes"), sizes,
+                                    device_max))
     trunc_cap = classes[-1]
     page_opt = int(opts.get("arena_page") or paged.PAGE)
     page = fit_page_classes(page_opt, classes)
@@ -204,6 +417,11 @@ def run_corpus_fleet(opts: dict, batch: int = 1024) -> int:
 
     devices = jax.devices()
     placement = FleetPlacement(n_shards, failure_threshold=1)
+    if resume_epoch is not None:
+        # continue the fencing sequence PAST the checkpointed epoch:
+        # every lease the dead coordinator granted is now stale, so a
+        # pre-crash zombie worker's reply can never pass validation
+        placement.restore(resume_epoch)
 
     class _Shard:
         """One lease-holder: a device slot plus its own paged arena,
@@ -228,17 +446,87 @@ def run_corpus_fleet(opts: dict, batch: int = 1024) -> int:
                         n, device_max=device_max),
                 )
 
-    shards = {s: _Shard(s) for s in range(n_shards)}
+    # one token per coordinator campaign: worker-side fence floors are
+    # scoped by it, so a fresh campaign's epoch-0 leases are not fenced
+    # by floors a previous campaign left on a long-lived worker, while
+    # zombies of past campaigns (old token) stay rejected. Transport
+    # metadata only — sample bytes stay f(seed, case, slot).
+    fleet_token = str(opts.get("fleet_token") or new_campaign_token())
 
-    n_cases = int(opts.get("n", 1))
+    class _Remote:
+        """One cross-host lease-holder: this shard's per-case dispatch
+        runs on a WorkerNode over the dist shard protocol. No arena —
+        the worker is stateless (the lease ships the step config, every
+        step ships the slice's bytes), so a worker restart costs a
+        re-lease, nothing else. Offspring produced here adopt host-side
+        only (no device buffer to splice from); they upload lazily at
+        their first schedule like any migrated seed."""
+
+        def __init__(self, shard_id: int, host: str, port: int):
+            self.id = shard_id
+            self.rs = RemoteShard(shard_id, host, port,
+                                  timeout=float(
+                                      opts.get("fleet_timeout") or 90.0),
+                                  token=fleet_token)
+            self._leased: int | None = None
+            self.cfg = {
+                "seed": [int(x) for x in opts["seed"]],
+                "pri": [int(x) for x in pri],
+                "classes": [int(c) for c in classes],
+                "device_max": int(device_max),
+                "batch": int(batch),
+            }
+
+        def ensure_lease(self, epoch: int):
+            """(Re-)grant the lease when the placement epoch moved —
+            initial grant, post-readmit, and post-resume all land here
+            lazily at the next dispatch that needs the shard."""
+            if self._leased != epoch:
+                self.rs.lease(epoch, self.cfg)
+                self._leased = epoch
+
+    # the FIRST len(fleet_nodes) shard ids are remote, the rest local —
+    # partition_of is shard-count-keyed only, so the mix never changes
+    # WHAT any slot computes, only where
+    shards: dict[int, object] = {
+        s: (_Remote(s, *fleet_nodes[s]) if s < len(fleet_nodes)
+            else _Shard(s))
+        for s in range(n_shards)
+    }
+
     writer, _mt = out.string_outputs(opts.get("output", "-"))
     stats = opts.get("_stats")
-    seen_hashes: set[bytes] = set()
+    seen_hashes: set[bytes] = resume_seen
     tallies = {"truncated": 0, "total": 0, "new_hashes": 0, "bytes_out": 0,
                "oracle_cases": 0, "redispatches": 0, "offspring": 0}
     step_shapes: set[tuple] = set()
 
-    def shard_dispatch(shard: _Shard, case: int, slots: list[int],
+    def remote_dispatch(shard: _Remote, case: int, slots: list[int],
+                        samples):
+        """Map step for one REMOTE shard's slice: ship (global slots,
+        bytes, score rows) under the shard's current lease epoch, get
+        (bytes, score rows, applied) back for the same slots. The
+        network round-trip IS the future — the result arrives complete
+        and is wrapped in _RemoteResult so the reduce treats local and
+        remote entries identically. RemoteShardError (incl. a fenced
+        stale reply, and injected dist.shard.* faults) flows into the
+        same revoke/redispatch path as a local device loss."""
+        epoch = placement.lease_epoch_of(shard.id)
+        t_a = time.perf_counter()
+        shard.ensure_lease(epoch)
+        payloads = [samples[s] for s in slots]
+        score_rows = [[int(x) for x in scores[s]] for s in slots]
+        with trace.span("fleet.remote_dispatch", case=case,
+                        shard=shard.id, rows=len(slots)):
+            outs, sc, applied, shapes = shard.rs.step(
+                epoch, case, slots, payloads, score_rows)
+        metrics.GLOBAL.record_stage("remote_step",
+                                    time.perf_counter() - t_a)
+        for sh in shapes:
+            step_shapes.add(tuple(int(x) for x in sh))
+        return [(list(slots), len(slots), _RemoteResult(outs, sc, applied))]
+
+    def shard_dispatch(shard, case: int, slots: list[int],
                        ids, samples):
         """Map step for one shard's slice: adopt queued offspring,
         ensure residency in the shard's arena (idempotent — migrated
@@ -246,8 +534,12 @@ def run_corpus_fleet(opts: dict, batch: int = 1024) -> int:
         CLASS, and dispatch one ragged step per class keyed on the
         GLOBAL slot indices. Returns a list of (global slots, rows, fut)
         entries, one per class present in the slice. Raises on device
-        error (incl. injected shard.step faults)."""
+        error (incl. injected shard.step faults). Remote shards route to
+        remote_dispatch — behind the SAME shard.step fault point, so a
+        shard.step chaos spec kills local and remote shards alike."""
         chaos.fault_point("shard.step")
+        if isinstance(shard, _Remote):
+            return remote_dispatch(shard, case, slots, samples)
         arena = shard.arena
         sub_ids = [ids[s] for s in slots]
         sub_samples = [samples[s] for s in slots]
@@ -315,12 +607,16 @@ def run_corpus_fleet(opts: dict, batch: int = 1024) -> int:
         metrics.GLOBAL.record_stage("dispatch", t_e - t_d)
         return launched_here
 
-    def probe_shard(shard: _Shard):
-        """One tiny forced op on the shard's device. The shard.step
+    def probe_shard(shard):
+        """One tiny forced op on the shard's device — or, for a remote
+        shard, a shard_probe round-trip to its worker. The shard.step
         fault point runs first so a still-armed persistent spec keeps
         probes failing — re-admission happens exactly when the fault
         clears (same discipline as the single-device runner's probe)."""
         chaos.fault_point("shard.step")
+        if isinstance(shard, _Remote):
+            shard.rs.probe()
+            return
         with jax.default_device(shard.device):
             jnp.zeros(8).block_until_ready()
 
@@ -353,6 +649,17 @@ def run_corpus_fleet(opts: dict, batch: int = 1024) -> int:
                    "redistributing its partitions", shard_id, case, err)
         metrics.GLOBAL.record_event("shard_lost")
         entry = placement.revoke(shard_id, case)
+        sh = shards[shard_id]
+        if isinstance(sh, _Remote):
+            # best-effort fence: raise the worker's floor so anything
+            # still in flight from this lease is rejected worker-side
+            # too. An unreachable worker is fenced anyway — its readmit
+            # lease will carry a strictly higher epoch.
+            sh._leased = None
+            try:
+                sh.rs.revoke(entry["epoch"])
+            except OSError:
+                pass
         try:
             chaos.fault_point("shard.migrate")
         except OSError:
@@ -380,10 +687,13 @@ def run_corpus_fleet(opts: dict, batch: int = 1024) -> int:
         except OSError:
             metrics.GLOBAL.record_event("shard_readmit_aborted")
             return False
-        # the old arena tensor died with the device: rebuild empty; its
-        # seeds re-upload lazily at the next dispatch that needs them
-        with jax.default_device(shards[shard_id].device):
-            shards[shard_id].arena.reset()
+        if isinstance(shards[shard_id], _Shard):
+            # the old arena tensor died with the device: rebuild empty;
+            # its seeds re-upload lazily at the next dispatch that needs
+            # them. (A remote shard has no arena — its re-grant happens
+            # lazily via ensure_lease at the bumped readmit epoch.)
+            with jax.default_device(shards[shard_id].device):
+                shards[shard_id].arena.reset()
         entry = placement.readmit(shard_id, case)
         logger.log("warning", "fleet: shard %d re-admitted at case %d — "
                    "taking its partitions back", shard_id, case)
@@ -397,8 +707,8 @@ def run_corpus_fleet(opts: dict, batch: int = 1024) -> int:
 
     metrics.GLOBAL.record_fleet(placement.snapshot())
     t0 = time.perf_counter()
-    probe_at = 0
-    case = 0
+    probe_at = start_case
+    case = start_case
     while case < n_cases:
         # -- re-admission probes (case-counter gated, like the runner) --
         if placement.dead() and case >= probe_at:
@@ -439,8 +749,12 @@ def run_corpus_fleet(opts: dict, batch: int = 1024) -> int:
                         (shard_id, *entry)
                         for entry in shard_dispatch(shards[shard_id], case,
                                                     slots, ids, samples))
-                except Exception as e:  # lint: broad-except-ok re-raised below unless is_device_error
-                    if not is_device_error(e):
+                except Exception as e:  # lint: broad-except-ok re-raised below unless a shard loss
+                    # a remote shard loss (timeout, protocol error, or a
+                    # FENCED stale reply) is the cross-host spelling of
+                    # a device error: same revoke + in-case redispatch
+                    if not (is_device_error(e)
+                            or isinstance(e, RemoteShardError)):
                         raise
                     revoke_shard(shard_id, case, e)
                     # the failed slice re-partitions onto its new owners
@@ -489,7 +803,10 @@ def run_corpus_fleet(opts: dict, batch: int = 1024) -> int:
                 new_data, new_lens, new_sc, meta = fut.result()
                 outs = unpack(Batch(new_data[:rows], new_lens[:rows]))
             parts.append({slot: outs[j] for j, slot in enumerate(slots)})
-            if adopt_on:
+            if adopt_on and isinstance(shards[shard_id], _Shard):
+                # remote shards never register adoption sources: there
+                # is no local device buffer to splice from, so their
+                # offspring take the lazy-upload path unconditionally
                 for j, slot in enumerate(slots):
                     devsrc[slot] = (shard_id, new_data, j)
             scores[np.asarray(slots, np.int32)] = new_sc[:rows]
@@ -559,6 +876,19 @@ def run_corpus_fleet(opts: dict, batch: int = 1024) -> int:
         metrics.GLOBAL.record_stage("write", time.perf_counter() - t_o)
         if stats is not None:
             stats.setdefault("finish_times", []).append(time.perf_counter())
+        if state_path and ((case + 1) % ckpt_every == 0
+                           or case + 1 == n_cases):
+            # mirror the single-device finish_case ordering: this case's
+            # outputs are written BEFORE the checkpoint marks it done (a
+            # resumed run must not skip a case whose outputs never hit
+            # disk), and the store snapshot follows so it contains this
+            # case's adoptions when the checkpoint says they exist
+            with trace.span("fleet.checkpoint", case=case):
+                save_fleet_state(state_path, opts["seed"], case + 1,
+                                 scores, seen_hashes, store.energies(),
+                                 placement.epoch, n_shards, classes)
+                store.save()
+            metrics.GLOBAL.record_event("fleet_checkpoint")
         case += 1
 
     store.save()
@@ -566,7 +896,8 @@ def run_corpus_fleet(opts: dict, batch: int = 1024) -> int:
     metrics.GLOBAL.record_pipeline_wall(dt)
     metrics.GLOBAL.record_fleet(placement.snapshot())
     for shard in shards.values():
-        metrics.GLOBAL.record_arena(shard.arena.stats())
+        if isinstance(shard, _Shard):
+            metrics.GLOBAL.record_arena(shard.arena.stats())
     total, new_hashes = tallies["total"], tallies["new_hashes"]
     if tallies["truncated"]:
         print(f"# {tallies['truncated']} scheduled samples exceeded the "
@@ -576,6 +907,8 @@ def run_corpus_fleet(opts: dict, batch: int = 1024) -> int:
         stats.update(total=total, dt=dt, batch=batch,
                      new_hashes=new_hashes, pipeline="fleet",
                      layout="fleet", shards=n_shards,
+                     remote_shards=len(fleet_nodes),
+                     start_case=start_case,
                      fleet=placement.snapshot(),
                      migrations=list(placement.migrations),
                      oracle_cases=tallies["oracle_cases"],
@@ -583,7 +916,8 @@ def run_corpus_fleet(opts: dict, batch: int = 1024) -> int:
                      offspring=tallies["offspring"],
                      step_shapes=sorted(step_shapes),
                      arenas={s: sh.arena.stats()
-                             for s, sh in shards.items()},
+                             for s, sh in shards.items()
+                             if isinstance(sh, _Shard)},
                      store_stats=store.stats())
     logger.log("info", "corpus fleet (%d shards, %d live): %d samples in "
                "%.2fs (%.0f samples/s), %d novel hashes, %d migration(s)",

@@ -182,17 +182,18 @@ def run_corpus_batch(opts: dict, batch: int = 1024) -> int:
                                 make_class_fuzzer, step_async)
 
     shards = opts.get("shards")
-    if shards is not None:
-        # --shards N routes the whole run through the elastic fleet
-        # coordinator (corpus/fleet.py): per-shard arenas, breaker-aware
-        # placement, live redistribution on shard loss
+    if shards is not None or opts.get("fleet_nodes"):
+        # --shards N / --fleet-nodes routes the whole run through the
+        # elastic fleet coordinator (corpus/fleet.py): per-shard arenas
+        # (or remote workers over dist), breaker-aware placement, live
+        # redistribution on shard loss
         from .fleet import run_corpus_fleet
 
         return run_corpus_fleet(opts, batch=batch)
     from ..ops.registry import DEVICE_CODES
     from ..ops.scheduler import init_scores
     from ..services.checkpoint import (load_corpus_energies, load_state,
-                                       save_state)
+                                       quarantine_mismatch, save_state)
 
     pipeline = str(opts.get("pipeline") or "async")
     if pipeline not in PIPELINES:
@@ -342,8 +343,13 @@ def run_corpus_batch(opts: dict, batch: int = 1024) -> int:
                 ck_seed, ck_case, ck_scores, _hs, _hsp = st
                 if (ck_seed != tuple(opts["seed"])
                         or ck_scores.shape != (batch, NUM_DEVICE_MUTATORS)):
+                    # the mismatched file belongs to a DIFFERENT run:
+                    # park it at .bak so that run can still resume from
+                    # it, instead of burying it under this run's first
+                    # save (tests pin the quarantine)
+                    quarantine_mismatch(state_path)
                     print("# checkpoint mismatch (seed/shape), starting "
-                          "fresh", file=sys.stderr)
+                          "fresh (original kept as .bak)", file=sys.stderr)
                 else:
                     start_case = ck_case
                     scores = jnp.asarray(ck_scores)

@@ -93,6 +93,13 @@ class FleetPlacement:
             self.health.touch(s)
         self._owner = assign_partitions(self.n_shards, self._live)
         self.migrations: list[dict] = []
+        # fencing: the epoch each shard's CURRENT lease was granted at
+        # (init, readmit, or checkpoint restore). A reply carrying any
+        # other epoch is stale — the coordinator rejects it instead of
+        # merging it into the reduce (services/dist.validate_shard_reply)
+        self.lease_epoch: dict[int, int] = {
+            s: 0 for s in range(self.n_shards)
+        }
 
     # -- queries ---------------------------------------------------------
 
@@ -112,6 +119,22 @@ class FleetPlacement:
     def partitions_of(self, shard: int) -> list[int]:
         return [p for p, s in self._owner.items() if s == shard]
 
+    def lease_epoch_of(self, shard: int) -> int:
+        """Fencing epoch of `shard`'s current lease — the token every
+        remote step request carries and every reply must echo."""
+        return self.lease_epoch[shard]
+
+    def restore(self, epoch: int) -> int:
+        """Resume from a fleet checkpoint: continue the fencing sequence
+        PAST the checkpointed epoch. Every lease is re-granted at
+        saved+1, so any lease the dead coordinator handed out is stale —
+        a pre-crash zombie worker's reply can never pass validation.
+        Returns the new epoch."""
+        self.epoch = max(self.epoch, int(epoch)) + 1
+        for s in range(self.n_shards):
+            self.lease_epoch[s] = self.epoch
+        return self.epoch
+
     # -- transitions -----------------------------------------------------
 
     def _migrate(self, case: int, kind: str, shard: int) -> dict:
@@ -121,6 +144,10 @@ class FleetPlacement:
         self._owner = assign_partitions(self.n_shards, self._live)
         moved = {p: s for p, s in self._owner.items() if old[p] != s}
         self.epoch += 1
+        if kind == "readmit":
+            # a re-admitted shard's lease is re-granted at the NEW epoch:
+            # anything still in flight from its previous life is fenced
+            self.lease_epoch[shard] = self.epoch
         entry = {"case": int(case), "epoch": self.epoch, "kind": kind,
                  "shard": int(shard), "moved": moved}
         self.migrations.append(entry)
@@ -159,6 +186,7 @@ class FleetPlacement:
                     "partitions": self.partitions_of(s),
                     "breaker": health.get(str(s), {}).get("state", "?"),
                     "score": health.get(str(s), {}).get("score", 0.0),
+                    "lease_epoch": self.lease_epoch[s],
                 }
                 for s in range(self.n_shards)
             },

@@ -59,6 +59,18 @@ Known sites (grep `fault_point(` for the authoritative list):
     fleet.reduce     the fleet coordinator's per-case merge
                      (corpus/fleet.py): an injected fault costs one
                      logged re-apply of the pure merge, never data loss
+    dist.shard.send  coordinator->fleet-worker shard-protocol
+                     transmission (services/dist.py): an injected fault
+                     reads as a remote shard loss — revoke, in-case
+                     redispatch on survivors, outputs unchanged
+    dist.shard.recv  fleet-worker shard-protocol reply read
+                     (services/dist.py): same revoke/redispatch
+                     contract as dist.shard.send
+    fleet.checkpoint the fleet coordinator's --state checkpoint write
+                     (services/checkpoint.py save_fleet_state): an
+                     injected fault degrades to a warning — the run
+                     continues, resume falls back to the previous
+                     checkpoint (or its .bak)
 
 Injected failures raise ``InjectedFault``, an OSError subclass, so they
 flow through exactly the except-clauses that catch real socket/disk

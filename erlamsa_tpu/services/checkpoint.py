@@ -141,6 +141,119 @@ def save_state(path: str, seed, case_idx: int, scores,
                    "resume falls back to the previous checkpoint", path)
 
 
+def quarantine_mismatch(path: str) -> bool:
+    """A checkpoint that LOADED fine but does not match the run it was
+    handed to (different seed, different score shape) is evidence worth
+    keeping, not a file to silently bury under the next save: move it
+    aside to `.bak` so the operator can still resume the original run
+    from it. Returns True when the quarantine landed. The next save then
+    finds no primary and does not rotate, so the quarantined file
+    survives at least one save cycle."""
+    try:
+        # the quarantine IS a durable publish on the checkpoint path, so
+        # it shares the save fault site: an injected checkpoint.save
+        # fault degrades it to "start fresh without quarantine" — the
+        # same best-effort contract as the save itself
+        chaos.fault_point("checkpoint.save")
+        os.replace(path, path + ".bak")
+        fsync_dir(path)
+    except OSError:
+        return False
+    from . import metrics
+
+    metrics.GLOBAL.record_event("checkpoint_quarantined")
+    logger.log("warning", "checkpoint %s: mismatched state quarantined "
+               "to %s.bak", path, path)
+    return True
+
+
+def save_fleet_state(path: str, seed, case_idx: int, scores, seen_hashes,
+                     corpus_energies: dict, epoch: int, n_shards: int,
+                     classes, engine: str = "fused") -> None:
+    """Fleet-coordinator checkpoint (corpus/fleet.py --shards --state):
+    per-case progress plus everything the resumed coordinator needs to
+    continue byte-identically — scheduler scores, the global seen-hash
+    dedupe set (12-byte sha1 prefixes), corpus energies, the placement
+    fencing epoch, and the capacity-class set (resolved from the store
+    at case 0; a resumed store already holds adopted offspring, so
+    re-deriving would change row widths and therefore bytes). Same
+    durability contract as save_state: crc32 whole-file checksum,
+    fsync-before-rename, previous checkpoint kept as .bak."""
+    tmp = path + ".tmp"
+    seen_sorted = sorted(seen_hashes)
+    seen_arr = (np.frombuffer(b"".join(seen_sorted), np.uint8)
+                .reshape(len(seen_sorted), 12)
+                if seen_sorted else np.zeros((0, 12), np.uint8))
+    ce_ids = sorted(corpus_energies or {})
+    fields = dict(
+        kind=np.asarray("fleet", "U8"),
+        seed=np.asarray(seed, np.int64),
+        case_idx=np.asarray(case_idx, np.int64),
+        engine=_engine_stamp(engine),
+        scores=np.asarray(scores, np.int32),
+        seen=seen_arr,
+        epoch=np.asarray(epoch, np.int64),
+        n_shards=np.asarray(n_shards, np.int64),
+        classes=np.asarray(list(classes), np.int64),
+        corpus_ids=np.asarray(ce_ids, "U64"),
+        corpus_energy=np.asarray(
+            [float(corpus_energies[s][0]) for s in ce_ids], np.float64),
+        corpus_hits=np.asarray(
+            [int(corpus_energies[s][1]) for s in ce_ids], np.int64),
+    )
+    fields["checksum"] = _checksum(fields)
+
+    def _write():
+        chaos.fault_point("fleet.checkpoint")
+        with open(tmp, "wb") as f:
+            np.savez(f, **fields)
+            f.flush()
+            os.fsync(f.fileno())
+        if os.path.exists(path):
+            try:
+                os.replace(path, path + ".bak")
+            except OSError:
+                pass
+        os.replace(tmp, path)
+        fsync_dir(path)
+
+    try:
+        SAVE_RETRY.call(_write, site="fleet.checkpoint")
+    except (RetryExhausted, OSError):
+        logger.log("warning", "fleet checkpoint %s: save failed; run "
+                   "continues, resume falls back to the previous "
+                   "checkpoint", path)
+
+
+def load_fleet_state(path: str, engine: str = "fused") -> dict | None:
+    """-> {seed, case_idx, scores, seen, energies, epoch, n_shards,
+    classes} from a fleet checkpoint, or None when the file (and its
+    .bak) is unreadable/corrupt, stamped for a different engine, or is
+    not a fleet checkpoint at all (a single-device save_state file
+    handed to --shards must start fresh, not half-resume)."""
+    try:
+        z = _load_fields(path, engine)
+        if z is None or str(z.get("kind", "")) != "fleet":
+            return None
+        return {
+            "seed": tuple(int(x) for x in z["seed"]),
+            "case_idx": int(z["case_idx"]),
+            "scores": z["scores"].copy(),
+            "seen": {bytes(row) for row in z["seen"]},
+            "energies": {
+                str(s): (float(e), int(h))
+                for s, e, h in zip(z["corpus_ids"], z["corpus_energy"],
+                                   z["corpus_hits"])
+            },
+            "epoch": int(z["epoch"]),
+            "n_shards": int(z["n_shards"]),
+            "classes": tuple(int(c) for c in z["classes"]),
+        }
+    except (OSError, KeyError, ValueError, TypeError, zipfile.BadZipFile,
+            zlib.error):
+        return None
+
+
 def _read_verified(path: str) -> dict | None:
     """Materialize one checkpoint file's fields, verifying the whole-file
     checksum when present (pre-checksum files pass — their contract was

@@ -244,7 +244,23 @@ def build_parser() -> argparse.ArgumentParser:
                         "lengths cross PCIe (requires --layout arena; "
                         "outputs stay byte-identical at a fixed -s)")
     p.add_argument("--state", default=None,
-                   help="checkpoint file (.npz) for stop/resume of batch runs")
+                   help="checkpoint file (.npz) for stop/resume of batch "
+                        "runs; with --shards/--fleet-nodes this is the "
+                        "fleet coordinator checkpoint (per-case progress, "
+                        "scores, seen hashes, energies, placement epoch) "
+                        "— a killed coordinator resumes byte-identically")
+    p.add_argument("--fleet-nodes", default=None, metavar="HOST:PORT,...",
+                   help="cross-host fleet: serve the first shard ids on "
+                        "these remote workers (each started with "
+                        "--fleet-worker) over the dist shard protocol "
+                        "with fenced leases; without --shards the fleet "
+                        "is sized to this list, with --shards N the "
+                        "remaining ids run locally (mixed fleet). "
+                        "Byte-identical to the all-local run at a "
+                        "fixed -s (corpus/fleet.py)")
+    p.add_argument("--fleet-worker", type=int, default=None, metavar="PORT",
+                   help="serve fleet shard leases on PORT (the worker "
+                        "half of --fleet-nodes) and block")
     p.add_argument("--node", default=None, help="join a parent node host:port")
     p.add_argument("--svcport", type=int, default=17771,
                    help="distribution/control port")
@@ -284,6 +300,15 @@ def build_parser() -> argparse.ArgumentParser:
 
 def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
+
+    if ((args.shards is not None or args.fleet_nodes)
+            and (args.struct_kernels or args.struct != "off")):
+        # hard error, not a printed notice: nobody should believe struct
+        # kernels ran fleet-wide when the overlay is single-device only
+        raise SystemExit(
+            "erlamsa-tpu: --struct is single-device only (the span-splice "
+            "overlay routes against one arena): drop --shards/--fleet-nodes "
+            "to run the struct overlay, or drop --struct to run the fleet")
 
     if args.list:
         _show_list()
@@ -393,6 +418,8 @@ def main(argv=None) -> int:
         "pipeline": args.pipeline,
         "layout": args.layout,
         "shards": args.shards,
+        "fleet_nodes": ([s for s in args.fleet_nodes.split(",") if s]
+                        if args.fleet_nodes else None),
         "arena_pages": args.arena_pages,
         "arena_page": args.arena_page,
         "arena_classes": args.arena_classes,
@@ -459,6 +486,11 @@ def main(argv=None) -> int:
         return FuzzProxy(args.proxy, args.proxy_prob, opts,
                          backend=args.backend, bypass=args.bypass,
                          ascent=args.ascent).start(block=True)
+    if args.fleet_worker:
+        from .dist import run_shard_worker
+
+        return run_shard_worker(args.fleet_worker, opts)
+
     if args.node:
         from .dist import run_node
 

@@ -1,4 +1,5 @@
-"""Distributed fuzzing nodes: join/keepalive control plane.
+"""Distributed fuzzing nodes: join/keepalive control plane, plus the
+cross-host fleet's shard-lease data plane.
 
 Reference: src/erlamsa_app.erl:144-246 — worker nodes join a parent over
 Erlang distribution with {join, Pid} keepalives every 15s, the parent
@@ -13,21 +14,45 @@ The data plane stays local to each node (its own oracle pool or TPU batch
 engine) — DCN-style corpus fan-out between hosts, device-local mutation,
 matching SURVEY.md §5.8's design obligation.
 
+The corpus fleet (corpus/fleet.py --fleet-nodes) extends the protocol
+with a shard-lease handshake so a fleet shard can live on another host:
+
+    {"op": "shard_lease", "shard": i, "epoch": e, ...cfg}  -> shard_leased
+    {"op": "shard_step", "shard": i, "epoch": e, "case": c,
+     "slots": [...], "data": [b64...], "scores": [[...]]}  -> shard_result
+    {"op": "shard_revoke", "shard": i, "epoch": e}         -> shard_revoked
+    {"op": "shard_probe"}                                  -> shard_alive
+
+Leases carry a monotonically increasing **fencing epoch** (the
+FleetPlacement migration epoch, parallel/shards.py). The worker rejects
+any step whose epoch is not its current lease (`shard_fenced`), and the
+coordinator rejects any reply that does not echo the epoch/case/shard it
+sent (`validate_shard_reply`) — a zombie worker's late reply is logged
+and dropped, never merged into the reduce. The worker itself is
+STATELESS between steps: each shard_step ships the slice's bytes and
+score rows, and the worker mirrors the local per-class dispatch recipe
+exactly (corpus/fleet.run_remote_slice), which is what makes
+remote-N == local-N == 1-shard byte-identity hold at a fixed seed.
+
 Resilience (services/resilience.py): the parent's node table is
 health-scored with a per-node circuit breaker — repeated request failures
 open a node's breaker (it stops receiving traffic without waiting for the
 17s keepalive eviction), a cooled-down breaker admits one probe request,
 and a successful probe re-admits the node. route_fuzz retries each node
 and fails over across distinct nodes before falling back to local
-fuzzing, with every hop visible in metrics events. remote_fuzz raises
-ProtocolError on a malformed/missing reply — "the node failed" is an
-exception, never a forged empty fuzz result. Fault sites dist.send /
-dist.recv (services/chaos.py) make all of it deterministically testable.
+fuzzing, with every hop visible in metrics events; the caller's remaining
+deadline propagates into each remote socket timeout so one slow node
+cannot eat the whole request budget. remote_fuzz raises ProtocolError on
+a malformed/missing reply — "the node failed" is an exception, never a
+forged empty fuzz result. Fault sites dist.send / dist.recv and
+dist.shard.send / dist.shard.recv (services/chaos.py) make all of it
+deterministically testable.
 """
 
 from __future__ import annotations
 
 import base64
+import functools
 import json
 import random as _pyrandom
 import socket
@@ -35,7 +60,7 @@ import threading
 import time
 
 from ..constants import NODE_ALIVE_DELTA, NODE_KEEPALIVE, NODES_CHECKTIMER
-from ..obs import trace
+from ..obs import flight, trace
 from ..utils.erlrand import gen_urandom_seed
 from . import chaos, logger, metrics
 from .batcher import make_batcher
@@ -47,6 +72,20 @@ class ProtocolError(ValueError):
     """The peer answered with garbage (or nothing): a node-side failure
     the caller must treat as retriable, distinct from a fuzzer that
     legitimately produced empty output."""
+
+
+class RemoteShardError(OSError):
+    """A remote fleet shard failed (connect/timeout/protocol/worker
+    error). OSError subclass on purpose: the fleet coordinator treats it
+    exactly like a local device loss — revoke the lease, redistribute,
+    re-dispatch the slice on survivors within the case."""
+
+
+class StaleEpochError(RemoteShardError):
+    """Fencing verdict: a message carried an epoch that is not the
+    current lease — either the worker fenced a stale coordinator
+    request, or the coordinator rejected a stale (zombie) worker reply.
+    The carried data is dropped, never merged."""
 
 
 def _send_json(sock: socket.socket, obj: dict):
@@ -69,6 +108,276 @@ def _recv_json(f) -> dict | None:
     return json.loads(line)
 
 
+def _send_shard_json(sock: socket.socket, obj: dict):
+    """Coordinator -> shard-worker transmission: its own fault site so a
+    chaos spec can kill the fleet's data plane without touching the
+    join/fuzz control plane (dist.send)."""
+    chaos.fault_point("dist.shard.send")
+    sock.sendall(json.dumps(obj).encode() + b"\n")
+
+
+def _recv_shard_json(f) -> dict | None:
+    """Coordinator-side shard reply read (fault site dist.shard.recv)."""
+    chaos.fault_point("dist.shard.recv")
+    line = f.readline(MAX_LINE + 1)
+    if not line:
+        return None
+    if len(line) > MAX_LINE:
+        raise ValueError("oversized protocol line")
+    return json.loads(line)
+
+
+def validate_shard_reply(resp: dict | None, shard: int, epoch: int | None,
+                         expect: str, case: int | None = None) -> dict:
+    """Coordinator-side fencing gate: every shard reply must be the
+    expected op AND echo the (shard, epoch, case) the request carried.
+    A `shard_fenced` verdict from the worker, or any stale echo — a
+    zombie worker answering after its lease was revoked and re-granted —
+    raises StaleEpochError after logging + a `fence_rejected` metrics
+    event and flight note. The reply's payload is never returned to the
+    reduce on that path."""
+    if resp is None:
+        raise RemoteShardError(
+            f"shard {shard}: peer closed without a reply")
+    op = resp.get("op")
+    if op == "shard_fenced":
+        metrics.GLOBAL.record_event("shard_fenced")
+        raise StaleEpochError(
+            f"shard {shard}: worker fenced the request "
+            f"(lease epoch {resp.get('have')}, sent {resp.get('got')})")
+    if op == "shard_error":
+        raise RemoteShardError(
+            f"shard {shard}: worker step failed: {resp.get('error')}")
+    if op != expect:
+        raise RemoteShardError(
+            f"shard {shard}: malformed reply: {str(resp)[:120]}")
+    stale = int(resp.get("shard", -1)) != int(shard)
+    if epoch is not None and int(resp.get("epoch", -1)) != int(epoch):
+        stale = True
+    if case is not None and int(resp.get("case", -1)) != int(case):
+        stale = True
+    if stale:
+        metrics.GLOBAL.record_event("fence_rejected")
+        flight.GLOBAL.note("fence_rejected", shard=int(shard),
+                           want_epoch=epoch, want_case=case,
+                           got_epoch=resp.get("epoch"),
+                           got_case=resp.get("case"),
+                           got_shard=resp.get("shard"))
+        logger.log("warning", "fleet: stale reply for shard %d rejected "
+                   "(want epoch=%s case=%s, got epoch=%s case=%s "
+                   "shard=%s) — fenced, not merged", shard, epoch, case,
+                   resp.get("epoch"), resp.get("case"), resp.get("shard"))
+        raise StaleEpochError(
+            f"shard {shard}: stale reply fenced (want epoch {epoch}, "
+            f"got {resp.get('epoch')})")
+    return resp
+
+
+#: the per-lease configuration keys a shard_lease ships to the worker —
+#: everything run_remote_slice needs to reproduce the local bytes
+LEASE_CFG_KEYS = ("seed", "pri", "classes", "device_max", "batch")
+
+
+def new_campaign_token() -> str:
+    """Mint the identity for ONE coordinator campaign. Fencing epochs
+    are scoped by this token on the worker: a fresh campaign (new
+    token) starts from floor 0 even on a long-lived worker that served
+    earlier runs, while a zombie of the SAME campaign stays fenced by
+    its stale epoch and a zombie of an OLD campaign is fenced by its
+    stale token. Transport metadata only — never mixed into sample
+    bytes, so replay determinism is untouched."""
+    return "".join(f"{x:04x}" for x in gen_urandom_seed())
+
+
+class RemoteShard:
+    """Coordinator-side client for one leased remote shard: lease /
+    step / revoke / probe over the shard protocol, one connection per
+    call (a dead worker costs one connect timeout, never a wedged
+    persistent socket). Every call raises RemoteShardError on transport
+    failure and StaleEpochError on a fencing verdict — both flow into
+    the fleet's revoke/redispatch path."""
+
+    def __init__(self, shard_id: int, host: str, port: int,
+                 timeout: float = 90.0, token: str = ""):
+        self.id = int(shard_id)
+        self.host = host
+        self.port = int(port)
+        self.timeout = timeout
+        self.token = token
+
+    def endpoint(self) -> str:
+        return f"{self.host}:{self.port}"
+
+    def _call(self, msg: dict, expect: str,
+              timeout: float | None = None) -> dict:
+        tmo = self.timeout if timeout is None else timeout
+        try:
+            with socket.create_connection((self.host, self.port),
+                                          timeout=tmo) as s:
+                _send_shard_json(s, msg)
+                resp = _recv_shard_json(s.makefile("rb"))
+        except (OSError, ValueError) as e:
+            if isinstance(e, StaleEpochError):
+                raise
+            raise RemoteShardError(
+                f"shard {self.id} @{self.endpoint()}: {e}") from e
+        return validate_shard_reply(resp, self.id, msg.get("epoch"),
+                                    expect, case=msg.get("case"))
+
+    def lease(self, epoch: int, cfg: dict) -> dict:
+        """Grant/refresh this shard's lease at `epoch`; ships the step
+        configuration the worker caches for the lease's lifetime."""
+        msg = {"op": "shard_lease", "shard": self.id, "epoch": int(epoch),
+               "token": self.token}
+        msg.update({k: cfg[k] for k in LEASE_CFG_KEYS})
+        return self._call(msg, "shard_leased")
+
+    def probe(self) -> dict:
+        """Liveness probe (the fleet's re-admission check)."""
+        return self._call({"op": "shard_probe", "shard": self.id},
+                          "shard_alive", timeout=min(self.timeout, 10.0))
+
+    def revoke(self, epoch: int) -> dict:
+        """Fence the worker at `epoch` (best-effort: the caller ignores
+        failures — an unreachable worker is already fenced by the
+        epoch its next readmit lease will carry)."""
+        return self._call({"op": "shard_revoke", "shard": self.id,
+                           "epoch": int(epoch), "token": self.token},
+                          "shard_revoked")
+
+    def step(self, epoch: int, case: int, slots, payloads, score_rows,
+             deadline: float | None = None):
+        """One per-case slice dispatch: ship (slots, bytes, score rows)
+        under the lease epoch, return (outs, score_rows, applied,
+        shapes) decoded from the validated reply. The caller's remaining
+        deadline caps the socket timeout (deadline propagation)."""
+        timeout = self.timeout
+        if deadline is not None:
+            timeout = max(0.05, min(timeout,
+                                    deadline - time.monotonic()))
+        msg = {
+            "op": "shard_step", "shard": self.id, "epoch": int(epoch),
+            "token": self.token,
+            "case": int(case), "slots": [int(s) for s in slots],
+            "data": [base64.b64encode(p).decode() for p in payloads],
+            "scores": [[int(x) for x in row] for row in score_rows],
+        }
+        with trace.span("dist.shard_step", shard=self.id, case=case,
+                        rows=len(msg["slots"])):
+            resp = self._call(msg, "shard_result", timeout=timeout)
+        outs = [base64.b64decode(d) for d in resp.get("data", [])]
+        if len(outs) != len(msg["slots"]):
+            raise RemoteShardError(
+                f"shard {self.id}: reply carries {len(outs)} rows for "
+                f"{len(msg['slots'])} slots")
+        return (outs, resp.get("scores", []), resp.get("applied", []),
+                [tuple(sh) for sh in resp.get("shapes", [])])
+
+
+class ShardHost:
+    """Worker-side half of the lease handshake: the lease table plus the
+    stateless slice executor. A lease pins (epoch, step config) for a
+    shard id; a revoke drops the lease and raises the shard's fence
+    floor so any later message from the revoking coordinator's past —
+    or a stale coordinator after a checkpoint resume — is rejected.
+    Floors are scoped per campaign token: a NEW campaign reaching a
+    long-lived worker starts from floor 0 (the old campaign's floors
+    must not fence it), while messages carrying an old token are
+    rejected outright. The compute itself
+    (corpus/fleet.run_remote_slice) is a pure function of the shipped
+    request, so fencing is the only state that matters."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._leases: dict[int, dict] = {}
+        self._floor: dict[int, int] = {}
+        self._token: dict[int, str] = {}
+
+    def handle(self, msg: dict) -> dict:
+        op = msg.get("op")
+        if op == "shard_probe":
+            return {"op": "shard_alive", "shard": int(msg.get("shard", -1))}
+        shard = int(msg.get("shard", -1))
+        epoch = int(msg.get("epoch", -1))
+        token = str(msg.get("token", ""))
+        if op == "shard_lease":
+            with self._lock:
+                if self._token.get(shard, token) != token:
+                    # new campaign: its epochs restart at 0, so the old
+                    # campaign's floor must not apply to it
+                    floor = 0
+                else:
+                    floor = self._floor.get(shard, 0)
+                if epoch < floor:
+                    metrics.GLOBAL.record_event("shard_fenced")
+                    logger.log("warning", "shard host: stale lease for "
+                               "shard %d fenced (epoch %d < floor %d)",
+                               shard, epoch, floor)
+                    return {"op": "shard_fenced", "shard": shard,
+                            "got": epoch, "have": floor}
+                self._leases[shard] = {
+                    "epoch": epoch, "token": token,
+                    "cfg": {k: msg.get(k) for k in LEASE_CFG_KEYS},
+                }
+                self._floor[shard] = epoch
+                self._token[shard] = token
+            logger.log("info", "shard host: lease granted shard=%d "
+                       "epoch=%d", shard, epoch)
+            return {"op": "shard_leased", "shard": shard, "epoch": epoch}
+        if op == "shard_revoke":
+            with self._lock:
+                if self._token.get(shard, token) != token:
+                    # a stale campaign's zombie cannot fence the
+                    # current one; best-effort semantics make the ack
+                    # harmless
+                    return {"op": "shard_revoked", "shard": shard,
+                            "epoch": epoch}
+                self._leases.pop(shard, None)
+                self._floor[shard] = max(self._floor.get(shard, 0), epoch)
+                self._token[shard] = token
+            logger.log("info", "shard host: lease revoked shard=%d, "
+                       "fenced below epoch %d", shard, epoch)
+            return {"op": "shard_revoked", "shard": shard, "epoch": epoch}
+        if op == "shard_step":
+            with self._lock:
+                lease = self._leases.get(shard)
+            if (lease is None or epoch != lease["epoch"]
+                    or token != lease["token"]):
+                have = lease["epoch"] if lease else -1
+                metrics.GLOBAL.record_event("shard_fenced")
+                logger.log("warning", "shard host: fenced stale step for "
+                           "shard %d (epoch %d, lease %d)", shard, epoch,
+                           have)
+                return {"op": "shard_fenced", "shard": shard,
+                        "got": epoch, "have": have}
+            cfg = lease["cfg"]
+            case = int(msg.get("case", 0))
+            slots = [int(s) for s in msg.get("slots", [])]
+            payloads = [base64.b64decode(d) for d in msg.get("data", [])]
+            try:
+                from ..corpus.fleet import run_remote_slice
+
+                outs, sc_out, applied, shapes = run_remote_slice(
+                    tuple(cfg["seed"]), case, int(cfg["batch"]), slots,
+                    payloads, msg.get("scores", []), cfg["pri"],
+                    cfg["classes"], int(cfg["device_max"]))
+            except Exception as e:  # lint: broad-except-ok a worker device failure becomes a protocol-level shard_error the coordinator revokes on, not a dead handler thread
+                logger.log("warning", "shard host: step failed shard=%d "
+                           "case=%d: %s", shard, case, e)
+                return {"op": "shard_error", "shard": shard,
+                        "epoch": epoch, "error": str(e)[:200]}
+            return {
+                "op": "shard_result", "shard": shard, "epoch": epoch,
+                "case": case,
+                "data": [base64.b64encode(o).decode() for o in outs],
+                "scores": [[int(x) for x in row] for row in sc_out],
+                "applied": [[int(x) for x in row] for row in applied],
+                "shapes": [list(sh) for sh in shapes],
+            }
+        return {"op": "shard_error", "shard": shard, "epoch": epoch,
+                "error": f"unknown shard op {op!r}"}
+
+
 # per-node request retry: short, bounded — failover to ANOTHER node beats
 # hammering a sick one (the reference just picks a random node per call)
 NODE_RETRY = RetryPolicy(attempts=2, base=0.05, max_delay=0.5,
@@ -82,25 +391,29 @@ class NodePool:
     keepalives keep a node listed, request outcomes move its score and
     breaker, and pick() routes around open breakers."""
 
-    def __init__(self):
+    def __init__(self, check_interval: float = NODES_CHECKTIMER,
+                 max_age: float = NODE_ALIVE_DELTA):
         self._rng = _pyrandom.Random(str(gen_urandom_seed()))
         # breaker cool-down ~ keepalive period: a node evicted for request
         # failures gets its re-admission probe about when the reference
         # would first notice it died
         self.table = HealthTable(self._rng, failure_threshold=2,
                                  reset_timeout=NODE_KEEPALIVE / 3.0)
-        supervise("nodepool-evict", self._evict_loop)
+        # eviction lives in HealthTable.start_eviction so dist node health
+        # and fleet shard health share one drop_stale implementation (and
+        # one `dropped_stale` accounting path)
+        self.table.start_eviction("nodepool-evict", check_interval, max_age,
+                                  on_drop=self._on_evicted)
+
+    @staticmethod
+    def _on_evicted(node):
+        host, port = node
+        metrics.GLOBAL.record_event("node_evicted")
+        logger.log("info", "node %s:%d evicted (silent)", host, port)
 
     def join(self, host: str, port: int):
         if self.table.touch((host, port)):
             logger.log("info", "node %s:%d joined", host, port)
-
-    def _evict_loop(self):
-        while True:
-            time.sleep(NODES_CHECKTIMER)
-            for host, port in self.table.drop_stale(NODE_ALIVE_DELTA):
-                metrics.GLOBAL.record_event("node_evicted")
-                logger.log("info", "node %s:%d evicted (silent)", host, port)
 
     def pick(self, exclude=()) -> tuple[str, int] | None:
         """A routable node (get_free_node, src/erlamsa_app.erl:185-190) —
@@ -126,6 +439,7 @@ class ParentServer:
         self.local = make_batcher(backend, workers=opts.get("workers", 10),
                                   seed=opts.get("seed"))
         self.opts = opts
+        self.shards = ShardHost()  # fleet shard-lease handshake host
         self._stop = threading.Event()
 
     def _handle(self, conn: socket.socket, addr):
@@ -138,6 +452,9 @@ class ParentServer:
                 if msg.get("op") == "join":
                     self.pool.join(addr[0], int(msg.get("port", 0)))
                     _send_json(conn, {"op": "joined"})
+                elif msg.get("op") in ("shard_lease", "shard_step",
+                                       "shard_revoke", "shard_probe"):
+                    _send_json(conn, self.shards.handle(msg))
                 elif msg.get("op") == "fuzz":
                     data = base64.b64decode(msg.get("data", ""))
                     out = self.route_fuzz(data)
@@ -168,8 +485,13 @@ class ParentServer:
             try:
                 with trace.span("dist.route", node=f"{node[0]}:{node[1]}",
                                 attempt=len(tried)):
+                    # the partial carries the deadline INTO remote_fuzz
+                    # (socket timeout = time remaining); the call kwarg
+                    # caps the retry loop itself — RetryPolicy.call
+                    # consumes `deadline`, it does not forward it
                     out = NODE_RETRY.call(
-                        remote_fuzz, node[0], node[1], data,
+                        functools.partial(remote_fuzz, node[0], node[1],
+                                          data, deadline=deadline),
                         site=f"dist:{node[0]}:{node[1]}", deadline=deadline,
                     )
                 self.pool.report(node, True)
@@ -216,12 +538,21 @@ class ParentServer:
             pass
 
 
-def remote_fuzz(host: str, port: int, data: bytes, timeout: float = 90.0) -> bytes:
+def remote_fuzz(host: str, port: int, data: bytes, timeout: float = 90.0,
+                deadline: float | None = None) -> bytes:
     """Client call into a node (erlamsa_app:call/2,
     src/erlamsa_app.erl:248-253). Raises ProtocolError when the node
     closes without answering or answers with a non-result — callers can
     then distinguish "node failed" (failover) from "fuzzer produced empty
-    output" (a legitimate result)."""
+    output" (a legitimate result).
+
+    deadline: absolute time.monotonic() bound from the caller; when set,
+    the socket timeout is the time REMAINING, not the flat default — a
+    slow node fails this hop fast enough that failover still fits inside
+    the caller's budget (resilience.RetryPolicy deadline propagation,
+    extended to the blocking I/O itself)."""
+    if deadline is not None:
+        timeout = max(0.05, min(timeout, deadline - time.monotonic()))
     with trace.span("dist.remote_fuzz", node=f"{host}:{port}",
                     bytes=len(data)):
         with socket.create_connection((host, port), timeout=timeout) as s:
@@ -275,3 +606,14 @@ class WorkerNode:
 
 def run_node(host: str, port: int, opts: dict) -> int:
     return WorkerNode(host, port, opts).start(block=True)
+
+
+def run_shard_worker(port: int, opts: dict) -> int:
+    """`--fleet-worker PORT`: serve fleet shard leases on this host. A
+    plain ParentServer — the shard protocol rides the same listener as
+    join/fuzz, so one process can serve both roles; the ShardHost keeps
+    the lease table and the compute is rebuilt per step from the shipped
+    request (stateless worker: a restart costs a re-lease, nothing
+    else)."""
+    logger.log("info", "fleet shard worker on :%d", port)
+    return ParentServer(port, opts).serve(block=True)

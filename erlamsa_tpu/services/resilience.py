@@ -286,6 +286,27 @@ class HealthTable:
             if h is not None:
                 h.report(ok)
 
+    def start_eviction(self, name: str, interval: float, max_age: float,
+                       on_drop=None):
+        """THE keepalive-eviction loop, shared by every HealthTable user
+        (the dist NodePool's node table, a fleet coordinator's worker
+        registry): a supervised daemon thread drop_stale()s this table
+        every `interval` seconds, so `dropped_stale` accounting and
+        breaker-reset-on-eviction behave identically wherever node
+        health lives. `on_drop(endpoint)` fires per evicted endpoint
+        (the caller's logging/metrics hook). Returns the supervised
+        thread handle."""
+        from .supervisor import supervise
+
+        def loop():
+            while True:
+                time.sleep(interval)
+                for ep in self.drop_stale(max_age):
+                    if on_drop is not None:
+                        on_drop(ep)
+
+        return supervise(name, loop)
+
     def pick(self, exclude=()):
         """A usable endpoint or None. Closed-breaker endpoints are drawn
         score-weighted; when none qualify, a half-open breaker may admit

@@ -40,6 +40,17 @@
 # kill redistributed within the case (no host-oracle fallback), and the
 # revoke/readmit migrations landed in the run stats.
 #
+# scripts/tier1.sh --dist-fleet-smoke additionally runs the r14
+# cross-host fleet end to end on loopback: two shard workers
+# (services/dist.run_shard_worker) serve a 2-shard remote campaign that
+# must be byte-identical to the all-local run at the same seed; one
+# worker is killed mid-campaign (the lease revokes, the slice
+# redispatches to the survivor within the case); then a checkpointed
+# campaign is "killed" at the coordinator half-way and resumed from
+# --state — the final output stream and corpus store must be
+# byte-identical to the uninterrupted run (corpus/fleet.py,
+# services/checkpoint.py).
+#
 # scripts/tier1.sh --serve-smoke additionally boots the faas server
 # with the continuous-batching engine (services/serving.py), checks one
 # request answers byte-identically to a flush-mode server at the same
@@ -67,6 +78,7 @@ chaos_smoke=0
 obs_smoke=0
 arena_smoke=0
 fleet_smoke=0
+dist_fleet_smoke=0
 serve_smoke=0
 struct_smoke=0
 lint=1
@@ -77,6 +89,7 @@ while [ $# -gt 0 ]; do
     --obs-smoke) obs_smoke=1; shift ;;
     --arena-smoke) arena_smoke=1; shift ;;
     --fleet-smoke) fleet_smoke=1; shift ;;
+    --dist-fleet-smoke) dist_fleet_smoke=1; shift ;;
     --serve-smoke) serve_smoke=1; shift ;;
     --struct-smoke) struct_smoke=1; shift ;;
     --lint) lint=1; shift ;;
@@ -359,6 +372,88 @@ print(f"FLEET_SMOKE={'ok' if ok else 'FAIL'} bytes={len(blob1)} "
       f"identical_2shard={blob2 == blob1} identical_kill={blob3 == blob1} "
       f"migrations={kinds} oracle_cases={st3['oracle_cases']} "
       f"redispatches={st3['redispatches']}")
+sys.exit(0 if ok else 1)
+EOF2
+  rc=$?
+fi
+
+if [ $rc -eq 0 ] && [ $dist_fleet_smoke -eq 1 ]; then
+  echo "== dist fleet smoke: remote==local identity, worker kill, resume =="
+  timeout -k 10 600 env JAX_PLATFORMS=cpu python - <<'EOF2'
+import os, shutil, sys, tempfile
+
+from erlamsa_tpu.corpus.fleet import run_corpus_fleet
+from erlamsa_tpu.services import chaos
+from erlamsa_tpu.services.dist import ParentServer
+
+SEED = (7, 7, 7)
+SEEDS = [bytes([65 + i]) * (30 * (i + 1)) for i in range(6)]
+
+
+def one_run(root, tag, n, shards=None, nodes=None, spec=None, state=False):
+    chaos.configure(spec, seed=SEED[0])
+    outdir = os.path.join(root, f"out-{tag}")
+    os.makedirs(outdir, exist_ok=True)
+    stats = {}
+    opts = {
+        "corpus_dir": os.path.join(root, f"corpus-{tag}"),
+        "corpus": list(SEEDS),
+        "seed": SEED,
+        "n": n,
+        "output": os.path.join(outdir, "%n.out"),
+        "shards": shards,
+        "fleet_nodes": nodes,
+        "_stats": stats,
+    }
+    if state:
+        opts["state_path"] = os.path.join(root, f"state-{tag}.npz")
+    try:
+        rc = run_corpus_fleet(opts, batch=8)
+    finally:
+        chaos.configure(None)
+    blob = b""
+    for i in range(n * 8):
+        p = os.path.join(outdir, f"{i}.out")
+        blob += open(p, "rb").read() if os.path.exists(p) else b"<missing>"
+    store = open(os.path.join(root, f"corpus-{tag}", "corpus.json"),
+                 "rb").read()
+    return rc, blob, store, stats
+
+
+srv1 = ParentServer(0, {"seed": SEED}).serve(block=False)
+srv2 = ParentServer(0, {"seed": SEED}).serve(block=False)
+nodes = [f"127.0.0.1:{srv._srv.getsockname()[1]}" for srv in (srv1, srv2)]
+root = tempfile.mkdtemp(prefix="tier1_dist_fleet_smoke_")
+try:
+    # reference: plain local 2-shard campaign
+    rc1, blob1, store1, _ = one_run(root, "loc", 4, shards=2)
+    # remote: the same campaign sliced across two loopback workers
+    rc2, blob2, store2, st2 = one_run(root, "rem", 4, nodes=nodes)
+    # worker kill mid-campaign: one injected send fault revokes a
+    # remote lease; the slice redispatches WITHIN the case
+    rc3, blob3, store3, st3 = one_run(root, "kill", 4, nodes=nodes,
+                                      spec="dist.shard.send:x1")
+    # coordinator kill + resume: 2 of 4 cases, then resume from --state
+    rc4, _, _, _ = one_run(root, "res", 2, nodes=nodes, state=True)
+    rc5, blob5, store5, st5 = one_run(root, "res", 4, nodes=nodes,
+                                      state=True)
+finally:
+    srv1.stop()
+    srv2.stop()
+    shutil.rmtree(root, ignore_errors=True)
+kinds = [m["kind"] for m in st3["migrations"]]
+ok = (rc1 == rc2 == rc3 == rc4 == rc5 == 0 and blob1
+      and st2["remote_shards"] == 2
+      and blob2 == blob1 and store2 == store1
+      and blob3 == blob1 and store3 == store1
+      and st3["redispatches"] >= 1 and kinds[:1] == ["revoke"]
+      and st5["start_case"] == 2
+      and blob5 == blob1 and store5 == store1)
+print(f"DIST_FLEET_SMOKE={'ok' if ok else 'FAIL'} bytes={len(blob1)} "
+      f"identical_remote={blob2 == blob1} identical_kill={blob3 == blob1} "
+      f"identical_resume={blob5 == blob1} store_resume={store5 == store1} "
+      f"migrations={kinds} redispatches={st3['redispatches']} "
+      f"start_case={st5.get('start_case')}")
 sys.exit(0 if ok else 1)
 EOF2
   rc=$?

@@ -1,0 +1,540 @@
+"""Cross-host fleet tests (r14): the dist shard-lease protocol with
+fencing epochs, remote==local byte-identity over loopback workers, and
+the checkpointed, crash-resumable fleet campaign.
+
+Fast tests never pay an engine compile: fencing is validated at the
+protocol layer (a stale request is rejected BEFORE any compute), remote
+total-loss rides persistent dist.shard.* faults onto the pre-compile
+host-oracle path, and resume/quarantine tests run the fleet under
+persistent shard.step faults (same discipline as tests/test_fleet.py).
+Anything that actually steps a remote worker's engine is
+@pytest.mark.slow."""
+
+import os
+import socket
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from erlamsa_tpu.obs import flight
+from erlamsa_tpu.parallel.shards import FleetPlacement
+from erlamsa_tpu.services import chaos, metrics
+from erlamsa_tpu.services.checkpoint import (load_fleet_state, load_state,
+                                             quarantine_mismatch,
+                                             save_fleet_state, save_state)
+from erlamsa_tpu.services.dist import (ParentServer, RemoteShard,
+                                       RemoteShardError, ShardHost,
+                                       StaleEpochError, remote_fuzz,
+                                       validate_shard_reply)
+
+SEED = (7, 7, 7)
+SEEDS = [bytes([65 + i]) * (30 * (i + 1)) for i in range(6)]
+
+CFG = {"seed": [7, 7, 7], "pri": [1] * 4, "classes": [256],
+       "device_max": 256, "batch": 8}
+
+
+@pytest.fixture(autouse=True)
+def _chaos_disarmed():
+    chaos.configure(None)
+    yield
+    chaos.configure(None)
+    metrics.GLOBAL.set_degraded(False)
+
+
+@pytest.fixture
+def worker():
+    """One loopback shard worker (a plain ParentServer); yields
+    (server, port)."""
+    srv = ParentServer(0, {"seed": SEED}).serve(block=False)
+    port = srv._srv.getsockname()[1]
+    yield srv, port
+    srv.stop()
+
+
+# ---- lease handshake + fencing (protocol layer, no compute) -------------
+
+
+def test_shard_host_lease_revoke_fences_floor():
+    h = ShardHost()
+    msg = {"op": "shard_lease", "shard": 0, "epoch": 2, **CFG}
+    assert h.handle(msg)["op"] == "shard_leased"
+    # revoke raises the fence floor: re-leasing BELOW it is rejected
+    assert h.handle({"op": "shard_revoke", "shard": 0,
+                     "epoch": 3})["op"] == "shard_revoked"
+    fenced = h.handle({"op": "shard_lease", "shard": 0, "epoch": 2, **CFG})
+    assert fenced["op"] == "shard_fenced"
+    assert fenced["got"] == 2 and fenced["have"] == 3
+    # a lease at (or past) the floor is granted again
+    assert h.handle({"op": "shard_lease", "shard": 0, "epoch": 4,
+                     **CFG})["op"] == "shard_leased"
+
+
+def test_shard_host_step_requires_current_lease():
+    h = ShardHost()
+    # no lease at all -> fenced, never computed
+    r = h.handle({"op": "shard_step", "shard": 1, "epoch": 0, "case": 0,
+                  "slots": [0], "data": [], "scores": []})
+    assert r["op"] == "shard_fenced" and r["have"] == -1
+    h.handle({"op": "shard_lease", "shard": 1, "epoch": 5, **CFG})
+    # stale epoch (a zombie coordinator's past) -> fenced
+    r = h.handle({"op": "shard_step", "shard": 1, "epoch": 4, "case": 0,
+                  "slots": [0], "data": [], "scores": []})
+    assert r["op"] == "shard_fenced" and r["got"] == 4 and r["have"] == 5
+    # probes never need a lease
+    assert h.handle({"op": "shard_probe", "shard": 1})["op"] == "shard_alive"
+
+
+def test_shard_host_floor_scoped_by_campaign_token():
+    """Fence floors belong to ONE campaign: a fresh coordinator (new
+    token) leasing at epoch 0 must not be fenced by floors a previous
+    campaign left on a long-lived worker — the bug spelling is a fresh
+    CLI run against a days-old worker degrading to the host oracle.
+    Zombies of the old campaign stay rejected: a step carrying the old
+    token is fenced, and an old-token revoke is acked but cannot raise
+    the current campaign's floor."""
+    h = ShardHost()
+    # campaign A runs, resumes (epoch bumps), then exits after a revoke
+    a = {"token": "aaaa" * 8}
+    assert h.handle({"op": "shard_lease", "shard": 0, "epoch": 2,
+                     **a, **CFG})["op"] == "shard_leased"
+    assert h.handle({"op": "shard_revoke", "shard": 0, "epoch": 3,
+                     **a})["op"] == "shard_revoked"
+    # campaign B starts fresh: epoch 0 is BELOW A's floor yet granted
+    b = {"token": "bbbb" * 8}
+    assert h.handle({"op": "shard_lease", "shard": 0, "epoch": 0,
+                     **b, **CFG})["op"] == "shard_leased"
+    # a zombie step from campaign A is fenced without compute
+    r = h.handle({"op": "shard_step", "shard": 0, "epoch": 2, **a,
+                  "case": 0, "slots": [0], "data": [], "scores": []})
+    assert r["op"] == "shard_fenced"
+    # a zombie revoke from campaign A is acked (best-effort) but must
+    # not fence B: B can still re-lease at its own next epoch
+    assert h.handle({"op": "shard_revoke", "shard": 0, "epoch": 9,
+                     **a})["op"] == "shard_revoked"
+    assert h.handle({"op": "shard_lease", "shard": 0, "epoch": 1,
+                     **b, **CFG})["op"] == "shard_leased"
+
+
+def test_validate_shard_reply_rejects_stale_echo():
+    ev0 = metrics.GLOBAL.snapshot()["resilience"]["events"].get(
+        "fence_rejected", 0)
+    ring0 = len(flight.GLOBAL._ring)
+    ok = {"op": "shard_result", "shard": 2, "epoch": 7, "case": 3}
+    assert validate_shard_reply(dict(ok), 2, 7, "shard_result", case=3) == ok
+    # a late reply carrying the PREVIOUS lease epoch: rejected, logged,
+    # counted — its payload never reaches the reduce
+    with pytest.raises(StaleEpochError):
+        validate_shard_reply({**ok, "epoch": 6}, 2, 7, "shard_result",
+                             case=3)
+    with pytest.raises(StaleEpochError):
+        validate_shard_reply({**ok, "case": 2}, 2, 7, "shard_result", case=3)
+    with pytest.raises(StaleEpochError):
+        validate_shard_reply({**ok, "shard": 1}, 2, 7, "shard_result",
+                             case=3)
+    snap = metrics.GLOBAL.snapshot()["resilience"]["events"]
+    assert snap.get("fence_rejected", 0) == ev0 + 3
+    # metrics.record_event mirrors into the ring too; count the
+    # coordinator's detailed notes (they carry the epoch echo)
+    notes = [e for e in list(flight.GLOBAL._ring)[ring0:]
+             if e.get("kind") == "fence_rejected" and "want_epoch" in e]
+    assert len(notes) == 3 and notes[0]["want_epoch"] == 7
+
+
+def test_validate_shard_reply_maps_protocol_failures():
+    with pytest.raises(RemoteShardError):
+        validate_shard_reply(None, 0, 1, "shard_result")
+    with pytest.raises(StaleEpochError):
+        validate_shard_reply({"op": "shard_fenced", "got": 1, "have": 2},
+                             0, 1, "shard_result")
+    with pytest.raises(RemoteShardError):
+        validate_shard_reply({"op": "shard_error", "error": "boom"},
+                             0, 1, "shard_result")
+    with pytest.raises(RemoteShardError):
+        validate_shard_reply({"op": "nonsense"}, 0, 1, "shard_result")
+    # RemoteShardError is an OSError: the fleet's revoke path catches it
+    # exactly like a local device loss
+    assert issubclass(RemoteShardError, OSError)
+    assert issubclass(StaleEpochError, RemoteShardError)
+
+
+def test_remote_shard_loopback_handshake_and_fencing(worker):
+    """Full round-trips against a real listener: lease, probe, revoke,
+    then a step under the revoked lease — fenced at the worker, raised
+    as StaleEpochError at the client, no compute ever attempted."""
+    _, port = worker
+    rs = RemoteShard(0, "127.0.0.1", port, timeout=5.0)
+    assert rs.lease(1, CFG)["epoch"] == 1
+    assert rs.probe()["op"] == "shard_alive"
+    assert rs.revoke(2)["op"] == "shard_revoked"
+    with pytest.raises(StaleEpochError):
+        rs.step(1, 0, [0], [b"AAAA"], [[0] * 4])
+    # re-lease past the floor and the shard serves again (fence check
+    # passes; the compute itself is exercised by the slow tests)
+    assert rs.lease(3, CFG)["op"] == "shard_leased"
+
+
+def test_remote_shard_connect_failure_is_remote_shard_error():
+    # grab a port and close it: nothing listens there
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    rs = RemoteShard(0, "127.0.0.1", port, timeout=0.3)
+    with pytest.raises(RemoteShardError):
+        rs.probe()
+
+
+def test_placement_restore_fences_every_saved_lease():
+    p = FleetPlacement(2, failure_threshold=1)
+    p.revoke(1, case=0)
+    p.readmit(1, case=1)  # epoch 2, shard 1 leased at 2
+    assert p.lease_epoch_of(1) == 2
+    new = p.restore(5)  # resume from a checkpoint that saved epoch 5
+    assert new == 6 and p.epoch == 6
+    # EVERY lease re-granted past the saved epoch: any lease the dead
+    # coordinator handed out (<= 5) can never validate again
+    assert all(p.lease_epoch_of(s) == 6 for s in range(2))
+
+
+# ---- satellite: deadline propagation + shared eviction loop -------------
+
+
+def test_remote_fuzz_deadline_caps_socket_timeout():
+    """A node that accepts and then goes silent must fail within the
+    caller's remaining deadline, not the flat 90s default."""
+    srv = socket.socket()
+    srv.bind(("127.0.0.1", 0))
+    srv.listen(1)
+    port = srv.getsockname()[1]
+    conns = []
+    threading.Thread(
+        target=lambda: conns.append(srv.accept()), daemon=True).start()
+    t0 = time.monotonic()
+    with pytest.raises(OSError):
+        remote_fuzz("127.0.0.1", port, b"x",
+                    deadline=time.monotonic() + 0.4)
+    assert time.monotonic() - t0 < 5.0
+    srv.close()
+
+
+def test_health_table_start_eviction_shared_loop():
+    """The NodePool's evict loop now lives in HealthTable.start_eviction
+    — one implementation for dist node health and fleet shard health,
+    one dropped_stale accounting path."""
+    import random
+
+    from erlamsa_tpu.services.resilience import HealthTable
+
+    ev0 = metrics.GLOBAL.snapshot()["resilience"]["events"].get(
+        "dropped_stale", 0)
+    t = HealthTable(random.Random(0))
+    t.touch("ep-a")
+    dropped = []
+    t.start_eviction("test-evict", interval=0.05, max_age=0.01,
+                     on_drop=dropped.append)
+    deadline = time.monotonic() + 5.0
+    while not dropped and time.monotonic() < deadline:
+        time.sleep(0.05)
+    assert dropped == ["ep-a"] and t.count() == 0
+    assert metrics.GLOBAL.snapshot()["resilience"]["events"].get(
+        "dropped_stale", 0) >= ev0 + 1
+
+
+# ---- fleet checkpoint: roundtrip, fallback, quarantine ------------------
+
+
+def test_fleet_checkpoint_roundtrip(tmp_path):
+    path = str(tmp_path / "st.npz")
+    scores = np.arange(8 * 4, dtype=np.int32).reshape(8, 4)
+    seen = {bytes(range(j, j + 12)) for j in range(5)}
+    energies = {"sid-a": (1.5, 3), "sid-b": (0.25, 1)}
+    save_fleet_state(path, SEED, 7, scores, seen, energies,
+                     epoch=4, n_shards=2, classes=(256, 4096))
+    st = load_fleet_state(path)
+    assert st is not None
+    assert st["seed"] == SEED and st["case_idx"] == 7
+    assert (st["scores"] == scores).all()
+    assert st["seen"] == seen
+    assert st["energies"] == energies
+    assert st["epoch"] == 4 and st["n_shards"] == 2
+    assert st["classes"] == (256, 4096)
+
+
+def test_fleet_checkpoint_bak_fallback(tmp_path):
+    path = str(tmp_path / "st.npz")
+    scores = np.zeros((4, 4), np.int32)
+    save_fleet_state(path, SEED, 3, scores, set(), {}, 1, 2, (256,))
+    save_fleet_state(path, SEED, 5, scores, set(), {}, 2, 2, (256,))
+    assert os.path.exists(path + ".bak")
+    # torch the primary: load falls back to the previous checkpoint
+    with open(path, "wb") as f:
+        f.write(b"garbage not a zip")
+    st = load_fleet_state(path)
+    assert st is not None and st["case_idx"] == 3 and st["epoch"] == 1
+
+
+def test_fleet_checkpoint_rejects_runner_checkpoint(tmp_path):
+    """A single-device save_state file handed to the fleet must start
+    fresh, not half-resume (kind stamp gate)."""
+    path = str(tmp_path / "st.npz")
+    save_state(path, SEED, 2, np.zeros((4, 4), np.int32))
+    assert load_state(path) is not None
+    assert load_fleet_state(path) is None
+
+
+def test_quarantine_mismatch_moves_to_bak(tmp_path):
+    path = str(tmp_path / "st.npz")
+    save_state(path, (1, 1, 1), 2, np.zeros((4, 4), np.int32))
+    ev0 = metrics.GLOBAL.snapshot()["resilience"]["events"].get(
+        "checkpoint_quarantined", 0)
+    assert quarantine_mismatch(path) is True
+    assert not os.path.exists(path) and os.path.exists(path + ".bak")
+    assert metrics.GLOBAL.snapshot()["resilience"]["events"].get(
+        "checkpoint_quarantined", 0) == ev0 + 1
+    # nothing to quarantine -> False, no crash
+    assert quarantine_mismatch(path) is False
+
+
+# ---- end-to-end harness (oracle path: no compiles) ----------------------
+
+
+def _run_fleet(tmp_path, tag, n, spec="shard.step:*", seed=SEED,
+               shards=2, state=True, opts_extra=None, batch=8):
+    """One fleet leg into tag-keyed output files; legs sharing a tag
+    share corpus/state/outdir (the kill-and-resume harness). Returns
+    (rc, stats)."""
+    from erlamsa_tpu.corpus.fleet import run_corpus_fleet
+
+    outdir = tmp_path / f"out-{tag}"
+    outdir.mkdir(exist_ok=True)
+    stats: dict = {}
+    opts = {
+        "corpus_dir": str(tmp_path / f"corpus-{tag}"),
+        "corpus": list(SEEDS),
+        "seed": seed,
+        "n": n,
+        "output": str(outdir / "%n.out"),
+        "_stats": stats,
+        "shards": shards,
+    }
+    if state:
+        opts["state_path"] = str(tmp_path / f"state-{tag}.npz")
+    if opts_extra:
+        opts.update(opts_extra)
+    chaos.configure(spec, seed=seed[0])
+    try:
+        rc = run_corpus_fleet(opts, batch=batch)
+    finally:
+        chaos.configure(None)
+    return rc, stats
+
+
+def _read_blob(tmp_path, tag, n, batch=8):
+    outdir = tmp_path / f"out-{tag}"
+    blob = b""
+    for i in range(n * batch):
+        p = outdir / f"{i}.out"
+        blob += (p.read_bytes() if p.exists() else b"<missing>")
+    return blob
+
+
+def test_fleet_kill_and_resume_byte_identity(tmp_path):
+    """The headline robustness pin: a coordinator killed mid-campaign
+    and resumed from the fleet checkpoint produces byte-identical
+    outputs AND an identical final store snapshot. Runs on the
+    pre-compile oracle path (persistent shard.step faults) so the
+    whole cycle is fast."""
+    rc, _ = _run_fleet(tmp_path, "ref", n=4, state=False)
+    assert rc == 0
+    ref = _read_blob(tmp_path, "ref", 4)
+
+    # leg 1: "killed" after 2 of 4 cases (per-case checkpoints land)
+    rc, _ = _run_fleet(tmp_path, "res", n=2)
+    assert rc == 0
+    assert os.path.exists(str(tmp_path / "state-res.npz"))
+    # leg 2: resume from --state, same corpus/outdir, finish the run
+    rc, stats = _run_fleet(tmp_path, "res", n=4)
+    assert rc == 0 and stats["start_case"] == 2
+    assert _read_blob(tmp_path, "res", 4) == ref
+    store_ref = (tmp_path / "corpus-ref" / "corpus.json").read_bytes()
+    store_res = (tmp_path / "corpus-res" / "corpus.json").read_bytes()
+    assert store_ref == store_res
+    # leg 3: resuming a COMPLETE run is a no-op success
+    rc, _ = _run_fleet(tmp_path, "res", n=4)
+    assert rc == 0
+
+
+def test_fleet_checkpoint_mismatch_quarantined(tmp_path):
+    """A fleet checkpoint from a different run (seed mismatch) is
+    quarantined to .bak, never silently overwritten — the original
+    run's resume point survives."""
+    rc, _ = _run_fleet(tmp_path, "q", n=1, seed=(1, 1, 1))
+    assert rc == 0
+    path = str(tmp_path / "state-q.npz")
+    # same state file, different seed: quarantine + fresh start
+    rc, stats = _run_fleet(tmp_path, "q", n=1, seed=(2, 2, 2))
+    assert rc == 0 and stats["start_case"] == 0
+    bak = load_fleet_state(path + ".bak")
+    assert bak is not None and bak["seed"] == (1, 1, 1)
+    cur = load_fleet_state(path)
+    assert cur is not None and cur["seed"] == (2, 2, 2)
+
+
+def test_runner_checkpoint_mismatch_quarantined(tmp_path):
+    """Same pin for the single-device runner: the old behaviour printed
+    and (on the next save) buried the mismatched file."""
+    from erlamsa_tpu.corpus.runner import run_corpus_batch
+
+    path = str(tmp_path / "state.npz")
+
+    def leg(seed):
+        outdir = tmp_path / f"out-{seed[0]}"
+        outdir.mkdir(exist_ok=True)
+        chaos.configure("device.step:*", seed=seed[0])
+        try:
+            rc = run_corpus_batch(
+                {"corpus_dir": str(tmp_path / f"c-{seed[0]}"),
+                 "corpus": list(SEEDS), "seed": seed, "n": 1,
+                 "output": str(outdir / "%n.out"), "state_path": path},
+                batch=8)
+        finally:
+            chaos.configure(None)
+        assert rc == 0
+
+    leg((1, 1, 1))
+    leg((2, 2, 2))
+    bak = load_state(path + ".bak")
+    assert bak is not None and bak[0] == (1, 1, 1)
+    cur = load_state(path)
+    assert cur is not None and cur[0] == (2, 2, 2)
+
+
+def test_fleet_checkpoint_write_fault_degrades(tmp_path):
+    """An injected fleet.checkpoint fault degrades the save to a
+    warning: the run completes, no state file lands."""
+    rc, _ = _run_fleet(tmp_path, "cf", n=1,
+                       spec="shard.step:*,fleet.checkpoint:*")
+    assert rc == 0
+    assert not os.path.exists(str(tmp_path / "state-cf.npz"))
+    snap = metrics.GLOBAL.snapshot()["resilience"]
+    assert snap["faults"].get("fleet.checkpoint", 0) >= 1
+
+
+def test_remote_total_loss_rides_revoke_to_oracle(tmp_path):
+    """Persistent dist.shard.send faults kill every (remote) shard at
+    its first dispatch — BEFORE any engine compile: the coordinator
+    revokes each lease through the same path as a local device loss and
+    completes the campaign from the host oracle."""
+    srv = ParentServer(0, {"seed": SEED}).serve(block=False)
+    port = srv._srv.getsockname()[1]
+    try:
+        rc, stats = _run_fleet(
+            tmp_path, "rl", n=2, spec="dist.shard.send:*", shards=None,
+            state=False,
+            opts_extra={"fleet_nodes": [f"127.0.0.1:{port}"] * 2})
+        assert rc == 0
+        assert stats["remote_shards"] == 2
+        assert stats["fleet"]["live"] == 0
+        assert [m["kind"] for m in stats["migrations"]] == ["revoke",
+                                                            "revoke"]
+        assert stats["oracle_cases"] == 2
+    finally:
+        srv.stop()
+
+
+def test_fleet_struct_combination_is_hard_error(tmp_path):
+    from erlamsa_tpu.corpus.fleet import run_corpus_fleet
+
+    with pytest.raises(ValueError, match="single-device"):
+        run_corpus_fleet({"seed": SEED, "shards": 2, "struct": "device",
+                          "corpus_dir": str(tmp_path / "c")})
+
+
+def test_cli_struct_plus_fleet_is_hard_error():
+    from erlamsa_tpu.services.cli import main
+
+    for argv in (["--shards", "2", "--struct", "device"],
+                 ["--shards", "2", "--struct-kernels"],
+                 ["--fleet-nodes", "127.0.0.1:1", "--struct", "host"]):
+        with pytest.raises(SystemExit, match="single-device"):
+            main(argv)
+
+
+def test_fleet_nodes_spec_validation(tmp_path):
+    from erlamsa_tpu.corpus.fleet import run_corpus_fleet
+
+    base = {"seed": SEED, "corpus_dir": str(tmp_path / "c")}
+    with pytest.raises(ValueError, match="host:port"):
+        run_corpus_fleet({**base, "fleet_nodes": ["nonsense"]})
+    with pytest.raises(ValueError, match="--fleet-nodes names"):
+        run_corpus_fleet({**base, "shards": 1,
+                          "fleet_nodes": ["h:1", "h:2"]})
+
+
+# ---- end-to-end over real loopback workers (compile-paying) -------------
+
+
+@pytest.mark.slow
+def test_remote_equals_local_equals_one_shard(tmp_path):
+    """The headline acceptance pin: remote 2-shard == local 2-shard ==
+    1-shard == mixed (1 remote + 1 local), byte-for-byte at a fixed
+    seed."""
+    srv1 = ParentServer(0, {"seed": SEED}).serve(block=False)
+    srv2 = ParentServer(0, {"seed": SEED}).serve(block=False)
+    p1 = srv1._srv.getsockname()[1]
+    p2 = srv2._srv.getsockname()[1]
+    try:
+        rc, _ = _run_fleet(tmp_path, "one", n=2, spec=None, shards=1,
+                           state=False)
+        assert rc == 0
+        one = _read_blob(tmp_path, "one", 2)
+        rc, _ = _run_fleet(tmp_path, "loc2", n=2, spec=None, shards=2,
+                           state=False)
+        assert rc == 0
+        assert _read_blob(tmp_path, "loc2", 2) == one
+        rc, stats = _run_fleet(
+            tmp_path, "rem2", n=2, spec=None, shards=None, state=False,
+            opts_extra={"fleet_nodes": [f"127.0.0.1:{p1}",
+                                        f"127.0.0.1:{p2}"]})
+        assert rc == 0 and stats["remote_shards"] == 2
+        assert _read_blob(tmp_path, "rem2", 2) == one
+        rc, stats = _run_fleet(
+            tmp_path, "mix", n=2, spec=None, shards=2, state=False,
+            opts_extra={"fleet_nodes": [f"127.0.0.1:{p1}"]})
+        assert rc == 0 and stats["remote_shards"] == 1
+        assert _read_blob(tmp_path, "mix", 2) == one
+    finally:
+        srv1.stop()
+        srv2.stop()
+
+
+@pytest.mark.slow
+def test_remote_worker_loss_redispatches_within_case(tmp_path):
+    """One injected dist.shard.send fault kills one remote shard's
+    dispatch: the lease is revoked, the slice redispatches to the
+    survivor WITHIN the case, and the output equals the unfaulted
+    run (migration moves WHERE, never WHAT)."""
+    srv1 = ParentServer(0, {"seed": SEED}).serve(block=False)
+    srv2 = ParentServer(0, {"seed": SEED}).serve(block=False)
+    p1 = srv1._srv.getsockname()[1]
+    p2 = srv2._srv.getsockname()[1]
+    nodes = [f"127.0.0.1:{p1}", f"127.0.0.1:{p2}"]
+    try:
+        rc, _ = _run_fleet(tmp_path, "ok", n=2, spec=None, shards=None,
+                           state=False, opts_extra={"fleet_nodes": nodes})
+        assert rc == 0
+        ref = _read_blob(tmp_path, "ok", 2)
+        rc, stats = _run_fleet(tmp_path, "flt", n=2,
+                               spec="dist.shard.send:x1", shards=None,
+                               state=False,
+                               opts_extra={"fleet_nodes": nodes})
+        assert rc == 0
+        assert stats["redispatches"] >= 1
+        assert [m["kind"] for m in stats["migrations"]][0] == "revoke"
+        assert _read_blob(tmp_path, "flt", 2) == ref
+    finally:
+        srv1.stop()
+        srv2.stop()
