@@ -310,7 +310,8 @@ def _run_mixed_arena_stage(batch_n: int, cases: int, t0: float,
 
 def _run_fleet_stage(batch_n: int, seed_len: int, cases: int, t0: float,
                      shards: int, spec: str | None = None,
-                     nodes: list | None = None, state: bool = False):
+                     nodes: list | None = None, state: bool = False,
+                     window: int = 1):
     """Sharded corpus fleet (corpus/fleet.py, `--shards N`): the same
     mixed-length seed set as the corpus stage, mapped across N per-shard
     arenas and reduced at the coordinator. At the fixed bench seed every
@@ -321,9 +322,10 @@ def _run_fleet_stage(batch_n: int, seed_len: int, cases: int, t0: float,
 
     `spec` arms a chaos spec for the run (e.g. "shard.step:x1" to kill
     one shard's first dispatch and measure recovery). `nodes` routes
-    the first len(nodes) shard ids to remote workers (r14 cross-host
-    path; loopback on this host); `state` enables the per-case fleet
-    checkpoint so its cost shows up in the warm rate. Returns
+    the first len(nodes) shard ids to remote workers (cross-host path;
+    loopback on this host); `state` enables the per-case fleet
+    checkpoint so its cost shows up in the warm rate; `window` sets the
+    framed-stream sync window (r15 --fleet-window). Returns
     (warm_samples_per_sec, stats dict); stats carries the migration log
     and per-case finish_times the caller derives recovery time from."""
     import shutil
@@ -350,6 +352,7 @@ def _run_fleet_stage(batch_n: int, seed_len: int, cases: int, t0: float,
             "_stats": stats,
             "shards": shards,
             "fleet_nodes": nodes,
+            "fleet_window": window,
         }
         if state:
             opts["state_path"] = os.path.join(tmpdir, "state.npz")
@@ -361,8 +364,16 @@ def _run_fleet_stage(batch_n: int, seed_len: int, cases: int, t0: float,
         raise RuntimeError(f"fleet stage failed rc={rc} stats={stats}")
     ft = stats["finish_times"]
     warm_sps = batch_n * (len(ft) - 1) / (ft[-1] - ft[0])
+    # the banner reports the REAL shard count: with `nodes` and no
+    # --shards the fleet is sized to the node list, and printing the
+    # raw argument here used to read "shards=None" on every remote leg
+    n_shards = stats.get("shards", shards)
+    remotes = stats.get("remote_shards", 0)
     _phase(
-        f"fleet stage (shards={shards}{', spec=' + spec if spec else ''}): "
+        f"fleet stage (shards={n_shards}"
+        f"{f', remote={remotes}' if remotes else ''}"
+        f"{f', window={window}' if window != 1 else ''}"
+        f"{', spec=' + spec if spec else ''}): "
         f"{warm_sps:,.0f} samples/s warm, "
         f"{len(stats.get('migrations', []))} migration(s), "
         f"{stats.get('oracle_cases', 0)} oracle case(s)", t0,
@@ -638,30 +649,64 @@ def child_main() -> None:
                 nodes = [f"127.0.0.1:{w._srv.getsockname()[1]}"
                          for w in workers]
 
-                def warm(shards, nodes=None, state=False):
-                    # pass 1 pays the per-class compiles; pass 2 is the
-                    # measured warm rate (each config compiles its own
-                    # donate/no-donate step variants, so without the
-                    # warmup pass the first-run compiles would swamp the
-                    # transport/checkpoint deltas this stage isolates)
-                    _run_fleet_stage(BATCH, SEED_LEN, fleet_cases, t0,
+                def warm(shards, nodes=None, state=False, window=1,
+                         cases=None):
+                    # pass 1 pays the per-class compiles (each config
+                    # compiles its own donate/no-donate step variants,
+                    # so without the warmup pass the first-run compiles
+                    # would swamp the transport/checkpoint deltas this
+                    # stage isolates); the measured rate is the best of
+                    # two warm passes — a single ~2 s window on a busy
+                    # 1-core host scatters +-6%, so one sample makes
+                    # the cross-release comparison a coin flip
+                    cs = cases or fleet_cases
+                    _run_fleet_stage(BATCH, SEED_LEN, cs, t0,
                                      shards=shards, nodes=nodes,
-                                     state=state)
-                    sps, _ = _run_fleet_stage(
-                        BATCH, SEED_LEN, fleet_cases, t0, shards=shards,
-                        nodes=nodes, state=state)
-                    return sps
+                                     state=state, window=window)
+                    best, best_st = 0.0, {}
+                    for _ in range(2):
+                        sps, st = _run_fleet_stage(
+                            BATCH, SEED_LEN, cs, t0, shards=shards,
+                            nodes=nodes, state=state, window=window)
+                        if sps >= best:
+                            best, best_st = sps, st
+                    return best, best_st
 
-                loc_sps = warm(shards=2)
-                rem_sps = warm(shards=None, nodes=nodes)
-                ckpt_sps = warm(shards=None, nodes=nodes, state=True)
+                loc_sps, _ = warm(shards=2)
+                rem_sps, rem_stats = warm(shards=None, nodes=nodes)
+                # the window comparison needs enough cases that the
+                # one-time lease + snapshot exchanges stop dominating
+                # the per-case syncs the window is amortizing
+                win_cases = max(16, fleet_cases)
+                w1_sps, w1_stats = warm(shards=None, nodes=nodes,
+                                        cases=win_cases)
+                w8_sps, w8_stats = warm(shards=None, nodes=nodes,
+                                        window=8, cases=win_cases)
+                ckpt_sps, _ = warm(shards=None, nodes=nodes, state=True)
             finally:
                 for w in workers:
                     w.stop()
             record["dist_fleet_local2_samples_per_sec"] = round(loc_sps, 1)
             record["dist_fleet_remote2_samples_per_sec"] = round(rem_sps, 1)
+            record["dist_fleet_remote2_w8_samples_per_sec"] = round(
+                w8_sps, 1)
             record["dist_fleet_remote2_ckpt_samples_per_sec"] = round(
                 ckpt_sps, 1)
+            # framed-transport economics (r15): awaited exchanges per
+            # case and wire bytes per sample, at window 1 vs 8 over
+            # win_cases cases — the window amortizes the sync barrier,
+            # so round trips/case should fall ~Wx while the bytes stay
+            # flat
+            for tag, st in (("w1", w1_stats), ("w8", w8_stats)):
+                tr = st.get("transport") or {}
+                n = max(1, st.get("total", 1))
+                record[f"dist_fleet_round_trips_per_case_{tag}"] = round(
+                    tr.get("round_trips", 0) / max(1, win_cases), 2)
+                record[f"dist_fleet_transport_bytes_per_sample_{tag}"] = (
+                    round((tr.get("bytes_sent", 0)
+                           + tr.get("bytes_recv", 0)) / n, 1))
+            record["dist_fleet_reduce_overlap"] = rem_stats.get(
+                "reduce_overlap")
             # NOT a transport number: local shards dispatch through
             # per-shard arenas (page admission for every novel
             # offspring), remote workers re-pack payload panels

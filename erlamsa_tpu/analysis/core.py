@@ -85,6 +85,7 @@ class LintConfig:
         "serving.admit", "serving.step",
         "shard.step", "shard.migrate", "fleet.reduce",
         "dist.shard.send", "dist.shard.recv", "fleet.checkpoint",
+        "dist.shard.frame", "fleet.snapshot",
     )
 
     def in_scope(self, rel: str, prefixes: tuple) -> bool:

@@ -55,6 +55,7 @@ from typing import Callable, NamedTuple, Sequence
 
 import math
 import threading
+import zlib
 
 import numpy as np
 
@@ -123,6 +124,76 @@ def resolve_classes(spec, sizes: Sequence[int],
     if not caps or caps[0] <= 0:
         raise ValueError(f"arena classes must be positive, got {spec!r}")
     return tuple(caps)
+
+
+class ArenaSnapshot(NamedTuple):
+    """Versioned warm-start image of one shard partition (r15): the
+    page-padded payloads plus everything needed to re-admit them without
+    touching the store — built host-side by build_arena_snapshot, shipped
+    over a shard_snapshot frame or replayed into a local arena after
+    readmission.
+
+    Fencing: `epoch` and `token` stamp the snapshot with the lease it
+    was built FOR. A worker only installs a snapshot whose stamp matches
+    its current lease, so a zombie coordinator's stale image — or a
+    zombie worker restoring after its lease was re-granted elsewhere —
+    can never serve a stale partition. `crc` (crc32 over the flat page
+    bytes) rejects corruption independently of fencing."""
+
+    sids: tuple  # seed ids, in admission order
+    lens: tuple  # true (class-truncated) payload lengths
+    cls_map: tuple  # class index per sid (routing at build time)
+    pages: np.ndarray  # uint8[n_pages, page] page-padded payloads
+    page: int  # physical page size the image was cut with
+    crc: int  # crc32 over pages.tobytes()
+    epoch: int  # fencing epoch the snapshot is valid at
+    token: str  # campaign token scoping the epoch
+
+
+def build_arena_snapshot(get: Callable[[str], bytes],
+                         sids: Sequence[str],
+                         classes: Sequence[int], page: int,
+                         classify: Callable[[int], int] | None = None,
+                         epoch: int = 0,
+                         token: str = "") -> ArenaSnapshot:
+    """Cut a warm-start snapshot for a partition's seeds, pure-host (no
+    jax): each payload is truncated at the TOP class (the same clamp
+    ensure() applies at admission, so a restore reproduces admission
+    byte-for-byte), class-routed exactly like DeviceArena.class_for, and
+    laid out as consecutive zero-padded page chunks in sid order — the
+    wire layout shard_snapshot frames and restore_snapshot() both walk."""
+    classes = tuple(sorted({int(c) for c in classes}))
+    if not classes or classes[0] <= 0:
+        raise ValueError(f"capacity classes must be positive, got {classes}")
+    page = int(page)
+    if page <= 0:
+        raise ValueError(f"page size must be positive, got {page}")
+    sids = [str(s) for s in sids]
+    lens: list[int] = []
+    cls_map: list[int] = []
+    chunks: list[np.ndarray] = []
+    for sid in sids:
+        data = bytes(get(sid))[:classes[-1]]
+        want = classify(len(data)) if classify else len(data)
+        cls = len(classes) - 1
+        for i, cap in enumerate(classes):
+            if cap >= want:
+                cls = i
+                break
+        npages = max(1, -(-len(data) // page))
+        buf = np.zeros(npages * page, np.uint8)
+        buf[:len(data)] = np.frombuffer(data, np.uint8)
+        lens.append(len(data))
+        cls_map.append(cls)
+        chunks.append(buf.reshape(npages, page))
+    pages = (np.vstack(chunks) if chunks
+             else np.zeros((0, page), np.uint8))
+    return ArenaSnapshot(
+        sids=tuple(sids), lens=tuple(lens), cls_map=tuple(cls_map),
+        pages=pages, page=page,
+        crc=zlib.crc32(pages.tobytes()) & 0xFFFFFFFF,
+        epoch=int(epoch), token=str(token),
+    )
 
 
 class ClassTable(NamedTuple):
@@ -722,6 +793,34 @@ class DeviceArena:
         with self._lock:
             self._adopt_q = []
         self._arena = self._paged.new_arena(self.alloc.num_pages, self.page)
+
+    def restore_snapshot(self, snap: ArenaSnapshot, tick: int) -> int:
+        """Warm-start this arena from a snapshot (r15): bulk re-admit
+        every payload through the normal ensure() path and close the
+        staging window with ONE flush — a readmitted shard repopulates
+        its partition in one upload instead of lazy per-case re-uploads.
+        Returns the number of seeds made resident (spilled seeds stay
+        host-resident, same transparency contract as ensure). The
+        caller checks the snapshot's epoch/token stamp against its lease
+        BEFORE calling; this method only verifies physical integrity
+        (page geometry + crc) and raises ValueError on a mismatch."""
+        if int(snap.page) != self.page:
+            raise ValueError(f"snapshot page size {snap.page} != arena "
+                             f"page size {self.page}")
+        if zlib.crc32(snap.pages.tobytes()) & 0xFFFFFFFF != snap.crc:
+            raise ValueError("snapshot crc mismatch — corrupt image "
+                             "rejected")
+        restored = 0
+        off = 0
+        with trace.span("corpus.arena.restore", seeds=len(snap.sids)):
+            for sid, ln in zip(snap.sids, snap.lens):
+                npages = max(1, -(-int(ln) // self.page))
+                data = snap.pages[off:off + npages].tobytes()[:int(ln)]
+                off += npages
+                if self.ensure(sid, data, tick):
+                    restored += 1
+            self.flush()
+        return restored
 
     def stats(self) -> dict:
         s = self.alloc.stats()

@@ -66,9 +66,44 @@ so every pre-crash lease is fenced. A checkpoint from a different run
 (seed/shape/shard-count mismatch) is quarantined to ``.bak``, never
 silently overwritten.
 
-Still single-device only: the async drain worker (the fleet reduces at
-case boundaries) and the --struct overlay (a hard error here, not a
-silent ignore).
+Fleet phase 3 (r15) — the data path:
+
+  transport  remote shards speak length-prefixed binary frames over ONE
+             persistent stream per shard (services/dist.ShardStream):
+             step frames are fire-and-forget (every remote shard
+             computes its slice in parallel; r14 blocked serially per
+             shard), raw byte panels ride the frame blob (no base64),
+             and the only awaited steady-state exchange is a window
+             sync every ``--fleet-window W`` steps per shard — round
+             trips amortize W x.
+  reduce     the host-side merge runs on the runner's drain worker
+             (corpus/runner._DrainWorker), sequenced strictly in case
+             order: the schedule for case N+1 waits for case N's
+             energy/score/seen merge (the scheduler draw depends on
+             it), then case N's output writes overlap case N+1's
+             schedule/assemble/dispatch. Byte-identity is untouched —
+             the drain moves WHERE the merge runs, never its order.
+             ``--fleet-reduce boundary`` restores the case-boundary
+             wait (the identity pin's reference ordering).
+  warm start on lease (and re-admission), a shard restores its
+             partition from a versioned arena snapshot (page payloads +
+             crc32 + fencing epoch, corpus/arena.build_arena_snapshot)
+             instead of lazy per-case re-upload: remote leases ship the
+             image over a shard_snapshot frame (steps then send seed
+             ids only), local readmits replay it into the rebuilt arena
+             in ONE flush. The ``fleet.snapshot`` fault site skips the
+             warm start — every seed ships/uploads lazily instead,
+             byte-identically (tests pin this).
+
+A reply lost mid-window (stream death, fenced zombie, injected
+``dist.shard.recv`` fault) surfaces as FleetShardLost from the drain:
+the coordinator rewinds to the first un-merged case, revokes the lost
+shard, closes every stream, and replays — the replayed schedule draws
+identically (energies unchanged since the last merged case), so the
+rewound run stays byte-identical to the clean one.
+
+Still single-device only: the --struct overlay (a hard error here, not
+a silent ignore).
 """
 
 from __future__ import annotations
@@ -87,7 +122,7 @@ from ..services import chaos, logger, metrics, out
 from . import feedback as fb
 from .assembler import bucket_capacity
 from .energy import EnergyScheduler
-from .runner import DEVICE_PROBE_EVERY, _out_hash
+from .runner import DEVICE_PROBE_EVERY, _DrainWorker, _out_hash
 from .store import CorpusStore
 
 
@@ -250,6 +285,77 @@ class _RemoteResult:
         return self._res
 
 
+class FleetShardLost(RuntimeError):
+    """A shard's already-dispatched work was lost AFTER the case left
+    the dispatch loop — a step reply that never arrived (stream death,
+    fenced zombie, injected dist.shard.recv fault) or a local future
+    that died at force time. Raised by the drain's merge, caught by the
+    coordinator's rewind: revoke the shard, close the streams, replay
+    from the first un-merged case. Distinct from a dispatch-time
+    failure, which redistributes WITHIN the case."""
+
+    def __init__(self, shard: int, case: int, cause: BaseException):
+        super().__init__(f"shard {shard} lost at case {case}: {cause}")
+        self.shard = int(shard)
+        self.case = int(case)
+        self.cause = cause
+
+
+class _PendingRemote:
+    """A fire-and-forget framed step awaiting its FIFO reply (r15).
+    The dispatch thread writes the step (and, when the window fills, a
+    shard_sync barrier) and moves on; the drain thread calls force() to
+    consume the result frame — and the sync ack behind it — off the
+    same stream. force() is idempotent (the settle paths may force an
+    entry the merge later reads), and the decoded reply is dressed as a
+    _RemoteResult so the reduce treats local and remote entries
+    identically."""
+
+    def __init__(self, stream, epoch: int, case: int, n_slots: int,
+                 sync: bool, shapes_acc: set):
+        self.stream = stream
+        self.epoch = int(epoch)
+        self.case = int(case)
+        self.n_slots = int(n_slots)
+        self.sync = bool(sync)
+        self._shapes = shapes_acc
+        self.done = False
+        self._result = None
+
+    def force(self) -> _RemoteResult:
+        if self.done:
+            return self._result
+        header, blob = self.stream.read_reply("shard_result", self.epoch,
+                                              case=self.case)
+        lens = [int(x) for x in header.get("lens", [])]
+        if len(lens) != self.n_slots or sum(lens) != len(blob):
+            from ..services.dist import RemoteShardError
+
+            raise RemoteShardError(
+                f"shard {self.stream.id}: reply geometry mismatch "
+                f"({len(lens)} lens / {sum(lens)}B declared for "
+                f"{self.n_slots} slots / {len(blob)}B blob)")
+        outs = []
+        off = 0
+        for ln in lens:
+            outs.append(blob[off:off + ln])
+            off += ln
+        for sh in header.get("shapes", []):
+            self._shapes.add(tuple(int(x) for x in sh))
+        if self.sync:
+            # the window barrier: the ONLY awaited steady-state
+            # exchange — consuming the ack re-opens the shard's window
+            self.stream.read_reply("shard_synced", self.epoch,
+                                   case=self.case)
+            if self.stream.tally is not None:
+                self.stream.tally.add(round_trips=1)
+            self.stream.unsynced = 0
+        self._result = _RemoteResult(outs, header.get("scores", []),
+                                     header.get("applied", []))
+        self.done = True
+        return self._result
+
+
 def run_corpus_fleet(opts: dict, batch: int = 1024) -> int:
     """The --corpus DIR --shards N entry point (see module docstring)."""
     import jax
@@ -264,15 +370,25 @@ def run_corpus_fleet(opts: dict, batch: int = 1024) -> int:
     from ..ops.registry import DEVICE_CODES
     from ..ops.scheduler import init_scores
     from .arena import RESERVED_PAGES, DeviceArena, _next_pow2, \
-        fit_page_classes, resolve_classes
+        build_arena_snapshot, fit_page_classes, resolve_classes
 
     from ..services.checkpoint import (load_fleet_state,
                                        quarantine_mismatch,
                                        save_fleet_state)
-    from ..services.dist import (RemoteShard, RemoteShardError,
-                                 new_campaign_token)
+    from ..services.dist import (RemoteShardError, ShardStream,
+                                 TransportTally, new_campaign_token)
 
     raw_shards = opts.get("shards")
+    # --fleet-window W: steps in flight per shard between sync barriers
+    # (W=1 degenerates to one awaited exchange per step, the r14 cadence)
+    fleet_window = max(1, int(opts.get("fleet_window") or 1))
+    # --fleet-reduce: 'overlap' (default) runs the merge on the drain
+    # worker; 'boundary' waits at the case boundary (the identity pin's
+    # reference ordering — processing is identical either way)
+    reduce_mode = str(opts.get("fleet_reduce") or "overlap")
+    if reduce_mode not in ("overlap", "boundary"):
+        raise ValueError(f"--fleet-reduce must be overlap|boundary, "
+                         f"got {reduce_mode!r}")
     fleet_nodes: list[tuple[str, int]] = []
     for spec in (opts.get("fleet_nodes") or []):
         host, _, port = str(spec).rpartition(":")
@@ -452,23 +568,32 @@ def run_corpus_fleet(opts: dict, batch: int = 1024) -> int:
     # zombies of past campaigns (old token) stay rejected. Transport
     # metadata only — sample bytes stay f(seed, case, slot).
     fleet_token = str(opts.get("fleet_token") or new_campaign_token())
+    # one transport ledger for the whole campaign, shared by every
+    # shard stream: frame bytes by direction + awaited round trips
+    transport = TransportTally()
+    fleet_timeout = float(opts.get("fleet_timeout") or 90.0)
+
+    def _classify(n: int) -> int:
+        return bucket_capacity(n, device_max=device_max)
 
     class _Remote:
-        """One cross-host lease-holder: this shard's per-case dispatch
-        runs on a WorkerNode over the dist shard protocol. No arena —
-        the worker is stateless (the lease ships the step config, every
-        step ships the slice's bytes), so a worker restart costs a
-        re-lease, nothing else. Offspring produced here adopt host-side
-        only (no device buffer to splice from); they upload lazily at
-        their first schedule like any migrated seed."""
+        """One cross-host lease-holder (r15): a persistent framed
+        stream to its worker. The worker stays stateless between leases
+        — but WITHIN a lease it caches the warm-start snapshot this
+        class ships right after the grant, so steady-state steps send
+        seed ids instead of payloads for every snapshot-resident seed.
+        A worker restart costs a re-lease plus a snapshot re-ship,
+        nothing else. Offspring produced here adopt host-side only (no
+        local device buffer to splice from); they ship inline at their
+        first schedule like any post-snapshot seed."""
 
         def __init__(self, shard_id: int, host: str, port: int):
             self.id = shard_id
-            self.rs = RemoteShard(shard_id, host, port,
-                                  timeout=float(
-                                      opts.get("fleet_timeout") or 90.0),
-                                  token=fleet_token)
+            self.stream = ShardStream(shard_id, host, port,
+                                      timeout=fleet_timeout,
+                                      token=fleet_token, tally=transport)
             self._leased: int | None = None
+            self.snap_sids: frozenset = frozenset()
             self.cfg = {
                 "seed": [int(x) for x in opts["seed"]],
                 "pri": [int(x) for x in pri],
@@ -480,10 +605,48 @@ def run_corpus_fleet(opts: dict, batch: int = 1024) -> int:
         def ensure_lease(self, epoch: int):
             """(Re-)grant the lease when the placement epoch moved —
             initial grant, post-readmit, and post-resume all land here
-            lazily at the next dispatch that needs the shard."""
-            if self._leased != epoch:
-                self.rs.lease(epoch, self.cfg)
-                self._leased = epoch
+            lazily at the next dispatch that needs the shard — then
+            ship the arena warm-start snapshot for the shard's current
+            partitions. The fleet.snapshot fault site skips the ship:
+            every seed rides the inline path instead, byte-identically
+            (the snapshot moves bytes earlier, never changes them)."""
+            if self._leased == epoch:
+                return
+            msg = {"op": "shard_lease", "shard": self.id,
+                   "epoch": int(epoch)}
+            msg.update(self.cfg)
+            with trace.span("fleet.lease", shard=self.id, epoch=epoch):
+                self.stream.request(msg, expect="shard_leased")
+            self._leased = epoch
+            self.snap_sids = frozenset()
+            try:
+                chaos.fault_point("fleet.snapshot")
+            except OSError:
+                metrics.GLOBAL.record_event("fleet_snapshot_skipped")
+                return
+            part = [sid for sid in store.ids()
+                    if placement.owner_of(partition_of(sid, n_shards))
+                    == self.id]
+            if not part:
+                return
+            snap = build_arena_snapshot(store.get, part, classes, page,
+                                        classify=_classify,
+                                        epoch=int(epoch),
+                                        token=fleet_token)
+            header = {"op": "shard_snapshot", "shard": self.id,
+                      "epoch": int(epoch), "sids": list(snap.sids),
+                      "lens": [int(x) for x in snap.lens],
+                      "page": int(snap.page), "crc": int(snap.crc)}
+            with trace.span("fleet.snapshot", shard=self.id,
+                            seeds=len(snap.sids),
+                            pages=int(snap.pages.shape[0])):
+                self.stream.request(header, snap.pages.tobytes(),
+                                    expect="shard_snapshotted")
+            self.snap_sids = frozenset(snap.sids)
+            metrics.GLOBAL.record_event("fleet_snapshot_shipped")
+            flight.GLOBAL.note("fleet_warm_start", shard=self.id,
+                               epoch=int(epoch), seeds=len(snap.sids),
+                               bytes=int(snap.pages.nbytes))
 
     # the FIRST len(fleet_nodes) shard ids are remote, the rest local —
     # partition_of is shard-count-keyed only, so the mix never changes
@@ -498,33 +661,55 @@ def run_corpus_fleet(opts: dict, batch: int = 1024) -> int:
     stats = opts.get("_stats")
     seen_hashes: set[bytes] = resume_seen
     tallies = {"truncated": 0, "total": 0, "new_hashes": 0, "bytes_out": 0,
-               "oracle_cases": 0, "redispatches": 0, "offspring": 0}
+               "oracle_cases": 0, "redispatches": 0, "offspring": 0,
+               "rewinds": 0}
     step_shapes: set[tuple] = set()
 
     def remote_dispatch(shard: _Remote, case: int, slots: list[int],
-                        samples):
-        """Map step for one REMOTE shard's slice: ship (global slots,
-        bytes, score rows) under the shard's current lease epoch, get
-        (bytes, score rows, applied) back for the same slots. The
-        network round-trip IS the future — the result arrives complete
-        and is wrapped in _RemoteResult so the reduce treats local and
-        remote entries identically. RemoteShardError (incl. a fenced
-        stale reply, and injected dist.shard.* faults) flows into the
-        same revoke/redispatch path as a local device loss."""
+                        ids, samples):
+        """Map step for one REMOTE shard's slice, fire-and-forget: the
+        step frame carries (global slots, seed ids, score rows) in the
+        header and ONLY non-snapshot payloads in the blob, then returns
+        a _PendingRemote immediately — the shard computes while the
+        coordinator dispatches the other shards (r14 blocked here,
+        serializing the fleet). When the shard's window fills, a
+        shard_sync barrier frame follows; its ack is consumed with the
+        step reply at the reduce. RemoteShardError (incl. injected
+        dist.shard.* faults) flows into the same revoke/redispatch
+        path as a local device loss."""
         epoch = placement.lease_epoch_of(shard.id)
         t_a = time.perf_counter()
         shard.ensure_lease(epoch)
-        payloads = [samples[s] for s in slots]
-        score_rows = [[int(x) for x in scores[s]] for s in slots]
+        sub_sids = [ids[s] for s in slots]
+        inline_sids: list[str] = []
+        inline_lens: list[int] = []
+        blobs: list[bytes] = []
+        for sid, slot in zip(sub_sids, slots):
+            if sid not in shard.snap_sids:
+                inline_sids.append(sid)
+                inline_lens.append(len(samples[slot]))
+                blobs.append(samples[slot])
+        header = {
+            "op": "shard_step", "shard": shard.id, "epoch": int(epoch),
+            "case": int(case), "slots": [int(s) for s in slots],
+            "sids": sub_sids, "inline_sids": inline_sids,
+            "inline_lens": inline_lens,
+            "scores": [[int(x) for x in scores[s]] for s in slots],
+        }
         with trace.span("fleet.remote_dispatch", case=case,
-                        shard=shard.id, rows=len(slots)):
-            outs, sc, applied, shapes = shard.rs.step(
-                epoch, case, slots, payloads, score_rows)
+                        shard=shard.id, rows=len(slots),
+                        inline=len(inline_sids)):
+            shard.stream.send(header, b"".join(blobs))
+        shard.stream.unsynced += 1
+        sync = shard.stream.unsynced >= fleet_window
+        if sync:
+            shard.stream.send({"op": "shard_sync", "shard": shard.id,
+                               "epoch": int(epoch), "case": int(case)})
         metrics.GLOBAL.record_stage("remote_step",
                                     time.perf_counter() - t_a)
-        for sh in shapes:
-            step_shapes.add(tuple(int(x) for x in sh))
-        return [(list(slots), len(slots), _RemoteResult(outs, sc, applied))]
+        return [(list(slots), len(slots),
+                 _PendingRemote(shard.stream, epoch, case, len(slots),
+                                sync, step_shapes))]
 
     def shard_dispatch(shard, case: int, slots: list[int],
                        ids, samples):
@@ -539,7 +724,7 @@ def run_corpus_fleet(opts: dict, batch: int = 1024) -> int:
         shard.step chaos spec kills local and remote shards alike."""
         chaos.fault_point("shard.step")
         if isinstance(shard, _Remote):
-            return remote_dispatch(shard, case, slots, samples)
+            return remote_dispatch(shard, case, slots, ids, samples)
         arena = shard.arena
         sub_ids = [ids[s] for s in slots]
         sub_samples = [samples[s] for s in slots]
@@ -615,7 +800,9 @@ def run_corpus_fleet(opts: dict, batch: int = 1024) -> int:
         clears (same discipline as the single-device runner's probe)."""
         chaos.fault_point("shard.step")
         if isinstance(shard, _Remote):
-            shard.rs.probe()
+            shard.stream.request(
+                {"op": "shard_probe", "shard": shard.id},
+                expect="shard_alive", timeout=min(fleet_timeout, 10.0))
             return
         with jax.default_device(shard.device):
             jnp.zeros(8).block_until_ready()
@@ -654,12 +841,19 @@ def run_corpus_fleet(opts: dict, batch: int = 1024) -> int:
             # best-effort fence: raise the worker's floor so anything
             # still in flight from this lease is rejected worker-side
             # too. An unreachable worker is fenced anyway — its readmit
-            # lease will carry a strictly higher epoch.
+            # lease will carry a strictly higher epoch. The old stream
+            # is closed FIRST so stale in-flight replies die with the
+            # connection instead of desynchronizing a fresh request.
             sh._leased = None
+            sh.snap_sids = frozenset()
+            sh.stream.close()
             try:
-                sh.rs.revoke(entry["epoch"])
-            except OSError:
+                sh.stream.request(
+                    {"op": "shard_revoke", "shard": shard_id,
+                     "epoch": entry["epoch"]}, expect="shard_revoked")
+            except (OSError, RemoteShardError):
                 pass
+            sh.stream.close()
         try:
             chaos.fault_point("shard.migrate")
         except OSError:
@@ -688,12 +882,38 @@ def run_corpus_fleet(opts: dict, batch: int = 1024) -> int:
             metrics.GLOBAL.record_event("shard_readmit_aborted")
             return False
         if isinstance(shards[shard_id], _Shard):
-            # the old arena tensor died with the device: rebuild empty;
-            # its seeds re-upload lazily at the next dispatch that needs
-            # them. (A remote shard has no arena — its re-grant happens
-            # lazily via ensure_lease at the bumped readmit epoch.)
+            # the old arena tensor died with the device: rebuild empty,
+            # then warm-start it from a store-built snapshot of the
+            # shard's HOME partition so the readmitted device serves
+            # its first case without a lazy per-seed re-upload storm.
+            # (A remote shard has no local arena — its re-grant AND
+            # snapshot ship lazily via ensure_lease at the readmit
+            # epoch.) The fleet.snapshot fault point degrades this to
+            # the r14 lazy path — identity tests pin that bytes match.
             with jax.default_device(shards[shard_id].device):
                 shards[shard_id].arena.reset()
+            warm = True
+            try:
+                chaos.fault_point("fleet.snapshot")
+            except OSError:
+                metrics.GLOBAL.record_event("fleet_snapshot_skipped")
+                warm = False
+            if warm:
+                home = [sid for sid in store.ids()
+                        if partition_of(sid, n_shards) == shard_id]
+                if home:
+                    snap = build_arena_snapshot(
+                        store.get, home, classes, page,
+                        classify=_classify,
+                        epoch=placement.epoch + 1, token=fleet_token)
+                    with jax.default_device(shards[shard_id].device):
+                        restored = shards[shard_id].arena.restore_snapshot(
+                            snap, tick=case)
+                    metrics.GLOBAL.record_event("fleet_snapshot_restored")
+                    flight.GLOBAL.note(
+                        "fleet_warm_start", shard=shard_id, case=case,
+                        seeds=restored, bytes=int(snap.pages.size),
+                        crc=snap.crc)
         entry = placement.readmit(shard_id, case)
         logger.log("warning", "fleet: shard %d re-admitted at case %d — "
                    "taking its partitions back", shard_id, case)
@@ -705,124 +925,85 @@ def run_corpus_fleet(opts: dict, batch: int = 1024) -> int:
         metrics.GLOBAL.record_fleet(placement.snapshot())
         return True
 
-    metrics.GLOBAL.record_fleet(placement.snapshot())
-    t0 = time.perf_counter()
-    probe_at = start_case
-    case = start_case
-    while case < n_cases:
-        # -- re-admission probes (case-counter gated, like the runner) --
-        if placement.dead() and case >= probe_at:
-            probe_at = case + DEVICE_PROBE_EVERY
-            for s in placement.dead():
-                try_readmit(s, case)
-
-        t_s = time.perf_counter()
-        with trace.span("fleet.schedule", case=case):
-            ids = sched.schedule(case, batch)
-            samples = [store.get(sid) for sid in ids]
-        metrics.GLOBAL.record_stage("schedule", time.perf_counter() - t_s)
-        if stats is not None:
-            stats.setdefault("schedules", []).append(list(ids))
-        trunc = sum(len(s) > trunc_cap for s in samples)
-        if trunc:
-            tallies["truncated"] += trunc
-            metrics.GLOBAL.record_truncated(trunc)
-
-        # -- map: partition slots by lease, dispatch shard slices ------
-        by_shard: dict[int, list[int]] = {}
-        host_slots: list[int] = []
-        for slot, sid in enumerate(ids):
-            owner = placement.owner_of(partition_of(sid, n_shards))
-            if owner is None:
-                host_slots.append(slot)
-            else:
-                by_shard.setdefault(owner, []).append(slot)
-        pending = sorted(by_shard.items())
-        # (shard_id, global slots, rows, fut) per dispatched class group
-        launched: list[tuple[int, list[int], int, object]] = []
-        t_map = time.perf_counter()
-        try:
-            while pending:
-                shard_id, slots = pending.pop(0)
-                try:
-                    launched.extend(
-                        (shard_id, *entry)
-                        for entry in shard_dispatch(shards[shard_id], case,
-                                                    slots, ids, samples))
-                except Exception as e:  # lint: broad-except-ok re-raised below unless a shard loss
-                    # a remote shard loss (timeout, protocol error, or a
-                    # FENCED stale reply) is the cross-host spelling of
-                    # a device error: same revoke + in-case redispatch
-                    if not (is_device_error(e)
-                            or isinstance(e, RemoteShardError)):
-                        raise
-                    revoke_shard(shard_id, case, e)
-                    # the failed slice re-partitions onto its new owners
-                    # and re-dispatches WITHIN this case — same global
-                    # slot indices, so the re-served bytes are identical
-                    tallies["redispatches"] += 1
-                    requeue: dict[int, list[int]] = {}
-                    for slot in slots:
-                        owner = placement.owner_of(
-                            partition_of(ids[slot], n_shards))
-                        if owner is None:
-                            host_slots.append(slot)
-                        else:
-                            requeue.setdefault(owner, []).append(slot)
-                    merged = dict(pending)
-                    for owner, sl in requeue.items():
-                        merged[owner] = sorted(merged.get(owner, []) + sl)
-                    pending = sorted(merged.items())
-        except BaseException:  # lint: broad-except-ok re-raised after settling in-flight futures
-            # a non-device error mid-map must not strand the survivors'
-            # in-flight futures: settle them before unwinding
-            drain_futures(f for _sh, _sl, _r, f in launched)
-            raise
-        if host_slots:
-            tallies["oracle_cases"] += 1
-            logger.log("warning", "fleet: no live shards at case %d — "
-                       "host oracle serves %d slot(s)", case,
-                       len(host_slots))
-
-        # -- reduce: force futures, merge by slot, fold feedback in ----
+    def process_case(work):
+        """Reduce for one case — runs ON THE DRAIN WORKER, strictly in
+        case order (r15 overlapped reduce): force the shard replies,
+        merge by slot, fold novelty / energy / feedback in, then write
+        the outputs. The merge of case N overlaps the map of case N+1
+        on the main thread; ordering keeps N-shard == 1-shard
+        byte-identity intact. Writes happen AFTER mark_done (the main
+        thread only needs the merged state, not the files) — except on
+        a checkpoint case, where the single-device ordering contract
+        (outputs before checkpoint before done) still holds. A reply
+        that never arrives surfaces as FleetShardLost into the
+        coordinator's rewind."""
+        case_i, ids = work.case, work.ids
         try:
             chaos.fault_point("fleet.reduce")
         except OSError:
-            # the merge below is pure over futures the coordinator
+            # the merge below is pure over replies the coordinator
             # already owns: an injected reduce fault costs one logged
             # re-apply, never data loss — outputs must not change
             metrics.GLOBAL.record_event("fleet_reduce_retry")
+        t_r = time.perf_counter()
         parts: list[dict[int, bytes]] = []
         # slot -> (producing shard, device output buffer, row): adoption
         # sources for the novelty walk below (arena output buffers are
         # never donated in the fleet, so holding them here is safe)
         devsrc: dict[int, tuple] = {}
-        t_r = time.perf_counter()
-        for shard_id, slots, rows, fut in launched:
-            with trace.span("fleet.drain", case=case, rows=rows):
-                new_data, new_lens, new_sc, meta = fut.result()
-                outs = unpack(Batch(new_data[:rows], new_lens[:rows]))
-            parts.append({slot: outs[j] for j, slot in enumerate(slots)})
-            if adopt_on and isinstance(shards[shard_id], _Shard):
-                # remote shards never register adoption sources: there
-                # is no local device buffer to splice from, so their
-                # offspring take the lazy-upload path unconditionally
-                for j, slot in enumerate(slots):
-                    devsrc[slot] = (shard_id, new_data, j)
-            scores[np.asarray(slots, np.int32)] = new_sc[:rows]
-            applied = meta.applied[:rows].ravel()
-            applied = applied[applied >= 0]
-            if applied.size:
-                counts = np.bincount(applied, minlength=len(DEVICE_CODES))
-                for mi in np.nonzero(counts)[0]:
-                    metrics.GLOBAL.record_mutator(
-                        DEVICE_CODES[mi], applied=True, n=int(counts[mi]))
-        if host_slots:
-            parts.append(oracle_slots(case, ids, host_slots))
+        shard_id = -1
+        try:
+            for shard_id, slots, rows, fut in work.launched:
+                with trace.span("fleet.drain", case=case_i, rows=rows):
+                    if isinstance(fut, _PendingRemote):
+                        fut = fut.force()
+                    new_data, new_lens, new_sc, meta = fut.result()
+                    outs = unpack(Batch(new_data[:rows], new_lens[:rows]))
+                parts.append({slot: outs[j]
+                              for j, slot in enumerate(slots)})
+                if adopt_on and isinstance(shards[shard_id], _Shard):
+                    # remote shards never register adoption sources:
+                    # there is no local device buffer to splice from, so
+                    # their offspring take the lazy-upload path
+                    for j, slot in enumerate(slots):
+                        devsrc[slot] = (shard_id, new_data, j)
+                scores[np.asarray(slots, np.int32)] = new_sc[:rows]
+                applied = meta.applied[:rows].ravel()
+                applied = applied[applied >= 0]
+                if applied.size:
+                    counts = np.bincount(applied,
+                                         minlength=len(DEVICE_CODES))
+                    for mi in np.nonzero(counts)[0]:
+                        metrics.GLOBAL.record_mutator(
+                            DEVICE_CODES[mi], applied=True,
+                            n=int(counts[mi]))
+        except BaseException as e:  # lint: broad-except-ok shard losses become FleetShardLost for the rewind; the rest re-raise
+            # settle local futures the merge will never read; remote
+            # pendings die with their streams at the rewind
+            drain_futures(
+                f for _sh, _sl, _r, f in work.launched
+                if not isinstance(f, (_PendingRemote, _RemoteResult)))
+            if isinstance(e, RemoteShardError) or is_device_error(e):
+                raise FleetShardLost(shard_id, case_i, e) from e
+            raise
+        if work.host_slots:
+            tallies["oracle_cases"] += 1
+            parts.append(oracle_slots(case_i, ids, work.host_slots))
+        # schedule-hit bookkeeping lands HERE, not at the draw: a case's
+        # counts commit exactly when its merge does, so an attempt
+        # abandoned by a rewind leaves the weights untouched and the
+        # replayed draw reproduces the reference schedule. Ordering vs
+        # the single-device runner is unchanged — case N's counts are
+        # still applied before case N+1's draw (which waits on this
+        # merge), and before the checkpoint's store.save below.
+        sched_counts: dict[str, int] = {}
+        for sid in ids:
+            sched_counts[sid] = sched_counts.get(sid, 0) + 1
+        store.record_scheduled(sched_counts)
         results = merge_shard_results(parts)
         drain_s = time.perf_counter() - t_r
-        metrics.GLOBAL.record_stage("drain_wait", drain_s)
-        device_s = drain_s + (t_r - t_map)
+        metrics.GLOBAL.record_stage("remote_wait", drain_s)
+        device_s = drain_s + (t_r - work.t_map)
         metrics.GLOBAL.observe("batch_latency", device_s)
 
         t_h = time.perf_counter()
@@ -845,56 +1026,264 @@ def run_corpus_fleet(opts: dict, batch: int = 1024) -> int:
             ent = devsrc.get(slot)
             if ent is None:
                 return
-            shard_id, src, row = ent
+            src_shard, src, row = ent
             if (placement.owner_of(partition_of(sid_new, n_shards))
-                    == shard_id):
-                shards[shard_id].arena.enqueue_adopt(
+                    == src_shard):
+                shards[src_shard].arena.enqueue_adopt(
                     sid_new, len(payload), src, row)
 
-        with trace.span("fleet.hash", case=case):
+        with trace.span("fleet.hash", case=case_i):
             tallies["new_hashes"] += apply_novelty(
                 store, ids, results, seen_hashes, batch, tallies,
                 on_novel=on_novel if adopt_on else None)
         tallies["total"] += len(results)
         metrics.GLOBAL.record_stage("hash", time.perf_counter() - t_h)
         metrics.GLOBAL.record_batch(len(results),
-                                    tallies["bytes_out"] - before, device_s)
+                                    tallies["bytes_out"] - before,
+                                    device_s)
         if consume_feedback:
             credit = sorted(set(ids))
             for ev in bus.drain():
                 store.apply_event(ev, credit=credit)
                 logger.log("decision", "fleet: %s event from %s -> "
                            "energy feedback", ev.kind, ev.source or "?")
-        t_o = time.perf_counter()
-        with trace.span("fleet.write", case=case):
-            for slot in range(batch):
-                payload = results.get(slot, b"")
-                if writer is not None:
-                    writer(case * batch + slot, payload, [])
-                else:
-                    sys.stdout.buffer.write(payload)
-        metrics.GLOBAL.record_stage("write", time.perf_counter() - t_o)
-        if stats is not None:
-            stats.setdefault("finish_times", []).append(time.perf_counter())
-        if state_path and ((case + 1) % ckpt_every == 0
-                           or case + 1 == n_cases):
+
+        def write_outputs():
+            t_o = time.perf_counter()
+            with trace.span("fleet.write", case=case_i):
+                for slot in range(batch):
+                    payload = results.get(slot, b"")
+                    if writer is not None:
+                        writer(case_i * batch + slot, payload, [])
+                    else:
+                        sys.stdout.buffer.write(payload)
+            metrics.GLOBAL.record_stage("write",
+                                        time.perf_counter() - t_o)
+
+        if state_path and ((case_i + 1) % ckpt_every == 0
+                           or case_i + 1 == n_cases):
             # mirror the single-device finish_case ordering: this case's
             # outputs are written BEFORE the checkpoint marks it done (a
             # resumed run must not skip a case whose outputs never hit
             # disk), and the store snapshot follows so it contains this
             # case's adoptions when the checkpoint says they exist
-            with trace.span("fleet.checkpoint", case=case):
-                save_fleet_state(state_path, opts["seed"], case + 1,
+            write_outputs()
+            with trace.span("fleet.checkpoint", case=case_i):
+                save_fleet_state(state_path, opts["seed"], case_i + 1,
                                  scores, seen_hashes, store.energies(),
                                  placement.epoch, n_shards, classes)
                 store.save()
             metrics.GLOBAL.record_event("fleet_checkpoint")
-        case += 1
+            drain.mark_done(case_i)
+        else:
+            # merged state is final: release the main thread BEFORE the
+            # writes — file output of case N overlaps the schedule and
+            # dispatch of case N+1 (the r15 overlapped reduce)
+            drain.mark_done(case_i)
+            write_outputs()
+        reduce_busy[0] += time.perf_counter() - t_r
+        if stats is not None:
+            stats["finish_times"].append(time.perf_counter())
+
+    def discard_work(work):
+        """Abandoned-queue hook at a rewind: settle local futures so no
+        device work is stranded; remote pendings die with the streams
+        the rewind closes."""
+        drain_futures(
+            f for _sh, _sl, _r, f in work.launched
+            if not isinstance(f, (_PendingRemote, _RemoteResult)))
+
+    metrics.GLOBAL.record_fleet(placement.snapshot())
+    if stats is not None:
+        stats.setdefault("schedules", [])
+        stats.setdefault("finish_times", [])
+    counted: set[int] = set()   # cases whose run-once tallies already ran
+    reduce_busy = [0.0]         # drain-thread seconds inside the merge
+    waited = [0.0]              # main-thread seconds blocked on the drain
+    t0 = time.perf_counter()
+    probe_at = start_case
+    case = start_case
+    drain = _DrainWorker(process_case, start_case, discard=discard_work)
+    try:
+        while True:
+            try:
+                while case < n_cases:
+                    # -- re-admission probes (case-counter gated) ------
+                    if placement.dead() and case >= probe_at:
+                        probe_at = case + DEVICE_PROBE_EVERY
+                        for s in placement.dead():
+                            try_readmit(s, case)
+
+                    # the schedule is energy-weighted: case N+1 cannot
+                    # draw until case N's merge lands, so the pipeline
+                    # holds ONE case in flight — the window bounds sync
+                    # frequency, not speculation depth
+                    t_w = time.perf_counter()
+                    drain.wait_done(case - 1)
+                    w = time.perf_counter() - t_w
+                    waited[0] += w
+                    metrics.GLOBAL.record_stage("drain_wait", w)
+                    if w > 0.05:
+                        flight.GLOBAL.note("fleet_window_stall",
+                                           case=case, waited=round(w, 4))
+
+                    t_s = time.perf_counter()
+                    with trace.span("fleet.schedule", case=case):
+                        # record=False: schedule-hit counts decay future
+                        # draw weights, so they must land exactly once
+                        # per MERGED case — the drain's process_case
+                        # applies them. Recording here would let an
+                        # aborted attempt (rewind) inflate hits and skew
+                        # the replayed draw off the reference bytes.
+                        ids = sched.schedule(case, batch, record=False)
+                        samples = [store.get(sid) for sid in ids]
+                    metrics.GLOBAL.record_stage(
+                        "schedule", time.perf_counter() - t_s)
+                    if case not in counted:
+                        # a rewind replays cases: run-once tallies and
+                        # the schedule log count each case exactly once
+                        counted.add(case)
+                        if stats is not None:
+                            stats["schedules"].append(list(ids))
+                        trunc = sum(len(s) > trunc_cap for s in samples)
+                        if trunc:
+                            tallies["truncated"] += trunc
+                            metrics.GLOBAL.record_truncated(trunc)
+
+                    # -- map: partition slots by lease, dispatch -------
+                    by_shard: dict[int, list[int]] = {}
+                    host_slots: list[int] = []
+                    for slot, sid in enumerate(ids):
+                        owner = placement.owner_of(
+                            partition_of(sid, n_shards))
+                        if owner is None:
+                            host_slots.append(slot)
+                        else:
+                            by_shard.setdefault(owner, []).append(slot)
+                    pending = sorted(by_shard.items())
+                    # (shard_id, global slots, rows, fut) per entry
+                    launched: list[tuple[int, list[int], int,
+                                         object]] = []
+                    t_map = time.perf_counter()
+                    try:
+                        while pending:
+                            shard_id, slots = pending.pop(0)
+                            try:
+                                launched.extend(
+                                    (shard_id, *entry)
+                                    for entry in shard_dispatch(
+                                        shards[shard_id], case,
+                                        slots, ids, samples))
+                            except Exception as e:  # lint: broad-except-ok re-raised below unless a shard loss
+                                # a remote shard loss (timeout, protocol
+                                # error, or a FENCED stale reply) is the
+                                # cross-host spelling of a device error:
+                                # same revoke + in-case redispatch
+                                if not (is_device_error(e)
+                                        or isinstance(e,
+                                                      RemoteShardError)):
+                                    raise
+                                revoke_shard(shard_id, case, e)
+                                # the failed slice re-partitions onto
+                                # its new owners and re-dispatches
+                                # WITHIN this case — same global slot
+                                # indices, so the re-served bytes are
+                                # identical. Steps already fired at the
+                                # dead stream will never be answered:
+                                # sweep them into the requeue too
+                                tallies["redispatches"] += 1
+                                slots = list(slots)
+                                kept = []
+                                for ent in launched:
+                                    f = ent[3]
+                                    if (isinstance(f, _PendingRemote)
+                                            and not f.done
+                                            and not f.stream.connected):
+                                        slots.extend(ent[1])
+                                    else:
+                                        kept.append(ent)
+                                launched = kept
+                                requeue: dict[int, list[int]] = {}
+                                for slot in slots:
+                                    owner = placement.owner_of(
+                                        partition_of(ids[slot],
+                                                     n_shards))
+                                    if owner is None:
+                                        host_slots.append(slot)
+                                    else:
+                                        requeue.setdefault(
+                                            owner, []).append(slot)
+                                merged = dict(pending)
+                                for owner, sl in requeue.items():
+                                    merged[owner] = sorted(
+                                        merged.get(owner, []) + sl)
+                                pending = sorted(merged.items())
+                    except BaseException:  # lint: broad-except-ok re-raised after settling in-flight futures
+                        # a non-device error mid-map must not strand the
+                        # survivors' in-flight futures: settle the local
+                        # ones before unwinding (remote pendings die
+                        # with their streams)
+                        drain_futures(
+                            f for _sh, _sl, _r, f in launched
+                            if not isinstance(f, (_PendingRemote,
+                                                  _RemoteResult)))
+                        raise
+                    if host_slots:
+                        logger.log("warning", "fleet: no live shards at "
+                                   "case %d — host oracle serves %d "
+                                   "slot(s)", case, len(host_slots))
+
+                    # -- reduce: hand the case to the drain worker -----
+                    drain.submit(SimpleNamespace(
+                        case=case, ids=ids, launched=launched,
+                        host_slots=host_slots, t_map=t_map))
+                    if reduce_mode == "boundary":
+                        # --fleet-reduce boundary: the r14 lockstep —
+                        # every case fully merges before the next maps
+                        drain.wait_done(case)
+                    case += 1
+                drain.close()
+                break
+            except FleetShardLost as e:
+                # a dispatched reply was lost after its case left the
+                # map: the merged prefix is intact (merges run in case
+                # order), so revoke the shard, drop every stream, and
+                # replay from the first un-merged case. The replayed
+                # schedule draws identically — energies and scores only
+                # mutate at merges, and none landed past the rewind
+                # point — so the rewound run stays byte-identical.
+                redo = drain.done_case + 1
+                drain.abandon()
+                if placement.is_live(e.shard):
+                    revoke_shard(e.shard, e.case, e.cause)
+                for sh in shards.values():
+                    if isinstance(sh, _Remote):
+                        sh.stream.close()
+                tallies["rewinds"] += 1
+                metrics.GLOBAL.record_event("fleet_rewind")
+                flight.GLOBAL.note("fleet_rewind", shard=e.shard,
+                                   case=e.case, redo=redo)
+                logger.log("warning", "fleet: shard %d reply lost at "
+                           "case %d — rewinding pipeline to case %d",
+                           e.shard, e.case, redo)
+                drain = _DrainWorker(process_case, redo,
+                                     discard=discard_work)
+                case = redo
+    finally:
+        for sh in shards.values():
+            if isinstance(sh, _Remote):
+                sh.stream.close()
 
     store.save()
     dt = time.perf_counter() - t0
     metrics.GLOBAL.record_pipeline_wall(dt)
     metrics.GLOBAL.record_fleet(placement.snapshot())
+    # overlap ratio: fraction of the drain worker's merge time the main
+    # thread did NOT spend blocked waiting for it (1.0 = fully hidden)
+    reduce_overlap = (max(0.0, min(1.0, (reduce_busy[0] - waited[0])
+                                   / reduce_busy[0]))
+                      if reduce_busy[0] > 0 else 0.0)
+    metrics.GLOBAL.set_reduce_overlap(reduce_overlap)
     for shard in shards.values():
         if isinstance(shard, _Shard):
             metrics.GLOBAL.record_arena(shard.arena.stats())
@@ -914,6 +1303,11 @@ def run_corpus_fleet(opts: dict, batch: int = 1024) -> int:
                      oracle_cases=tallies["oracle_cases"],
                      redispatches=tallies["redispatches"],
                      offspring=tallies["offspring"],
+                     rewinds=tallies["rewinds"],
+                     transport=transport.snapshot(),
+                     fleet_window=fleet_window,
+                     reduce_mode=reduce_mode,
+                     reduce_overlap=round(reduce_overlap, 3),
                      step_shapes=sorted(step_shapes),
                      arenas={s: sh.arena.stats()
                              for s, sh in shards.items()

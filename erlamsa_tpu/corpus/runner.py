@@ -92,7 +92,13 @@ class _DrainWorker:
 
     The first exception raised by the process callback is captured and
     re-raised in the MAIN thread (from wait_done/close) — a dead drain
-    must fail the run, not silently stop consuming."""
+    must fail the run, not silently stop consuming.
+
+    Shared with the fleet coordinator (corpus/fleet.py), whose
+    overlapped reduce runs the whole per-case merge as the process
+    callback and rebuilds the worker at a rewind — the FIFO + in-order
+    mark_done contract is what keeps N-shard == 1-shard byte-identity
+    intact there."""
 
     def __init__(self, process, start_case: int, discard=None):
         self._process = process

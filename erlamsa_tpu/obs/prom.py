@@ -100,6 +100,11 @@ def render(counters: metrics.Counters | None = None) -> str:
     w.head("erlamsa_drain_backlog_peak", "gauge",
            "High-water mark of cases queued behind the drain worker.")
     w.sample("erlamsa_drain_backlog_peak", pipeline["drain_backlog_peak"])
+    w.head("erlamsa_fleet_reduce_overlap_ratio", "gauge",
+           "Fraction of the fleet merge hidden behind the next case's "
+           "map (1.0 = fully overlapped).")
+    w.sample("erlamsa_fleet_reduce_overlap_ratio",
+             pipeline.get("reduce_overlap", 0.0))
     w.head("erlamsa_stage_seconds_total", "counter",
            "Cumulative wall seconds per pipeline stage.")
     for stage, secs in pipeline["stages"].items():
@@ -254,6 +259,21 @@ def render(counters: metrics.Counters | None = None) -> str:
         for sid, lease in sorted(fleet["leases"].items()):
             w.sample("erlamsa_fleet_shard_live",
                      1 if lease["live"] else 0, {"shard": sid})
+
+    transport = snap.get("fleet_transport")
+    if transport and (transport["bytes_sent"] or transport["bytes_recv"]
+                      or transport["round_trips"]):
+        w.head("erlamsa_fleet_transport_bytes_total", "counter",
+               "Framed shard-stream bytes on the wire, by direction.")
+        w.sample("erlamsa_fleet_transport_bytes_total",
+                 transport["bytes_sent"], {"dir": "sent"})
+        w.sample("erlamsa_fleet_transport_bytes_total",
+                 transport["bytes_recv"], {"dir": "recv"})
+        w.head("erlamsa_fleet_round_trips_total", "counter",
+               "Awaited shard exchanges (lease, snapshot, probe, "
+               "window sync) — fire-and-forget steps excluded.")
+        w.sample("erlamsa_fleet_round_trips_total",
+                 transport["round_trips"])
 
     serving = snap.get("serving")
     if serving:

@@ -124,6 +124,14 @@ class FleetPlacement:
         remote step request carries and every reply must echo."""
         return self.lease_epoch[shard]
 
+    def lease_stamp(self, shard: int) -> dict:
+        """Fencing stamp for artifacts minted under `shard`'s current
+        lease — warm-start arena snapshots carry this so a receiver can
+        check the image against its OWN lease before installing it
+        (corpus/arena.ArenaSnapshot): a zombie's stale snapshot fails
+        the epoch match and is rejected, never restored."""
+        return {"shard": int(shard), "epoch": self.lease_epoch[shard]}
+
     def restore(self, epoch: int) -> int:
         """Resume from a fleet checkpoint: continue the fencing sequence
         PAST the checkpointed epoch. Every lease is re-granted at

@@ -65,7 +65,17 @@ Known sites (grep `fault_point(` for the authoritative list):
                      redispatch on survivors, outputs unchanged
     dist.shard.recv  fleet-worker shard-protocol reply read
                      (services/dist.py): same revoke/redispatch
-                     contract as dist.shard.send
+                     contract as dist.shard.send — on a framed stream
+                     a lost reply after dispatch rewinds the pipeline
+                     to the first un-merged case instead
+    dist.shard.frame shard frame encode/decode on the framed stream
+                     (services/dist.py): an injected fault poisons the
+                     codec before any bytes hit the wire — same remote
+                     shard-loss contract as dist.shard.send
+    fleet.snapshot   arena warm-start snapshot build/ship at lease or
+                     re-admission (corpus/fleet.py): an injected fault
+                     skips the warm start — the shard degrades to lazy
+                     per-case seed upload, outputs unchanged
     fleet.checkpoint the fleet coordinator's --state checkpoint write
                      (services/checkpoint.py save_fleet_state): an
                      injected fault degrades to a warning — the run

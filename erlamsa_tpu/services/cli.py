@@ -261,6 +261,17 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--fleet-worker", type=int, default=None, metavar="PORT",
                    help="serve fleet shard leases on PORT (the worker "
                         "half of --fleet-nodes) and block")
+    p.add_argument("--fleet-window", type=int, default=1, metavar="W",
+                   help="framed shard-stream window: steps in flight "
+                        "per remote shard between sync barriers (default "
+                        "1 = a barrier every case; 8 amortizes the "
+                        "round trip 8x with identical output bytes)")
+    p.add_argument("--fleet-reduce", choices=("overlap", "boundary"),
+                   default="overlap",
+                   help="where the fleet merge runs: 'overlap' (default) "
+                        "folds case N's reduce into the drain worker "
+                        "while case N+1 maps; 'boundary' is the lockstep "
+                        "fallback — both are byte-identical")
     p.add_argument("--node", default=None, help="join a parent node host:port")
     p.add_argument("--svcport", type=int, default=17771,
                    help="distribution/control port")
@@ -420,6 +431,8 @@ def main(argv=None) -> int:
         "shards": args.shards,
         "fleet_nodes": ([s for s in args.fleet_nodes.split(",") if s]
                         if args.fleet_nodes else None),
+        "fleet_window": args.fleet_window,
+        "fleet_reduce": args.fleet_reduce,
         "arena_pages": args.arena_pages,
         "arena_page": args.arena_page,
         "arena_classes": args.arena_classes,

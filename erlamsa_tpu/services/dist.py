@@ -23,6 +23,32 @@ with a shard-lease handshake so a fleet shard can live on another host:
     {"op": "shard_revoke", "shard": i, "epoch": e}         -> shard_revoked
     {"op": "shard_probe"}                                  -> shard_alive
 
+Framed shard streams (r15): the fleet's data plane no longer rides
+JSON-lines. A coordinator opens ONE persistent connection per remote
+shard and speaks length-prefixed binary frames (FRAME_MAGIC + header
+length + blob length + JSON header + raw byte panel — no base64, no
+per-case connect). The listener sniffs the first byte: FRAME_MAGIC
+can never begin a JSON line, so framed streams and legacy JSON peers
+share one port (RemoteShard, the JSON client, stays for compatibility
+and tests). Framed ops extend the lease protocol:
+
+    shard_step      header carries slots/sids/scores + inline seed
+                    payloads in the frame blob; seeds covered by the
+                    lease's warm-start snapshot ship by id only
+    shard_snapshot  arena warm-start image for the shard's partitions
+                    (page payloads in the blob, crc32 + lease epoch in
+                    the header) — cached in the lease entry, fenced
+                    like any step
+    shard_sync      window barrier: the only awaited exchange on the
+                    steady-state step path — the coordinator writes
+                    step frames fire-and-forget and syncs every
+                    --fleet-window cases, so round trips amortize W x
+
+Frames on one stream are processed strictly in arrival order and
+replies come back FIFO, which is what lets the coordinator's reduce
+thread consume step results while the dispatch thread writes the next
+case's frames on the same socket (one writer, one reader per stream).
+
 Leases carry a monotonically increasing **fencing epoch** (the
 FleetPlacement migration epoch, parallel/shards.py). The worker rejects
 any step whose epoch is not its current lease (`shard_fenced`), and the
@@ -56,8 +82,10 @@ import functools
 import json
 import random as _pyrandom
 import socket
+import struct
 import threading
 import time
+import zlib
 
 from ..constants import NODE_ALIVE_DELTA, NODE_KEEPALIVE, NODES_CHECKTIMER
 from ..obs import flight, trace
@@ -125,6 +153,115 @@ def _recv_shard_json(f) -> dict | None:
     if len(line) > MAX_LINE:
         raise ValueError("oversized protocol line")
     return json.loads(line)
+
+
+# -- framed shard streams (r15) ------------------------------------------
+
+#: first byte 0x8f can never start a JSON line, so the listener sniffs
+#: one byte to route a connection to the framed or the JSON-lines loop
+FRAME_MAGIC = b"\x8fEF1"
+_FRAME_HDR = struct.Struct("<II")  # header_len, blob_len
+#: raw byte panels (seed payloads, outputs, snapshot pages) ride the
+#: frame blob un-encoded; 1 GiB is far past any legitimate batch slice
+MAX_FRAME = 1 << 30
+
+
+def _pack_frame(header: dict, blob: bytes = b"") -> bytes:
+    """Encode one frame: MAGIC + (header_len, blob_len) + JSON header +
+    raw blob. Pure — the fault sites live on the send/recv wrappers."""
+    hdr = json.dumps(header, separators=(",", ":")).encode()
+    if len(hdr) > MAX_LINE or len(blob) > MAX_FRAME:
+        raise ValueError("oversized frame")
+    return b"".join((FRAME_MAGIC, _FRAME_HDR.pack(len(hdr), len(blob)),
+                     hdr, blob))
+
+
+def _read_frame(f) -> tuple[dict, bytes] | None:
+    """Read one frame from a buffered reader; None on clean EOF. The
+    reader's read(n) loops internally, so a short result outside EOF is
+    impossible; any malformed prefix raises ValueError (a garbling peer
+    is an error, never a hang)."""
+    want = len(FRAME_MAGIC) + _FRAME_HDR.size
+    head = f.read(want)
+    if not head:
+        return None
+    if len(head) < want or head[:len(FRAME_MAGIC)] != FRAME_MAGIC:
+        raise ValueError("malformed frame header")
+    hlen, blen = _FRAME_HDR.unpack(head[len(FRAME_MAGIC):])
+    if hlen > MAX_LINE or blen > MAX_FRAME:
+        raise ValueError("oversized frame")
+    hdr = f.read(hlen)
+    blob = f.read(blen)
+    if len(hdr) < hlen or len(blob) < blen:
+        raise ValueError("truncated frame")
+    return json.loads(hdr), blob
+
+
+def _shard_frame_send(sock: socket.socket, header: dict,
+                      blob: bytes = b"") -> int:
+    """Coordinator -> worker framed transmission. Two fault sites:
+    dist.shard.frame (the codec — a frame-level fault reads as a shard
+    loss exactly like a wire fault) and dist.shard.send (the wire, the
+    same site the legacy JSON client fires). Returns bytes written."""
+    chaos.fault_point("dist.shard.frame")
+    payload = _pack_frame(header, blob)
+    chaos.fault_point("dist.shard.send")
+    sock.sendall(payload)
+    return len(payload)
+
+
+def _shard_frame_recv(f) -> tuple[dict, bytes] | None:
+    """Coordinator-side framed reply read (fault site dist.shard.recv,
+    shared with the legacy JSON client)."""
+    chaos.fault_point("dist.shard.recv")
+    return _read_frame(f)
+
+
+def _node_frame_send(sock: socket.socket, header: dict,
+                     blob: bytes = b"") -> int:
+    """Worker-side framed reply — fires dist.send like the legacy
+    _send_json reply path, NOT the coordinator's dist.shard.* sites, so
+    a dist.shard.* chaos spec keeps meaning 'the coordinator's view of
+    the wire' with per-invocation counters the r14 tests rely on."""
+    chaos.fault_point("dist.send")
+    payload = _pack_frame(header, blob)
+    sock.sendall(payload)
+    return len(payload)
+
+
+def _node_frame_recv(f) -> tuple[dict, bytes] | None:
+    """Worker-side frame read (site dist.recv, like _recv_json)."""
+    chaos.fault_point("dist.recv")
+    return _read_frame(f)
+
+
+class TransportTally:
+    """Thread-safe per-campaign transport accounting, shared by every
+    ShardStream of one fleet run: raw frame bytes by direction plus
+    AWAITED round trips (lease / snapshot / probe / revoke / window
+    sync — fire-and-forget step frames are pipelined data flow, not
+    round trips). Mirrors into metrics.GLOBAL.record_transport for
+    /metrics."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.bytes_sent = 0
+        self.bytes_recv = 0
+        self.round_trips = 0
+
+    def add(self, sent: int = 0, recv: int = 0, round_trips: int = 0):
+        with self._lock:
+            self.bytes_sent += int(sent)
+            self.bytes_recv += int(recv)
+            self.round_trips += int(round_trips)
+        metrics.GLOBAL.record_transport(sent=sent, recv=recv,
+                                        round_trips=round_trips)
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {"bytes_sent": self.bytes_sent,
+                    "bytes_recv": self.bytes_recv,
+                    "round_trips": self.round_trips}
 
 
 def validate_shard_reply(resp: dict | None, shard: int, epoch: int | None,
@@ -274,6 +411,127 @@ class RemoteShard:
                 [tuple(sh) for sh in resp.get("shapes", [])])
 
 
+class ShardStream:
+    """Persistent framed connection to one remote shard (r15). Unlike
+    RemoteShard (one connect per call), a stream amortizes the TCP setup
+    across the lease's whole lifetime and supports the window protocol:
+    `send` is fire-and-forget (the coordinator's dispatch thread writes
+    step frames without waiting), `read_reply` consumes the FIFO reply
+    stream (the reduce thread), and `request` is the awaited pair for
+    lease / snapshot / probe / sync — the only calls counted as round
+    trips. One writer + one reader per stream; `_wlock` serializes
+    writers, reads are owned by whichever thread drains that shard's
+    replies. Any transport or protocol failure closes the stream and
+    raises RemoteShardError (StaleEpochError for fencing verdicts) into
+    the fleet's revoke/redispatch path; a closed stream reconnects
+    lazily on the next send."""
+
+    def __init__(self, shard_id: int, host: str, port: int,
+                 timeout: float = 90.0, token: str = "",
+                 tally: TransportTally | None = None):
+        self.id = int(shard_id)
+        self.host = host
+        self.port = int(port)
+        self.timeout = timeout
+        self.token = token
+        self.tally = tally
+        self._sock: socket.socket | None = None
+        self._rfile = None
+        self._wlock = threading.Lock()
+        #: step frames written since the last acknowledged window sync —
+        #: bumped by the dispatcher after each fire-and-forget step,
+        #: reset when the sync ack is consumed; the coordinator reads it
+        #: to decide when the window is full
+        self.unsynced = 0
+
+    def endpoint(self) -> str:
+        return f"{self.host}:{self.port}"
+
+    @property
+    def connected(self) -> bool:
+        return self._sock is not None
+
+    def _connect(self):
+        sock = socket.create_connection((self.host, self.port),
+                                        timeout=self.timeout)
+        self._sock = sock
+        self._rfile = sock.makefile("rb")
+
+    def send(self, header: dict, blob: bytes = b""):
+        """Fire-and-forget frame write. Does NOT wait for a reply — the
+        matching reply arrives on the FIFO stream and is consumed by a
+        later recv. The campaign token is stamped in here so callers
+        build headers with only op-specific fields."""
+        header.setdefault("token", self.token)
+        try:
+            with self._wlock:
+                if self._sock is None:
+                    self._connect()
+                n = _shard_frame_send(self._sock, header, blob)
+        except StaleEpochError:
+            raise
+        except (OSError, ValueError) as e:
+            self.close()
+            raise RemoteShardError(
+                f"shard {self.id} @{self.endpoint()}: {e}") from e
+        if self.tally is not None:
+            self.tally.add(sent=n)
+
+    def read_reply(self, expect: str, epoch: int | None,
+                   case: int | None = None,
+                   timeout: float | None = None) -> tuple[dict, bytes]:
+        """Consume the next FIFO reply frame and fence-validate it
+        against (expect, epoch, case). Reader-thread only."""
+        if self._sock is None:
+            raise RemoteShardError(
+                f"shard {self.id} @{self.endpoint()}: stream closed")
+        tmo = self.timeout if timeout is None else timeout
+        try:
+            self._sock.settimeout(tmo)
+            got = _shard_frame_recv(self._rfile)
+        except StaleEpochError:
+            raise
+        except (OSError, ValueError) as e:
+            self.close()
+            raise RemoteShardError(
+                f"shard {self.id} @{self.endpoint()}: {e}") from e
+        if got is None:
+            self.close()
+            raise RemoteShardError(
+                f"shard {self.id} @{self.endpoint()}: peer closed "
+                "mid-stream")
+        header, blob = got
+        if self.tally is not None:
+            # exact: the worker packs replies with the same compact
+            # separators, so re-encoding reproduces the wire length
+            hlen = len(json.dumps(header,
+                                  separators=(",", ":")).encode())
+            self.tally.add(recv=len(FRAME_MAGIC) + _FRAME_HDR.size
+                           + hlen + len(blob))
+        validate_shard_reply(header, self.id, epoch, expect, case=case)
+        return header, blob
+
+    def request(self, header: dict, blob: bytes = b"", *, expect: str,
+                timeout: float | None = None) -> tuple[dict, bytes]:
+        """Awaited send+recv pair — a genuine round trip (lease,
+        snapshot, probe, revoke, window sync)."""
+        self.send(header, blob)
+        out = self.read_reply(expect, header.get("epoch"),
+                              case=header.get("case"), timeout=timeout)
+        if self.tally is not None:
+            self.tally.add(round_trips=1)
+        return out
+
+    def close(self):
+        self.unsynced = 0
+        sock, self._sock, self._rfile = self._sock, None, None
+        if sock is not None:
+            try:
+                sock.close()
+            except OSError:
+                pass
+
+
 class ShardHost:
     """Worker-side half of the lease handshake: the lease table plus the
     stateless slice executor. A lease pins (epoch, step config) for a
@@ -377,6 +635,140 @@ class ShardHost:
         return {"op": "shard_error", "shard": shard, "epoch": epoch,
                 "error": f"unknown shard op {op!r}"}
 
+    # -- framed ops (r15) ------------------------------------------------
+
+    def _check_lease(self, shard: int, epoch: int,
+                     token: str) -> tuple[dict | None, dict | None]:
+        """Framed-path fencing gate: (lease, None) when (epoch, token)
+        match the current lease, (None, shard_fenced header) otherwise.
+        Same verdict the JSON shard_step path produces."""
+        with self._lock:
+            lease = self._leases.get(shard)
+        if (lease is None or epoch != lease["epoch"]
+                or token != lease["token"]):
+            have = lease["epoch"] if lease else -1
+            metrics.GLOBAL.record_event("shard_fenced")
+            logger.log("warning", "shard host: fenced stale frame for "
+                       "shard %d (epoch %d, lease %d)", shard, epoch, have)
+            return None, {"op": "shard_fenced", "shard": shard,
+                          "got": epoch, "have": have}
+        return lease, None
+
+    def handle_frame(self, header: dict,
+                     blob: bytes) -> tuple[dict, bytes]:
+        """Framed-op dispatch: the binary-stream twin of handle().
+        shard_step / shard_snapshot / shard_sync are frame-native;
+        everything else (lease, revoke, probe) reuses the JSON handler
+        with an empty reply blob, so both transports share one lease
+        table and one fencing discipline."""
+        op = header.get("op")
+        if op == "shard_step":
+            return self._step_framed(header, blob)
+        if op == "shard_snapshot":
+            return self._snapshot_framed(header, blob)
+        if op == "shard_sync":
+            shard = int(header.get("shard", -1))
+            epoch = int(header.get("epoch", -1))
+            _, fenced = self._check_lease(shard, epoch,
+                                          str(header.get("token", "")))
+            if fenced is not None:
+                return fenced, b""
+            return ({"op": "shard_synced", "shard": shard, "epoch": epoch,
+                     "case": int(header.get("case", -1))}, b"")
+        return self.handle(header), b""
+
+    def _step_framed(self, header: dict,
+                     blob: bytes) -> tuple[dict, bytes]:
+        """Framed shard_step: slots/sids/scores in the header, inline
+        seed payloads packed back-to-back in the blob; sids absent from
+        the inline set resolve against the lease's warm-start snapshot.
+        Outputs return as raw concatenated bytes with a lens table —
+        no base64 in either direction."""
+        shard = int(header.get("shard", -1))
+        epoch = int(header.get("epoch", -1))
+        lease, fenced = self._check_lease(shard, epoch,
+                                          str(header.get("token", "")))
+        if fenced is not None:
+            return fenced, b""
+        cfg = lease["cfg"]
+        case = int(header.get("case", 0))
+        slots = [int(s) for s in header.get("slots", [])]
+        try:
+            inline: dict[str, bytes] = {}
+            off = 0
+            for sid, ln in zip(header.get("inline_sids", []),
+                               [int(x) for x in
+                                header.get("inline_lens", [])]):
+                inline[str(sid)] = blob[off:off + ln]
+                off += ln
+            snap = lease.get("snap", {})
+            payloads = []
+            for sid in header.get("sids", []):
+                p = inline.get(str(sid))
+                if p is None:
+                    p = snap.get(str(sid))
+                if p is None:
+                    return ({"op": "shard_error", "shard": shard,
+                             "epoch": epoch,
+                             "error": f"seed {sid} not resident "
+                                      "(no inline payload, not in "
+                                      "snapshot)"}, b"")
+                payloads.append(p)
+            from ..corpus.fleet import run_remote_slice
+
+            outs, sc_out, applied, shapes = run_remote_slice(
+                tuple(cfg["seed"]), case, int(cfg["batch"]), slots,
+                payloads, header.get("scores", []), cfg["pri"],
+                cfg["classes"], int(cfg["device_max"]))
+        except Exception as e:  # lint: broad-except-ok a worker device failure becomes a protocol-level shard_error the coordinator revokes on, not a dead stream thread
+            logger.log("warning", "shard host: framed step failed "
+                       "shard=%d case=%d: %s", shard, case, e)
+            return ({"op": "shard_error", "shard": shard, "epoch": epoch,
+                     "error": str(e)[:200]}, b"")
+        return ({
+            "op": "shard_result", "shard": shard, "epoch": epoch,
+            "case": case, "lens": [len(o) for o in outs],
+            "scores": [[int(x) for x in row] for row in sc_out],
+            "applied": [[int(x) for x in row] for row in applied],
+            "shapes": [list(sh) for sh in shapes],
+        }, b"".join(outs))
+
+    def _snapshot_framed(self, header: dict,
+                         blob: bytes) -> tuple[dict, bytes]:
+        """Install an arena warm-start snapshot into the lease: the blob
+        carries page-padded payloads, the header their sids/lens, the
+        page size, and a crc32 over the blob. Fenced like any step (the
+        epoch stamp is what stops a zombie restore from serving a stale
+        partition), and crc-checked so a corrupt image is rejected
+        rather than silently served."""
+        shard = int(header.get("shard", -1))
+        epoch = int(header.get("epoch", -1))
+        lease, fenced = self._check_lease(shard, epoch,
+                                          str(header.get("token", "")))
+        if fenced is not None:
+            return fenced, b""
+        want_crc = int(header.get("crc", -1)) & 0xFFFFFFFF
+        if zlib.crc32(blob) & 0xFFFFFFFF != want_crc:
+            metrics.GLOBAL.record_event("snapshot_crc_rejected")
+            logger.log("warning", "shard host: snapshot crc mismatch "
+                       "shard=%d epoch=%d — rejected", shard, epoch)
+            return ({"op": "shard_error", "shard": shard, "epoch": epoch,
+                     "error": "snapshot crc mismatch"}, b"")
+        page = max(1, int(header.get("page", 1)))
+        snap: dict[str, bytes] = {}
+        off = 0
+        for sid, ln in zip(header.get("sids", []),
+                           [int(x) for x in header.get("lens", [])]):
+            snap[str(sid)] = blob[off:off + ln]
+            off += max(1, -(-ln // page)) * page
+        with self._lock:
+            if self._leases.get(shard) is lease:
+                lease["snap"] = snap
+        logger.log("info", "shard host: snapshot installed shard=%d "
+                   "epoch=%d seeds=%d", shard, epoch, len(snap))
+        return ({"op": "shard_snapshotted", "shard": shard,
+                 "epoch": epoch, "count": len(snap)}, b"")
+
 
 # per-node request retry: short, bounded — failover to ANOTHER node beats
 # hammering a sick one (the reference just picks a random node per call)
@@ -445,6 +837,12 @@ class ParentServer:
     def _handle(self, conn: socket.socket, addr):
         f = conn.makefile("rb")
         try:
+            # one-byte sniff routes the connection: FRAME_MAGIC's first
+            # byte (0x8f) can never begin a JSON line, so framed fleet
+            # streams and legacy JSON peers share this listener
+            if f.peek(1)[:1] == FRAME_MAGIC[:1]:
+                self._handle_frames(conn, f)
+                return
             while True:
                 msg = _recv_json(f)
                 if msg is None:
@@ -468,6 +866,19 @@ class ParentServer:
                        addr[0], addr[1], e)
         finally:
             conn.close()
+
+    def _handle_frames(self, conn: socket.socket, f):
+        """Framed shard-stream loop: strict FIFO request -> reply on one
+        persistent connection (the ordering ShardStream's one-writer /
+        one-reader split depends on). Runs until clean EOF; transport
+        and codec failures ride _handle's logged-drop path."""
+        while True:
+            got = _node_frame_recv(f)
+            if got is None:
+                return
+            header, blob = got
+            reply, rblob = self.shards.handle_frame(header, blob)
+            _node_frame_send(conn, reply, rblob)
 
     def route_fuzz(self, data: bytes, timeout: float = 90.0) -> bytes:
         """Route one request: up to MAX_FAILOVER_NODES distinct healthy
@@ -611,9 +1022,10 @@ def run_node(host: str, port: int, opts: dict) -> int:
 def run_shard_worker(port: int, opts: dict) -> int:
     """`--fleet-worker PORT`: serve fleet shard leases on this host. A
     plain ParentServer — the shard protocol rides the same listener as
-    join/fuzz, so one process can serve both roles; the ShardHost keeps
+    join/fuzz (framed streams AND legacy JSON, routed by first-byte
+    sniff), so one process can serve both roles; the ShardHost keeps
     the lease table and the compute is rebuilt per step from the shipped
-    request (stateless worker: a restart costs a re-lease, nothing
-    else)."""
+    request (stateless worker: a restart costs a re-lease plus a
+    snapshot re-ship, nothing else)."""
     logger.log("info", "fleet shard worker on :%d", port)
     return ParentServer(port, opts).serve(block=True)

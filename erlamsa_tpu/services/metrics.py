@@ -102,6 +102,16 @@ class Counters:
         self.host_routed: dict[str, int] = {}
         self.host_samples = 0
         self.routed_samples = 0
+        # fleet transport accounting (services/dist.TransportTally
+        # mirrors in here): raw frame bytes by direction plus awaited
+        # round trips — the erlamsa_fleet_transport_bytes_total{dir}
+        # and erlamsa_fleet_round_trips_total counters in /metrics
+        self.transport = {"bytes_sent": 0, "bytes_recv": 0,
+                          "round_trips": 0}
+        # reduce-overlap ratio (corpus/fleet.py): fraction of the
+        # host-side merge hidden behind remote shard compute —
+        # gauge-style, set not summed
+        self.reduce_overlap = 0.0
         # admission-control sheds by reason (queue_full/quota/chaos) —
         # the faas_rejected_total counter in /metrics
         self.rejected: dict[str, int] = {}
@@ -212,6 +222,21 @@ class Counters:
             t["served"] += served
             t["rejected"] += rejected
 
+    def record_transport(self, sent: int = 0, recv: int = 0,
+                         round_trips: int = 0):
+        """Fleet transport deltas (framed shard streams): raw wire bytes
+        by direction, plus awaited round trips."""
+        with self._lock:
+            self.transport["bytes_sent"] += int(sent)
+            self.transport["bytes_recv"] += int(recv)
+            self.transport["round_trips"] += int(round_trips)
+
+    def set_reduce_overlap(self, ratio: float):
+        """Fraction of the fleet's host-side merge hidden behind shard
+        compute (0 = fully serialized, 1 = fully overlapped)."""
+        with self._lock:
+            self.reduce_overlap = float(ratio)
+
     def record_stage(self, name: str, seconds: float):
         """Accumulate wall time for one pipeline stage (schedule, assemble,
         dispatch, drain_wait, hash, write, ...)."""
@@ -275,6 +300,7 @@ class Counters:
                     max(0.0, 1.0 - dev_busy / self.pipeline_wall), 3
                 ) if self.pipeline_wall else 0.0,
                 "drain_backlog_peak": self.drain_backlog_peak,
+                "reduce_overlap": round(self.reduce_overlap, 3),
             }
             resilience = {
                 "degraded": self.degraded,
@@ -327,6 +353,7 @@ class Counters:
                 "truncated": self.truncated,
                 "arena": dict(self.arena) if self.arena else None,
                 "fleet": dict(self.fleet) if self.fleet else None,
+                "fleet_transport": dict(self.transport),
                 "serving": dict(self.serving) if self.serving else None,
                 "rejected": dict(self.rejected),
                 "tenants": {t: dict(v)

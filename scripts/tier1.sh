@@ -40,16 +40,17 @@
 # kill redistributed within the case (no host-oracle fallback), and the
 # revoke/readmit migrations landed in the run stats.
 #
-# scripts/tier1.sh --dist-fleet-smoke additionally runs the r14
-# cross-host fleet end to end on loopback: two shard workers
-# (services/dist.run_shard_worker) serve a 2-shard remote campaign that
-# must be byte-identical to the all-local run at the same seed; one
-# worker is killed mid-campaign (the lease revokes, the slice
-# redispatches to the survivor within the case); then a checkpointed
-# campaign is "killed" at the coordinator half-way and resumed from
-# --state — the final output stream and corpus store must be
-# byte-identical to the uninterrupted run (corpus/fleet.py,
-# services/checkpoint.py).
+# scripts/tier1.sh --dist-fleet-smoke additionally runs the cross-host
+# fleet end to end on loopback: two shard workers
+# (services/dist.run_shard_worker) serve a 2-shard remote campaign over
+# framed streams that must be byte-identical to the all-local run at
+# the same seed; one worker is killed mid-campaign (the lease revokes,
+# the slice redispatches to the survivor within the case); a
+# checkpointed campaign is "killed" at the coordinator half-way and
+# resumed from --state; and the same campaign re-runs at
+# --fleet-window 4 — still byte-identical, with the awaited round
+# trips bounded by shards*(ceil(cases/W)+3) (corpus/fleet.py,
+# services/dist.py, services/checkpoint.py).
 #
 # scripts/tier1.sh --serve-smoke additionally boots the faas server
 # with the continuous-batching engine (services/serving.py), checks one
@@ -378,7 +379,7 @@ EOF2
 fi
 
 if [ $rc -eq 0 ] && [ $dist_fleet_smoke -eq 1 ]; then
-  echo "== dist fleet smoke: remote==local identity, worker kill, resume =="
+  echo "== dist fleet smoke: remote==local identity, worker kill, resume, framed window =="
   timeout -k 10 600 env JAX_PLATFORMS=cpu python - <<'EOF2'
 import os, shutil, sys, tempfile
 
@@ -390,7 +391,8 @@ SEED = (7, 7, 7)
 SEEDS = [bytes([65 + i]) * (30 * (i + 1)) for i in range(6)]
 
 
-def one_run(root, tag, n, shards=None, nodes=None, spec=None, state=False):
+def one_run(root, tag, n, shards=None, nodes=None, spec=None, state=False,
+            window=1):
     chaos.configure(spec, seed=SEED[0])
     outdir = os.path.join(root, f"out-{tag}")
     os.makedirs(outdir, exist_ok=True)
@@ -403,6 +405,7 @@ def one_run(root, tag, n, shards=None, nodes=None, spec=None, state=False):
         "output": os.path.join(outdir, "%n.out"),
         "shards": shards,
         "fleet_nodes": nodes,
+        "fleet_window": window,
         "_stats": stats,
     }
     if state:
@@ -437,21 +440,32 @@ try:
     rc4, _, _, _ = one_run(root, "res", 2, nodes=nodes, state=True)
     rc5, blob5, store5, st5 = one_run(root, "res", 4, nodes=nodes,
                                       state=True)
+    # framed window (r15): same campaign at --fleet-window 4 — output
+    # must stay byte-identical while the awaited exchanges collapse to
+    # lease + snapshot + one sync per window (<= shards*(ceil(n/W)+3))
+    rc6, blob6, store6, st6 = one_run(root, "win", 4, nodes=nodes,
+                                      window=4)
 finally:
     srv1.stop()
     srv2.stop()
     shutil.rmtree(root, ignore_errors=True)
 kinds = [m["kind"] for m in st3["migrations"]]
-ok = (rc1 == rc2 == rc3 == rc4 == rc5 == 0 and blob1
+rt6 = st6.get("transport", {}).get("round_trips", 1 << 30)
+rt_bound = st6["shards"] * (-(-4 // 4) + 3)
+ok = (rc1 == rc2 == rc3 == rc4 == rc5 == rc6 == 0 and blob1
       and st2["remote_shards"] == 2
       and blob2 == blob1 and store2 == store1
       and blob3 == blob1 and store3 == store1
       and st3["redispatches"] >= 1 and kinds[:1] == ["revoke"]
       and st5["start_case"] == 2
-      and blob5 == blob1 and store5 == store1)
+      and blob5 == blob1 and store5 == store1
+      and blob6 == blob1 and store6 == store1
+      and rt6 <= rt_bound)
 print(f"DIST_FLEET_SMOKE={'ok' if ok else 'FAIL'} bytes={len(blob1)} "
       f"identical_remote={blob2 == blob1} identical_kill={blob3 == blob1} "
       f"identical_resume={blob5 == blob1} store_resume={store5 == store1} "
+      f"identical_window={blob6 == blob1} "
+      f"round_trips={rt6}<=bound={rt_bound} "
       f"migrations={kinds} redispatches={st3['redispatches']} "
       f"start_case={st5.get('start_case')}")
 sys.exit(0 if ok else 1)

@@ -738,3 +738,65 @@ def test_runner_arena_eviction_pressure_transparent(tmp_path):
         _ONE_CLASS_SEEDS, arena_pages=RESERVED_PAGES + 2)
     assert outs_tiny == outs_big
     assert (st_tiny["arena"]["evictions"] + st_tiny["arena"]["spills"]) > 0
+
+
+# ---- warm-start snapshots (r15) ----------------------------------------
+
+
+def test_build_arena_snapshot_layout_truncation_crc():
+    import zlib
+
+    from erlamsa_tpu.corpus.arena import build_arena_snapshot
+
+    data = {"aa": b"x" * 5, "bb": b"y" * 20, "cc": b"z" * 64}
+    snap = build_arena_snapshot(data.__getitem__, ["aa", "bb", "cc"],
+                                classes=(16, 32), page=8, epoch=3,
+                                token="t" * 8)
+    assert snap.sids == ("aa", "bb", "cc")
+    # payloads clamp at the TOP class — the same truncation ensure()
+    # applies at admission, so a restore reproduces admission exactly
+    assert snap.lens == (5, 20, 32)
+    assert snap.cls_map == (0, 1, 1)
+    # consecutive zero-padded page runs in sid order: 1 + 3 + 4 pages
+    assert snap.pages.shape == (8, 8)
+    assert bytes(snap.pages[0]) == b"x" * 5 + b"\x00" * 3
+    assert snap.page == 8 and snap.epoch == 3 and snap.token == "t" * 8
+    assert snap.crc == zlib.crc32(snap.pages.tobytes()) & 0xFFFFFFFF
+    # empty partition still snapshots (a lease over no seeds)
+    empty = build_arena_snapshot(data.__getitem__, [], classes=(16,),
+                                 page=8)
+    assert empty.pages.shape == (0, 8) and empty.sids == ()
+
+
+def test_arena_restore_snapshot_roundtrip_and_rejects():
+    from erlamsa_tpu.corpus.arena import build_arena_snapshot
+
+    seeds = {"aa": b"A" * 5, "bb": b"B" * 13, "cc": b"C" * 30}
+    snap = build_arena_snapshot(seeds.__getitem__, list(seeds),
+                                classes=(16, 32), page=8, epoch=1)
+    ar = DeviceArena(num_pages=64, page=8, classes=(16, 32), donate=False)
+    assert ar.restore_snapshot(snap, tick=0) == 3
+    # restored seeds are resident: re-admission uploads nothing new
+    before = ar.uploads
+    for sid, data in seeds.items():
+        ar.ensure(sid, data, tick=1)
+    ar.flush()
+    assert ar.uploads == before
+    # and gathers reproduce the original bytes through the page table
+    sids = list(seeds)
+    groups = ar.tables_for(sids, [seeds[s] for s in sids], tick=2)
+    got: dict[str, bytes] = {}
+    for g in groups:
+        panel = np.asarray(ar.gather(g.table))
+        for j, r in enumerate(g.rows):
+            sid = sids[int(r)]
+            got[sid] = bytes(panel[j][: int(g.lens[j])])
+    assert got == seeds
+    # wrong page geometry and corrupt images are rejected loudly
+    ar2 = DeviceArena(num_pages=64, page=16, classes=(16, 32),
+                      donate=False)
+    with pytest.raises(ValueError, match="page size"):
+        ar2.restore_snapshot(snap, tick=0)
+    tampered = snap._replace(crc=(snap.crc ^ 1))
+    with pytest.raises(ValueError, match="crc"):
+        ar.restore_snapshot(tampered, tick=0)

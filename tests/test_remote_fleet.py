@@ -538,3 +538,219 @@ def test_remote_worker_loss_redispatches_within_case(tmp_path):
     finally:
         srv1.stop()
         srv2.stop()
+
+
+# ---- framed streams (r15): codec, fencing, snapshots, windows -----------
+
+
+def test_frame_codec_roundtrip_and_errors():
+    import io
+
+    from erlamsa_tpu.services.dist import (FRAME_MAGIC, _pack_frame,
+                                           _read_frame)
+
+    blob = bytes(range(256)) * 3
+    wire = _pack_frame({"op": "shard_step", "slots": [1, 2]}, blob)
+    assert wire.startswith(FRAME_MAGIC)
+    header, got = _read_frame(io.BytesIO(wire))
+    assert header["op"] == "shard_step" and got == blob
+    # clean EOF between frames -> None (peer closed, not an error)
+    assert _read_frame(io.BytesIO(b"")) is None
+    # a JSON first byte is NOT a frame (the listener's sniff contract)
+    with pytest.raises(ValueError):
+        _read_frame(io.BytesIO(b'{"op": "shard_lease"}\n'))
+    # truncated mid-frame -> loud error, never a silent partial message
+    with pytest.raises(ValueError):
+        _read_frame(io.BytesIO(wire[: len(wire) - 3]))
+
+
+def test_shard_host_framed_step_and_sync_are_fenced():
+    h = ShardHost()
+    assert h.handle({"op": "shard_lease", "shard": 0, "epoch": 5,
+                     **CFG})["op"] == "shard_leased"
+    # a stale framed step is fenced without compute, reply blob empty
+    r, blob = h.handle_frame(
+        {"op": "shard_step", "shard": 0, "epoch": 4, "case": 0,
+         "slots": [], "sids": [], "inline_sids": [], "inline_lens": [],
+         "scores": []}, b"")
+    assert r["op"] == "shard_fenced" and blob == b""
+    # the window barrier is fenced by the same lease check...
+    r, _ = h.handle_frame({"op": "shard_sync", "shard": 0, "epoch": 4,
+                           "case": 0}, b"")
+    assert r["op"] == "shard_fenced"
+    # ...and echoes (shard, epoch, case) when current
+    r, _ = h.handle_frame({"op": "shard_sync", "shard": 0, "epoch": 5,
+                           "case": 3}, b"")
+    assert r["op"] == "shard_synced" and r["case"] == 3
+    # a framed step naming a sid with no inline bytes and no snapshot
+    # is a protocol-level error the coordinator revokes on
+    r, _ = h.handle_frame(
+        {"op": "shard_step", "shard": 0, "epoch": 5, "case": 0,
+         "slots": [0], "sids": ["zz"], "inline_sids": [],
+         "inline_lens": [], "scores": [[0, 0, 0, 0]]}, b"")
+    assert r["op"] == "shard_error" and "not resident" in r["error"]
+
+
+def test_shard_host_snapshot_install_and_crc_reject():
+    import zlib
+
+    h = ShardHost()
+    h.handle({"op": "shard_lease", "shard": 0, "epoch": 1, **CFG})
+    blob = b"HELLO\x00\x00\x00"  # one 5B payload, page-padded to 8
+    hdr = {"op": "shard_snapshot", "shard": 0, "epoch": 1,
+           "sids": ["aa"], "lens": [5], "page": 8,
+           "crc": zlib.crc32(blob) & 0xFFFFFFFF}
+    r, _ = h.handle_frame(dict(hdr), blob)
+    assert r["op"] == "shard_snapshotted" and r["count"] == 1
+    assert h._leases[0]["snap"]["aa"] == b"HELLO"
+    # a corrupt image is rejected loudly, the installed snapshot stays
+    r, _ = h.handle_frame(dict(hdr, crc=hdr["crc"] ^ 1), blob)
+    assert r["op"] == "shard_error" and "crc" in r["error"]
+    assert h._leases[0]["snap"]["aa"] == b"HELLO"
+    # snapshots are fenced like steps: a zombie cannot install one
+    r, _ = h.handle_frame(dict(hdr, epoch=0), blob)
+    assert r["op"] == "shard_fenced"
+
+
+def test_shard_stream_framed_loopback_lease_probe_tally(worker):
+    from erlamsa_tpu.services.dist import ShardStream, TransportTally
+
+    _, port = worker
+    tally = TransportTally()
+    st = ShardStream(0, "127.0.0.1", port, timeout=10.0, tally=tally)
+    try:
+        hdr, blob = st.request({"op": "shard_lease", "shard": 0,
+                                "epoch": 0, **CFG},
+                               expect="shard_leased")
+        assert hdr["op"] == "shard_leased" and blob == b""
+        hdr, _ = st.request({"op": "shard_probe", "shard": 0},
+                            expect="shard_alive")
+        assert hdr["op"] == "shard_alive"
+    finally:
+        st.close()
+    snap = tally.snapshot()
+    # only awaited exchanges count as round trips, byte counters move
+    assert snap["round_trips"] == 2
+    assert snap["bytes_sent"] > 0 and snap["bytes_recv"] > 0
+
+
+def test_shard_stream_fenced_reply_raises_stale_epoch(worker):
+    from erlamsa_tpu.services.dist import ShardStream
+
+    _, port = worker
+    st = ShardStream(0, "127.0.0.1", port, timeout=10.0)
+    try:
+        st.request({"op": "shard_lease", "shard": 0, "epoch": 5, **CFG},
+                   expect="shard_leased")
+        with pytest.raises(StaleEpochError):
+            st.request({"op": "shard_sync", "shard": 0, "epoch": 4,
+                        "case": 0}, expect="shard_synced")
+    finally:
+        st.close()
+
+
+def test_overlap_boundary_window_identical_on_oracle_path(tmp_path):
+    """The r15 pipeline knobs never change bytes: overlapped reduce,
+    boundary reduce, a wide window, and an injected fleet.reduce fault
+    all produce the run the r14 lockstep produced (total-loss oracle
+    path: deterministic without device compute)."""
+    legs = {
+        "ref": None,
+        "boundary": {"fleet_reduce": "boundary"},
+        "window": {"fleet_window": 4},
+        "redo": None,  # + fleet.reduce:x1 chaos below
+    }
+    blobs: dict[str, bytes] = {}
+    for tag, extra in legs.items():
+        spec = "shard.step:*"
+        if tag == "redo":
+            spec += ",fleet.reduce:x1"
+        rc, stats = _run_fleet(tmp_path, tag, n=3, spec=spec,
+                               state=False, opts_extra=extra)
+        assert rc == 0 and stats["oracle_cases"] == 3
+        blobs[tag] = _read_blob(tmp_path, tag, 3)
+    assert blobs["boundary"] == blobs["ref"]
+    assert blobs["window"] == blobs["ref"]
+    assert blobs["redo"] == blobs["ref"]
+    # the stats advertise the new knobs
+    _, st = _run_fleet(tmp_path, "knobs", n=1, spec="shard.step:*",
+                       state=False, opts_extra={"fleet_window": 8})
+    assert st["fleet_window"] == 8 and st["reduce_mode"] == "overlap"
+    assert st["rewinds"] == 0 and "transport" in st
+
+
+def test_fleet_reduce_mode_validation(tmp_path):
+    with pytest.raises(ValueError, match="fleet-reduce"):
+        _run_fleet(tmp_path, "bad", n=1, spec=None, state=False,
+                   opts_extra={"fleet_reduce": "speculative"})
+
+
+@pytest.mark.slow
+def test_windowed_framed_remote_identity(tmp_path):
+    """The r15 acceptance pin: a framed remote campaign at window 4 is
+    byte-identical to window 1 and to the all-local run, and the wide
+    window slashes awaited round trips to lease + snapshot + syncs."""
+    srv1 = ParentServer(0, {"seed": SEED}).serve(block=False)
+    srv2 = ParentServer(0, {"seed": SEED}).serve(block=False)
+    p1 = srv1._srv.getsockname()[1]
+    p2 = srv2._srv.getsockname()[1]
+    nodes = [f"127.0.0.1:{p1}", f"127.0.0.1:{p2}"]
+    try:
+        rc, _ = _run_fleet(tmp_path, "loc", n=4, spec=None, shards=2,
+                           state=False)
+        assert rc == 0
+        ref = _read_blob(tmp_path, "loc", 4)
+        rc, st1 = _run_fleet(tmp_path, "w1", n=4, spec=None, shards=None,
+                             state=False,
+                             opts_extra={"fleet_nodes": nodes})
+        assert rc == 0 and _read_blob(tmp_path, "w1", 4) == ref
+        rc, st4 = _run_fleet(tmp_path, "w4", n=4, spec=None, shards=None,
+                             state=False,
+                             opts_extra={"fleet_nodes": nodes,
+                                         "fleet_window": 4})
+        assert rc == 0 and _read_blob(tmp_path, "w4", 4) == ref
+        # w1 syncs every case; w4 once — both stay under the bound
+        # shards * (ceil(cases/W) + lease + snapshot + slack)
+        rt1 = st1["transport"]["round_trips"]
+        rt4 = st4["transport"]["round_trips"]
+        assert rt4 < rt1
+        assert rt4 <= 2 * (1 + 3)
+        # the snapshot shipped the partitions: steps inline ~no seeds
+        assert st4["transport"]["bytes_sent"] > 0
+    finally:
+        srv1.stop()
+        srv2.stop()
+
+
+@pytest.mark.slow
+def test_mid_window_reply_loss_rewinds_byte_identically(tmp_path):
+    """A reply lost AFTER dispatch (injected dist.shard.recv fault on
+    the coordinator's read) cannot redispatch within the case — the
+    pipeline rewinds to the first un-merged case, revokes the shard,
+    and replays byte-identically. The spec skips the 4 lease/snapshot
+    acks (2 shards x 2) so the fault lands on the first shard_result
+    read — a lease-ack fault is a DISPATCH failure and takes the
+    in-case redispatch path instead."""
+    srv1 = ParentServer(0, {"seed": SEED}).serve(block=False)
+    srv2 = ParentServer(0, {"seed": SEED}).serve(block=False)
+    p1 = srv1._srv.getsockname()[1]
+    p2 = srv2._srv.getsockname()[1]
+    nodes = [f"127.0.0.1:{p1}", f"127.0.0.1:{p2}"]
+    try:
+        rc, _ = _run_fleet(tmp_path, "ok", n=2, spec=None, shards=None,
+                           state=False,
+                           opts_extra={"fleet_nodes": nodes})
+        assert rc == 0
+        ref = _read_blob(tmp_path, "ok", 2)
+        rc, st = _run_fleet(tmp_path, "lost", n=2,
+                            spec="dist.shard.recv:s4x1", shards=None,
+                            state=False,
+                            opts_extra={"fleet_nodes": nodes,
+                                        "fleet_window": 2})
+        assert rc == 0
+        assert st["rewinds"] >= 1
+        assert [m["kind"] for m in st["migrations"]][0] == "revoke"
+        assert _read_blob(tmp_path, "lost", 2) == ref
+    finally:
+        srv1.stop()
+        srv2.stop()
