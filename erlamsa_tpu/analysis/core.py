@@ -73,7 +73,8 @@ class LintConfig:
     #: chaos fault site (chaos-site-coverage)
     chaos_modules: tuple = ("services/dist.py", "corpus/store.py",
                             "services/checkpoint.py",
-                            "services/serving.py")
+                            "services/serving.py",
+                            "services/monitors.py")
     #: sites a package-wide lint must find as a literal
     #: chaos.fault_point("<site>") somewhere in the tree — a refactor
     #: that drops one silently makes a documented resilience path
@@ -86,6 +87,7 @@ class LintConfig:
         "shard.step", "shard.migrate", "fleet.reduce",
         "dist.shard.send", "dist.shard.recv", "fleet.checkpoint",
         "dist.shard.frame", "fleet.snapshot",
+        "monitor.spawn", "monitor.ingest", "coverage.fold",
     )
 
     def in_scope(self, rel: str, prefixes: tuple) -> bool:

@@ -54,6 +54,12 @@ NODES_CHECKTIMER = 5.0
 # (reference: src/erlamsa_mon_connect.erl:27-29, src/erlamsa_mutations.erl:703).
 DEFAULT_CM_PORT = 51234
 
+# Edge-coverage bitmap width in bytes (8 edges/byte): 8192 edges, the
+# classic AFL map scaled to loopback-smoke friendliness. Lives here (not
+# ops/coverage.py) so the jax-free monitor plane can share it; the hub
+# and checkpoints still carry the actual width explicitly.
+COVERAGE_MAP_BYTES = 1024
+
 # Default TPU batch capacity classes: sample buffers are padded to the
 # smallest class >= seed length * growth slack.  TPU-native choice: lane
 # dimension multiples of 128 keep layouts tight. The 2048/8192 rungs

@@ -1,0 +1,134 @@
+"""Corpus distillation: per-seed coverage tensor + greedy set-cover.
+
+The coverage hub (services/monitors.py) delivers per-sample edge
+bitmaps; this module owns what the campaign LEARNS from them:
+
+- ``CoverageIndex`` folds each sample's bitmap into a per-seed coverage
+  tensor and the global accumulated map, answering the per-slot gating
+  question "did this sample light a genuinely-new edge?" with the
+  ops/coverage.py kernels (device) or their numpy oracles (host /
+  degraded) — both bit-identical by the parity tests.
+- ``greedy_minimize`` is the afl-cmin analogue: a greedy set-cover over
+  the per-seed tensor keeps the smallest seed set whose union still
+  covers every observed edge; everything else is provably subsumed and
+  can be retired so store/arena stay lean at large corpus sizes.
+
+Determinism: candidate rows are scanned in insertion (idx) order and
+ties on gain break toward the earliest-inserted seed (np.argmax picks
+the first maximum), so the same tensor always distills to the same
+keep set. Seeds with EMPTY bitmaps are never retired — no coverage
+evidence ever arrived for them, which is absence of signal, not proof
+of subsumption.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..ops import coverage as covops
+from ..services import chaos
+
+
+class CoverageIndex:
+    """Global + per-seed edge-coverage state for one campaign.
+
+    Single-threaded by design: folds happen only at case boundaries on
+    the runner thread (the determinism contract), never from monitor
+    threads — the hub buffers raw frames, the runner folds them.
+    """
+
+    def __init__(self, map_bytes: int = covops.MAP_BYTES,
+                 use_device: bool = False):
+        self.map_bytes = int(map_bytes)
+        self.use_device = bool(use_device)
+        self.global_map = np.zeros(self.map_bytes, np.uint8)
+        # sid -> uint8[map_bytes], insertion-ordered (dict preserves it)
+        self.per_seed: dict[str, np.ndarray] = {}
+        self.folds = 0
+
+    def fold_case(self, pairs: list[tuple[str, bytes]]) -> list[int]:
+        """OR one case's maps into the tensor, slot order; returns the
+        per-map genuinely-new edge counts (sequential semantics: a map
+        that only repeats a lower slot's edges gains 0).
+
+        Raises OSError under an injected ``coverage.fold`` fault — the
+        runner treats the whole case as uncovered (hash-novelty
+        fallback) so the fault is observable but never diverging.
+        """
+        chaos.fault_point("coverage.fold")
+        if not pairs:
+            return []
+        maps = np.stack([np.frombuffer(m, np.uint8) for _, m in pairs])
+        if maps.shape[1] != self.map_bytes:
+            raise ValueError(
+                f"coverage map width {maps.shape[1]} != {self.map_bytes}")
+        if self.use_device:
+            gains_dev, acc_dev = covops.batch_gains(self.global_map, maps)
+            gains = np.asarray(gains_dev, np.int32)
+            self.global_map = np.asarray(acc_dev, np.uint8)
+        else:
+            gains, self.global_map = covops.batch_gains_np(
+                self.global_map, maps)
+        for (sid, _), row in zip(pairs, maps):
+            cur = self.per_seed.get(sid)
+            self.per_seed[sid] = row.copy() if cur is None else cur | row
+        self.folds += 1
+        return [int(g) for g in gains]
+
+    def edges(self) -> int:
+        """Total distinct edges observed so far."""
+        return int(covops.popcount_np(self.global_map[None])[0])
+
+    # --- checkpoint round-trip (services/checkpoint.py) -----------------
+
+    def snapshot(self) -> dict:
+        ids = list(self.per_seed)
+        maps = (np.stack([self.per_seed[s] for s in ids])
+                if ids else np.zeros((0, self.map_bytes), np.uint8))
+        return {"ids": ids, "maps": maps, "global": self.global_map.copy()}
+
+    def restore(self, snap: dict):
+        self.per_seed = {
+            sid: np.asarray(row, np.uint8).copy()
+            for sid, row in zip(snap["ids"], snap["maps"])
+        }
+        self.global_map = np.asarray(snap["global"], np.uint8).copy()
+
+
+def greedy_minimize(ids: list[str],
+                    maps: np.ndarray) -> tuple[list[str], list[str]]:
+    """Greedy set-cover over per-seed coverage rows.
+
+    Returns (keep, retired). Every retired seed's edge set is fully
+    subsumed by the union of the kept set (asserted row by row, not
+    just implied by the greedy loop); empty rows are always kept.
+    Deterministic at fixed input: rows scanned in given order, gain
+    ties break toward the earliest row.
+    """
+    if len(ids) != len(maps):
+        raise ValueError("ids/maps length mismatch")
+    if not ids:
+        return [], []
+    maps = np.asarray(maps, np.uint8)
+    counts = covops.popcount_np(maps)
+    target = np.zeros(maps.shape[1], np.uint8)
+    for row in maps:
+        target |= row
+    covered = np.zeros_like(target)
+    chosen: list[int] = []
+    candidates = [i for i in range(len(ids)) if counts[i] > 0]
+    while np.any(covered != target) and candidates:
+        gains = covops.popcount_np(maps[candidates] & ~covered)
+        best = int(np.argmax(gains))  # first max: earliest-row tie-break
+        if gains[best] == 0:
+            break
+        pick = candidates.pop(best)
+        chosen.append(pick)
+        covered |= maps[pick]
+    keep_idx = set(chosen) | {i for i in range(len(ids)) if counts[i] == 0}
+    retired = [
+        ids[i] for i in range(len(ids))
+        if i not in keep_idx and not np.any(maps[i] & ~covered)
+    ]
+    keep = [ids[i] for i in range(len(ids)) if ids[i] not in set(retired)]
+    return keep, retired

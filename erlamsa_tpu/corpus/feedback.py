@@ -46,6 +46,10 @@ EVENT_GAIN = {
     "drop": 2.0,
     "finding": 2.0,
     "new_hash": 0.5,
+    # a genuinely-new coverage edge outranks a merely-novel output hash:
+    # hashes churn forever, the edge frontier is finite and is the
+    # ground-truth exploration signal when --coverage is live
+    "new_cov": 2.0,
 }
 
 
@@ -80,6 +84,45 @@ class FeedbackBus:
     def pending(self) -> int:
         with self._lock:
             return len(self._events)
+
+
+class SampleLedger:
+    """(case, slot) -> seed-id attribution for externally-observed
+    signals.
+
+    The runner records every scheduled case here BEFORE launching it;
+    the coverage fold and any monitor that can name a (case, slot) —
+    e.g. an instrumented target echoing the ids the harness passed it —
+    resolve through the ledger instead of guessing. Bounded: only the
+    most recent `keep` cases are held, which comfortably covers the
+    in-flight window (drain depth) plus monitor reporting latency.
+    Thread-safe for the same reason the bus is: resolvers may be
+    monitor threads.
+    """
+
+    _GUARDED_BY = {"_lock": ("_cases",)}
+
+    def __init__(self, keep: int = 64):
+        self.keep = int(keep)
+        self._lock = threading.Lock()
+        self._cases: dict[int, tuple[str, ...]] = {}
+
+    def record(self, case: int, ids: list[str]) -> None:
+        with self._lock:
+            self._cases[case] = tuple(ids)
+            while len(self._cases) > self.keep:
+                self._cases.pop(next(iter(self._cases)))
+
+    def resolve(self, case: int, slot: int) -> str | None:
+        with self._lock:
+            ids = self._cases.get(case)
+        if ids is None or not 0 <= slot < len(ids):
+            return None
+        return ids[slot]
+
+    def ids(self, case: int) -> tuple[str, ...]:
+        with self._lock:
+            return self._cases.get(case, ())
 
 
 #: process-global bus: detectors publish here without any wiring; only a

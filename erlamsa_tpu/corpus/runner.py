@@ -49,6 +49,21 @@ availability), and every DEVICE_PROBE_EVERY cases the runner probes the
 device; a successful probe resumes the device pipeline. The transition
 is visible as metrics events (device_lost / device_recovered) and the
 ``degraded`` flag in metrics snapshots and the faas stats op.
+
+Coverage feedback (--coverage, r16): when a CoverageHub
+(services/monitors.py) is wired in through opts["coverage_hub"], the
+runner records every scheduled case in a SampleLedger, pulls the case's
+buffered edge bitmaps off the hub at the case boundary, and folds them
+through corpus/distill.CoverageIndex (ops/coverage.py kernels, numpy
+oracles when degraded). A slot WITH a map gates adoption and energy on
+genuinely-new edges (``new_cov`` events) instead of output-hash
+novelty; a slot WITHOUT one keeps the exact baseline hash path. Hub
+death — monitor killed, listener lost, or an injected monitor.ingest
+fault tripping its breaker — degrades the run STICKILY to pure
+hash-novelty (coverage_lost event, coverage-degraded flag): flickering
+coverage would make adoption depend on reconnect timing, which the -s
+replay contract forbids. A degraded or coverage-off run is
+byte-identical to the r15 hash-novelty stream.
 """
 
 from __future__ import annotations
@@ -198,7 +213,8 @@ def run_corpus_batch(opts: dict, batch: int = 1024) -> int:
         return run_corpus_fleet(opts, batch=batch)
     from ..ops.registry import DEVICE_CODES
     from ..ops.scheduler import init_scores
-    from ..services.checkpoint import (load_corpus_energies, load_state,
+    from ..services.checkpoint import (load_corpus_energies,
+                                       load_coverage_maps, load_state,
                                        quarantine_mismatch, save_state)
 
     pipeline = str(opts.get("pipeline") or "async")
@@ -269,6 +285,24 @@ def run_corpus_batch(opts: dict, batch: int = 1024) -> int:
     scores = init_scores(jax.random.fold_in(base, 999), batch)
     bus = opts.get("feedback_bus", fb.GLOBAL)
     consume_feedback = bool(opts.get("feedback"))
+
+    # r16 coverage plane: the hub buffers connect-back edge bitmaps off
+    # the wire; the runner folds them at case boundaries (never from
+    # monitor threads — the determinism contract) and gates per-slot
+    # adoption/energy on genuinely-new edges. The ledger maps
+    # (case, slot) back to the scheduled seed for the fold and for any
+    # monitor that can name the sample that provoked a signal.
+    hub = opts.get("coverage_hub")
+    coverage_on = bool(opts.get("coverage")) and hub is not None
+    distill_on = bool(opts.get("distill"))
+    ledger = fb.SampleLedger()
+    cov = None
+    cov_live = False
+    if coverage_on:
+        from .distill import CoverageIndex
+
+        cov = CoverageIndex(map_bytes=hub.map_bytes, use_device=True)
+        cov_live = True
 
     arena = None
     trunc_cap = device_max  # truncation threshold (both layouts)
@@ -347,21 +381,33 @@ def run_corpus_batch(opts: dict, batch: int = 1024) -> int:
                       file=sys.stderr)
             else:
                 ck_seed, ck_case, ck_scores, _hs, _hsp = st
+                cov_verdict, cov_snap = "absent", None
+                if cov is not None:
+                    # kind-stamped coverage fields: "absent" (pre-r16
+                    # checkpoint) resumes with fresh empty coverage,
+                    # "mismatch" (wrong kind/version/width) means the
+                    # file belongs to a different configuration
+                    cov_verdict, cov_snap = load_coverage_maps(
+                        state_path, cov.map_bytes)
                 if (ck_seed != tuple(opts["seed"])
-                        or ck_scores.shape != (batch, NUM_DEVICE_MUTATORS)):
+                        or ck_scores.shape != (batch, NUM_DEVICE_MUTATORS)
+                        or cov_verdict == "mismatch"):
                     # the mismatched file belongs to a DIFFERENT run:
                     # park it at .bak so that run can still resume from
                     # it, instead of burying it under this run's first
                     # save (tests pin the quarantine)
                     quarantine_mismatch(state_path)
-                    print("# checkpoint mismatch (seed/shape), starting "
-                          "fresh (original kept as .bak)", file=sys.stderr)
+                    print("# checkpoint mismatch (seed/shape/coverage), "
+                          "starting fresh (original kept as .bak)",
+                          file=sys.stderr)
                 else:
                     start_case = ck_case
                     scores = jnp.asarray(ck_scores)
                     energies = load_corpus_energies(state_path)
                     if energies:
                         store.restore_energies(energies)
+                    if cov_snap is not None:
+                        cov.restore(cov_snap)
                     print(f"# resumed at case {start_case} "
                           f"({len(energies or {})} seed energies restored)",
                           file=sys.stderr)
@@ -421,7 +467,8 @@ def run_corpus_batch(opts: dict, batch: int = 1024) -> int:
     bucket_stats: dict[int, dict] = {}
     # tallies the drain worker owns in async mode (main reads after join)
     tallies = {"truncated": 0, "total": 0, "new_hashes": 0,
-               "bytes_uploaded": 0, "offspring": 0, "struct_routed": 0}
+               "bytes_uploaded": 0, "offspring": 0, "struct_routed": 0,
+               "cov_maps": 0, "cov_new_edges": 0}
     # distinct (rows, capacity, scan_len) triples the jitted step saw —
     # the compiled-program count the arena drives to O(1)
     step_shapes: set[tuple] = set()
@@ -600,6 +647,9 @@ def run_corpus_batch(opts: dict, batch: int = 1024) -> int:
         t_s = time.perf_counter()
         with trace.span("corpus.schedule", case=case):
             ids = sched.schedule(case, batch)
+            # attribution ledger BEFORE launch: monitors and the
+            # coverage fold resolve (case, slot) -> seed through it
+            ledger.record(case, ids)
             samples = [store.get(sid) for sid in ids]
             plans = (None if use_arena
                      else plan_buckets(samples, device_max=device_max))
@@ -695,8 +745,47 @@ def run_corpus_batch(opts: dict, batch: int = 1024) -> int:
         outputs are still device-resident (arena layout): an adopted
         offspring then queues for DeviceArena.adopt_pending and its
         payload bytes never cross back over PCIe."""
-        # novelty feedback: a never-seen output hash is the cheap
-        # stand-in for new coverage — the source seed earns energy
+        nonlocal cov_live
+        # coverage pre-pass: pull this case's buffered bitmaps off the
+        # hub and fold them (runner/drain thread, case boundary). Hub
+        # death is STICKY — once lost, the rest of the run is pure
+        # hash-novelty, so adoption never depends on reconnect timing.
+        slot_gain: dict[int, int] = {}
+        if cov is not None and cov_live:
+            if not hub.alive():
+                cov_live = False
+                logger.log("warning", "corpus: coverage hub lost at case "
+                           "%d — degrading to hash-novelty", case)
+                metrics.GLOBAL.record_event("coverage_lost")
+                metrics.GLOBAL.set_coverage_degraded(True)
+            else:
+                frames = hub.take(case)
+                covered = [s for s in sorted(frames) if s < batch]
+                pairs = [(ledger.resolve(case, s) or ids[s], frames[s])
+                         for s in covered]
+                try:
+                    gains = cov.fold_case(pairs)
+                except OSError as e:
+                    # injected coverage.fold fault: the whole case is
+                    # treated as uncovered — observable, never diverging
+                    # from the hash-novelty baseline
+                    logger.log("warning", "corpus: coverage fold failed "
+                               "at case %d (%s) — case uncovered", case, e)
+                    metrics.GLOBAL.record_coverage_frame("faulted")
+                else:
+                    if covered:
+                        slot_gain = dict(zip(covered, gains))
+                        new_edges = int(sum(gains))
+                        metrics.GLOBAL.record_coverage_fold(
+                            len(pairs), new_edges, cov.edges())
+                        tallies["cov_maps"] += len(pairs)
+                        tallies["cov_new_edges"] += new_edges
+
+        # novelty feedback: a slot WITH a coverage map admits on
+        # genuinely-new edges (new_cov energy); a slot without one keeps
+        # the hash-novelty stand-in byte-for-byte. seen_hashes is still
+        # recorded for covered slots so a later degradation cannot
+        # re-count their outputs as novel.
         t_h = time.perf_counter()
         case_bytes = 0
         case_adopted = 0
@@ -705,24 +794,33 @@ def run_corpus_batch(opts: dict, batch: int = 1024) -> int:
                 payload = results.get(slot, b"")
                 case_bytes += len(payload)
                 h = _out_hash(payload)
-                if h not in seen_hashes:
+                novel_hash = h not in seen_hashes
+                if novel_hash:
                     seen_hashes.add(h)
                     tallies["new_hashes"] += 1
-                    store.apply_event(fb.Event("new_hash", ids[slot]))
-                    if adopt_on and payload and case_adopted < adopt_cap:
-                        # the store decides (dedup by content hash);
-                        # store.add fires the arena's listener, and the
-                        # device path below turns that host upload into
-                        # a no-op when the scatter wins
-                        sid_new, added = store.add(payload,
-                                                   origin="offspring")
-                        if added:
-                            case_adopted += 1
-                            tallies["offspring"] += 1
-                            if devsrc is not None and slot in devsrc:
-                                src, row = devsrc[slot]
-                                arena.enqueue_adopt(sid_new, len(payload),
-                                                    src, row)
+                if slot in slot_gain:
+                    admit = slot_gain[slot] > 0
+                    if admit:
+                        store.apply_event(fb.Event("new_cov", ids[slot]))
+                else:
+                    admit = novel_hash
+                    if admit:
+                        store.apply_event(fb.Event("new_hash", ids[slot]))
+                if admit and adopt_on and payload \
+                        and case_adopted < adopt_cap:
+                    # the store decides (dedup by content hash);
+                    # store.add fires the arena's listener, and the
+                    # device path below turns that host upload into
+                    # a no-op when the scatter wins
+                    sid_new, added = store.add(payload,
+                                               origin="offspring")
+                    if added:
+                        case_adopted += 1
+                        tallies["offspring"] += 1
+                        if devsrc is not None and slot in devsrc:
+                            src, row = devsrc[slot]
+                            arena.enqueue_adopt(sid_new, len(payload),
+                                                src, row)
         tallies["total"] += len(results)
         metrics.GLOBAL.record_stage("hash", time.perf_counter() - t_h)
         metrics.GLOBAL.record_batch(len(results), case_bytes,
@@ -764,7 +862,9 @@ def run_corpus_batch(opts: dict, batch: int = 1024) -> int:
             # records its hits (else resume would double-count them)
             save_state(state_path, opts["seed"], case + 1,
                        np.asarray(ckpt_scores),
-                       corpus_energies=store.energies())
+                       corpus_energies=store.energies(),
+                       coverage=(cov.snapshot()
+                                 if cov is not None else None))
             store.save()
             if drain is not None:
                 drain.mark_done(case)
@@ -943,6 +1043,10 @@ def run_corpus_batch(opts: dict, batch: int = 1024) -> int:
                                case, e, redo_from)
                     metrics.GLOBAL.record_event("device_lost")
                     metrics.GLOBAL.set_degraded(True)
+                    if cov is not None:
+                        # fold on the numpy oracle while the device is
+                        # out (bit-identical by the parity tests)
+                        cov.use_device = False
                     scores = _scores_to_host(scores)
                     case = redo_from
                     device_mode = False
@@ -960,6 +1064,8 @@ def run_corpus_batch(opts: dict, batch: int = 1024) -> int:
                                    case)
                         metrics.GLOBAL.record_event("device_recovered")
                         metrics.GLOBAL.set_degraded(False)
+                        if cov is not None:
+                            cov.use_device = True
                         device_mode = True
                         if use_arena:
                             # the old arena tensor died with the device:
@@ -974,6 +1080,7 @@ def run_corpus_batch(opts: dict, batch: int = 1024) -> int:
                         continue
                 t_s = time.perf_counter()
                 ids = sched.schedule(case, batch)
+                ledger.record(case, ids)
                 metrics.GLOBAL.record_stage("schedule",
                                             time.perf_counter() - t_s)
                 if stats is not None:
@@ -989,6 +1096,24 @@ def run_corpus_batch(opts: dict, batch: int = 1024) -> int:
         # stages) must see their own routing split
         _registry.set_struct_kernels(_struct_flag_before)
 
+    # --distill: greedy set-cover over the per-seed coverage tensor —
+    # retire every seed whose edge set is provably subsumed by the kept
+    # set (afl-cmin analogue; corpus/distill.py pins the determinism and
+    # the never-retire-uncovered rule)
+    distilled = 0
+    if cov is not None and distill_on:
+        from .distill import greedy_minimize
+
+        snap = cov.snapshot()
+        keep, retired = greedy_minimize(snap["ids"], snap["maps"])
+        for sid in retired:
+            if store.retire(sid):
+                distilled += 1
+        if distilled:
+            metrics.GLOBAL.record_distilled(distilled)
+        print(f"# distill: {len(keep)} covering seeds keep "
+              f"{cov.edges()} edges, {distilled} subsumed seeds retired",
+              file=sys.stderr)
     store.save()
     dt = time.perf_counter() - t0
     metrics.GLOBAL.record_pipeline_wall(dt)
@@ -1014,6 +1139,14 @@ def run_corpus_batch(opts: dict, batch: int = 1024) -> int:
                      store_stats=store.stats())
         if arena is not None:
             stats["arena"] = arena.stats()
+        if cov is not None:
+            stats["coverage"] = {
+                "edges": cov.edges(), "folds": cov.folds,
+                "maps": tallies["cov_maps"],
+                "new_edges": tallies["cov_new_edges"],
+                "degraded": not cov_live, "distilled": distilled,
+                "hub": hub.stats(),
+            }
     logger.log("info", "corpus backend (%s pipeline, %s layout): %d "
                "samples in %.2fs (%.0f samples/s), %d novel output hashes",
                pipeline, layout, total, dt, total / max(dt, 1e-9),

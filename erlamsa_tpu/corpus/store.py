@@ -357,6 +357,32 @@ class CorpusStore:
             for sid in credit:
                 self.bump(sid, share, ev.kind)
 
+    def retire(self, seed_id: str) -> bool:
+        """Remove a seed the distillation pass proved subsumed
+        (corpus/distill.py). The seed file moves to <root>/retired/ —
+        evidence is preserved but fsck will not re-adopt it as an
+        orphan. Returns False for unknown ids. If the move itself fails
+        (or an injected store.seed fault fires) the metadata removal
+        still sticks; the stranded file is adopted back by a later fsck,
+        which is the safe direction — a retired seed resurfacing costs
+        schedule weight, a lost seed costs coverage."""
+        with self._lock:
+            m = self._meta.pop(seed_id, None)
+            if m is None:
+                return False
+            self._cache.pop(seed_id, None)
+            rdir = os.path.join(self.root, "retired")
+            try:
+                chaos.fault_point("store.seed")
+                os.makedirs(rdir, exist_ok=True)
+                os.replace(os.path.join(self.seeds_dir, seed_id),
+                           os.path.join(rdir, seed_id))
+            except OSError as e:
+                logger.log("warning", "corpus: retiring %s: move failed "
+                           "(%s); file left for fsck", seed_id, e)
+            self._save_locked()
+        return True
+
     def record_scheduled(self, counts: dict[str, int]):
         """hits += n per seed: the scheduler's energy-spend record that
         decays a seed's effective weight over time (energy.seed_weights)."""

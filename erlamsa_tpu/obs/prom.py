@@ -300,6 +300,44 @@ def render(counters: metrics.Counters | None = None) -> str:
                "Compiled-step cache misses (XLA compiles paid).")
         w.sample("erlamsa_serving_compiles_total", serving["compiles"])
 
+    coverage = snap.get("coverage")
+    if coverage and (coverage["frames"] or coverage["stale"]
+                     or coverage["torn"] or coverage["faulted"]
+                     or coverage["folds"] or coverage["degraded"]):
+        w.head("erlamsa_coverage_frames_total", "counter",
+               "Edge-bitmap frames received by the coverage hub, by "
+               "disposition (ok / stale epoch / torn / injected fault).")
+        w.sample("erlamsa_coverage_frames_total", coverage["frames"],
+                 {"result": "ok"})
+        for res in ("stale", "torn", "faulted"):
+            w.sample("erlamsa_coverage_frames_total", coverage[res],
+                     {"result": res})
+        w.head("erlamsa_coverage_folds_total", "counter",
+               "Per-case coverage folds applied at case boundaries.")
+        w.sample("erlamsa_coverage_folds_total", coverage["folds"])
+        w.head("erlamsa_coverage_new_edges_total", "counter",
+               "Genuinely-new edges discovered (sequential per-slot "
+               "gains).")
+        w.sample("erlamsa_coverage_new_edges_total", coverage["new_edges"])
+        w.head("erlamsa_coverage_edges", "gauge",
+               "Distinct edges in the accumulated global coverage map.")
+        w.sample("erlamsa_coverage_edges", coverage["edges"])
+        w.head("erlamsa_coverage_degraded", "gauge",
+               "1 after the monitor plane died and the campaign fell "
+               "back to hash-novelty (sticky for the run).")
+        w.sample("erlamsa_coverage_degraded", coverage["degraded"])
+        w.head("erlamsa_coverage_distilled_total", "counter",
+               "Seeds retired by greedy set-cover distillation.")
+        w.sample("erlamsa_coverage_distilled_total", coverage["distilled"])
+
+    monitors = snap.get("monitors")
+    if monitors:
+        w.head("erlamsa_monitor_events_total", "counter",
+               "Monitor-plane events (spawns, spawn failures, hang "
+               "kills, crashes, dedup hits), by kind.")
+        for kind, n in sorted(monitors.items()):
+            w.sample("erlamsa_monitor_events_total", n, {"kind": kind})
+
     rejected = snap.get("rejected")
     if rejected:
         w.head("erlamsa_faas_rejected_total", "counter",

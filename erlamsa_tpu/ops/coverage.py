@@ -1,0 +1,95 @@
+"""Device edge-coverage tensors: uint8 bitmap OR-fold and popcount.
+
+AFL-style edge bitmaps arrive from the monitor plane as per-sample
+uint8 maps (1 bit per edge).  Every feedback decision — "did this
+sample light a genuinely-new edge?" — reduces to bitmap OR plus
+popcount, natural uint8 element-wise kernels that live beside the
+mutators.  The kernels are expressed in the DrJAX map_reduce shape
+(PAPERS.md, arxiv 2403.07128): vmap the per-map popcount (the map
+leg), OR-reduce along the sample axis (the reduce leg), so the fold
+later rides the single-program fleet reduce unchanged.
+
+The `*_np` twins are the numpy oracles and the byte-identity ground
+truth: the device kernels must match them bit-for-bit (pinned in
+tests/test_coverage.py), and degraded campaigns — device lost, or
+coverage folded on a host-only path — run the oracles directly.
+
+Gain semantics are SEQUENTIAL within a batch: map i's genuinely-new
+edges are counted against the accumulated map OR'd with every earlier
+map in the batch, so a slot that merely repeats the edges a lower slot
+just lit scores zero.  That makes the per-slot adoption gate
+order-stable and independent of how many maps share one batch.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..constants import COVERAGE_MAP_BYTES
+
+#: default edge-bitmap width in bytes (8 edges per byte); shared with
+#: the jax-free monitor plane through constants.py
+MAP_BYTES = COVERAGE_MAP_BYTES
+
+
+# ---------------------------------------------------------------- numpy
+
+def popcount_np(maps: np.ndarray) -> np.ndarray:
+    """int32[...]: set-bit count over the trailing byte axis."""
+    m = np.ascontiguousarray(maps, dtype=np.uint8)
+    return np.unpackbits(m, axis=-1).sum(axis=-1, dtype=np.int32)
+
+
+def fold_maps_np(acc: np.ndarray, maps: np.ndarray) -> np.ndarray:
+    """uint8[B]: acc OR'd with every row of maps[N, B]."""
+    out = np.asarray(acc, np.uint8).copy()
+    for row in np.asarray(maps, np.uint8):
+        out |= row
+    return out
+
+
+def batch_gains_np(acc: np.ndarray,
+                   maps: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
+    """(gains int32[N], new_acc uint8[B]) — sequential new-edge counts.
+
+    gains[i] = popcount(maps[i] & ~(acc | maps[0] | .. | maps[i-1])).
+    """
+    cur = np.asarray(acc, np.uint8).copy()
+    gains = np.empty(len(maps), np.int32)
+    for i, row in enumerate(np.asarray(maps, np.uint8)):
+        gains[i] = popcount_np((row & ~cur)[None])[0]
+        cur |= row
+    return gains, cur
+
+
+# --------------------------------------------------------------- device
+
+def popcount(maps):
+    """int32[...]: per-map popcount — SWAR bit-twiddling on uint8 lanes,
+    no lookup table to stage per trace."""
+    x = maps.astype(jnp.uint8)
+    x = x - ((x >> 1) & jnp.uint8(0x55))
+    x = (x & jnp.uint8(0x33)) + ((x >> 2) & jnp.uint8(0x33))
+    x = (x + (x >> 4)) & jnp.uint8(0x0F)
+    return jnp.sum(x.astype(jnp.int32), axis=-1)
+
+
+@jax.jit
+def fold_maps(acc, maps):
+    """uint8[B]: acc OR every row of maps[N, B] (the reduce leg)."""
+    folded = jax.lax.reduce(maps, np.uint8(0), jax.lax.bitwise_or, (0,))
+    return acc | folded
+
+
+@jax.jit
+def batch_gains(acc, maps):
+    """(gains int32[N], new_acc uint8[B]) — device twin of
+    `batch_gains_np`: an inclusive OR-scan gives each map the union of
+    its predecessors, the vmapped popcount scores what is left."""
+    pref = jax.lax.associative_scan(jnp.bitwise_or, maps, axis=0)
+    before = jnp.concatenate(
+        [jnp.zeros_like(acc)[None, :], pref[:-1]], axis=0) | acc[None, :]
+    gains = jax.vmap(popcount)(maps & ~before)
+    return gains, pref[-1] | acc

@@ -81,6 +81,20 @@ Known sites (grep `fault_point(` for the authoritative list):
                      injected fault degrades to a warning — the run
                      continues, resume falls back to the previous
                      checkpoint (or its .bak)
+    monitor.spawn    monitor-plane subprocess creation
+                     (services/monitors.py _spawn): an injected fault
+                     reads as a failed target launch — logged, counted,
+                     never a crashed monitor thread
+    monitor.ingest   all monitor-plane socket I/O (services/monitors.py):
+                     connect-back reads, probe/lxi sends, and the
+                     CoverageHub's frame ingest; a persistent fault
+                     trips the hub's breaker and the campaign degrades
+                     to hash-novelty — outputs byte-identical to the
+                     coverage-off baseline (tests pin this)
+    coverage.fold    per-case edge-bitmap fold (corpus/distill.py
+                     CoverageIndex.fold_case): an injected fault leaves
+                     the whole case uncovered — the runner falls back
+                     to hash-novelty for those slots, outputs unchanged
 
 Injected failures raise ``InjectedFault``, an OSError subclass, so they
 flow through exactly the except-clauses that catch real socket/disk

@@ -67,11 +67,18 @@ def fsync_dir(path: str) -> None:
         pass
 
 
+#: version stamp for the coverage-map checkpoint fields: bump on any
+#: change to their layout/semantics so a resume can never silently
+#: alias maps written under a different scheme
+COVERAGE_STATE_VERSION = 1
+
+
 def save_state(path: str, seed, case_idx: int, scores,
                host_scores: dict | None = None,
                host_scores_post: dict | None = None,
                engine: str = "fused",
-               corpus_energies: dict | None = None) -> None:
+               corpus_energies: dict | None = None,
+               coverage: dict | None = None) -> None:
     """Atomic write (tmp + rename): a kill mid-save — the very interruption
     checkpoints exist for — must never corrupt the previous checkpoint.
     host_scores: the hybrid routing scores the resumed case's split must
@@ -82,7 +89,12 @@ def save_state(path: str, seed, case_idx: int, scores,
     uninterrupted one.
     corpus_energies: {seed_id: (energy, hits)} from the corpus store
     (corpus/store.py) — the feedback-mode schedule state; restoring it
-    makes a resumed run draw identical schedules."""
+    makes a resumed run draw identical schedules.
+    coverage: CoverageIndex.snapshot() ({ids, maps, global}) from a
+    --coverage run. The fields are kind-stamped ("edges") and versioned
+    (COVERAGE_STATE_VERSION) with the map width recorded explicitly, so
+    load_coverage_maps can refuse — never alias — maps written under a
+    different scheme or width."""
     tmp = path + ".tmp"
     hs = host_scores or {}
     hsp = host_scores_post if host_scores_post is not None else hs
@@ -111,6 +123,19 @@ def save_state(path: str, seed, case_idx: int, scores,
             corpus_hits=np.asarray(
                 [int(corpus_energies[s][1]) for s in ce_ids], np.int64
             ),
+        )
+    if coverage is not None:
+        width = len(coverage["global"])
+        cov_ids = list(coverage["ids"])
+        cov_maps = (np.asarray(coverage["maps"], np.uint8)
+                    if cov_ids else np.zeros((0, width), np.uint8))
+        fields.update(
+            cov_kind=np.asarray("edges", "U8"),
+            cov_version=np.asarray(COVERAGE_STATE_VERSION, np.int64),
+            cov_map_bytes=np.asarray(width, np.int64),
+            cov_ids=np.asarray(cov_ids, "U64"),
+            cov_maps=cov_maps,
+            cov_global=np.asarray(coverage["global"], np.uint8),
         )
     fields["checksum"] = _checksum(fields)
 
@@ -336,6 +361,42 @@ def load_state(path: str, engine: str = "fused"):
     except (OSError, KeyError, ValueError, TypeError, zipfile.BadZipFile,
             zlib.error):
         return None
+
+
+def load_coverage_maps(path: str, map_bytes: int,
+                       engine: str = "fused") -> tuple[str, dict | None]:
+    """Coverage-map leg of a --coverage resume. Returns a verdict pair:
+
+    - ("ok", {ids, maps, global}) — kind/version/width all match; feed
+      it to CoverageIndex.restore().
+    - ("absent", None) — the checkpoint carries no coverage fields at
+      all (a pre-coverage or stateless checkpoint, or no usable file).
+      Resuming with fresh, empty coverage cannot alias anything.
+    - ("mismatch", None) — coverage fields exist but their kind,
+      version, or map width disagrees with this run. The caller must
+      quarantine the checkpoint (quarantine_mismatch) and start fresh:
+      folding new bitmaps into maps written under another scheme would
+      corrupt every subsequent adoption decision.
+    """
+    try:
+        z = _load_fields(path, engine)
+        if z is None or "cov_kind" not in z:
+            return "absent", None
+        if (str(z["cov_kind"]) != "edges"
+                or int(z.get("cov_version", -1)) != COVERAGE_STATE_VERSION
+                or int(z.get("cov_map_bytes", -1)) != int(map_bytes)
+                or z["cov_maps"].ndim != 2
+                or z["cov_maps"].shape[1] != int(map_bytes)
+                or len(z["cov_global"]) != int(map_bytes)):
+            return "mismatch", None
+        return "ok", {
+            "ids": [str(s) for s in z["cov_ids"]],
+            "maps": z["cov_maps"].copy(),
+            "global": z["cov_global"].copy(),
+        }
+    except (OSError, KeyError, ValueError, TypeError, zipfile.BadZipFile,
+            zlib.error):
+        return "absent", None
 
 
 def load_corpus_energies(path: str, engine: str = "fused") -> dict | None:

@@ -243,6 +243,25 @@ def build_parser() -> argparse.ArgumentParser:
                         "right class, so only content hashes and "
                         "lengths cross PCIe (requires --layout arena; "
                         "outputs stay byte-identical at a fixed -s)")
+    p.add_argument("--coverage", action="store_true",
+                   help="device edge-coverage feedback (requires "
+                        "--feedback): listen for connect-back edge "
+                        "bitmaps (services/monitors.py CoverageHub), "
+                        "fold them into per-seed coverage tensors "
+                        "(ops/coverage.py) and gate adoption/energy on "
+                        "genuinely-new edges instead of output-hash "
+                        "novelty; a dead monitor plane degrades the run "
+                        "to hash-novelty, byte-identical to --coverage "
+                        "off at a fixed -s")
+    p.add_argument("--coverage-port", type=int, default=None, metavar="PORT",
+                   help="coverage hub listen port (default: ephemeral, "
+                        "printed at startup)")
+    p.add_argument("--distill", action="store_true",
+                   help="end-of-run corpus distillation (requires "
+                        "--coverage): greedy set-cover keeps the "
+                        "smallest seed set whose union covers every "
+                        "observed edge and retires the provably-"
+                        "subsumed rest (corpus/distill.py)")
     p.add_argument("--state", default=None,
                    help="checkpoint file (.npz) for stop/resume of batch "
                         "runs; with --shards/--fleet-nodes this is the "
@@ -320,6 +339,18 @@ def main(argv=None) -> int:
             "erlamsa-tpu: --struct is single-device only (the span-splice "
             "overlay routes against one arena): drop --shards/--fleet-nodes "
             "to run the struct overlay, or drop --struct to run the fleet")
+
+    if args.distill and not args.coverage:
+        raise SystemExit("erlamsa-tpu: --distill requires --coverage "
+                         "(set-cover needs the per-seed coverage tensor)")
+    if args.coverage and not args.feedback:
+        raise SystemExit("erlamsa-tpu: --coverage requires --feedback "
+                         "(coverage gates the feedback runner's adoption)")
+    if args.coverage and (args.shards is not None or args.fleet_nodes):
+        raise SystemExit(
+            "erlamsa-tpu: --coverage is single-device only (the hub's "
+            "sample ledger maps (case, slot) against one schedule): drop "
+            "--shards/--fleet-nodes to run with coverage")
 
     if args.list:
         _show_list()
@@ -437,6 +468,8 @@ def main(argv=None) -> int:
         "arena_page": args.arena_page,
         "arena_classes": args.arena_classes,
         "adopt": args.adopt,
+        "coverage": args.coverage,
+        "distill": args.distill,
         "struct": "device" if args.struct_kernels else args.struct,
         "output": args.output,
         "verbose": args.verbose,
@@ -522,9 +555,21 @@ def main(argv=None) -> int:
             raise SystemExit("erlamsa-tpu: --feedback requires --corpus DIR")
         from ..corpus.runner import run_corpus_batch
 
+        cov_hub = None
+        if args.coverage:
+            # the hub is jax-free and binds before the runner imports the
+            # device stack, so instrumented targets can connect back the
+            # moment the campaign starts
+            from .monitors import CoverageHub
+
+            cov_hub = CoverageHub(port=args.coverage_port or 0).start()
+            opts["coverage_hub"] = cov_hub
         try:
             return run_corpus_batch(opts, batch=args.batch)
         finally:
+            if cov_hub is not None:
+                cov_hub.stop()
+                cov_hub.join(timeout=5)
             _finish()
 
     if args.backend == "tpu":

@@ -115,6 +115,16 @@ class Counters:
         # admission-control sheds by reason (queue_full/quota/chaos) —
         # the faas_rejected_total counter in /metrics
         self.rejected: dict[str, int] = {}
+        # coverage-plane accounting (services/monitors.CoverageHub +
+        # corpus runner fold): frame dispositions, fold totals, the
+        # edges/degraded gauges — the erlamsa_coverage_* families
+        self.coverage = {"frames": 0, "stale": 0, "torn": 0, "faulted": 0,
+                         "folds": 0, "new_edges": 0, "edges": 0,
+                         "degraded": 0, "distilled": 0}
+        # monitor-plane event tallies by kind (crash/crash_dup/
+        # hang_killed/spawn_failed/after_spawned, ...) — the
+        # erlamsa_monitor_events_total counter
+        self.monitor_events: dict[str, int] = {}
         # per-tenant served/rejected tallies (services/serving.TenantTable)
         self.tenants: dict[str, dict[str, int]] = {}
         self.t0 = time.perf_counter()
@@ -273,6 +283,38 @@ class Counters:
         # auto-dump the ring inside note()
         flight.GLOBAL.note(kind)
 
+    def record_monitor(self, kind: str):
+        """One monitor-plane event (spawn/crash/hang bookkeeping)."""
+        with self._lock:
+            self.monitor_events[kind] = self.monitor_events.get(kind, 0) + 1
+
+    def record_coverage_frame(self, result: str):
+        """One coverage frame's disposition: ok/stale/torn/faulted."""
+        key = "frames" if result == "ok" else result
+        with self._lock:
+            if key in self.coverage:
+                self.coverage[key] += 1
+
+    def record_coverage_fold(self, maps: int, new_edges: int, edges: int):
+        """One case-boundary coverage fold: `maps` bitmaps folded,
+        `new_edges` genuinely new, `edges` the global gauge after."""
+        with self._lock:
+            self.coverage["folds"] += 1
+            self.coverage["new_edges"] += int(new_edges)
+            self.coverage["edges"] = int(edges)
+
+    def record_distilled(self, n: int):
+        """`n` seeds retired by the set-cover distillation pass."""
+        with self._lock:
+            self.coverage["distilled"] += int(n)
+
+    def set_coverage_degraded(self, on: bool):
+        """Flip the coverage-degraded gauge: 1 while the campaign runs
+        on hash-novelty because the monitor plane died (distinct from
+        the device-loss `degraded` flag — the device may be fine)."""
+        with self._lock:
+            self.coverage["degraded"] = 1 if on else 0
+
     def set_degraded(self, on: bool):
         """Flip the degraded-mode flag (corpus runner fell back to the
         host oracle after device loss / recovered)."""
@@ -358,6 +400,8 @@ class Counters:
                 "rejected": dict(self.rejected),
                 "tenants": {t: dict(v)
                             for t, v in sorted(self.tenants.items())},
+                "coverage": dict(self.coverage),
+                "monitors": dict(sorted(self.monitor_events.items())),
             }
 
 

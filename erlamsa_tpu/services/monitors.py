@@ -1,4 +1,4 @@
-"""Crash/event monitors.
+"""Crash/event monitors and the coverage ingest hub.
 
 Reference: src/erlamsa_monitor.erl and mon_* modules — a registry of
 monitors started from ``--monitor +name:params`` / ``!name:off`` CLI specs,
@@ -21,44 +21,223 @@ each reporting findings through the logger and optionally running an
   cdb     Windows CDB console-debugger driver: on a debugger break-in log
           backtrace/registers, write a minidump, restart
           (src/erlamsa_mon_cdb.erl); gated on an available `cdb` binary
+
+Unlike the original fire-and-forget daemon threads, every monitor loop
+now runs under services/supervisor.py (per-monitor restart backoff,
+give-up breaker on crash storms), monitor subprocesses spawn through
+one chaos-faultable funnel with a per-execution hang watchdog
+(deadline + process-group kill), and crash reports are deduped by
+(signal, top-frames stack hash) before they reach the feedback bus —
+the energy scheduler sees each distinct crash once, not a log line per
+re-trigger.
+
+``CoverageHub`` is the monitor plane's device-feedback half: a framed
+connect-back listener (the r15 frame codec from services/dist.py)
+accepting per-sample edge bitmaps that the corpus runner folds into
+per-seed coverage tensors at case boundaries. This module stays
+jax-free on purpose (like corpus/feedback.py): monitor threads must
+never trigger an accelerator backend import.
 """
 
 from __future__ import annotations
 
+import hashlib
+import os
 import re
 import shlex
 import shutil
+import signal
 import socket
 import subprocess
 import threading
 import time
+import zlib
 
-from ..constants import DEFAULT_CM_PORT
+from ..constants import COVERAGE_MAP_BYTES, DEFAULT_CM_PORT
 from ..corpus import feedback
-from . import logger
+from . import chaos, logger, metrics
+from .dist import _read_frame
+from .resilience import OPEN, CircuitBreaker
+from .supervisor import SupervisedThread
 
 # shared monitor config, the reference's global_config ets analogue
 CONFIG: dict = {"cm_port": DEFAULT_CM_PORT, "cm_host": None}
 
+#: per-execution watchdog default: a watched target (or after-action)
+#: that produces no exit within this many seconds is group-killed
+EXEC_DEADLINE = 30.0
+
+
+# --- subprocess funnel: one spawn site, one hang watchdog ----------------
+
+def _spawn(argv: list[str], **popen_kw) -> subprocess.Popen:
+    """Every monitor subprocess comes to life here: one chaos site
+    (monitor.spawn) so fault specs can starve the whole recovery/triage
+    plane, and its own session/process group so the hang watchdog can
+    kill the target together with anything it forked."""
+    chaos.fault_point("monitor.spawn")
+    return subprocess.Popen(argv, start_new_session=True, **popen_kw)
+
+
+def _kill_group(proc: subprocess.Popen):
+    """Process-group kill with reaping; falls back to killing the lone
+    process when the group is already gone."""
+    try:
+        os.killpg(os.getpgid(proc.pid), signal.SIGKILL)
+    except OSError:
+        try:
+            proc.kill()
+        except OSError:
+            pass
+    try:
+        proc.wait(timeout=5)
+    except (subprocess.TimeoutExpired, OSError):
+        pass
+
+
+def _watch(proc: subprocess.Popen,
+           deadline: float) -> tuple[bytes | None, int | None]:
+    """Per-execution hang watchdog: wait for exit within `deadline`
+    seconds; a target still running past it is process-group-killed.
+    Returns (output, returncode); returncode None means the watchdog
+    fired (a hang, not an exit)."""
+    try:
+        out, _ = proc.communicate(timeout=deadline if deadline > 0 else None)
+        return out, proc.returncode
+    except subprocess.TimeoutExpired:
+        _kill_group(proc)
+        try:
+            out, _ = proc.communicate(timeout=5)
+        except (subprocess.TimeoutExpired, OSError):
+            out = b""
+        return out, None
+
 
 def _run_after(params: dict):
     """after=exec recovery hook (erlamsa_monitor:do_after,
-    src/erlamsa_monitor.erl:98-104)."""
+    src/erlamsa_monitor.erl:98-104). Spawns through the monitor.spawn
+    funnel — failures are LOGGED, never swallowed — and a reaper thread
+    waits on the action under the hang watchdog so a stuck recovery
+    command is group-killed instead of leaking a zombie."""
     cmd = params.get("after")
-    if cmd:
-        subprocess.Popen(shlex.split(cmd))
+    if not cmd:
+        return
+    budget = float(params.get("after_timeout", EXEC_DEADLINE))
+    try:
+        proc = _spawn(shlex.split(cmd))
+    except (OSError, ValueError) as e:
+        metrics.GLOBAL.record_monitor("spawn_failed")
+        logger.log("error", "monitor after-action %r failed to spawn: %s",
+                   cmd, e)
+        return
+    metrics.GLOBAL.record_monitor("after_spawned")
+
+    def _reap():
+        _out, rc = _watch(proc, budget)
+        if rc is None:
+            metrics.GLOBAL.record_monitor("hang_killed")
+            logger.log("warning", "monitor after-action %r hung past "
+                       "%.1fs, killed", cmd, budget)
+
+    threading.Thread(target=_reap, name="mon:after-reap",
+                     daemon=True).start()
 
 
-class Monitor(threading.Thread):
+# --- network helpers: monitor-plane socket I/O behind one fault site -----
+
+def _net_read(sock: socket.socket, n: int) -> bytes:
+    """Monitor-plane socket read (chaos site monitor.ingest)."""
+    chaos.fault_point("monitor.ingest")
+    return sock.recv(n)
+
+
+def _net_write(sock: socket.socket, payload: bytes, addr=None):
+    """Monitor-plane socket write (probe hellos, SCPI queries) behind
+    the same monitor.ingest site — one spec kills the whole plane's
+    I/O."""
+    chaos.fault_point("monitor.ingest")
+    if addr is not None:
+        sock.sendto(payload, addr)
+    else:
+        sock.sendall(payload)
+
+
+# --- crash dedup/triage --------------------------------------------------
+
+_FRAME_PAT = re.compile(rb"(?:#\d+\s|\+0x[0-9a-fA-F]+|\bat\s+\S|\bin\s+\S+\s*\()")
+
+
+class CrashTriage:
+    """Dedup crashes by (signal, top-frames stack hash).
+
+    The triage key hashes the first `frames` backtrace-looking lines of
+    the target's output (falling back to the first non-empty lines when
+    no frame pattern matches) together with the signal number — the
+    classic "same signal, same top of stack => same bug" bucketing. The
+    first observation of a bucket is a finding for the feedback bus;
+    re-triggers only count.
+    """
+
+    def __init__(self, frames: int = 3):
+        self.frames = int(frames)
+        self._seen: set[str] = set()
+        self.dups = 0
+
+    def key(self, sig: int, output: bytes | None) -> str:
+        lines = [ln.strip() for ln in (output or b"").splitlines()
+                 if ln.strip()]
+        top = [ln for ln in lines if _FRAME_PAT.search(ln)][:self.frames]
+        if not top:
+            top = lines[:self.frames]
+        h = hashlib.sha1(b"|".join([b"sig%d" % sig, *top])).hexdigest()[:12]
+        return f"sig{sig}:{h}"
+
+    def observe(self, sig: int, output: bytes | None) -> tuple[str, bool]:
+        """(triage key, first time seen?)"""
+        k = self.key(sig, output)
+        if k in self._seen:
+            self.dups += 1
+            return k, False
+        self._seen.add(k)
+        return k, True
+
+
+# --- monitor base: supervised loops --------------------------------------
+
+class Monitor:
+    """One monitor = one supervised loop (services/supervisor.py): an
+    unhandled crash in run() restarts it with backoff, and a crash
+    storm trips the supervisor's give-up breaker instead of spinning.
+    The public surface (start/stop/join/is_alive) matches the old
+    threading.Thread subclass so CLI wiring and tests are unchanged."""
+
     name_code = "base"
 
     def __init__(self, params: dict):
-        super().__init__(daemon=True)
         self.params = params
         self._stop_evt = threading.Event()
+        self._thread = SupervisedThread(f"monitor:{self.name_code}",
+                                        self._supervised_run)
+
+    def _supervised_run(self):
+        if not self._stop_evt.is_set():
+            self.run()
+
+    def run(self):
+        raise NotImplementedError
+
+    def start(self) -> "Monitor":
+        self._thread.start()
+        return self
 
     def stop(self):
         self._stop_evt.set()
+
+    def join(self, timeout=None):
+        self._thread.join(timeout)
+
+    def is_alive(self) -> bool:
+        return self._thread.is_alive()
 
 
 class ConnectMonitor(Monitor):
@@ -89,7 +268,7 @@ class ConnectMonitor(Monitor):
                 break
             try:
                 conn.settimeout(2.0)
-                data = conn.recv(4096)
+                data = _net_read(conn, 4096)
             except OSError:
                 data = b""
             finally:
@@ -122,11 +301,11 @@ class NetworkProbeMonitor(Monitor):
                 if proto == "udp":
                     s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
                     s.settimeout(3.0)
-                    s.sendto(hello, (host, port))
+                    _net_write(s, hello, (host, port))
                     ok = True
                 else:
                     with socket.create_connection((host, port), timeout=3.0) as s:
-                        s.sendall(hello)
+                        _net_write(s, hello)
                         ok = True
             except OSError as e:
                 logger.log("finding", "probe: %s:%d unreachable (%s)", host, port, e)
@@ -141,30 +320,75 @@ class NetworkProbeMonitor(Monitor):
 class ExecMonitor(Monitor):
     """exec: keep a target app running; abnormal exits are findings and the
     app is restarted — the cross-platform stand-in for the cdb/r2 debugger
-    monitors (src/erlamsa_mon_cdb.erl behavior)."""
+    monitors (src/erlamsa_mon_cdb.erl behavior).
+
+    Every execution runs under the hang watchdog (``timeout=`` param,
+    default EXEC_DEADLINE): a wedged target is process-group-killed and
+    reported as a hang finding. Spawn failures feed a CircuitBreaker so
+    a broken cmdline cools down instead of hot-spinning, and crashes
+    are triage-deduped before they reach the bus."""
 
     name_code = "exec"
+
+    def __init__(self, params: dict):
+        super().__init__(params)
+        self.triage = CrashTriage()
+        self.breaker = CircuitBreaker(failure_threshold=3,
+                                      reset_timeout=10.0,
+                                      name="monitor:exec")
 
     def run(self):
         cmd = self.params.get("app")
         if not cmd:
             logger.log("error", "exec monitor needs app=<cmdline>")
             return
+        deadline = float(self.params.get("timeout", EXEC_DEADLINE))
+        delay = float(self.params.get("delay", 5.0))
         while not self._stop_evt.is_set():
-            proc = subprocess.Popen(
-                shlex.split(cmd), stdout=subprocess.PIPE, stderr=subprocess.STDOUT
-            )
-            out, _ = proc.communicate()
-            rc = proc.returncode
-            if rc and not self._stop_evt.is_set():
-                level = "finding" if rc < 0 else "warning"
-                logger.log(level, "exec target exited rc=%d; tail: %r",
-                           rc, out[-500:] if out else b"")
-                # signal exits are crashes; plain nonzero rc a finding
-                feedback.publish("crash" if rc < 0 else "finding",
-                                 source="monitor:exec", detail=f"rc={rc}")
+            if not self.breaker.allow():
+                self._stop_evt.wait(delay)
+                continue
+            try:
+                proc = _spawn(shlex.split(cmd), stdout=subprocess.PIPE,
+                              stderr=subprocess.STDOUT)
+            except (OSError, ValueError) as e:
+                self.breaker.record_failure()
+                metrics.GLOBAL.record_monitor("spawn_failed")
+                logger.log("error", "exec monitor: cannot spawn %r: %s",
+                           cmd, e)
+                self._stop_evt.wait(delay)
+                continue
+            self.breaker.record_success()
+            out, rc = _watch(proc, deadline)
+            if rc is None:
+                metrics.GLOBAL.record_monitor("hang_killed")
+                logger.log("finding", "exec target hung past %.1fs, "
+                           "killed; tail: %r", deadline,
+                           out[-500:] if out else b"")
+                feedback.publish("finding", source="monitor:exec",
+                                 detail="hang")
                 _run_after(self.params)
-            time.sleep(float(self.params.get("delay", 5.0)))
+            elif rc and not self._stop_evt.is_set():
+                if rc < 0:
+                    key, first = self.triage.observe(-rc, out)
+                    if first:
+                        metrics.GLOBAL.record_monitor("crash")
+                        logger.log("finding", "exec target crashed sig=%d "
+                                   "triage=%s; tail: %r", -rc, key,
+                                   out[-500:] if out else b"")
+                        feedback.publish("crash", source="monitor:exec",
+                                         detail=key)
+                    else:
+                        metrics.GLOBAL.record_monitor("crash_dup")
+                        logger.log("debug", "exec target crash (dup "
+                                   "triage=%s)", key)
+                else:
+                    logger.log("warning", "exec target exited rc=%d; "
+                               "tail: %r", rc, out[-500:] if out else b"")
+                    feedback.publish("finding", source="monitor:exec",
+                                     detail=f"rc={rc}")
+                _run_after(self.params)
+            self._stop_evt.wait(delay)
 
 
 class R2Monitor(Monitor):
@@ -173,16 +397,24 @@ class R2Monitor(Monitor):
 
     name_code = "r2"
 
+    def __init__(self, params: dict):
+        super().__init__(params)
+        self.triage = CrashTriage()
+
     def run(self):
         if shutil.which("r2") is None:
             logger.log("error", "r2 monitor: radare2 not found in PATH")
             return
         app = self.params.get("app")
         while not self._stop_evt.is_set():
-            proc = subprocess.Popen(
-                ["r2", "-q0", "-d", *shlex.split(app)],
-                stdin=subprocess.PIPE, stdout=subprocess.PIPE,
-            )
+            try:
+                proc = _spawn(["r2", "-q0", "-d", *shlex.split(app)],
+                              stdin=subprocess.PIPE, stdout=subprocess.PIPE)
+            except (OSError, ValueError) as e:
+                metrics.GLOBAL.record_monitor("spawn_failed")
+                logger.log("error", "r2 monitor: cannot spawn: %s", e)
+                self._stop_evt.wait(float(self.params.get("delay", 2.0)))
+                continue
             try:
                 proc.stdin.write(b"dc\n")
                 proc.stdin.flush()
@@ -191,14 +423,21 @@ class R2Monitor(Monitor):
                     proc.stdin.write(b"drj\nij\ndbt\n")
                     proc.stdin.flush()
                     dump = proc.stdout.read()
-                    logger.log("finding", "r2 crash dump: %r", dump[:1000])
-                    feedback.publish("crash", source="monitor:r2")
+                    key, first = self.triage.observe(signal.SIGSEGV, dump)
+                    if first:
+                        metrics.GLOBAL.record_monitor("crash")
+                        logger.log("finding", "r2 crash dump triage=%s: %r",
+                                   key, dump[:1000])
+                        feedback.publish("crash", source="monitor:r2",
+                                         detail=key)
+                    else:
+                        metrics.GLOBAL.record_monitor("crash_dup")
                     _run_after(self.params)
             except (OSError, ValueError):
                 pass
             finally:
-                proc.kill()
-            time.sleep(float(self.params.get("delay", 2.0)))
+                _kill_group(proc)
+            self._stop_evt.wait(float(self.params.get("delay", 2.0)))
 
 
 class LogcatMonitor(Monitor):
@@ -214,9 +453,12 @@ class LogcatMonitor(Monitor):
         app = self.params.get("app", "")
         if app:
             subprocess.run(["adb", "shell", "am", "start", "-n", app], check=False)
-        proc = subprocess.Popen(
-            ["adb", "logcat", "*:E"], stdout=subprocess.PIPE
-        )
+        try:
+            proc = _spawn(["adb", "logcat", "*:E"], stdout=subprocess.PIPE)
+        except OSError as e:
+            metrics.GLOBAL.record_monitor("spawn_failed")
+            logger.log("error", "logcat monitor: cannot spawn adb: %s", e)
+            return
         crash_lines: list[bytes] = []
         for line in proc.stdout:
             if self._stop_evt.is_set():
@@ -231,7 +473,7 @@ class LogcatMonitor(Monitor):
                     feedback.publish("crash", source="monitor:lc")
                     _run_after(self.params)
                     crash_lines = []
-        proc.kill()
+        _kill_group(proc)
 
 
 class LxiMonitor(Monitor):
@@ -249,8 +491,8 @@ class LxiMonitor(Monitor):
         while not self._stop_evt.is_set():
             try:
                 with socket.create_connection((host, port), timeout=3.0) as s:
-                    s.sendall(b"MEAS:CURR?\n")
-                    v = float(s.recv(256).strip())
+                    _net_write(s, b"MEAS:CURR?\n")
+                    v = float(_net_read(s, 256).strip())
                     if not (lo <= v <= hi):
                         logger.log("finding",
                                    "lxi measurement %g outside [%g, %g]", v, lo, hi)
@@ -283,6 +525,7 @@ class CdbMonitor(Monitor):
     def __init__(self, params: dict):
         super().__init__(params)
         self._proc: subprocess.Popen | None = None
+        self.triage = CrashTriage()
 
     def stop(self):
         super().stop()
@@ -336,11 +579,12 @@ class CdbMonitor(Monitor):
                            self.ATTEMPTS)
                 return
             try:
-                self._proc = subprocess.Popen(
+                self._proc = _spawn(
                     [cdb, *args], stdin=subprocess.PIPE,
                     stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
                 )
             except OSError as e:
+                metrics.GLOBAL.record_monitor("spawn_failed")
                 logger.log("warning", "cdb monitor spawn failed: %s", e)
                 attempts -= 1
                 self._stop_evt.wait(1.0)
@@ -370,10 +614,15 @@ class CdbMonitor(Monitor):
             attempts = self.ATTEMPTS
             logger.log("finding", "cdb monitor detected event (crash?): %r",
                        crash[:1000])
-            feedback.publish("crash", source="monitor:cdb")
             bt = self._call(b"k\r\n")
             logger.log("finding", "cdb monitor backtrace: %r",
                        (bt or b"")[:2000])
+            key, first = self.triage.observe(0, bt or crash)
+            if first:
+                metrics.GLOBAL.record_monitor("crash")
+                feedback.publish("crash", source="monitor:cdb", detail=key)
+            else:
+                metrics.GLOBAL.record_monitor("crash_dup")
             regs = self._call(b"r\r\n")
             logger.log("finding", "cdb monitor registers: %r",
                        (regs or b"")[:2000])
@@ -401,6 +650,177 @@ class CdbMonitor(Monitor):
             proc.wait(timeout=5)
         except OSError:
             pass
+
+
+# --- coverage ingest hub --------------------------------------------------
+
+class CoverageHub:
+    """Framed connect-back coverage ingest (the r15 frame codec of
+    services/dist.py on a loopback-friendly listener).
+
+    Instrumented targets (or the tier-1 stub) connect back and stream
+    frames whose header is ``{"op": "cov", "case": C, "slot": S,
+    "epoch": E, "crc": crc32(blob)}`` with the raw edge bitmap as the
+    blob. Frames are crc32-checked against the blob and epoch-stamped;
+    stale-epoch and torn (bad magic/width/crc) frames are rejected AND
+    counted. Accepted maps buffer per case until the runner folds them
+    at the case boundary (corpus/runner.py), where the sample ledger
+    maps them back to (seed, case, slot).
+
+    Robustness contract: the accept loop runs under the supervisor;
+    every ingest failure — including an injected ``monitor.ingest``
+    chaos fault — feeds a CircuitBreaker, and an OPEN circuit or a dead
+    listener thread marks the hub dead. Death is sticky and one-way:
+    the campaign degrades to hash-novelty and STAYS degraded, because a
+    coverage signal that flickers would make adoption decisions depend
+    on reconnect timing.
+    """
+
+    _GUARDED_BY = {"_lock": ("_pending", "counts")}
+
+    def __init__(self, port: int = 0, host: str = "127.0.0.1",
+                 map_bytes: int = COVERAGE_MAP_BYTES, epoch: int = 0):
+        self.map_bytes = int(map_bytes)
+        self.epoch = int(epoch)
+        self._lock = threading.Lock()
+        self._stop_evt = threading.Event()
+        self._dead = False
+        self._pending: dict[int, dict[int, bytes]] = {}
+        self.counts = {"frames": 0, "stale": 0, "torn": 0, "faulted": 0,
+                       "late": 0}
+        srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        srv.bind((host, int(port)))
+        srv.listen(8)
+        srv.settimeout(0.5)
+        self._srv = srv
+        self.host, self.port = srv.getsockname()[:2]
+        self.breaker = CircuitBreaker(failure_threshold=4,
+                                      reset_timeout=3600.0,
+                                      name="monitor:ingest")
+        self._thread = SupervisedThread("monitor:coverage", self._serve)
+
+    def start(self) -> "CoverageHub":
+        self._thread.start()
+        logger.log("info", "coverage hub listening on %s:%d (map=%dB "
+                   "epoch=%d)", self.host, self.port, self.map_bytes,
+                   self.epoch)
+        return self
+
+    def _serve(self):
+        while not self._stop_evt.is_set():
+            try:
+                conn, addr = self._srv.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                break  # listener socket gone: alive() flips false
+            threading.Thread(target=self._client, args=(conn, addr),
+                             name="mon:cov-conn", daemon=True).start()
+        try:
+            self._srv.close()
+        except OSError:
+            pass
+
+    def _client(self, conn: socket.socket, addr):
+        f = conn.makefile("rb")
+        try:
+            while not self._stop_evt.is_set():
+                try:
+                    fr = _read_frame(f)
+                except ValueError as e:
+                    with self._lock:
+                        self.counts["torn"] += 1
+                    metrics.GLOBAL.record_coverage_frame("torn")
+                    logger.log("warning", "coverage hub: torn stream from "
+                               "%s: %s", addr[0], e)
+                    break
+                if fr is None:
+                    break
+                self._ingest(fr[0], fr[1], addr)
+        except OSError:
+            pass  # peer vanished mid-frame; buffered maps stay valid
+        finally:
+            try:
+                f.close()
+                conn.close()
+            except OSError:
+                pass
+
+    def _ingest(self, header: dict, blob: bytes, addr):
+        try:
+            chaos.fault_point("monitor.ingest")
+        except OSError as e:
+            with self._lock:
+                self.counts["faulted"] += 1
+            self.breaker.record_failure()
+            if self.breaker.state == OPEN:
+                self._dead = True
+            logger.log("warning", "coverage hub: ingest fault from %s: %s",
+                       addr[0], e)
+            return
+        try:
+            op = header.get("op")
+            case = int(header["case"])
+            slot = int(header["slot"])
+            epoch = int(header.get("epoch", -1))
+            crc = int(header.get("crc", -1))
+        except (KeyError, TypeError, ValueError):
+            op = None
+            case = slot = epoch = crc = -1
+        if op != "cov":
+            with self._lock:
+                self.counts["torn"] += 1
+            metrics.GLOBAL.record_coverage_frame("torn")
+            return
+        if epoch != self.epoch:
+            with self._lock:
+                self.counts["stale"] += 1
+            metrics.GLOBAL.record_coverage_frame("stale")
+            return
+        if len(blob) != self.map_bytes or zlib.crc32(blob) != crc & 0xFFFFFFFF:
+            with self._lock:
+                self.counts["torn"] += 1
+            metrics.GLOBAL.record_coverage_frame("torn")
+            return
+        with self._lock:
+            self.counts["frames"] += 1
+            self._pending.setdefault(case, {})[slot] = blob
+        metrics.GLOBAL.record_coverage_frame("ok")
+        self.breaker.record_success()
+
+    def take(self, case: int) -> dict[int, bytes]:
+        """Pop this case's buffered maps {slot: bitmap}. Frames for
+        cases the runner already folded are dropped and counted late —
+        re-folding them would make energy depend on arrival timing."""
+        with self._lock:
+            out = self._pending.pop(case, {})
+            n_late = sum(len(self._pending.pop(c))
+                         for c in [c for c in self._pending if c < case])
+            if n_late:
+                self.counts["late"] += n_late
+        return out
+
+    def pending_frames(self) -> int:
+        with self._lock:
+            return sum(len(v) for v in self._pending.values())
+
+    def stats(self) -> dict:
+        with self._lock:
+            return dict(self.counts)
+
+    def alive(self) -> bool:
+        return not self._dead and self._thread.is_alive()
+
+    def stop(self):
+        self._stop_evt.set()
+        try:
+            self._srv.close()
+        except OSError:
+            pass
+
+    def join(self, timeout=None):
+        self._thread.join(timeout)
 
 
 MONITORS = {

@@ -68,6 +68,18 @@
 # --struct-kernels at most one of the 38 reference codes may still
 # route to the host).
 #
+# scripts/tier1.sh --monitor-smoke additionally exercises the r16
+# monitor plane end to end on loopback: an in-process CoverageHub
+# receives deterministic pre-buffered edge-bitmap frames and the
+# coverage-gated run must adopt differently from the hash-novelty
+# baseline (genuinely-new edges admit, zero-gain slots do not) and
+# distill subsumed seeds; then the same campaign under an injected
+# monitor.ingest fault storm (the hub's breaker opens, the plane is
+# dead before case 0) must complete DEGRADED with output bytes
+# identical to the coverage-off baseline; finally an ExecMonitor stub
+# must land an abnormal-exit finding on the feedback bus through the
+# supervised plane (services/monitors.py, corpus/distill.py).
+#
 # The gate starts with fuzzlint (erlamsa_tpu/analysis): pure-AST
 # invariant checks (determinism, device purity, lock discipline,
 # resilience coverage) over the whole package in ~2s. Opt out with
@@ -82,10 +94,12 @@ fleet_smoke=0
 dist_fleet_smoke=0
 serve_smoke=0
 struct_smoke=0
+monitor_smoke=0
 lint=1
 while [ $# -gt 0 ]; do
   case "$1" in
     --bench-smoke) bench_smoke=1; shift ;;
+    --monitor-smoke) monitor_smoke=1; shift ;;
     --chaos-smoke) chaos_smoke=1; shift ;;
     --obs-smoke) obs_smoke=1; shift ;;
     --arena-smoke) arena_smoke=1; shift ;;
@@ -611,6 +625,128 @@ print(f"STRUCT_SMOKE={'ok' if ok else 'FAIL'} identical={blob_h == blob_d} "
       f"bytes={len(blob_d)} "
       f"struct_upload_bytes={st_d.get('struct_bytes_uploaded')} "
       f"device_host_tail={tail} stray_codes={stray}")
+sys.exit(0 if ok else 1)
+EOF
+  rc=$?
+fi
+
+if [ $rc -eq 0 ] && [ $monitor_smoke -eq 1 ]; then
+  echo "== monitor smoke: coverage-gated adoption + degradation byte-identity =="
+  timeout -k 10 900 env JAX_PLATFORMS=cpu python - <<'EOF'
+import os, shutil, socket, sys, tempfile, time, zlib
+
+from erlamsa_tpu.corpus import feedback as fb
+from erlamsa_tpu.corpus.runner import run_corpus_batch
+from erlamsa_tpu.services import chaos
+from erlamsa_tpu.services.dist import _pack_frame
+from erlamsa_tpu.services.monitors import CoverageHub, ExecMonitor
+
+SEEDS = [bytes([65 + i]) * (30 * (i + 1)) for i in range(6)]
+N, BATCH = 3, 8
+
+
+def one_run(root, hub=None, distill=False):
+    outdir = os.path.join(root, "out")
+    os.makedirs(outdir)
+    stats = {}
+    opts = {
+        "corpus_dir": os.path.join(root, "corpus"),
+        "corpus": list(SEEDS),
+        "feedback": True,
+        "seed": (16, 16, 16),
+        "n": N,
+        "output": os.path.join(outdir, "%n.out"),
+        "adopt": True,
+        "_stats": stats,
+    }
+    if hub is not None:
+        opts.update(coverage=True, coverage_hub=hub, distill=distill)
+    rc = run_corpus_batch(opts, batch=BATCH)
+    blob = b""
+    for f in sorted(os.listdir(outdir), key=lambda s: int(s.split(".")[0])):
+        blob += open(os.path.join(outdir, f), "rb").read()
+    return rc, blob, stats
+
+
+def send_frames(hub, frames):
+    with socket.create_connection((hub.host, hub.port), timeout=10) as s:
+        for case, slot, blob in frames:
+            s.sendall(_pack_frame(
+                {"op": "cov", "case": case, "slot": slot, "epoch": 0,
+                 "crc": zlib.crc32(blob)}, blob))
+
+
+def wait(pred, timeout=15.0):
+    t0 = time.monotonic()
+    while not pred():
+        if time.monotonic() - t0 > timeout:
+            return False
+        time.sleep(0.01)
+    return True
+
+
+root = tempfile.mkdtemp(prefix="tier1_monitor_smoke_")
+try:
+    # A: hash-novelty baseline (coverage off) — every novel output
+    # hash adopts, up to the cap
+    rc_a, blob_a, st_a = one_run(os.path.join(root, "base"))
+
+    # B: coverage-gated. All frames are buffered BEFORE the run (the
+    # deterministic stub): case 0 slot 0 lights 32 edges, slots 1-7
+    # light a strict subset — sequential gains make slot 0 the only
+    # admit; cases 1-2 send all-zero maps, so nothing else adopts.
+    # Distillation must then retire the subset-covered seeds.
+    hub_b = CoverageHub(port=0).start()
+    mb = hub_b.map_bytes
+    full = bytes([0xFF] * 4) + bytes(mb - 4)
+    subset = bytes([0xFF] * 2) + bytes(mb - 2)
+    frames = [(0, 0, full)] + [(0, s, subset) for s in range(1, BATCH)]
+    frames += [(c, s, bytes(mb)) for c in (1, 2) for s in range(BATCH)]
+    send_frames(hub_b, frames)
+    buffered = wait(lambda: hub_b.pending_frames() == len(frames))
+    rc_b, blob_b, st_b = one_run(os.path.join(root, "cov"), hub=hub_b,
+                                 distill=True)
+    hub_b.stop()
+    cov_b = st_b.get("coverage", {})
+
+    # C: same campaign, but a monitor.ingest fault storm kills the
+    # plane (breaker opens on the pre-run frames) — the run must
+    # complete DEGRADED and byte-identical to the A baseline
+    chaos.configure("monitor.ingest:*", seed=16)
+    hub_c = CoverageHub(port=0).start()
+    send_frames(hub_c, frames[:6])
+    dead = wait(lambda: not hub_c.alive())
+    rc_c, blob_c, st_c = one_run(os.path.join(root, "deg"), hub=hub_c)
+    chaos.configure(None)
+    hub_c.stop()
+    cov_c = st_c.get("coverage", {})
+
+    # exec stub: one abnormal exit must cross the supervised monitor
+    # plane onto the findings bus (after the runs — the runs consume
+    # the bus)
+    fb.GLOBAL.drain()
+    mon = ExecMonitor({"app": "sh -c 'exit 7'", "delay": 30,
+                       "timeout": 10}).start()
+    exec_ok = wait(lambda: any(e.kind == "finding" and e.detail == "rc=7"
+                               for e in fb.GLOBAL.drain()))
+    mon.stop()
+    mon.join(timeout=10)
+finally:
+    shutil.rmtree(root, ignore_errors=True)
+
+ok = (rc_a == rc_b == rc_c == 0 and blob_a and buffered and dead
+      and st_a["offspring"] > 1 and st_b["offspring"] <= 1
+      and cov_b.get("folds", 0) == N and cov_b.get("new_edges") == 32
+      and not cov_b.get("degraded") and cov_b.get("distilled", 0) >= 1
+      and blob_b != blob_a
+      and cov_c.get("degraded") and blob_c == blob_a
+      and exec_ok)
+print(f"MONITOR_SMOKE={'ok' if ok else 'FAIL'} "
+      f"adopt_base={st_a['offspring']} adopt_cov={st_b['offspring']} "
+      f"folds={cov_b.get('folds')} new_edges={cov_b.get('new_edges')} "
+      f"distilled={cov_b.get('distilled')} "
+      f"degraded={bool(cov_c.get('degraded'))} "
+      f"identical_degraded={blob_c == blob_a} exec_finding={exec_ok}")
 sys.exit(0 if ok else 1)
 EOF
   rc=$?

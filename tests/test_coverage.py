@@ -1,0 +1,471 @@
+"""r16 monitor plane + coverage feedback: device kernels vs numpy
+oracles (bit-for-bit), greedy set-cover distillation, the CoverageHub
+frame protocol (ok/stale/torn/fault dispositions, breaker-driven
+death), crash triage dedup, the spawn/hang watchdogs, checkpoint
+round-trip with kind-stamped coverage maps, and the runner's
+coverage-gated adoption + degradation byte-identity contract."""
+
+import os
+import socket
+import time
+import zlib
+
+import numpy as np
+import pytest
+
+from erlamsa_tpu.corpus import feedback as fb
+from erlamsa_tpu.corpus.distill import CoverageIndex, greedy_minimize
+from erlamsa_tpu.corpus.store import CorpusStore
+from erlamsa_tpu.ops import coverage as covops
+from erlamsa_tpu.services import chaos, metrics
+from erlamsa_tpu.services.dist import _pack_frame
+from erlamsa_tpu.services.monitors import (CoverageHub, CrashTriage,
+                                           ExecMonitor, _run_after)
+
+
+def _wait(pred, timeout=15.0):
+    t0 = time.monotonic()
+    while not pred():
+        if time.monotonic() - t0 > timeout:
+            return False
+        time.sleep(0.01)
+    return True
+
+
+# ---- device kernels vs numpy oracles ------------------------------------
+
+
+def test_popcount_matches_oracle():
+    rng = np.random.default_rng(0)
+    maps = rng.integers(0, 256, size=(7, 64), dtype=np.uint8)
+    assert np.array_equal(np.asarray(covops.popcount(maps)),
+                          covops.popcount_np(maps))
+    assert int(covops.popcount(np.zeros((1, 16), np.uint8))[0]) == 0
+    assert int(covops.popcount(np.full((1, 16), 255, np.uint8))[0]) == 128
+
+
+def test_fold_and_gains_match_oracle_bit_for_bit():
+    rng = np.random.default_rng(1)
+    acc = rng.integers(0, 256, size=128, dtype=np.uint8)
+    maps = rng.integers(0, 256, size=(9, 128), dtype=np.uint8)
+    assert np.array_equal(np.asarray(covops.fold_maps(acc, maps)),
+                          covops.fold_maps_np(acc, maps))
+    g_np, a_np = covops.batch_gains_np(acc, maps)
+    g_d, a_d = covops.batch_gains(acc, maps)
+    assert np.array_equal(np.asarray(g_d), g_np)
+    assert np.array_equal(np.asarray(a_d), a_np)
+
+
+def test_batch_gains_sequential_semantics():
+    """A map that only repeats a lower slot's edges gains zero — the
+    order-stable per-slot adoption gate."""
+    acc = np.zeros(8, np.uint8)
+    m = np.zeros(8, np.uint8)
+    m[0] = 0xF0
+    maps = np.stack([m, m, m])
+    g, a = covops.batch_gains_np(acc, maps)
+    assert list(g) == [4, 0, 0]
+    gd, ad = covops.batch_gains(acc, maps)
+    assert list(np.asarray(gd)) == [4, 0, 0]
+    assert np.array_equal(np.asarray(ad), a)
+    # already-accumulated edges never count again
+    g2, _ = covops.batch_gains_np(a, m[None])
+    assert list(g2) == [0]
+
+
+# ---- CoverageIndex -------------------------------------------------------
+
+
+def test_coverage_index_device_matches_host():
+    rng = np.random.default_rng(5)
+    pairs = [(f"s{i % 3}",
+              rng.integers(0, 256, 16, dtype=np.uint8).tobytes())
+             for i in range(6)]
+    host = CoverageIndex(map_bytes=16, use_device=False)
+    dev = CoverageIndex(map_bytes=16, use_device=True)
+    for chunk in (pairs[:3], pairs[3:]):
+        assert host.fold_case(list(chunk)) == dev.fold_case(list(chunk))
+    assert np.array_equal(host.global_map, dev.global_map)
+    assert host.edges() == dev.edges()
+    assert list(host.per_seed) == list(dev.per_seed)
+    for sid in host.per_seed:
+        assert np.array_equal(host.per_seed[sid], dev.per_seed[sid])
+
+
+def test_coverage_index_width_mismatch_and_empty():
+    idx = CoverageIndex(map_bytes=8)
+    assert idx.fold_case([]) == []
+    assert idx.folds == 0
+    with pytest.raises(ValueError):
+        idx.fold_case([("x", bytes(4))])
+
+
+def test_coverage_index_snapshot_roundtrip():
+    idx = CoverageIndex(map_bytes=8)
+    idx.fold_case([("a", b"\x01" + bytes(7)), ("b", bytes(8))])
+    other = CoverageIndex(map_bytes=8)
+    other.restore(idx.snapshot())
+    assert list(other.per_seed) == ["a", "b"]
+    assert np.array_equal(other.global_map, idx.global_map)
+    assert other.edges() == idx.edges() == 1
+
+
+def test_fold_case_chaos_fault_raises_oserror():
+    idx = CoverageIndex(map_bytes=8)
+    chaos.configure("coverage.fold:x1", seed=2)
+    try:
+        with pytest.raises(OSError):
+            idx.fold_case([("a", bytes(8))])
+        # the fault healed: the very next fold lands
+        assert idx.fold_case([("a", b"\xff" + bytes(7))]) == [8]
+    finally:
+        chaos.configure(None)
+
+
+# ---- greedy set-cover distillation --------------------------------------
+
+
+def test_greedy_minimize_empty_input():
+    assert greedy_minimize([], np.zeros((0, 8), np.uint8)) == ([], [])
+    with pytest.raises(ValueError):
+        greedy_minimize(["a"], np.zeros((2, 8), np.uint8))
+
+
+def test_greedy_minimize_empty_rows_always_kept():
+    """No coverage evidence is absence of signal, not subsumption."""
+    ids = ["a", "b", "c"]
+    maps = np.zeros((3, 4), np.uint8)
+    maps[1, 0] = 1
+    keep, retired = greedy_minimize(ids, maps)
+    assert sorted(keep) == ["a", "b", "c"]
+    assert retired == []
+
+
+def test_greedy_minimize_all_subsumed_retires_rest():
+    ids = ["big", "s1", "s2"]
+    maps = np.zeros((3, 4), np.uint8)
+    maps[0] = (255, 255, 0, 0)
+    maps[1] = (255, 0, 0, 0)
+    maps[2] = (15, 15, 0, 0)
+    keep, retired = greedy_minimize(ids, maps)
+    assert keep == ["big"]
+    assert sorted(retired) == ["s1", "s2"]
+
+
+def test_greedy_minimize_partial_overlap_never_retired():
+    ids = ["a", "b"]
+    maps = np.zeros((2, 4), np.uint8)
+    maps[0] = (255, 0, 0, 0)
+    maps[1] = (1, 1, 0, 0)  # one edge outside a's set
+    keep, retired = greedy_minimize(ids, maps)
+    assert sorted(keep) == ["a", "b"]
+    assert retired == []
+
+
+def test_greedy_minimize_tie_break_deterministic():
+    """Equal-gain rows break toward the earliest-inserted seed, every
+    time."""
+    ids = ["first", "second"]
+    maps = np.tile(np.asarray([1, 2, 3, 4], np.uint8), (2, 1))
+    for _ in range(3):
+        keep, retired = greedy_minimize(ids, maps)
+        assert keep == ["first"]
+        assert retired == ["second"]
+
+
+# ---- store retirement ----------------------------------------------------
+
+
+def test_store_retire_removes_seed(tmp_path):
+    store = CorpusStore(str(tmp_path / "c"))
+    sid, _ = store.add(b"retire me", origin="direct")
+    keep_id, _ = store.add(b"keeper", origin="direct")
+    assert store.retire(sid)
+    assert sid not in store.ids()
+    assert not store.retire(sid)  # already gone
+    reopened = CorpusStore(str(tmp_path / "c"))
+    assert sid not in reopened.ids()
+    assert keep_id in reopened.ids()
+
+
+# ---- sample ledger -------------------------------------------------------
+
+
+def test_sample_ledger_bounded_and_resolves():
+    led = fb.SampleLedger(keep=2)
+    led.record(0, ["a", "b"])
+    led.record(1, ["c"])
+    led.record(2, ["d"])
+    assert led.resolve(0, 0) is None  # evicted past the keep window
+    assert led.resolve(1, 0) == "c"
+    assert led.resolve(2, 5) is None  # out-of-range slot
+    assert led.ids(2) == ("d",)
+
+
+# ---- CoverageHub frame protocol -----------------------------------------
+
+
+def _frame(case, slot, blob, epoch=0, crc=None, op="cov"):
+    return _pack_frame({"op": op, "case": case, "slot": slot,
+                        "epoch": epoch,
+                        "crc": zlib.crc32(blob) if crc is None else crc},
+                       blob)
+
+
+def test_coverage_hub_frame_dispositions():
+    hub = CoverageHub(port=0, map_bytes=32).start()
+    try:
+        good = bytes(31) + b"\x01"
+        with socket.create_connection((hub.host, hub.port), timeout=5) as s:
+            s.sendall(_frame(0, 0, good))
+            s.sendall(_frame(0, 1, good, epoch=5))     # stale epoch
+            s.sendall(_frame(0, 2, good, crc=123))     # torn: bad crc
+            s.sendall(_frame(0, 3, bytes(8)))          # torn: bad width
+            s.sendall(_frame(0, 4, good, op="bogus"))  # torn: wrong op
+        assert _wait(lambda: (hub.stats()["frames"],
+                              hub.stats()["stale"],
+                              hub.stats()["torn"]) == (1, 1, 3))
+        assert hub.pending_frames() == 1
+        assert hub.take(0) == {0: good}
+        assert hub.take(0) == {}  # consumed
+        assert hub.alive()
+    finally:
+        hub.stop()
+        hub.join(timeout=10)
+
+
+def test_coverage_hub_torn_stream_and_late_frames():
+    hub = CoverageHub(port=0, map_bytes=16).start()
+    try:
+        with socket.create_connection((hub.host, hub.port), timeout=5) as s:
+            s.sendall(_frame(0, 0, bytes(16)))
+        assert _wait(lambda: hub.pending_frames() == 1)
+        # a take past the case drops the stragglers as late
+        assert hub.take(2) == {}
+        assert hub.stats()["late"] == 1
+        # raw garbage is a torn stream, not a hub crash
+        with socket.create_connection((hub.host, hub.port), timeout=5) as s:
+            s.sendall(b"this is not a frame at all")
+        assert _wait(lambda: hub.stats()["torn"] >= 1)
+        assert hub.alive()
+    finally:
+        hub.stop()
+        hub.join(timeout=10)
+
+
+def test_coverage_hub_ingest_faults_trip_breaker_dead():
+    """A persistent monitor.ingest fault storm opens the hub's breaker:
+    the plane reports dead and the runner degrades to hash-novelty."""
+    chaos.configure("monitor.ingest:*", seed=3)
+    hub = CoverageHub(port=0, map_bytes=16).start()
+    try:
+        blob = bytes(16)
+        with socket.create_connection((hub.host, hub.port), timeout=5) as s:
+            for i in range(6):
+                s.sendall(_frame(0, i, blob))
+        assert _wait(lambda: not hub.alive())
+        assert hub.stats()["faulted"] >= 4
+        assert hub.stats()["frames"] == 0
+    finally:
+        chaos.configure(None)
+        hub.stop()
+        hub.join(timeout=10)
+
+
+# ---- crash triage --------------------------------------------------------
+
+
+def test_crash_triage_dedup_by_signal_and_top_frames():
+    t = CrashTriage()
+    bt = (b"#0 0x0004 in foo (a.c:1)\n#1 0x0008 in bar (a.c:9)\n"
+          b"#2 0x000c in baz (a.c:12)")
+    k1, first1 = t.observe(11, bt)
+    # a deeper frame below the top-3 does not change the bucket
+    k2, first2 = t.observe(11, bt + b"\n#3 0x0010 in deeper (a.c:44)")
+    assert first1 and not first2
+    assert k1 == k2
+    assert t.dups == 1
+    # same stack under a different signal is a different bug
+    k3, first3 = t.observe(6, bt)
+    assert first3 and k3 != k1
+    # different top frames, same signal: different bug
+    k4, first4 = t.observe(11, b"#0 0x00c0 in other (b.c:2)")
+    assert first4 and k4 != k1
+    # no backtrace at all still buckets (first non-empty lines)
+    k5, first5 = t.observe(11, b"plain stderr noise\nmore noise")
+    assert first5 and k5.startswith("sig11:")
+
+
+# ---- watchdogs -----------------------------------------------------------
+
+
+def test_run_after_spawn_failure_logged_not_swallowed():
+    before = metrics.GLOBAL.snapshot()["monitors"].get("spawn_failed", 0)
+    _run_after({"after": "/nonexistent/definitely-missing-binary-xyz"})
+    after = metrics.GLOBAL.snapshot()["monitors"].get("spawn_failed", 0)
+    assert after == before + 1
+
+
+def test_run_after_hang_killed_by_watchdog():
+    snap = metrics.GLOBAL.snapshot()["monitors"]
+    spawned0 = snap.get("after_spawned", 0)
+    hung0 = snap.get("hang_killed", 0)
+    _run_after({"after": "sleep 30", "after_timeout": 0.3})
+    assert _wait(lambda: metrics.GLOBAL.snapshot()["monitors"]
+                 .get("hang_killed", 0) == hung0 + 1)
+    assert (metrics.GLOBAL.snapshot()["monitors"].get("after_spawned", 0)
+            == spawned0 + 1)
+
+
+def test_exec_monitor_hang_watchdog_kills_and_publishes():
+    fb.GLOBAL.drain()
+    mon = ExecMonitor({"app": "sleep 30", "timeout": 0.3,
+                       "delay": 60}).start()
+    try:
+        assert _wait(lambda: any(e.kind == "finding" and e.detail == "hang"
+                                 and e.source == "monitor:exec"
+                                 for e in fb.GLOBAL.drain()))
+    finally:
+        mon.stop()
+        mon.join(timeout=10)
+    assert metrics.GLOBAL.snapshot()["monitors"].get("hang_killed", 0) >= 1
+
+
+# ---- checkpointed coverage maps -----------------------------------------
+
+
+def test_checkpoint_coverage_roundtrip_absent_and_mismatch(tmp_path):
+    from erlamsa_tpu.services.checkpoint import (load_coverage_maps,
+                                                 quarantine_mismatch,
+                                                 save_state)
+
+    idx = CoverageIndex(map_bytes=32)
+    idx.fold_case([("s1", b"\x07" + bytes(31)), ("s2", bytes(32))])
+    path = str(tmp_path / "s.npz")
+    save_state(path, (1, 2, 3), 1, np.zeros((4, 3), np.int32),
+               coverage=idx.snapshot())
+    verdict, snap = load_coverage_maps(path, 32)
+    assert verdict == "ok"
+    idx2 = CoverageIndex(map_bytes=32)
+    idx2.restore(snap)
+    assert list(idx2.per_seed) == ["s1", "s2"]
+    assert np.array_equal(idx2.global_map, idx.global_map)
+    assert idx2.edges() == idx.edges() == 3
+
+    # empty coverage still stamps and round-trips
+    p_empty = str(tmp_path / "empty.npz")
+    save_state(p_empty, (1, 2, 3), 1, np.zeros((4, 3), np.int32),
+               coverage=CoverageIndex(map_bytes=32).snapshot())
+    verdict, snap = load_coverage_maps(p_empty, 32)
+    assert verdict == "ok" and snap["ids"] == []
+
+    # a pre-coverage checkpoint is absent, never a crash or an alias
+    p_old = str(tmp_path / "old.npz")
+    save_state(p_old, (1, 2, 3), 1, np.zeros((4, 3), np.int32))
+    assert load_coverage_maps(p_old, 32) == ("absent", None)
+
+    # a different map width is a refusal the caller quarantines to .bak
+    verdict, snap = load_coverage_maps(path, 64)
+    assert verdict == "mismatch" and snap is None
+    assert quarantine_mismatch(path)
+    assert os.path.exists(path + ".bak") and not os.path.exists(path)
+
+
+# ---- prometheus families -------------------------------------------------
+
+
+def test_prom_renders_coverage_and_monitor_families():
+    from erlamsa_tpu.obs import prom
+
+    c = metrics.Counters()
+    c.record_coverage_frame("ok")
+    c.record_coverage_frame("torn")
+    c.record_coverage_fold(4, 12, 30)
+    c.record_distilled(2)
+    c.set_coverage_degraded(True)
+    c.record_monitor("hang_killed")
+    body = prom.render(c)
+    assert 'erlamsa_coverage_frames_total{result="ok"} 1' in body
+    assert 'erlamsa_coverage_frames_total{result="torn"} 1' in body
+    assert "erlamsa_coverage_new_edges_total 12" in body
+    assert "erlamsa_coverage_edges 30" in body
+    assert "erlamsa_coverage_folds_total 1" in body
+    assert "erlamsa_coverage_degraded 1" in body
+    assert "erlamsa_coverage_distilled_total 2" in body
+    assert 'erlamsa_monitor_events_total{kind="hang_killed"} 1' in body
+    # untouched counters render neither family (absent != zero)
+    empty = prom.render(metrics.Counters())
+    assert "erlamsa_coverage_" not in empty
+    assert "erlamsa_monitor_events_total" not in empty
+
+
+# ---- end-to-end runner (compiles the device engine: slow) ---------------
+
+
+@pytest.mark.slow
+def test_runner_coverage_gates_adoption_then_degrades_identically(tmp_path):
+    """The r16 acceptance triangle: (A) hash-novelty baseline, (B) the
+    same campaign coverage-gated — only genuinely-new edges admit — and
+    (C) the same campaign with the monitor plane killed by an injected
+    ingest fault storm, which must complete DEGRADED and byte-identical
+    to A."""
+    from erlamsa_tpu.corpus.runner import run_corpus_batch
+
+    seeds = [bytes([65 + i]) * (30 * (i + 1)) for i in range(6)]
+    n, batch = 2, 8
+
+    def run(tag, hub=None, distill=False):
+        outdir = tmp_path / f"out-{tag}"
+        os.makedirs(outdir)
+        stats = {}
+        opts = {"corpus_dir": str(tmp_path / f"c-{tag}"), "corpus": seeds,
+                "feedback": True, "feedback_bus": fb.FeedbackBus(),
+                "seed": (16, 16, 16), "n": n,
+                "output": str(outdir / "%n.out"), "adopt": True,
+                "_stats": stats}
+        if hub is not None:
+            opts.update(coverage=True, coverage_hub=hub, distill=distill)
+        assert run_corpus_batch(opts, batch=batch) == 0
+        blob = b"".join(
+            open(outdir / f"{i}.out", "rb").read()
+            for i in range(n * batch))
+        return blob, stats
+
+    blob_a, st_a = run("base")
+
+    hub_b = CoverageHub(port=0).start()
+    mb = hub_b.map_bytes
+    full = bytes([0xFF] * 4) + bytes(mb - 4)
+    frames = [(0, 0, full)]
+    frames += [(0, s, bytes(mb)) for s in range(1, batch)]
+    frames += [(1, s, bytes(mb)) for s in range(batch)]
+    with socket.create_connection((hub_b.host, hub_b.port), timeout=5) as s:
+        for case, slot, blob in frames:
+            s.sendall(_frame(case, slot, blob))
+    assert _wait(lambda: hub_b.pending_frames() == len(frames))
+    blob_b, st_b = run("cov", hub=hub_b, distill=True)
+    hub_b.stop()
+    hub_b.join(timeout=10)
+    cov_b = st_b["coverage"]
+    # only the one edge-lighting slot admitted; zero-gain slots did not
+    assert st_b["offspring"] <= 1 < st_a["offspring"]
+    assert cov_b["folds"] == n and cov_b["new_edges"] == 32
+    assert not cov_b["degraded"]
+    assert cov_b["hub"]["frames"] == len(frames)
+    assert blob_b != blob_a  # the gate really changed the campaign
+
+    chaos.configure("monitor.ingest:*", seed=16)
+    hub_c = CoverageHub(port=0).start()
+    try:
+        with socket.create_connection((hub_c.host, hub_c.port),
+                                      timeout=5) as s:
+            for case, slot, blob in frames[:6]:
+                s.sendall(_frame(case, slot, blob))
+        assert _wait(lambda: not hub_c.alive())
+        blob_c, st_c = run("deg", hub=hub_c)
+    finally:
+        chaos.configure(None)
+        hub_c.stop()
+        hub_c.join(timeout=10)
+    assert st_c["coverage"]["degraded"]
+    assert blob_c == blob_a  # degradation is byte-identical to baseline
