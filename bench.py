@@ -381,6 +381,54 @@ def _run_fleet_stage(batch_n: int, seed_len: int, cases: int, t0: float,
     return warm_sps, stats
 
 
+def _run_gen_stage(cases: int, t0: float):
+    """Device grammar expansion (r17, ops/grammar.py) vs the sequential
+    host ``generate()`` loop on the same builtin grammar, fuzzing draws
+    on — the entry cost of the generate-then-mutate workload. The host
+    loop is time-boxed (it is the slow side by design); its rate comes
+    from however many expansions fit the box. Returns (device
+    samples/s, host samples/s, device bytes/sample)."""
+    import numpy as np
+
+    from erlamsa_tpu.gen import (BUILTIN_GRAMMARS, compile_grammar,
+                                 parse_grammar)
+    from erlamsa_tpu.models.genfuzz import fuzz_grammar
+    from erlamsa_tpu.ops import grammar as gk
+    from erlamsa_tpu.ops import prng
+    from erlamsa_tpu.utils.erlrand import ErlRand
+
+    gb = int(os.environ.get("ERLAMSA_BENCH_GEN_BATCH", 256))
+    grammar = parse_grammar(BUILTIN_GRAMMARS["demo-http"])
+    cg = compile_grammar(grammar, source="demo-http")
+    base = prng.base_key((1, 2, 3))
+    fn = gk.make_expand(cg, fuzz=True)
+    slots = np.arange(gb)
+    panel, lens, _ = fn(base, 0, slots)  # compile + warmup
+    panel.block_until_ready()
+    _phase(f"gen stage warm (B={gb}, grammar=demo-http)", t0)
+    t1 = time.perf_counter()
+    nbytes = 0
+    for case in range(cases):
+        panel, lens, _ = fn(base, case + 1, slots)
+        nbytes += int(np.asarray(lens).sum())
+    dev_s = time.perf_counter() - t1
+    dev_sps = gb * cases / max(dev_s, 1e-9)
+
+    r = ErlRand((1, 2, 3))
+    budget = min(max(dev_s * 10, 2.0), 20.0)
+    t1 = time.perf_counter()
+    host_n = 0
+    while (time.perf_counter() - t1 < budget
+           and host_n < gb * cases):
+        fuzz_grammar(r, grammar)
+        host_n += 1
+    host_sps = host_n / max(time.perf_counter() - t1, 1e-9)
+    _phase(
+        f"gen stage: device {dev_sps:.0f}/s vs host generate() "
+        f"{host_sps:.0f}/s ({dev_sps / max(host_sps, 1e-9):.1f}x)", t0)
+    return dev_sps, host_sps, nbytes / (gb * cases)
+
+
 def child_main() -> None:
     """The measured run. Writes its JSON record to $ERLAMSA_BENCH_RESULT
     (and stdout); phase timings go to stderr.
@@ -491,6 +539,24 @@ def child_main() -> None:
             _write_result(line)
         except Exception as e:  # noqa: BLE001 — earlier numbers stand
             _phase(f"struct stage FAILED: {type(e).__name__}: {e}", t0)
+
+    # grammar-generation stage (r17): table-driven device expansion
+    # (gen/ + ops/grammar.py) vs the sequential host generate() loop at
+    # batch 256 — the ISSUE target is >= 10x host on the same grammar.
+    # ERLAMSA_BENCH_GEN=0 skips.
+    if os.environ.get("ERLAMSA_BENCH_GEN", "1") != "0":
+        try:
+            gen_sps, gen_host_sps, gen_bps = _run_gen_stage(
+                max(4, ITERS // 2), t0)
+            record["gen_samples_per_sec"] = round(gen_sps, 1)
+            record["gen_host_samples_per_sec"] = round(gen_host_sps, 1)
+            record["gen_bytes_per_sample"] = round(gen_bps, 1)
+            record["gen_vs_host"] = (round(gen_sps / gen_host_sps, 2)
+                                     if gen_host_sps else 0.0)
+            line = json.dumps(record)
+            _write_result(line)
+        except Exception as e:  # noqa: BLE001 — earlier numbers stand
+            _phase(f"gen stage FAILED: {type(e).__name__}: {e}", t0)
 
     # corpus-mode stage: the feedback engine on a mixed-length seed set,
     # with per-bucket padded-bytes-wasted so the bucketing win over the

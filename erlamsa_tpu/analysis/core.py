@@ -43,7 +43,8 @@ class LintConfig:
     #: obs/ is included: the observability side channel may use monotonic
     #: clocks (allowed below) but must never read wall-clock entropy that
     #: could leak into replay values
-    wallclock_paths: tuple = ("ops/", "corpus/", "utils/erlrand.py", "obs/")
+    wallclock_paths: tuple = ("ops/", "corpus/", "utils/erlrand.py", "obs/",
+                              "gen/")
     #: monotonic/perf clocks never feed replay values, only metrics
     wallclock_allowed: tuple = ("time.monotonic", "time.perf_counter",
                                "time.perf_counter_ns", "time.monotonic_ns")
@@ -68,6 +69,8 @@ class LintConfig:
         # purpose — its key-led host_struct_fuzz is the numpy oracle and
         # coerces draws with int() by design
         "tree_mutators",
+        # r17 grammar-expansion kernel (gen/ compiler tables -> lax.scan)
+        "grammar",
     )
     #: modules whose raw send/recv + durable writes must route through a
     #: chaos fault site (chaos-site-coverage)
@@ -88,6 +91,7 @@ class LintConfig:
         "dist.shard.send", "dist.shard.recv", "fleet.checkpoint",
         "dist.shard.frame", "fleet.snapshot",
         "monitor.spawn", "monitor.ingest", "coverage.fold",
+        "gen.expand",
     )
 
     def in_scope(self, rel: str, prefixes: tuple) -> bool:

@@ -263,6 +263,37 @@ def run_corpus_batch(opts: dict, batch: int = 1024) -> int:
             print(f"# corpus: {new} new, {dup} duplicate, "
                   f"{skipped} skipped -> {len(store)} seeds in store",
                   file=sys.stderr)
+    gen_opts = opts.get("gen")
+    gen_engine = None
+    if gen_opts:
+        # r17 generate-then-mutate: seed the campaign from ONE batched
+        # device expansion of the compiled grammar. Generated rows enter
+        # the store like any other seed and the existing gather→mutate→
+        # score loop takes over — zero per-case host expansion on the
+        # hot path. Device loss (or an injected gen.expand fault)
+        # degrades the expansion to the keyed host oracle per
+        # (case, slot), byte-identically, so the campaign is the same
+        # either way.
+        from ..gen import GenEngine, compile_grammar
+
+        cg = gen_opts.get("compiled")
+        if cg is None:
+            cg = compile_grammar(gen_opts["grammar"],
+                                 source=gen_opts.get("label", "--gen"))
+        gen_engine = GenEngine(cg, opts["seed"],
+                               fuzz=bool(gen_opts.get("fuzz")))
+        gen_n = int(gen_opts.get("n") or 64)
+        payloads, gen_trunc = gen_engine.expand(case_idx=0, n=gen_n)
+        gen_added = 0
+        for p in payloads:
+            if p:
+                _sid, fresh = store.add(p, origin="gen")
+                gen_added += int(fresh)
+        print(f"# gen: {len(payloads)} samples from grammar "
+              f"{cg.source} -> {gen_added} new seeds"
+              f" ({gen_trunc} truncated)"
+              f"{', host-degraded' if gen_engine.degraded else ''}",
+              file=sys.stderr)
     if len(store) == 0:
         print("no corpus (store empty and no readable seeds)",
               file=sys.stderr)
@@ -1139,6 +1170,14 @@ def run_corpus_batch(opts: dict, batch: int = 1024) -> int:
                      store_stats=store.stats())
         if arena is not None:
             stats["arena"] = arena.stats()
+        if gen_engine is not None:
+            stats["gen"] = {
+                "grammar": gen_engine.cg.source,
+                "grammar_id": gen_engine.cg.grammar_id,
+                "generated": gen_engine.expansions,
+                "host_fallback": gen_engine.host_fallbacks,
+                "degraded": gen_engine.degraded,
+            }
         if cov is not None:
             stats["coverage"] = {
                 "edges": cov.edges(), "folds": cov.folds,

@@ -1,0 +1,23 @@
+"""Device grammar generation: compiler + engine (r17).
+
+gen/compile.py flattens genfuzz grammars (models/genfuzz.py tuple form
+or the --gen s-expression DSL) into fixed-shape int32/uint8 tables;
+ops/grammar.py expands those tables as a bounded, counter-keyed stack
+machine on device; gen/engine.py wraps both behind the ``gen.expand``
+chaos site with a byte-identical host-oracle fallback. See the README's
+"Generation-based fuzzing" section for the DSL and --gen usage.
+"""
+
+from .compile import (BUILTIN_GRAMMARS, CompiledGrammar, GenSpecError,
+                      compile_grammar, load_grammar, parse_grammar)
+from .engine import GenEngine
+
+__all__ = [
+    "BUILTIN_GRAMMARS",
+    "CompiledGrammar",
+    "GenSpecError",
+    "GenEngine",
+    "compile_grammar",
+    "load_grammar",
+    "parse_grammar",
+]

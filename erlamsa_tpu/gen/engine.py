@@ -1,0 +1,155 @@
+"""GenEngine: the host-side face of device grammar generation.
+
+Owns one compiled grammar plus its jitted expander and turns "give me N
+generated samples for case C" into either ONE device call (the hot
+path — zero per-sample host work) or, when the device call fails, a
+per-(case, slot) walk of the keyed host oracle. Both paths consume the
+identical TAG_GEN draw chain, so a campaign that loses its device mid-
+generation produces byte-identical panels to one that never did — the
+same availability-over-latency trade the corpus runner makes, pinned by
+tests. The device call is guarded by the ``gen.expand`` chaos site
+(services/chaos.py), which is how the fallback path gets exercised in
+CI instead of waiting for a real XLA abort.
+
+Recovery mirrors corpus/runner.py: after a failure the engine serves
+from the host oracle and re-probes the device every PROBE_EVERY
+expansions, clearing the degraded flag on success.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..obs import flight
+from ..services import metrics
+from ..services.chaos import InjectedFault, fault_point
+from .compile import CompiledGrammar
+
+PROBE_EVERY = 4  # degraded-mode device re-probe cadence, in expansions
+
+
+class GenEngine:
+    def __init__(self, compiled: CompiledGrammar, seed, fuzz: bool = False):
+        self.cg = compiled
+        self.seed = seed
+        self.fuzz = bool(fuzz)
+        self.degraded = False
+        self.host_fallbacks = 0
+        self.expansions = 0
+        self._fn = None
+        self._base = None
+        self._probe_in = 0
+
+    # -- device path -----------------------------------------------------
+
+    def _ensure_fn(self):
+        if self._fn is None:
+            from ..ops import grammar, prng
+
+            self._base = prng.base_key(self.seed)
+            self._fn = grammar.make_expand(self.cg, fuzz=self.fuzz)
+        return self._fn
+
+    def _device_expand(self, case_idx: int, slots: np.ndarray):
+        fn = self._ensure_fn()
+        panel, lens, trunc = fn(self._base, int(case_idx), slots)
+        return (
+            np.asarray(panel, np.uint8),
+            np.asarray(lens, np.int32),
+            np.asarray(trunc, np.int32),
+        )
+
+    # -- host twin -------------------------------------------------------
+
+    def _host_expand(self, case_idx: int, slots: np.ndarray):
+        import jax
+
+        from ..models.genfuzz import generate_keyed
+        from ..ops import grammar, prng
+
+        base = prng.base_key(self.seed)
+        ck = grammar.gen_case_key(base, self.cg.grammar_id, int(case_idx))
+        rows, lens, truncs = [], [], []
+        for s in slots.tolist():
+            row, ln, tr = generate_keyed(
+                self.cg, jax.random.fold_in(ck, int(s)), fuzz=self.fuzz
+            )
+            rows.append(np.frombuffer(row, np.uint8))
+            lens.append(ln)
+            truncs.append(int(tr))
+        return (
+            np.stack(rows),
+            np.asarray(lens, np.int32),
+            np.asarray(truncs, np.int32),
+        )
+
+    # -- public API ------------------------------------------------------
+
+    def expand(self, case_idx: int, n: int | None = None, slots=None):
+        """Generate one panel: samples for `slots` (or range(n)) of
+        `case_idx`. Returns (payloads list[bytes], truncated_count).
+        Device-first; degrades per-(case, slot) to the keyed host
+        oracle on an injected or real device failure."""
+        if slots is None:
+            slots = np.arange(int(n), dtype=np.int32)
+        else:
+            slots = np.asarray(slots, np.int32)
+        used_host = False
+        if self.degraded:
+            self._probe_in -= 1
+            probe = self._probe_in <= 0
+        else:
+            probe = True
+        if probe:
+            try:
+                fault_point("gen.expand")
+                panel, lens, trunc = self._device_expand(case_idx, slots)
+                if self.degraded:
+                    from ..services import logger
+
+                    logger.log("info", "gen: device recovered, leaving "
+                               "degraded mode")
+                    self.degraded = False
+                    metrics.GLOBAL.set_gen_degraded(False)
+            except Exception as e:  # lint: broad-except-ok re-raised below unless device/injected
+                from ..ops.pipeline import is_device_error
+
+                if not isinstance(e, InjectedFault) and not is_device_error(e):
+                    raise
+                used_host = True
+        else:
+            used_host = True
+        if used_host:
+            if not self.degraded:
+                from ..services import logger
+
+                logger.log("warning", "gen: device expansion failed, "
+                           "degrading to keyed host oracle")
+                self.degraded = True
+                metrics.GLOBAL.set_gen_degraded(True)
+            if probe:
+                # only a *failed probe* re-arms the countdown; countdown
+                # expansions must keep draining toward the next probe
+                self._probe_in = PROBE_EVERY
+            panel, lens, trunc = self._host_expand(case_idx, slots)
+            self.host_fallbacks += len(slots)
+            metrics.GLOBAL.record_gen_fallback(len(slots))
+
+        payloads = [
+            panel[i, : int(lens[i])].tobytes() for i in range(len(slots))
+        ]
+        nbytes = int(lens.sum())
+        ntrunc = int(trunc.sum())
+        self.expansions += len(slots)
+        metrics.GLOBAL.record_gen_expand(len(slots), nbytes, ntrunc)
+        flight.GLOBAL.note(
+            "gen_panel",
+            grammar=self.cg.source,
+            grammar_id=self.cg.grammar_id,
+            case=int(case_idx),
+            samples=len(slots),
+            bytes=nbytes,
+            truncated=ntrunc,
+            host=used_host,
+        )
+        return payloads, ntrunc

@@ -137,6 +137,148 @@ def fuzz_grammar(r: ErlRand, grammar, session: dict | None = None) -> bytes:
     return generate(r, grammar, session, fuzz_prob=prob)
 
 
+def generate_keyed(cg, skey, fuzz: bool = False):
+    """Counter-keyed expansion of a COMPILED grammar — the host twin of
+    ops/grammar.py's device stack machine.
+
+    ``generate()`` above follows the reference's sequential ErlRand
+    stream and stays the gfcomms/per-sample path. This walk instead
+    consumes draw j of a per-sample threefry key, exactly the (j, n)
+    sequence the device kernel consumes (threefry is backend-
+    deterministic, so a draw computed host-side equals the same draw
+    inside a jitted kernel) — which makes this function both the byte-
+    identity test oracle and the degraded path when the device is lost
+    (gen/engine.py, chaos site ``gen.expand``). Truncation, sizer-record
+    budgets and step budgets mirror the kernel's static bounds.
+
+    Returns (row bytes[width], length, truncated) — the full padded
+    panel row, so tests can compare entire rows against the device.
+    """
+    import jax
+    import numpy as np
+
+    from ..gen.compile import (ENDIAN_LITTLE, K_LOOP, K_PICK, K_PICKP,
+                               K_RANGE, K_RBYTES, K_SEQ, K_SIZER, K_STATIC,
+                               K_SZEND, K_VERB)
+    from ..ops import prng
+
+    prod = cg.prod
+    children = cg.children
+    cweights = cg.cweights
+    pool = bytes(cg.pool)
+    W = int(cg.width)
+    R = int(cg.max_recs)
+    prob = np.float32(cg.fuzz_prob) if fuzz else None
+
+    def dk(j):
+        return jax.random.fold_in(skey, j)
+
+    def draw(j, n):
+        return int(prng.rand(dk(j), int(n)))
+
+    def ufire(j):
+        return bool(np.float32(prng.uniform_f32(dk(j))) < prob)
+
+    out = bytearray(W + max(int(cg.emit), 4))
+    stack: list[tuple[int, int]] = [(int(cg.root), 1)]
+    recs: list[list[int]] = []
+    pos = j = steps = 0
+    truncated = False
+
+    def emit(data: bytes, n: int):
+        nonlocal pos
+        wp = min(pos, W)
+        out[wp : wp + n] = data[:n]
+        pos += n
+
+    while stack and steps < int(cg.max_steps):
+        steps += 1
+        node, aux = stack[-1]
+        kind, a, b, off, cnt = (int(x) for x in prod[node])
+        if kind != K_SZEND and aux > 1:
+            stack[-1] = (node, aux - 1)
+        else:
+            stack.pop()
+        if kind in (K_STATIC, K_VERB):
+            lit = pool[a : a + b]
+            if prob is not None and kind == K_STATIC:
+                fire = ufire(j) and b > 0
+                if fire:
+                    p = draw(j + 1, b)
+                    v = draw(j + 2, 256)
+                    lit = lit[:p] + bytes([v]) + lit[p + 1 :]
+                j += 1 + (2 if fire else 0)
+            emit(lit, b)
+        elif kind == K_RANGE:
+            if prob is not None:
+                v = draw(j + 1, 256) if ufire(j) else a + draw(
+                    j + 1, b - a + 1
+                )
+                j += 2
+            else:
+                v = a + draw(j, b - a + 1)
+                j += 1
+            emit(bytes([v]), 1)
+        elif kind == K_RBYTES:
+            emit(bytes(draw(j + t, 256) for t in range(a)), a)
+            j += a
+        elif kind == K_PICK:
+            c = draw(j, cnt)
+            j += 1
+            stack.append((int(children[off + c]), 1))
+        elif kind == K_PICKP:
+            n = draw(j, b)
+            j += 1
+            sel = next(
+                i for i in range(cnt) if n < int(cweights[off + i])
+            )
+            stack.append((int(children[off + sel]), 1))
+        elif kind == K_LOOP:
+            times = draw(j, a) + 1
+            j += 1
+            if prob is not None:
+                fire = ufire(j)
+                if fire:
+                    times *= 1 + int(prng.rand_log(dk(j + 1), 6))
+                j += 1 + (1 if fire else 0)
+            stack.append((int(children[off]), times))
+        elif kind == K_SIZER:
+            avail = len(recs) < R
+            field_pos = pos
+            emit(b"\x00" * a, a)
+            if avail:
+                recs.append([field_pos, pos, 0, a, b])
+                stack.append((int(children[off + 1]), len(recs) - 1))
+            else:
+                truncated = True
+            stack.append((int(children[off]), 1))
+        elif kind == K_SZEND:
+            width = recs[aux][3]
+            blen = pos - recs[aux][1]
+            lo, hi = blen & 0xFFFF, blen >> 16
+            if prob is not None:
+                fire = ufire(j)
+                wide = width == 4
+                if fire:
+                    d1 = draw(j + 1, 256 if width == 1 else 65536)
+                    lo, hi = (draw(j + 2, 65536), d1) if wide else (d1, 0)
+                j += 1 + (2 if wide else 1) * int(fire)
+            recs[aux][1], recs[aux][2] = lo, hi
+        elif kind == K_SEQ:
+            for i in reversed(range(cnt)):
+                stack.append((int(children[off + i]), 1))
+        else:
+            raise ValueError(f"bad compiled node kind {kind}")
+
+    truncated = truncated or bool(stack) or pos > W
+    for fp, lo, hi, width, endian in recs:
+        le = (lo & 0xFF, (lo >> 8) & 0xFF, hi & 0xFF, (hi >> 8) & 0xFF)
+        wp = min(fp, W)
+        for k in range(width):
+            out[wp + k] = le[k if endian == ENDIAN_LITTLE else width - 1 - k]
+    return bytes(out[:W]), min(pos, W), truncated
+
+
 def make_external_generator(grammar, seed=None):
     """Adapter: a grammar becomes a generator for the engine's genfuz slot
     (the reference's external module `generator` capability)."""

@@ -330,6 +330,26 @@ def render(counters: metrics.Counters | None = None) -> str:
                "Seeds retired by greedy set-cover distillation.")
         w.sample("erlamsa_coverage_distilled_total", coverage["distilled"])
 
+    gen = snap.get("gen")
+    if gen and (gen["expansions"] or gen["host_fallback"]
+                or gen["degraded"]):
+        w.head("erlamsa_gen_expansions_total", "counter",
+               "Grammar samples expanded (device kernel + host fallback).")
+        w.sample("erlamsa_gen_expansions_total", gen["expansions"])
+        w.head("erlamsa_gen_bytes_total", "counter",
+               "Bytes produced by grammar expansion (pre-padding lengths).")
+        w.sample("erlamsa_gen_bytes_total", gen["bytes"])
+        w.head("erlamsa_gen_truncated_total", "counter",
+               "Expansions clipped to the compiled emit width.")
+        w.sample("erlamsa_gen_truncated_total", gen["truncated"])
+        w.head("erlamsa_gen_host_fallback_total", "counter",
+               "Samples expanded by the keyed host oracle after a "
+               "gen.expand device fault.")
+        w.sample("erlamsa_gen_host_fallback_total", gen["host_fallback"])
+        w.head("erlamsa_gen_degraded", "gauge",
+               "1 while grammar expansion is served by the host oracle.")
+        w.sample("erlamsa_gen_degraded", gen["degraded"])
+
     monitors = snap.get("monitors")
     if monitors:
         w.head("erlamsa_monitor_events_total", "counter",

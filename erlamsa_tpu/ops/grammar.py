@@ -1,0 +1,311 @@
+"""Device grammar expansion: a bounded stack machine over compiled tables.
+
+gen/compile.py flattens a genfuzz grammar into fixed-shape int32 tables;
+this module executes them as ONE jitted program per batch. Each sample
+runs a ``lax.scan`` of at most ``max_steps`` stack-machine steps: pop an
+entry (node, aux), dispatch on the node kind with ``lax.switch``, emit
+up to ``emit`` bytes into a padded panel row, push children. Loops ride
+the aux field as a repeat count (one stack row regardless of the repeat
+count, so the stack bound is static); sizers emit a placeholder field,
+open a record, and a synthetic end-marker node closes it when the body
+has fully expanded — the length fields are then backpatched over the
+panel as a second fused pass, mirroring models/genfuzz's
+``struct.pack(fmt, size) + body`` layout.
+
+Determinism contract (the whole point): every draw is counter-keyed as
+
+    sample_key = fold_in(fold_in(fold_in(sub(base, TAG_GEN),
+                                         grammar_id), case_idx), slot)
+    draw j     = rand(fold_in(sample_key, j), n)
+
+and threefry is backend-deterministic, so models/genfuzz.generate_keyed
+— a plain-python walk of the SAME tables consuming the SAME (j, n)
+sequence — reproduces the device panel byte-for-byte. That host twin is
+both the test oracle (tests/test_grammar_kernels.py) and the degraded
+path when the device is lost mid-campaign (gen/engine.py, chaos site
+``gen.expand``).
+
+Truncation is deterministic on both sides: ``pos`` counts TRUE bytes
+(sizer lengths stay honest past the panel edge), writes clamp at the
+panel width, and a sample is flagged truncated when it overran the
+panel, exhausted the step budget, or blew the sizer-record budget.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..gen.compile import ENDIAN_LITTLE, K_STATIC, K_SZEND, CompiledGrammar
+from . import prng
+
+K_NOP = 10  # synthetic switch branch for exhausted stacks
+
+
+def gen_case_key(base: jax.Array, grammar_id, case_idx) -> jax.Array:
+    """The (grammar, case) point of the TAG_GEN draw chain."""
+    k = jax.random.fold_in(prng.sub(base, prng.TAG_GEN), grammar_id)
+    return jax.random.fold_in(k, case_idx)
+
+
+def gen_sample_key(base: jax.Array, grammar_id, case_idx, slot) -> jax.Array:
+    """Per-sample key; the host oracle derives the identical key."""
+    return jax.random.fold_in(gen_case_key(base, grammar_id, case_idx), slot)
+
+
+def make_expand(cg: CompiledGrammar, fuzz: bool = False):
+    """Build the jitted batch expander for one compiled grammar.
+
+    Returns ``expand(base, case_idx, slots) -> (panel, lens, truncated)``
+    with panel uint8[batch, width], lens/truncated int32[batch]. With
+    ``fuzz`` the expansion mutates leaves at the grammar's 1/depth
+    probability (fuzz_grammar's scaling); draws stay counter-keyed, so
+    batched == per-sample == host oracle either way.
+    """
+    prod = jnp.asarray(cg.prod)
+    children = jnp.asarray(cg.children)
+    cweights = jnp.asarray(cg.cweights)
+    pool = jnp.asarray(cg.pool)
+    W = int(cg.width)
+    EMIT = int(cg.emit)
+    PAD = max(EMIT, 4)
+    S = int(cg.stack)
+    R = int(cg.max_recs)
+    MAXC = int(cg.max_child)
+    STEPS = int(cg.max_steps)
+    root = int(cg.root)
+    gid = int(cg.grammar_id)
+    prob = jnp.float32(cg.fuzz_prob) if fuzz else None
+    lane = jnp.arange(EMIT)
+
+    def _expand_one(skey):
+        def dk(j):
+            return jax.random.fold_in(skey, j)
+
+        def draw(j, n):
+            return prng.rand(dk(j), n)
+
+        def emit_chunk(out, pos, chunk, n):
+            wp = jnp.minimum(pos, W)
+            cur = lax.dynamic_slice(out, (wp,), (EMIT,))
+            merged = jnp.where(lane < n, chunk, cur).astype(jnp.uint8)
+            return lax.dynamic_update_slice(out, merged, (wp,)), pos + n
+
+        def push(stack, sp, node, aux, do):
+            # scratch row S-1 swallows suppressed pushes
+            slot = jnp.where(do, sp, S - 1)
+            row = jnp.stack(
+                [jnp.asarray(node, jnp.int32), jnp.asarray(aux, jnp.int32)]
+            )
+            stack = stack.at[slot].set(jnp.where(do, row, stack[slot]))
+            return stack, sp + do.astype(jnp.int32)
+
+        def b_literal(op):
+            stack, sp, out, pos, j, recs, nrec, of, node, aux = op
+            off, ln = prod[node, 1], prod[node, 2]
+            chunk = lax.dynamic_slice(pool, (off,), (EMIT,))
+            if prob is not None:
+                fuzzable = prod[node, 0] == K_STATIC  # K_VERB never fuzzes
+                fire = (prng.uniform_f32(dk(j)) < prob) & (ln > 0) & fuzzable
+                p = draw(j + 1, ln)
+                v = draw(j + 2, 256).astype(jnp.uint8)
+                chunk = jnp.where(fire & (lane == p), v, chunk)
+                j = j + jnp.where(
+                    fuzzable, 1 + 2 * fire.astype(jnp.int32), 0
+                )
+            out, pos = emit_chunk(out, pos, chunk, ln)
+            return stack, sp, out, pos, j, recs, nrec, of
+
+        def b_range(op):
+            stack, sp, out, pos, j, recs, nrec, of, node, aux = op
+            lo, hi = prod[node, 1], prod[node, 2]
+            if prob is not None:
+                fire = prng.uniform_f32(dk(j)) < prob
+                v = jnp.where(
+                    fire, draw(j + 1, 256), lo + draw(j + 1, hi - lo + 1)
+                )
+                j = j + 2
+            else:
+                v = lo + draw(j, hi - lo + 1)
+                j = j + 1
+            chunk = jnp.full((EMIT,), 0, jnp.uint8).at[0].set(
+                v.astype(jnp.uint8)
+            )
+            out, pos = emit_chunk(out, pos, chunk, 1)
+            return stack, sp, out, pos, j, recs, nrec, of
+
+        def b_rbytes(op):
+            stack, sp, out, pos, j, recs, nrec, of, node, aux = op
+            n = prod[node, 1]
+            chunk = jax.vmap(
+                lambda t: draw(j + t, 256).astype(jnp.uint8)
+            )(lane)
+            out, pos = emit_chunk(out, pos, chunk, n)
+            return stack, sp, out, pos, j + n, recs, nrec, of
+
+        def b_pick(op):
+            stack, sp, out, pos, j, recs, nrec, of, node, aux = op
+            off, cnt = prod[node, 3], prod[node, 4]
+            c = draw(j, cnt)
+            stack, sp = push(
+                stack, sp, children[off + c], 1, jnp.bool_(True)
+            )
+            return stack, sp, out, pos, j + 1, recs, nrec, of
+
+        def b_pickp(op):
+            stack, sp, out, pos, j, recs, nrec, of, node, aux = op
+            off, total = prod[node, 3], prod[node, 2]
+            n = draw(j, total)
+            cw = lax.dynamic_slice(cweights, (off,), (MAXC,))
+            sel = jnp.argmax(n < cw)
+            stack, sp = push(
+                stack, sp, children[off + sel], 1, jnp.bool_(True)
+            )
+            return stack, sp, out, pos, j + 1, recs, nrec, of
+
+        def b_loop(op):
+            stack, sp, out, pos, j, recs, nrec, of, node, aux = op
+            times = draw(j, prod[node, 1]) + 1
+            j = j + 1
+            if prob is not None:
+                fire = prng.uniform_f32(dk(j)) < prob
+                blow = 1 + prng.rand_log(dk(j + 1), 6)
+                times = jnp.where(fire, times * blow, times)
+                j = j + 1 + fire.astype(jnp.int32)
+            stack, sp = push(
+                stack, sp, children[prod[node, 3]], times, jnp.bool_(True)
+            )
+            return stack, sp, out, pos, j, recs, nrec, of
+
+        def b_sizer(op):
+            stack, sp, out, pos, j, recs, nrec, of, node, aux = op
+            width, endian, off = prod[node, 1], prod[node, 2], prod[node, 3]
+            avail = nrec < R
+            field_pos = pos
+            out, pos = emit_chunk(
+                out, pos, jnp.zeros((EMIT,), jnp.uint8), width
+            )
+            row = jnp.stack([field_pos, pos, jnp.int32(0), width, endian])
+            rslot = jnp.where(avail, nrec, R)  # row R is scratch
+            recs = recs.at[rslot].set(jnp.where(avail, row, recs[rslot]))
+            stack, sp = push(stack, sp, children[off + 1], nrec, avail)
+            stack, sp = push(
+                stack, sp, children[off], 1, jnp.bool_(True)
+            )
+            of = of | ~avail  # unpatchable sizer: flag, field stays zero
+            return (stack, sp, out, pos, j, recs,
+                    nrec + avail.astype(jnp.int32), of)
+
+        def b_szend(op):
+            stack, sp, out, pos, j, recs, nrec, of, node, aux = op
+            width = recs[aux, 3]
+            blen = pos - recs[aux, 1]
+            lo = blen & 0xFFFF
+            hi = blen >> 16
+            if prob is not None:
+                fire = prng.uniform_f32(dk(j)) < prob
+                wide = width == 4
+                d1 = draw(j + 1, jnp.where(width == 1, 256, 65536))
+                d2 = draw(j + 2, 65536)
+                lo = jnp.where(fire, jnp.where(wide, d2, d1), lo)
+                hi = jnp.where(fire, jnp.where(wide, d1, 0), hi)
+                j = j + 1 + fire.astype(jnp.int32) * jnp.where(wide, 2, 1)
+            recs = recs.at[aux, 1].set(lo)
+            recs = recs.at[aux, 2].set(hi)
+            return stack, sp, out, pos, j, recs, nrec, of
+
+        def b_seq(op):
+            stack, sp, out, pos, j, recs, nrec, of, node, aux = op
+            off, cnt = prod[node, 3], prod[node, 4]
+            # push children cnt-1 .. 0 so child 0 lands on top (executes
+            # first); static unroll over MAXC, suppressed rows skipped
+            for i in reversed(range(MAXC)):
+                stack, sp = push(
+                    stack, sp, children[off + i], 1, i < cnt
+                )
+            return stack, sp, out, pos, j, recs, nrec, of
+
+        def b_nop(op):
+            stack, sp, out, pos, j, recs, nrec, of, node, aux = op
+            return stack, sp, out, pos, j, recs, nrec, of
+
+        def step(state, _):
+            stack, sp, out, pos, j, recs, nrec, of = state
+            active = sp > 0
+            top = jnp.maximum(sp - 1, 0)
+            node = jnp.where(active, stack[top, 0], 0)
+            aux = jnp.where(active, stack[top, 1], 0)
+            kind = jnp.where(active, prod[node, 0], K_NOP)
+            # repeat entries (loops) decrement in place instead of popping
+            repeat = active & (kind != K_SZEND) & (aux > 1)
+            stack = stack.at[top, 1].set(jnp.where(repeat, aux - 1, aux))
+            sp = jnp.where(
+                active, jnp.where(repeat, sp, sp - 1), sp
+            )
+            op = (stack, sp, out, pos, j, recs, nrec, of, node, aux)
+            branches = [
+                b_literal,  # K_STATIC
+                b_range,  # K_RANGE
+                b_rbytes,  # K_RBYTES
+                b_pick,  # K_PICK
+                b_pickp,  # K_PICKP
+                b_loop,  # K_LOOP
+                b_sizer,  # K_SIZER
+                b_szend,  # K_SZEND
+                b_seq,  # K_SEQ
+                b_literal,  # K_VERB
+                b_nop,  # K_NOP
+            ]
+            new = lax.switch(kind, branches, op)
+            return new, None
+
+        stack0 = jnp.zeros((S, 2), jnp.int32).at[0].set(
+            jnp.asarray([root, 1], jnp.int32)
+        )
+        out0 = jnp.zeros((W + PAD,), jnp.uint8)
+        recs0 = jnp.zeros((R + 1, 5), jnp.int32)
+        state0 = (
+            stack0,
+            jnp.int32(1),
+            out0,
+            jnp.int32(0),
+            jnp.int32(0),
+            recs0,
+            jnp.int32(0),
+            jnp.bool_(False),
+        )
+        (stack, sp, out, pos, j, recs, nrec, of), _ = lax.scan(
+            step, state0, None, length=STEPS
+        )
+
+        # fused backpatch: write every closed sizer record's length field
+        def patch(r, o):
+            valid = r < nrec
+            fp, lo, hi, width, endian = (
+                recs[r, 0], recs[r, 1], recs[r, 2], recs[r, 3], recs[r, 4]
+            )
+            le = jnp.stack(
+                [lo & 0xFF, (lo >> 8) & 0xFF, hi & 0xFF, (hi >> 8) & 0xFF]
+            ).astype(jnp.uint8)
+            k4 = jnp.arange(4)
+            src = jnp.where(endian == ENDIAN_LITTLE, k4, width - 1 - k4)
+            vals = le[jnp.clip(src, 0, 3)]
+            wp = jnp.minimum(fp, W)
+            cur = lax.dynamic_slice(o, (wp,), (4,))
+            merged = jnp.where((k4 < width) & valid, vals, cur).astype(
+                jnp.uint8
+            )
+            return lax.dynamic_update_slice(o, merged, (wp,))
+
+        out = lax.fori_loop(0, R, patch, out)
+        truncated = (of | (sp > 0) | (pos > W)).astype(jnp.int32)
+        return out[:W], jnp.minimum(pos, W), truncated
+
+    def expand(base, case_idx, slots):
+        ck = gen_case_key(base, gid, case_idx)
+        return jax.vmap(
+            lambda s: _expand_one(jax.random.fold_in(ck, s))
+        )(jnp.asarray(slots, jnp.int32))
+
+    return jax.jit(expand)

@@ -36,6 +36,9 @@ TAG_SCHED = 0x0D  # corpus energy-schedule draws (corpus/energy.py keeps a
 #                   jax-free copy; tests pin the two equal)
 TAG_STRUCT = 0x0E  # struct span-splice draws (ops/structure.py host oracle
 #                    and ops/tree_mutators.py device kernels share them)
+TAG_GEN = 0x0F  # grammar-generation draws (gen/ compiler + ops/grammar.py
+#                 kernel and the models/genfuzz.py keyed host oracle share
+#                 the (grammar_id, case, slot, draw) coordinate)
 
 
 def base_key(seed: tuple[int, int, int] | int) -> jax.Array:

@@ -193,14 +193,28 @@ def _d(key, j: int, n: int) -> int:
 # --- host span-oracle (numpy reference semantics) -----------------------
 
 
+def _pick_depth(key, j, nd, idx):
+    """Pump/stutter node choice over the span rows ``idx``: one draw in
+    [0, sum(depth+1)), first row whose cumulative (depth+1) mass exceeds
+    it. The sequential oracle reaches a repeat/delete target by walking
+    into the tree, so deeper spans are likelier — uniform picks (the old
+    behaviour) over-selected shallow wrappers. Same (key, j) draw slot
+    the uniform pick used; the device kernels compute the identical
+    masked cumsum (ops/tree_mutators._wpick)."""
+    w = nd[idx, 2] + 1
+    cw = np.cumsum(w)
+    t = _d(key, j, int(cw[-1]))
+    return int(idx[int(np.argmax(cw > t))])
+
+
 def _mut_tr2(key, raw, nd, cnt, cap):
-    i = _d(key, 0, cnt)
+    i = _pick_depth(key, 0, nd, np.arange(cnt))
     s, e = int(nd[i, 0]), int(nd[i, 1])
     return raw[:s] + raw[s:e] + raw[s:]
 
 
 def _mut_td(key, raw, nd, cnt, cap):
-    i = _d(key, 0, cnt)
+    i = _pick_depth(key, 0, nd, np.arange(cnt))
     s, e = int(nd[i, 0]), int(nd[i, 1])
     return raw[:s] + raw[e:]
 
@@ -245,9 +259,9 @@ def _mut_tr(key, raw, nd, cnt, cap):
     pidx = np.nonzero(ccnt > 0)[0]
     if pidx.size == 0:
         return None
-    p = int(pidx[_d(key, 0, pidx.size)])
+    p = _pick_depth(key, 0, nd, pidx)
     kids = np.nonzero(desc[p])[0]
-    c = int(kids[_d(key, 1, kids.size)])
+    c = _pick_depth(key, 1, nd, kids)
     reps = 2 + _d(key, 2, 7)
     sp, ep = int(s[p]), int(e[p])
     sc, ec = int(s[c]), int(e[c])

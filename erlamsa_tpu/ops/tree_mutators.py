@@ -88,6 +88,17 @@ def _replace(row, n, sa, ea, sb, eb, cap):
     return _gather(row, src), jnp.clip(n - (ea - sa) + lb, 0, cap)
 
 
+def _wpick(key, j, mask, depth):
+    """Depth-weighted node pick over a bool mask: (depth+1) mass per
+    eligible row, one draw — mirrors structure._pick_depth (masked-out
+    rows carry zero mass, so the full-table cumsum lands on the same
+    node the host's compacted-index cumsum does)."""
+    w = jnp.where(mask, depth + 1, 0)
+    cw = jnp.cumsum(w)
+    t = prng.rand(_f(key, j), cw[-1])
+    return jnp.argmax(cw > t).astype(jnp.int32)
+
+
 def _two(key, cnt):
     """Two distinct node ordinals, the reference's a/b draw pair."""
     a = prng.rand(_f(key, 0), cnt)
@@ -103,14 +114,16 @@ def _node(nd, i):
 
 
 def k_tr2(key, row, n, nd, cnt, cap):
-    i = prng.rand(_f(key, 0), cnt)
+    valid = jnp.arange(nd.shape[0], dtype=jnp.int32) < cnt
+    i = _wpick(key, 0, valid, nd[:, 2])
     s, e = _node(nd, i)
     out, n2 = _insert_self(row, n, s, e - s, cap)
     return out, n2, cnt > 0
 
 
 def k_td(key, row, n, nd, cnt, cap):
-    i = prng.rand(_f(key, 0), cnt)
+    valid = jnp.arange(nd.shape[0], dtype=jnp.int32) < cnt
+    i = _wpick(key, 0, valid, nd[:, 2])
     s, e = _node(nd, i)
     out, n2 = _delete(row, n, s, e - s, cap)
     return out, n2, cnt > 0
@@ -134,8 +147,8 @@ def k_tr(key, row, n, nd, cnt, cap):
     ccnt = desc.sum(1)
     is_par = ccnt > 0
     ok = jnp.any(is_par)
-    p = _nth_true(is_par, prng.rand(_f(key, 0), is_par.sum()))
-    c = _nth_true(desc[p], prng.rand(_f(key, 1), ccnt[p]))
+    p = _wpick(key, 0, is_par, nd[:, 2])
+    c = _wpick(key, 1, desc[p], nd[:, 2])
     reps = 2 + prng.rand(_f(key, 2), 7)
     sp, ep = s[p], e[p]
     sc, ec = s[c], e[c]

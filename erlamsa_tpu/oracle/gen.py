@@ -206,6 +206,15 @@ def make_generator(ctx: Ctx, pris: list[tuple[str, int]], paths, opts, n_cases: 
             candidates.append((pri, name, random_generator(ctx, block_scale)))
         elif name == "genfuz" and external is not None:
             candidates.append((pri, name, external))
+        elif name == "genfuz" and opts.get("gen_grammar") is not None:
+            # --gen without an external module: the parsed grammar fills
+            # the reference's genfuz slot through the sequential ErlRand
+            # path (models/genfuzz.make_external_generator); the batched
+            # counter-keyed path lives in gen/ + ops/grammar.py
+            from ..models.genfuzz import make_external_generator
+
+            candidates.append((pri, name, make_external_generator(
+                opts["gen_grammar"], seed=opts.get("seed"))))
     if not candidates:
         raise ValueError("No generators!")
     if len(candidates) == 1:

@@ -95,6 +95,10 @@ Known sites (grep `fault_point(` for the authoritative list):
                      CoverageIndex.fold_case): an injected fault leaves
                      the whole case uncovered — the runner falls back
                      to hash-novelty for those slots, outputs unchanged
+    gen.expand       device grammar-expansion call (gen/engine.py
+                     GenEngine.expand): an injected fault degrades
+                     generation to the counter-keyed host oracle,
+                     byte-identical panels, erlamsa_gen_degraded=1
 
 Injected failures raise ``InjectedFault``, an OSError subclass, so they
 flow through exactly the except-clauses that catch real socket/disk

@@ -300,6 +300,30 @@ def build_parser() -> argparse.ArgumentParser:
                         "(services/chaos.py; ERLAMSA_FAULTS is the env "
                         "equivalent, --chaos wins). Replayable: the same "
                         "spec + seed fires the same faults")
+    gen = p.add_argument_group(
+        "grammar generation (erlamsa_tpu/gen; r17 generate-then-mutate)")
+    gen.add_argument("--gen", default=None, metavar="GRAMMAR[:N]",
+                     help="compile GRAMMAR (a builtin name or an "
+                          "s-expression DSL file; see README "
+                          "'Generation-based fuzzing') for device "
+                          "expansion. With --feedback, seed the campaign "
+                          "with N generated samples (default 64) from one "
+                          "batched kernel call — device loss degrades to "
+                          "the keyed host oracle byte-identically (chaos "
+                          "site gen.expand). Without --feedback the "
+                          "grammar feeds the oracle engine's genfuz "
+                          "generator slot. Spec errors are hard errors")
+    gen.add_argument("--gfcomms", type=int, default=None, metavar="PORT",
+                     help="serve grammar-generated data per TCP packet "
+                          "(services/gfcomms.py; requires --gen). -s "
+                          "seeds the stream and is logged at startup, so "
+                          "a fixed seed replays byte-identically")
+    gen.add_argument("--gfcomms-batched", action="store_true",
+                     help="gfcomms drains a connection's pending packets "
+                          "through ONE device kernel call; responses are "
+                          "keyed by (connection, packet index), so the "
+                          "single-connection replay contract holds "
+                          "regardless of how packets were batched")
     obs = p.add_argument_group(
         "observability (erlamsa_tpu/obs; pure side channel — outputs at a "
         "fixed -s are byte-identical with tracing on or off)")
@@ -351,6 +375,44 @@ def main(argv=None) -> int:
             "erlamsa-tpu: --coverage is single-device only (the hub's "
             "sample ledger maps (case, slot) against one schedule): drop "
             "--shards/--fleet-nodes to run with coverage")
+
+    gen_opts = None
+    if args.gen:
+        # hard errors by design: a typo'd grammar must abort the run with
+        # a pointer at the DSL doc, never start an unseeded campaign
+        spec, _, n_part = args.gen.partition(":")
+        try:
+            gen_count = int(n_part) if n_part else 64
+        except ValueError:
+            raise SystemExit(f"erlamsa-tpu: --gen {args.gen!r}: sample "
+                             f"count {n_part!r} is not an integer")
+        if gen_count < 1:
+            raise SystemExit(f"erlamsa-tpu: --gen {args.gen!r}: sample "
+                             f"count must be >= 1")
+        from ..gen import GenSpecError, compile_grammar, load_grammar
+
+        try:
+            grammar, label = load_grammar(spec)
+            compiled = compile_grammar(grammar, source=label)
+        except GenSpecError as e:
+            raise SystemExit(
+                f"erlamsa-tpu: --gen: {e} (grammar DSL reference: "
+                f"README.md, 'Generation-based fuzzing')")
+        gen_opts = {"grammar": grammar, "compiled": compiled,
+                    "label": label, "n": gen_count}
+    if args.gen and (args.shards is not None or args.fleet_nodes):
+        # hard error, not a silent ignore: generation is single-device
+        # first (one panel seeds one store before the campaign starts)
+        raise SystemExit(
+            "erlamsa-tpu: --gen is single-device only for now: drop "
+            "--shards/--fleet-nodes to run generate-then-mutate, or drop "
+            "--gen to run the fleet")
+    if args.gfcomms is not None and not args.gen:
+        raise SystemExit("erlamsa-tpu: --gfcomms requires --gen GRAMMAR "
+                         "(the grammar to serve)")
+    if args.gfcomms_batched and args.gfcomms is None:
+        raise SystemExit("erlamsa-tpu: --gfcomms-batched requires "
+                         "--gfcomms PORT")
 
     if args.list:
         _show_list()
@@ -477,6 +539,10 @@ def main(argv=None) -> int:
         "certfile": args.certfile,
         "keyfile": args.keyfile,
         "state_path": args.state,
+        # --gen: the runner seeds from the compiled grammar; the oracle
+        # engine's genfuz slot picks up the raw grammar (sequential path)
+        **({"gen": gen_opts, "gen_grammar": gen_opts["grammar"]}
+           if gen_opts else {}),
     }
 
     if args.detach:
@@ -532,6 +598,22 @@ def main(argv=None) -> int:
         return FuzzProxy(args.proxy, args.proxy_prob, opts,
                          backend=args.backend, bypass=args.bypass,
                          ascent=args.ascent).start(block=True)
+    if args.gfcomms is not None:
+        from .gfcomms import GfComms
+
+        engine = None
+        if args.gfcomms_batched:
+            from ..gen import GenEngine
+
+            # fuzz=True: the batched service replaces the sequential
+            # fuzz_grammar path, so leaves mutate at the 1/depth rate
+            engine = GenEngine(gen_opts["compiled"], seed, fuzz=True)
+        try:
+            return GfComms(args.gfcomms, grammar=gen_opts["grammar"],
+                           seed=seed, engine=engine).serve(block=True)
+        finally:
+            _finish()
+
     if args.fleet_worker:
         from .dist import run_shard_worker
 

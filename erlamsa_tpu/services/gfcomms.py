@@ -4,6 +4,22 @@ Reference: src/erlamsa_gfcomms.erl — accept TCP, call the external module's
 fuzzer per packet with a session dict. Here the handler generates from a
 genfuzz grammar (models/genfuzz.py) or delegates to an external module's
 ``fuzzer(proto, data, session)``.
+
+Two generation paths (r17):
+
+* **sequential** (default): one shared ErlRand AS183 stream, one
+  ``fuzz_grammar`` expansion per packet under a lock — the reference's
+  shape. The stream seed is explicit and logged at startup, so a fixed
+  ``--seed`` replays the service byte-identically (it used to default to
+  urandom silently, which made "replay the session" impossible).
+* **batched** (``engine=`` / --gfcomms-batched): responses come from the
+  device grammar kernel via a GenEngine. A handler drains whatever
+  packets are already pending on the connection and answers them with
+  ONE kernel call. Response i of connection c is keyed on
+  ``(grammar_id, c, i)`` — a pure function of the seed and the packet's
+  position, independent of how packets were grouped into kernel calls —
+  so the single-connection replay contract survives batching, and the
+  engine's gen.expand chaos/degradation semantics apply.
 """
 
 from __future__ import annotations
@@ -15,17 +31,32 @@ from ..models.genfuzz import fuzz_grammar
 from ..utils.erlrand import ErlRand, gen_urandom_seed
 from . import logger
 
+# batched mode: cap on packets answered by one kernel call
+MAX_DRAIN = 64
+
+
+def _fmt_seed(seed) -> str:
+    if isinstance(seed, tuple):
+        return ",".join(str(x) for x in seed)
+    return str(seed)
+
 
 class GfComms:
-    def __init__(self, port: int, grammar=None, external_fuzzer=None, seed=None):
+    def __init__(self, port: int, grammar=None, external_fuzzer=None,
+                 seed=None, engine=None):
         self.port = port
         self.grammar = grammar
         self.external = external_fuzzer
-        self.r = ErlRand(seed or gen_urandom_seed())
+        self.engine = engine  # gen.GenEngine -> batched keyed mode
+        if seed is None:
+            seed = gen_urandom_seed()
+        self.seed = seed
+        self.r = ErlRand(seed)
         # one AS183 stream shared by handler threads: serialize draws so a
         # fixed seed stays reproducible (single-connection replay contract)
         self._rlock = threading.Lock()
         self._stop = threading.Event()
+        self._conn_seq = 0
 
     def _handle(self, conn: socket.socket, addr):
         session: dict = {}
@@ -47,13 +78,51 @@ class GfComms:
         finally:
             conn.close()
 
+    def _handle_batched(self, conn: socket.socket, addr, conn_id: int):
+        """Drain pending packets, answer them with one kernel call.
+        Response i of this connection is expand(case=conn_id, slot=i)
+        whatever the grouping — replay-stable by construction."""
+        seq = 0
+        try:
+            while not self._stop.is_set():
+                data = conn.recv(65536)
+                if not data:
+                    break
+                npkts = 1
+                conn.setblocking(False)
+                try:
+                    while npkts < MAX_DRAIN:
+                        more = conn.recv(65536)
+                        if not more:
+                            break
+                        npkts += 1
+                except OSError:
+                    pass  # nothing else pending
+                finally:
+                    conn.setblocking(True)
+                outs, _trunc = self.engine.expand(
+                    conn_id, slots=range(seq, seq + npkts)
+                )
+                seq += npkts
+                for out in outs:
+                    conn.sendall(out)
+        except OSError:
+            pass
+        finally:
+            conn.close()
+
     def serve(self, block: bool = True):
         srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         srv.bind(("0.0.0.0", self.port))
         srv.listen(16)
         self._srv = srv
-        logger.log("info", "gfcomms listening on :%d", self.port)
+        # the replay coordinate, stated up front: rerunning with this
+        # seed (and the same per-connection packet sequence) reproduces
+        # every response byte
+        logger.log("info", "gfcomms listening on :%d (seed %s, %s mode)",
+                   self.port, _fmt_seed(self.seed),
+                   "batched" if self.engine is not None else "sequential")
 
         def loop():
             while not self._stop.is_set():
@@ -61,9 +130,14 @@ class GfComms:
                     conn, addr = srv.accept()
                 except OSError:
                     break
-                threading.Thread(
-                    target=self._handle, args=(conn, addr), daemon=True
-                ).start()
+                conn_id = self._conn_seq
+                self._conn_seq += 1
+                if self.engine is not None:
+                    target, args = self._handle_batched, (conn, addr, conn_id)
+                else:
+                    target, args = self._handle, (conn, addr)
+                threading.Thread(target=target, args=args,
+                                 daemon=True).start()
 
         if block:
             loop()

@@ -121,6 +121,12 @@ class Counters:
         self.coverage = {"frames": 0, "stale": 0, "torn": 0, "faulted": 0,
                          "folds": 0, "new_edges": 0, "edges": 0,
                          "degraded": 0, "distilled": 0}
+        # grammar-generation accounting (gen/engine.py): device panel
+        # expansions, generated bytes, truncated rows, per-sample host
+        # fallbacks and the gen-degraded gauge — the erlamsa_gen_*
+        # families
+        self.gen = {"expansions": 0, "bytes": 0, "truncated": 0,
+                    "host_fallback": 0, "degraded": 0}
         # monitor-plane event tallies by kind (crash/crash_dup/
         # hang_killed/spawn_failed/after_spawned, ...) — the
         # erlamsa_monitor_events_total counter
@@ -315,6 +321,28 @@ class Counters:
         with self._lock:
             self.coverage["degraded"] = 1 if on else 0
 
+    def record_gen_expand(self, samples: int, nbytes: int, truncated: int):
+        """One grammar-panel expansion: `samples` rows generated,
+        `nbytes` payload bytes, `truncated` rows that hit a static
+        bound (panel width / step budget / sizer records)."""
+        with self._lock:
+            self.gen["expansions"] += int(samples)
+            self.gen["bytes"] += int(nbytes)
+            self.gen["truncated"] += int(truncated)
+
+    def record_gen_fallback(self, samples: int):
+        """`samples` rows expanded by the keyed host oracle because the
+        device call failed (chaos gen.expand or a real device loss)."""
+        with self._lock:
+            self.gen["host_fallback"] += int(samples)
+
+    def set_gen_degraded(self, on: bool):
+        """Flip the gen-degraded gauge: 1 while grammar generation runs
+        on the host oracle (distinct from the runner's device-loss flag
+        — generation may degrade while mutation is healthy)."""
+        with self._lock:
+            self.gen["degraded"] = 1 if on else 0
+
     def set_degraded(self, on: bool):
         """Flip the degraded-mode flag (corpus runner fell back to the
         host oracle after device loss / recovered)."""
@@ -401,6 +429,7 @@ class Counters:
                 "tenants": {t: dict(v)
                             for t, v in sorted(self.tenants.items())},
                 "coverage": dict(self.coverage),
+                "gen": dict(self.gen),
                 "monitors": dict(sorted(self.monitor_events.items())),
             }
 

@@ -80,6 +80,15 @@
 # must land an abnormal-exit finding on the feedback bus through the
 # supervised plane (services/monitors.py, corpus/distill.py).
 #
+# scripts/tier1.sh --gen-smoke additionally exercises the r17 device
+# grammar-generation subsystem (gen/ + ops/grammar.py): the expansion
+# kernel must be byte-identical to the keyed host oracle at a fixed
+# seed for every builtin grammar in both plain and fuzzing modes; a
+# generate-then-mutate campaign (--gen seeds into the arena with
+# adoption on) must run with zero host expansions on the hot path; and
+# the same campaign under an injected gen.expand fault must degrade to
+# the host oracle with output bytes identical to the unfaulted run.
+#
 # The gate starts with fuzzlint (erlamsa_tpu/analysis): pure-AST
 # invariant checks (determinism, device purity, lock discipline,
 # resilience coverage) over the whole package in ~2s. Opt out with
@@ -95,6 +104,7 @@ dist_fleet_smoke=0
 serve_smoke=0
 struct_smoke=0
 monitor_smoke=0
+gen_smoke=0
 lint=1
 while [ $# -gt 0 ]; do
   case "$1" in
@@ -107,6 +117,7 @@ while [ $# -gt 0 ]; do
     --dist-fleet-smoke) dist_fleet_smoke=1; shift ;;
     --serve-smoke) serve_smoke=1; shift ;;
     --struct-smoke) struct_smoke=1; shift ;;
+    --gen-smoke) gen_smoke=1; shift ;;
     --lint) lint=1; shift ;;
     --no-lint) lint=0; shift ;;
     *) break ;;
@@ -747,6 +758,93 @@ print(f"MONITOR_SMOKE={'ok' if ok else 'FAIL'} "
       f"distilled={cov_b.get('distilled')} "
       f"degraded={bool(cov_c.get('degraded'))} "
       f"identical_degraded={blob_c == blob_a} exec_finding={exec_ok}")
+sys.exit(0 if ok else 1)
+EOF
+  rc=$?
+fi
+
+if [ $rc -eq 0 ] && [ $gen_smoke -eq 1 ]; then
+  echo "== gen smoke: device grammar expansion, adoption run, host-fallback identity =="
+  timeout -k 10 600 env JAX_PLATFORMS=cpu python - <<'EOF'
+import os, shutil, sys, tempfile
+
+import numpy as np
+
+from erlamsa_tpu.corpus.runner import run_corpus_batch
+from erlamsa_tpu.gen import BUILTIN_GRAMMARS, compile_grammar
+from erlamsa_tpu.models.genfuzz import generate_keyed
+from erlamsa_tpu.ops import grammar as gk
+from erlamsa_tpu.ops import prng
+from erlamsa_tpu.services import chaos
+
+SEED = (17, 17, 17)
+
+# 1. kernel == keyed host oracle, every builtin grammar, both modes
+ident = True
+for name, g in sorted(BUILTIN_GRAMMARS.items()):
+    cg = compile_grammar(g, source=name)
+    base = prng.base_key(SEED)
+    for fuzz in (False, True):
+        fn = gk.make_expand(cg, fuzz=fuzz)
+        panel, lens, trunc = fn(base, 0, np.arange(6))
+        for s in range(6):
+            skey = gk.gen_sample_key(base, cg.grammar_id, 0, s)
+            row, ln, tr = generate_keyed(cg, skey, fuzz=fuzz)
+            if (ln != int(lens[s]) or tr != bool(trunc[s])
+                    or bytes(row) != bytes(np.asarray(panel[s]))):
+                ident = False
+print(f"gen identity: {'ok' if ident else 'FAIL'}")
+
+
+def one_run(root, spec=None):
+    chaos.configure(spec, seed=SEED[0])
+    outdir = os.path.join(root, "out")
+    os.makedirs(outdir)
+    stats = {}
+    try:
+        rc = run_corpus_batch(
+            {
+                "corpus_dir": os.path.join(root, "corpus"),
+                "gen": {"grammar": BUILTIN_GRAMMARS["demo-tlv"],
+                        "label": "demo-tlv", "n": 12},
+                "feedback": True,
+                "layout": "arena",
+                "adopt": True,
+                "seed": SEED,
+                "n": 3,
+                "output": os.path.join(outdir, "%n.out"),
+                "_stats": stats,
+            },
+            batch=8,
+        )
+    finally:
+        chaos.configure(None)
+    blob = b""
+    for f in sorted(os.listdir(outdir), key=lambda s: int(s.split(".")[0])):
+        blob += open(os.path.join(outdir, f), "rb").read()
+    return rc, blob, stats
+
+
+root = tempfile.mkdtemp(prefix="tier1_gen_smoke_")
+try:
+    # 2. generate-then-mutate adoption run (clean)
+    rc1, blob1, st1 = one_run(os.path.join(root, "clean"))
+    # 3. injected gen.expand fault -> host oracle, byte-identical
+    rc2, blob2, st2 = one_run(os.path.join(root, "fault"),
+                              spec="gen.expand:x1")
+finally:
+    shutil.rmtree(root, ignore_errors=True)
+g1, g2 = st1.get("gen", {}), st2.get("gen", {})
+ok = (ident and rc1 == rc2 == 0 and blob1 and blob2 == blob1
+      and g1.get("generated", 0) > 0 and g1.get("host_fallback", 0) == 0
+      and not g1.get("degraded") and g2.get("host_fallback", 0) > 0
+      and g2.get("degraded"))
+print(f"GEN_SMOKE={'ok' if ok else 'FAIL'} identity={ident} "
+      f"bytes={len(blob1)} identical_fault={blob2 == blob1} "
+      f"generated={g1.get('generated')} "
+      f"fallback_clean={g1.get('host_fallback')} "
+      f"fallback_fault={g2.get('host_fallback')} "
+      f"degraded_fault={g2.get('degraded')}")
 sys.exit(0 if ok else 1)
 EOF
   rc=$?

@@ -1,0 +1,479 @@
+"""r17 device grammar generation: compiler, kernel==oracle identity,
+engine degradation, gfcomms replay, depth-weighted span picks.
+
+The load-bearing pins:
+
+* the expansion kernel (ops/grammar.py) is byte-identical to the keyed
+  host oracle (models/genfuzz.generate_keyed) for every node kind —
+  including nested sizer/loop/pick_pref and fuzz_grammar's 1/depth leaf
+  mutation — batched == per-sample == oracle;
+* GenEngine degrades to the host oracle on an injected ``gen.expand``
+  fault with byte-identical panels, and recovers on re-probe;
+* gfcomms replays byte-identically at a fixed seed, and the batched
+  mode's responses are independent of how packets were grouped;
+* the struct span-node picks are depth-weighted on BOTH sides
+  (ops/structure.py oracle and ops/tree_mutators.py kernels stay in
+  lockstep — the r13 parity suite re-pins that; here we pin the weight).
+"""
+
+from __future__ import annotations
+
+import socket as pysock
+
+import numpy as np
+import pytest
+
+from erlamsa_tpu.gen.compile import (BUILTIN_GRAMMARS, EMIT_CAP,
+                                     CompiledGrammar, GenSpecError,
+                                     compile_grammar, load_grammar,
+                                     parse_grammar)
+
+SEED = (17, 18, 19)
+
+# one grammar exercising EVERY node kind, with a sizer nested inside a
+# loop inside a pick_pref and an inner pick inside the sizer body — the
+# acceptance matrix in one table
+KITCHEN_SINK = """
+; all node kinds, nested
+(static "HDR\\x00")
+(loop 3
+  (pick_pref
+    (3 (sizer u16be (rbinary 3) (pick (static "") (static "!")
+                                      (range 65 70))))
+    (1 (sizer u32le (static "deep") (loop 2 (rbyte))))
+    (1 (rword)))
+  (static "|"))
+(pick (rdword) (rddword) (session k "dflt"))
+(range 97 99)
+"""
+
+
+def _expand_host(cg, base, case_idx, slots, fuzz):
+    from erlamsa_tpu.models.genfuzz import generate_keyed
+    from erlamsa_tpu.ops import grammar as gk
+
+    rows, lens, truncs = [], [], []
+    for s in slots:
+        skey = gk.gen_sample_key(base, cg.grammar_id, case_idx, int(s))
+        row, ln, tr = generate_keyed(cg, skey, fuzz=fuzz)
+        rows.append(bytes(row))
+        lens.append(ln)
+        truncs.append(bool(tr))
+    return rows, lens, truncs
+
+
+# ------------------------------------------------------------- DSL ----
+
+
+def test_dsl_parses_every_form():
+    g = parse_grammar(KITCHEN_SINK)
+    kinds = {n[0] for n in g}
+    assert kinds == {"static", "loop", "pick", "range"}
+    loop = g[1]
+    assert loop[0] == "loop" and loop[2] == 3
+    pp = loop[1][0]
+    assert pp[0] == "pick_pref"
+    assert [w for w, _body in pp[1]] == [3, 1, 1]
+    sizer = pp[1][0][1][0]
+    assert sizer[0] == "sizer" and sizer[1] == "u16be"
+    assert ("session_get", "k", b"dflt") in g[2][1]
+
+
+def test_dsl_string_escapes():
+    (node,) = parse_grammar(r'(static "a\r\n\t\0\"\\\x41")')
+    assert node == ("static", b'a\r\n\t\0"\\A')
+
+
+@pytest.mark.parametrize("bad", [
+    "",
+    "(static",
+    "(static 3)",
+    "(nosuch 1)",
+    "(range 300 400)",
+    "(range 9 2)",
+    "(sizer u24 (rbyte))",
+    "(pick)",
+    "(pick_pref (0 (rbyte)))",
+    "(pick_pref (-2 (rbyte)))",
+    "(loop 0 (rbyte))",
+    "(rbinary -1)",
+    "42",
+    '(static "a\\q")',
+    '(static "a\\xZZ")',
+    '(static "unterminated',
+    "(pick (rbyte)))",
+])
+def test_dsl_errors_are_hard(bad):
+    with pytest.raises(GenSpecError):
+        parse_grammar(bad)
+
+
+def test_load_grammar_resolution(tmp_path):
+    g, label = load_grammar("demo-tlv")
+    assert label == "demo-tlv" and g
+    p = tmp_path / "g.gf"
+    p.write_text('(static "xy")\n(rbyte)')
+    g2, label2 = load_grammar(str(p))
+    assert label2 == "g.gf" and g2[0] == ("static", b"xy")
+    with pytest.raises(GenSpecError, match="builtin"):
+        load_grammar("no-such-grammar")
+    bad = tmp_path / "bad.gf"
+    bad.write_text("(pick)")
+    with pytest.raises(GenSpecError, match="bad.gf"):
+        load_grammar(str(bad))
+
+
+# -------------------------------------------------------- compiler ----
+
+
+def test_compile_static_bounds_and_id():
+    cg = compile_grammar(KITCHEN_SINK, source="sink")
+    assert isinstance(cg, CompiledGrammar)
+    assert cg.width >= 4 and cg.max_steps > 0 and cg.max_recs >= 1
+    assert cg.stack > 0 and cg.emit >= 4
+    # id is a pure function of the tables: stable across compiles,
+    # different across grammars (it keys the TAG_GEN draw chain)
+    assert cg.grammar_id == compile_grammar(KITCHEN_SINK).grammar_id
+    other = compile_grammar(BUILTIN_GRAMMARS["demo-tlv"])
+    assert cg.grammar_id != other.grammar_id
+
+
+def test_compile_depth_scaling_matches_fuzz_grammar():
+    from erlamsa_tpu.models.genfuzz import _flatten_depth
+
+    g = parse_grammar(BUILTIN_GRAMMARS["demo-lines"])
+    cg = compile_grammar(g)
+    assert cg.depth == _flatten_depth(g)
+    assert cg.fuzz_prob == 1.0 / max(2 * cg.depth, 2)
+
+
+def test_compile_emit_cap_is_spec_error():
+    with pytest.raises(GenSpecError, match="cap"):
+        compile_grammar([("rbinary", EMIT_CAP + 1)])
+
+
+# --------------------------------------- kernel == oracle identity ----
+
+
+@pytest.mark.parametrize("fuzz", [False, True])
+def test_kitchen_sink_kernel_matches_oracle(fuzz):
+    """Every node kind, nested: device == keyed host oracle, full padded
+    rows + lengths + truncation flags."""
+    from erlamsa_tpu.ops import grammar as gk
+    from erlamsa_tpu.ops import prng
+
+    cg = compile_grammar(KITCHEN_SINK, source="sink")
+    base = prng.base_key(SEED)
+    slots = list(range(5))
+    fn = gk.make_expand(cg, fuzz=fuzz)
+    panel, lens, trunc = fn(base, 2, np.asarray(slots))
+    rows, hlens, htrunc = _expand_host(cg, base, 2, slots, fuzz)
+    for i in slots:
+        assert bytes(np.asarray(panel[i])) == rows[i], f"slot {i}"
+    assert [int(x) for x in lens] == hlens
+    assert [bool(x) for x in trunc] == htrunc
+
+
+def test_batched_equals_per_sample_equals_oracle():
+    """The acceptance pin: one batched call == per-sample calls == host
+    oracle, so grouping can never leak into bytes."""
+    from erlamsa_tpu.ops import grammar as gk
+    from erlamsa_tpu.ops import prng
+
+    cg = compile_grammar(BUILTIN_GRAMMARS["demo-tlv"], source="demo-tlv")
+    base = prng.base_key(SEED)
+    fn = gk.make_expand(cg, fuzz=True)
+    panel, lens, trunc = fn(base, 0, np.arange(4))
+    rows, hlens, _ = _expand_host(cg, base, 0, range(4), True)
+    for s in range(4):
+        one_p, one_l, _t = fn(base, 0, np.asarray([s]))
+        assert bytes(np.asarray(one_p[0])) == bytes(np.asarray(panel[s]))
+        assert int(one_l[0]) == int(lens[s]) == hlens[s]
+        assert bytes(np.asarray(panel[s])) == rows[s]
+
+
+def test_truncation_flags_match_oracle():
+    """Force overflow with a tiny panel width: both sides must clip at
+    the same byte and raise the same truncated flag."""
+    from erlamsa_tpu.ops import grammar as gk
+    from erlamsa_tpu.ops import prng
+
+    cg = compile_grammar(BUILTIN_GRAMMARS["demo-http"], width=24,
+                         source="demo-http-w24")
+    base = prng.base_key(SEED)
+    fn = gk.make_expand(cg, fuzz=False)
+    panel, lens, trunc = fn(base, 0, np.arange(6))
+    rows, hlens, htrunc = _expand_host(cg, base, 0, range(6), False)
+    assert any(htrunc), "width 24 must truncate demo-http"
+    for i in range(6):
+        assert bytes(np.asarray(panel[i])) == rows[i]
+        assert int(lens[i]) == hlens[i] <= 24
+        assert bool(trunc[i]) == htrunc[i]
+
+
+# ------------------------------------------------ engine + chaos ------
+
+
+def test_gen_engine_clean_expand_counts():
+    from erlamsa_tpu.gen import GenEngine
+    from erlamsa_tpu.services import metrics
+
+    cg = compile_grammar(BUILTIN_GRAMMARS["demo-lines"], source="demo-lines")
+    eng = GenEngine(cg, SEED)
+    before = metrics.GLOBAL.snapshot()["gen"]
+    payloads, ntrunc = eng.expand(0, n=6)
+    after = metrics.GLOBAL.snapshot()["gen"]
+    assert len(payloads) == 6 and all(isinstance(p, bytes) for p in payloads)
+    assert eng.expansions == 6 and not eng.degraded
+    assert after["expansions"] - before["expansions"] == 6
+    assert after["bytes"] - before["bytes"] == sum(map(len, payloads))
+
+
+def test_gen_engine_fault_degrades_byte_identically_then_recovers():
+    from erlamsa_tpu.gen import GenEngine
+    from erlamsa_tpu.gen.engine import PROBE_EVERY
+    from erlamsa_tpu.services import chaos
+
+    cg = compile_grammar(BUILTIN_GRAMMARS["demo-tlv"], source="demo-tlv")
+    clean = GenEngine(cg, SEED, fuzz=True)
+    want = [clean.expand(c, n=3)[0] for c in range(PROBE_EVERY + 2)]
+
+    eng = GenEngine(cg, SEED, fuzz=True)
+    chaos.configure("gen.expand:x1", seed=5)
+    try:
+        got = [eng.expand(c, n=3)[0] for c in range(PROBE_EVERY + 2)]
+    finally:
+        chaos.configure(None)
+    assert got == want, "host fallback must be byte-identical"
+    assert eng.host_fallbacks >= 3
+    # the injected fault fired once; the PROBE_EVERY cadence re-probes
+    # the device and clears the degraded flag
+    assert not eng.degraded
+
+
+def test_gen_engine_slots_grouping_independent():
+    """expand(case, slots=...) keyed per (case, slot): one call over
+    0..3 == singleton calls — the gfcomms batched-drain contract."""
+    from erlamsa_tpu.gen import GenEngine
+
+    cg = compile_grammar(BUILTIN_GRAMMARS["demo-tlv"], source="demo-tlv")
+    eng = GenEngine(cg, SEED, fuzz=True)
+    grouped, _ = eng.expand(7, slots=range(4))
+    singles = [eng.expand(7, slots=[s])[0][0] for s in range(4)]
+    assert grouped == singles
+
+
+# ------------------------------------------------------- gfcomms ------
+
+
+def _gf_session(srv, packets):
+    srv.serve(block=False)
+    port = srv._srv.getsockname()[1]
+    out = []
+    try:
+        cli = pysock.create_connection(("127.0.0.1", port), timeout=5)
+        cli.settimeout(5)
+        for p in packets:
+            cli.sendall(p)
+            out.append(cli.recv(65536))
+        cli.close()
+    finally:
+        srv.stop()
+    return out
+
+
+def test_gfcomms_fixed_seed_replays_and_logs():
+    from erlamsa_tpu.services import logger as logmod
+    from erlamsa_tpu.services.gfcomms import GfComms
+
+    g = [("static", b"ab"), ("rbinary", 4)]
+    got: list[str] = []
+    sink = got.append  # bind once: remove_sink matches by identity
+    logmod.GLOBAL.add_sink("debug", sink)
+    try:
+        runs = []
+        for _ in range(2):
+            srv = GfComms(0, grammar=g, seed=(9, 9, 9))
+            assert srv.seed == (9, 9, 9)
+            runs.append(_gf_session(srv, [b"x"] * 4))
+        logmod.GLOBAL.flush()
+    finally:
+        logmod.GLOBAL.remove_sink(sink)
+    assert runs[0] == runs[1], "fixed seed must replay byte-identically"
+    # default seeding is explicit-but-random now, never silent
+    assert GfComms(0, grammar=g).seed is not None
+    assert any("seed 9,9,9" in line for line in got)
+
+
+def test_gfcomms_batched_mode_grouping_independent():
+    """One connection, N packets: responses must equal the sequential
+    per-packet engine expansion whatever the drain grouping did."""
+    from erlamsa_tpu.gen import GenEngine
+    from erlamsa_tpu.services.gfcomms import GfComms
+
+    cg = compile_grammar(BUILTIN_GRAMMARS["demo-lines"],
+                         source="demo-lines")
+    eng = GenEngine(cg, SEED, fuzz=True)
+    want, _ = eng.expand(0, slots=range(3))  # conn 0, packets 0..2
+
+    srv = GfComms(0, seed=SEED, engine=GenEngine(cg, SEED, fuzz=True))
+    replies = _gf_session(srv, [b"ping"] * 3)
+    # request/response lockstep -> one packet per drain; byte equality
+    # against the slot-keyed expansion IS grouping-independence
+    assert replies == want
+
+
+# ------------------------------- depth-weighted span picks (r13) ------
+
+
+def test_span_pick_depth_weighting():
+    """Pump/stutter picks weight nodes by (depth+1): on a 3-deep nest
+    the innermost span must be picked ~3x the outermost (the sequential
+    oracle reaches repeat targets by walking INTO the tree)."""
+    from erlamsa_tpu.ops import structure as st
+
+    nd, cnt = st.tokenize(b"(((abc)))")
+    assert cnt == 3
+    depths = {int(nd[i, 2]): i for i in range(cnt)}
+    key = st.struct_sample_key(_base(), 0, 0)
+    counts = np.zeros(cnt, np.int64)
+    import jax
+
+    for t in range(240):
+        i = st._pick_depth(jax.random.fold_in(key, 1000 + t), 0, nd,
+                           np.arange(cnt))
+        counts[i] += 1
+    assert counts[depths[2]] > counts[depths[0]] * 1.8, counts
+
+
+def test_span_pick_kernel_matches_oracle_on_deep_nest():
+    """tr2/td/tr draw the same depth-weighted node on both sides (the
+    wider r13 parity suite re-pins all mutators; this is the focused
+    depth pin on a span table with real depth spread)."""
+    import jax
+
+    from erlamsa_tpu.ops import structure as st
+    from erlamsa_tpu.ops import tree_mutators as tm
+
+    raw = b'{"a": {"b": ["c", ["d"]], "e": "f"}}'
+    nd, cnt = st.tokenize(raw)
+    cap = 128
+    row = np.zeros(cap, np.uint8)
+    row[: len(raw)] = np.frombuffer(raw, np.uint8)
+    for code_idx, kern in ((0, tm.k_tr2), (1, tm.k_td), (3, tm.k_tr)):
+        for slot in range(6):
+            key = st.struct_sample_key(_base(), 3, slot)
+            want = st.host_struct_fuzz(key, raw, nd, cnt, code_idx, cap)
+            out, n2, ok = kern(key, jax.numpy.asarray(row), len(raw),
+                               jax.numpy.asarray(nd), cnt, cap)
+            assert bool(ok)
+            got = bytes(np.asarray(out)[: int(n2)])
+            assert got == want, (code_idx, slot)
+
+
+def _base():
+    from erlamsa_tpu.ops import prng
+
+    return prng.base_key(SEED)
+
+
+# ------------------------------------------------- observability ------
+
+
+def test_prom_renders_gen_family():
+    from erlamsa_tpu.obs import prom
+    from erlamsa_tpu.services import metrics
+
+    c = metrics.Counters()
+    c.record_gen_expand(8, 512, 1)
+    c.record_gen_fallback(2)
+    c.set_gen_degraded(True)
+    text = prom.render(c)
+    assert "erlamsa_gen_expansions_total 8" in text
+    assert "erlamsa_gen_bytes_total 512" in text
+    assert "erlamsa_gen_truncated_total 1" in text
+    assert "erlamsa_gen_host_fallback_total 2" in text
+    assert "erlamsa_gen_degraded 1" in text
+    # silent when the subsystem never ran (scrape noise discipline)
+    assert "erlamsa_gen_" not in prom.render(metrics.Counters())
+
+
+def test_flight_breadcrumb_on_expand():
+    from erlamsa_tpu.gen import GenEngine
+    from erlamsa_tpu.obs import flight
+
+    cg = compile_grammar(BUILTIN_GRAMMARS["demo-lines"], source="demo-lines")
+    GenEngine(cg, SEED).expand(0, n=2)
+    notes = [n for n in list(flight.GLOBAL._ring)
+             if n.get("kind") == "gen_panel"]
+    assert notes and notes[-1]["samples"] == 2
+    assert notes[-1]["grammar"] == "demo-lines"
+    assert notes[-1]["host"] is False
+
+
+# ------------------------------------------------------ CLI wiring ----
+
+
+def test_cli_gen_validation_errors():
+    from erlamsa_tpu.services.cli import main
+
+    with pytest.raises(SystemExit, match="DSL"):
+        main(["--gen", "no-such-grammar", "-n", "1"])
+    with pytest.raises(SystemExit, match="not an integer"):
+        main(["--gen", "demo-tlv:zap", "-n", "1"])
+    with pytest.raises(SystemExit, match="count"):
+        main(["--gen", "demo-tlv:0", "-n", "1"])
+    with pytest.raises(SystemExit, match="single-device"):
+        main(["--gen", "demo-tlv", "--fleet-nodes", "h:1", "-n", "1"])
+    with pytest.raises(SystemExit, match="single-device"):
+        main(["--gen", "demo-tlv", "--shards", "2", "-n", "1"])
+    with pytest.raises(SystemExit, match="--gfcomms"):
+        main(["--gfcomms-batched", "-n", "1"])
+    with pytest.raises(SystemExit, match="--gen"):
+        main(["--gfcomms", "0", "-n", "1"])
+
+
+# ------------------------------------------- end-to-end (slow) --------
+
+
+@pytest.mark.slow
+def test_runner_gen_campaign_fault_identity(tmp_path):
+    """--gen seeds a feedback campaign; an injected gen.expand fault
+    must leave every output byte identical (the tier1 --gen-smoke pin,
+    kept here so `pytest -m slow` covers it without the shell leg)."""
+    from erlamsa_tpu.corpus.runner import run_corpus_batch
+    from erlamsa_tpu.services import chaos
+
+    def one(tag, spec):
+        chaos.configure(spec, seed=3)
+        outdir = tmp_path / tag
+        outdir.mkdir()
+        stats = {}
+        try:
+            rc = run_corpus_batch(
+                {
+                    "corpus_dir": str(tmp_path / f"c-{tag}"),
+                    "gen": {"grammar": BUILTIN_GRAMMARS["demo-tlv"],
+                            "label": "demo-tlv", "n": 8},
+                    "feedback": True,
+                    "seed": SEED,
+                    "n": 2,
+                    "output": str(outdir / "%n.out"),
+                    "_stats": stats,
+                },
+                batch=8,
+            )
+        finally:
+            chaos.configure(None)
+        blob = b"".join(
+            p.read_bytes()
+            for p in sorted(outdir.iterdir(), key=lambda p: int(p.stem))
+        )
+        return rc, blob, stats
+
+    rc1, blob1, st1 = one("clean", None)
+    rc2, blob2, st2 = one("fault", "gen.expand:x1")
+    assert rc1 == rc2 == 0 and blob1
+    assert blob2 == blob1
+    assert st1["gen"]["host_fallback"] == 0
+    assert st2["gen"]["host_fallback"] > 0 and st2["gen"]["degraded"]
