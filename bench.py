@@ -576,6 +576,14 @@ def child_main() -> None:
             record["corpus_upload_bytes_per_sample"] = round(
                 cstats.get("bytes_uploaded", 0) / max(cstats.get("total", 1), 1), 1
             )
+            # the campaign report (obs/report.py) over the live counters:
+            # the same per-stage cost ledger `python -m
+            # erlamsa_tpu.obs.report` renders from a --metrics-out file
+            from erlamsa_tpu.obs import report as _obs_report
+            from erlamsa_tpu.services import metrics as _r_metrics
+
+            record["stage_report"] = _obs_report.build_report(
+                metrics_snap=_r_metrics.GLOBAL.snapshot())
             line = json.dumps(record)
             _write_result(line)
             # arena leg: same shape, --layout arena. Seeds cross PCIe once

@@ -22,6 +22,9 @@ Rule catalogue (see the rules_* modules for each rule's contract):
                                   wide lints also verify every expected
                                   site still exists as a literal
                                   fault_point("<site>")
+    span-coverage                 frame-protocol ops in the fleet
+                                  transport scope open a trace span (or
+                                  name where the span lives in a waiver)
     unused-import                 imports bound but never referenced
 
 Suppressions are per-line comments::
@@ -50,6 +53,7 @@ from .core import RULES, Finding, LintConfig, Module, run_lint, rule
 # importing the rule modules registers every rule in RULES
 from . import rules_determinism  # noqa: E402,F401  (registration import)
 from . import rules_device  # noqa: E402,F401
+from . import rules_obs  # noqa: E402,F401
 from . import rules_resilience  # noqa: E402,F401
 from . import rules_threads  # noqa: E402,F401
 from . import symbols  # noqa: E402,F401

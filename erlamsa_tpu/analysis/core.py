@@ -72,6 +72,10 @@ class LintConfig:
         # r17 grammar-expansion kernel (gen/ compiler tables -> lax.scan)
         "grammar",
     )
+    #: framed-transport scope for span-coverage: functions here whose
+    #: own body touches a frame primitive must open a trace span (or
+    #: carry a waiver naming where the span lives)
+    span_paths: tuple = ("services/dist.py", "corpus/fleet.py")
     #: modules whose raw send/recv + durable writes must route through a
     #: chaos fault site (chaos-site-coverage)
     chaos_modules: tuple = ("services/dist.py", "corpus/store.py",
@@ -92,6 +96,7 @@ class LintConfig:
         "dist.shard.frame", "fleet.snapshot",
         "monitor.spawn", "monitor.ingest", "coverage.fold",
         "gen.expand",
+        "obs.telemetry",
     )
 
     def in_scope(self, rel: str, prefixes: tuple) -> bool:

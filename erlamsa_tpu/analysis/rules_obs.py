@@ -1,0 +1,70 @@
+"""Observability rule: span-coverage.
+
+**span-coverage.** The r18 contract is that the fleet wire protocol is
+traceable end to end: every function in the framed-transport scope
+(``LintConfig.span_paths`` — services/dist.py and the fleet reduce
+paths in corpus/fleet.py) whose own body touches a frame primitive
+(``_pack_frame`` / ``_read_frame`` / the ``_shard_frame_*`` /
+``_node_frame_*`` codecs, or a ShardStream ``read_reply``/``request``)
+must open a ``trace.span(...)`` / ``trace.span_remote(...)`` in that
+same body — otherwise a new protocol op ships dark, invisible in the
+merged fleet trace. Pure codec helpers and transport primitives whose
+callers carry the span annotate ``# lint: span-coverage-ok <reason>``;
+like every waiver, the reason documents where the span actually lives.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .core import Finding, LintConfig, Module, call_name, functions, \
+    own_body_walk, rule
+
+#: call names (last dotted segment) that touch the framed wire protocol
+FRAME_OPS = frozenset({
+    "_pack_frame", "_read_frame",
+    "_shard_frame_send", "_shard_frame_recv",
+    "_node_frame_send", "_node_frame_recv",
+    "read_reply", "request",
+})
+
+#: call names (last dotted segment) that open a span
+SPAN_CALLS = frozenset({"span", "span_remote"})
+
+
+def _last_segment(node: ast.Call) -> str | None:
+    name = call_name(node)
+    if name is not None:
+        return name.rsplit(".", 1)[-1]
+    # dynamic receiver (self.streams[i].request(...)): the attribute
+    # name is still the thing the rule keys on
+    if isinstance(node.func, ast.Attribute):
+        return node.func.attr
+    return None
+
+
+@rule("span-coverage")
+def check_span_coverage(mod: Module, config: LintConfig):
+    if not config.in_scope(mod.rel, config.span_paths):
+        return
+    for fn in functions(mod.tree):
+        body = list(own_body_walk(fn))
+        has_span = any(
+            isinstance(n, ast.Call) and _last_segment(n) in SPAN_CALLS
+            for n in body
+        )
+        if has_span:
+            continue
+        for n in body:
+            if isinstance(n, ast.Call):
+                op = _last_segment(n)
+                if op in FRAME_OPS:
+                    yield Finding(
+                        mod.path, n.lineno, "span-coverage",
+                        f"frame op `{op}(...)` in `{fn.name}` runs "
+                        f"outside any trace span: open a trace.span/"
+                        f"span_remote in this function so the op shows "
+                        f"in the merged fleet trace, or annotate "
+                        f"`# lint: span-coverage-ok <where the span "
+                        f"lives>`",
+                    )

@@ -312,12 +312,15 @@ class _PendingRemote:
     identically."""
 
     def __init__(self, stream, epoch: int, case: int, n_slots: int,
-                 sync: bool, shapes_acc: set):
+                 sync: bool, shapes_acc: set, tele: bool = False):
         self.stream = stream
         self.epoch = int(epoch)
         self.case = int(case)
         self.n_slots = int(n_slots)
         self.sync = bool(sync)
+        #: a shard_telemetry request rode this window's fence — its
+        #: reply is owed on the FIFO stream right after the sync ack
+        self.tele = bool(tele)
         self._shapes = shapes_acc
         self.done = False
         self._result = None
@@ -325,6 +328,7 @@ class _PendingRemote:
     def force(self) -> _RemoteResult:
         if self.done:
             return self._result
+        # lint: span-coverage-ok forced under the drain worker's fleet.drain span (process_case)
         header, blob = self.stream.read_reply("shard_result", self.epoch,
                                               case=self.case)
         lens = [int(x) for x in header.get("lens", [])]
@@ -345,11 +349,17 @@ class _PendingRemote:
         if self.sync:
             # the window barrier: the ONLY awaited steady-state
             # exchange — consuming the ack re-opens the shard's window
-            self.stream.read_reply("shard_synced", self.epoch,
+            self.stream.read_reply("shard_synced", self.epoch,  # lint: span-coverage-ok same fleet.drain span as the result frame above
                                    case=self.case)
             if self.stream.tally is not None:
                 self.stream.tally.add(round_trips=1)
             self.stream.unsynced = 0
+            if self.tele:
+                from ..services.dist import consume_telemetry
+
+                # out-of-band: a lost/garbled telemetry reply counts
+                # telemetry_lost and the merge proceeds untouched
+                consume_telemetry(self.stream, self.epoch, self.case)
         self._result = _RemoteResult(outs, header.get("scores", []),
                                      header.get("applied", []))
         self.done = True
@@ -376,7 +386,8 @@ def run_corpus_fleet(opts: dict, batch: int = 1024) -> int:
                                        quarantine_mismatch,
                                        save_fleet_state)
     from ..services.dist import (RemoteShardError, ShardStream,
-                                 TransportTally, new_campaign_token)
+                                 TransportTally, new_campaign_token,
+                                 request_telemetry)
 
     raw_shards = opts.get("shards")
     # --fleet-window W: steps in flight per shard between sync barriers
@@ -496,6 +507,11 @@ def run_corpus_fleet(opts: dict, batch: int = 1024) -> int:
                 store.restore_energies(st["energies"])
             resume_epoch = st["epoch"]
             classes_override = st["classes"]
+            # event counters (fence_rejected, telemetry_lost, ...) are
+            # monotone across a resume: max-merge the checkpointed
+            # floors so no counter ever reads lower after a restore
+            for kind, floor in (st.get("events") or {}).items():
+                metrics.GLOBAL.restore_event_floor(kind, floor)
             print(f"# fleet resumed at case {start_case} "
                   f"({len(st['seen'])} seen hashes, "
                   f"{len(st['energies'])} seed energies, "
@@ -696,20 +712,34 @@ def run_corpus_fleet(opts: dict, batch: int = 1024) -> int:
             "inline_lens": inline_lens,
             "scores": [[int(x) for x in scores[s]] for s in slots],
         }
+        # propagate the per-case trace context so the worker's
+        # shard.step span parents onto this coordinator's fleet.case
+        # span; keys are omitted entirely with tracing off, keeping
+        # the wire bytes identical
+        ctx_tid, ctx_span = trace.current_context()
+        if ctx_tid:
+            header["trace"] = ctx_tid
+            header["span"] = ctx_span
         with trace.span("fleet.remote_dispatch", case=case,
                         shard=shard.id, rows=len(slots),
                         inline=len(inline_sids)):
             shard.stream.send(header, b"".join(blobs))
         shard.stream.unsynced += 1
         sync = shard.stream.unsynced >= fleet_window
+        tele = False
         if sync:
             shard.stream.send({"op": "shard_sync", "shard": shard.id,
                                "epoch": int(epoch), "case": int(case)})
+            # piggyback one out-of-band telemetry exchange on the window
+            # fence; a chaos obs.telemetry firing drops it (counted as
+            # telemetry_lost) and nothing downstream changes
+            tele = request_telemetry(shard.stream, int(epoch),
+                                     int(case))
         metrics.GLOBAL.record_stage("remote_step",
                                     time.perf_counter() - t_a)
         return [(list(slots), len(slots),
                  _PendingRemote(shard.stream, epoch, case, len(slots),
-                                sync, step_shapes))]
+                                sync, step_shapes, tele=tele))]
 
     def shard_dispatch(shard, case: int, slots: list[int],
                        ids, samples):
@@ -800,9 +830,11 @@ def run_corpus_fleet(opts: dict, batch: int = 1024) -> int:
         clears (same discipline as the single-device runner's probe)."""
         chaos.fault_point("shard.step")
         if isinstance(shard, _Remote):
-            shard.stream.request(
-                {"op": "shard_probe", "shard": shard.id},
-                expect="shard_alive", timeout=min(fleet_timeout, 10.0))
+            with trace.span("fleet.probe", shard=shard.id):
+                shard.stream.request(
+                    {"op": "shard_probe", "shard": shard.id},
+                    expect="shard_alive",
+                    timeout=min(fleet_timeout, 10.0))
             return
         with jax.default_device(shard.device):
             jnp.zeros(8).block_until_ready()
@@ -848,9 +880,12 @@ def run_corpus_fleet(opts: dict, batch: int = 1024) -> int:
             sh.snap_sids = frozenset()
             sh.stream.close()
             try:
-                sh.stream.request(
-                    {"op": "shard_revoke", "shard": shard_id,
-                     "epoch": entry["epoch"]}, expect="shard_revoked")
+                with trace.span("fleet.revoke", shard=shard_id,
+                                case=case):
+                    sh.stream.request(
+                        {"op": "shard_revoke", "shard": shard_id,
+                         "epoch": entry["epoch"]},
+                        expect="shard_revoked")
             except (OSError, RemoteShardError):
                 pass
             sh.stream.close()
@@ -938,6 +973,10 @@ def run_corpus_fleet(opts: dict, batch: int = 1024) -> int:
         that never arrives surfaces as FleetShardLost into the
         coordinator's rewind."""
         case_i, ids = work.case, work.ids
+        # cross-thread parenting: the map thread stamped its fleet.case
+        # span id into the work item, so this thread's reduce spans join
+        # the same case tree in the merged trace
+        case_parent = int(getattr(work, "span", 0) or 0)
         try:
             chaos.fault_point("fleet.reduce")
         except OSError:
@@ -954,7 +993,8 @@ def run_corpus_fleet(opts: dict, batch: int = 1024) -> int:
         shard_id = -1
         try:
             for shard_id, slots, rows, fut in work.launched:
-                with trace.span("fleet.drain", case=case_i, rows=rows):
+                with trace.span_remote("fleet.drain", parent=case_parent,
+                                       case=case_i, rows=rows):
                     if isinstance(fut, _PendingRemote):
                         fut = fut.force()
                     new_data, new_lens, new_sc, meta = fut.result()
@@ -1032,7 +1072,8 @@ def run_corpus_fleet(opts: dict, batch: int = 1024) -> int:
                 shards[src_shard].arena.enqueue_adopt(
                     sid_new, len(payload), src, row)
 
-        with trace.span("fleet.hash", case=case_i):
+        with trace.span_remote("fleet.hash", parent=case_parent,
+                               case=case_i):
             tallies["new_hashes"] += apply_novelty(
                 store, ids, results, seen_hashes, batch, tallies,
                 on_novel=on_novel if adopt_on else None)
@@ -1050,7 +1091,8 @@ def run_corpus_fleet(opts: dict, batch: int = 1024) -> int:
 
         def write_outputs():
             t_o = time.perf_counter()
-            with trace.span("fleet.write", case=case_i):
+            with trace.span_remote("fleet.write", parent=case_parent,
+                                   case=case_i):
                 for slot in range(batch):
                     payload = results.get(slot, b"")
                     if writer is not None:
@@ -1068,11 +1110,16 @@ def run_corpus_fleet(opts: dict, batch: int = 1024) -> int:
             # disk), and the store snapshot follows so it contains this
             # case's adoptions when the checkpoint says they exist
             write_outputs()
-            with trace.span("fleet.checkpoint", case=case_i):
+            t_c = time.perf_counter()
+            with trace.span_remote("fleet.checkpoint", parent=case_parent,
+                                   case=case_i):
                 save_fleet_state(state_path, opts["seed"], case_i + 1,
                                  scores, seen_hashes, store.energies(),
-                                 placement.epoch, n_shards, classes)
+                                 placement.epoch, n_shards, classes,
+                                 events=metrics.GLOBAL.event_counts())
                 store.save()
+            metrics.GLOBAL.record_stage("checkpoint",
+                                        time.perf_counter() - t_c)
             metrics.GLOBAL.record_event("fleet_checkpoint")
             drain.mark_done(case_i)
         else:
@@ -1082,6 +1129,7 @@ def run_corpus_fleet(opts: dict, batch: int = 1024) -> int:
             drain.mark_done(case_i)
             write_outputs()
         reduce_busy[0] += time.perf_counter() - t_r
+        metrics.GLOBAL.record_stage("reduce", time.perf_counter() - t_r)
         if stats is not None:
             stats["finish_times"].append(time.perf_counter())
 
@@ -1103,6 +1151,7 @@ def run_corpus_fleet(opts: dict, batch: int = 1024) -> int:
     t0 = time.perf_counter()
     probe_at = start_case
     case = start_case
+    case_span = None
     drain = _DrainWorker(process_case, start_case, discard=discard_work)
     try:
         while True:
@@ -1127,6 +1176,14 @@ def run_corpus_fleet(opts: dict, batch: int = 1024) -> int:
                         flight.GLOBAL.note("fleet_window_stall",
                                            case=case, waited=round(w, 4))
 
+                    # per-case umbrella span: remote shard.step spans
+                    # and the drain worker's reduce-side spans parent
+                    # onto it, so the merged trace shows one case tree
+                    # across threads and hosts. Managed manually — the
+                    # map section has several exits (rewind included)
+                    # and a `with` block can't straddle them
+                    case_span = trace.span("fleet.case", case=case)
+                    case_span.__enter__()
                     t_s = time.perf_counter()
                     with trace.span("fleet.schedule", case=case):
                         # record=False: schedule-hit counts decay future
@@ -1236,7 +1293,10 @@ def run_corpus_fleet(opts: dict, batch: int = 1024) -> int:
                     # -- reduce: hand the case to the drain worker -----
                     drain.submit(SimpleNamespace(
                         case=case, ids=ids, launched=launched,
-                        host_slots=host_slots, t_map=t_map))
+                        host_slots=host_slots, t_map=t_map,
+                        span=case_span.span_id))  # lint: no-wallclock-nondeterminism-ok span id only parents reduce-side spans, never feeds replay values
+                    case_span.__exit__(None, None, None)
+                    case_span = None
                     if reduce_mode == "boundary":
                         # --fleet-reduce boundary: the r14 lockstep —
                         # every case fully merges before the next maps
@@ -1245,6 +1305,12 @@ def run_corpus_fleet(opts: dict, batch: int = 1024) -> int:
                 drain.close()
                 break
             except FleetShardLost as e:
+                if case_span is not None:  # lint: no-wallclock-nondeterminism-ok stack hygiene on the abandoned span, no replay value involved
+                    # the abandoned case's umbrella span must come off
+                    # this thread's stack or every later span would
+                    # parent onto it
+                    case_span.__exit__(None, None, None)
+                    case_span = None
                 # a dispatched reply was lost after its case left the
                 # map: the merged prefix is intact (merges run in case
                 # order), so revoke the shard, drop every stream, and

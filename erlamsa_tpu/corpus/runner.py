@@ -794,8 +794,11 @@ def run_corpus_batch(opts: dict, batch: int = 1024) -> int:
                 covered = [s for s in sorted(frames) if s < batch]
                 pairs = [(ledger.resolve(case, s) or ids[s], frames[s])
                          for s in covered]
+                t_f = time.perf_counter()
                 try:
-                    gains = cov.fold_case(pairs)
+                    with trace.span("coverage.fold", case=case,
+                                    maps=len(pairs)):
+                        gains = cov.fold_case(pairs)
                 except OSError as e:
                     # injected coverage.fold fault: the whole case is
                     # treated as uncovered — observable, never diverging
@@ -811,6 +814,9 @@ def run_corpus_batch(opts: dict, batch: int = 1024) -> int:
                             len(pairs), new_edges, cov.edges())
                         tallies["cov_maps"] += len(pairs)
                         tallies["cov_new_edges"] += new_edges
+                finally:
+                    metrics.GLOBAL.record_stage(
+                        "coverage", time.perf_counter() - t_f)
 
         # novelty feedback: a slot WITH a coverage map admits on
         # genuinely-new edges (new_cov energy); a slot without one keeps

@@ -8,8 +8,7 @@ tracing on or off (pinned by tests/test_obs.py), and with tracing
 disabled a ``trace.span()`` call is one attribute check returning a
 shared no-op.
 
-Modules (all pure stdlib — importable in jax-free contexts like the
-fuzzlint CI leg):
+Modules:
 
     trace.py   counter-keyed spans with monotonic timing; Chrome-trace
                (Perfetto-loadable) JSON export (``--trace FILE``) and
@@ -22,6 +21,17 @@ fuzzlint CI leg):
     prom.py    Prometheus text exposition over the metrics snapshot;
                the faas ``GET /metrics`` body and the standalone
                ``--metrics-port`` exporter
+    federate.py  coordinator-side fold of fleet worker telemetry
+               (``shard_telemetry`` frames): node-labeled
+               ``erlamsa_worker_*`` families on /metrics, worker flight
+               and span tails merged into the local ring/tracer
+    report.py  the campaign report — per-stage cost ledger, span census
+               and per-node worker totals rendered from a run's
+               artifacts (``python -m erlamsa_tpu.obs.report``)
+
+prom.py and federate.py import services.metrics, so they are NOT
+imported here — use-sites import them lazily; this package stays
+stdlib-pure (importable in jax-free contexts like the fuzzlint CI leg).
 """
 
 from . import flight, hist, trace  # lint: unused-import-ok re-exported submodules

@@ -1,0 +1,187 @@
+"""Telemetry federation: the coordinator-side fold of fleet worker
+telemetry into one observable plane.
+
+Each fleet worker answers a ``shard_telemetry`` frame (piggybacked on
+the ``shard_sync`` window fence, services/dist.py) with its cumulative
+metric totals (services.metrics.Counters.federation_totals), its
+flight-ring tail, and its span-event tail. This module is where those
+payloads land:
+
+  * metric totals are kept per node and re-exposed by obs/prom.py as
+    ``erlamsa_worker_*{node="host:port"}`` families on the existing
+    ``/metrics`` endpoint — one scrape covers the fleet;
+  * flight entries fold node-stamped into the coordinator's flight
+    recorder ring, so one SIGUSR2 dump captures every process;
+  * span events fold into the coordinator's tracer, so one ``--trace``
+    export is a merged fleet-wide timeline.
+
+Totals are cumulative, not deltas, on purpose: ingest is idempotent, so
+a telemetry frame lost to the ``obs.telemetry`` chaos site (or a real
+wire fault) means stale data for one window — never corrupted counters.
+The campaign itself is unaffected either way; telemetry is strictly
+out-of-band (byte-identity pinned by tests/tier1 --obs-smoke).
+
+Like obs/prom.py this module imports services.metrics, so it is NOT
+imported from the obs package __init__ — dist/prom/report import it
+lazily.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+
+from . import flight, hist, trace
+
+
+class Federation:
+    """Per-node telemetry accumulator (GLOBAL below; one per process)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        #: node -> latest cumulative totals payload ("metrics" key)
+        self._nodes: dict[str, dict] = {}
+        #: node -> telemetry frames ingested / entries folded
+        self._ingests: dict[str, int] = {}
+
+    def reset(self) -> None:
+        with self._lock:
+            self._nodes = {}
+            self._ingests = {}
+
+    def ingest(self, node: str, payload: dict) -> None:
+        """Fold one worker telemetry payload. Raises ValueError on a
+        malformed payload — the caller counts it as telemetry_lost and
+        moves on; nothing here may raise into the campaign hot path."""
+        if not isinstance(payload, dict):
+            raise ValueError("telemetry payload: want a dict")
+        totals = payload.get("metrics")
+        if totals is not None and not isinstance(totals, dict):
+            raise ValueError("telemetry payload: metrics must be a dict")
+        node = str(node)
+        if totals is not None:
+            with self._lock:
+                self._nodes[node] = totals
+        with self._lock:
+            self._ingests[node] = self._ingests.get(node, 0) + 1
+        # an in-process loopback worker shares this process's GLOBAL
+        # flight ring and tracer — folding its tails back in would
+        # duplicate every entry, so same-pid payloads keep metrics only
+        if payload.get("pid") == os.getpid():
+            return
+        entries = payload.get("flight") or []
+        if entries:
+            flight.GLOBAL.ingest(entries, node)
+        events = payload.get("trace") or []
+        if events:
+            trace.GLOBAL.ingest(events, node)
+
+    def nodes(self) -> list[str]:
+        with self._lock:
+            return sorted(self._nodes)
+
+    def snapshot(self) -> dict:
+        """Per-node totals for the campaign report / bench record."""
+        with self._lock:
+            return {"nodes": {n: dict(t) for n, t in self._nodes.items()},
+                    "ingests": dict(self._ingests)}
+
+    # -- exposition (called from obs/prom.py render) -----------------------
+
+    def render_into(self, w) -> None:
+        """Append ``erlamsa_worker_*{node=...}`` families to a prom
+        _Writer. Families render once with every node's sample under
+        them (prometheus forbids repeated HELP/TYPE heads)."""
+        with self._lock:
+            nodes = {n: t for n, t in sorted(self._nodes.items())}
+        if not nodes:
+            return
+
+        scalar = (
+            ("samples", "erlamsa_worker_samples_total", "counter",
+             "Fuzzed samples produced on a fleet worker, by node.", 0),
+            ("batches", "erlamsa_worker_batches_total", "counter",
+             "Device batches stepped on a fleet worker, by node.", 0),
+            ("bytes_out", "erlamsa_worker_bytes_out_total", "counter",
+             "Output bytes produced on a fleet worker, by node.", 0),
+            ("device_s", "erlamsa_worker_device_seconds_total", "counter",
+             "Cumulative device step time on a fleet worker, by node.",
+             0.0),
+            ("round_trips", "erlamsa_worker_round_trips_total", "counter",
+             "Awaited exchanges observed from the worker side, by node.",
+             0),
+            ("degraded", "erlamsa_worker_degraded", "gauge",
+             "1 while a fleet worker serves from its host oracle.", 0),
+        )
+        for key, metric, kind, help_text, default in scalar:
+            w.head(metric, kind, help_text)
+            for node, totals in nodes.items():
+                c = totals.get("counters") or {}
+                w.sample(metric, c.get(key, default), {"node": node})
+
+        w.head("erlamsa_worker_stage_seconds_total", "counter",
+               "Cumulative wall seconds per pipeline stage on a fleet "
+               "worker, by node and stage.")
+        for node, totals in nodes.items():
+            for stage, secs in sorted((totals.get("stages") or {}).items()):
+                w.sample("erlamsa_worker_stage_seconds_total", secs,
+                         {"node": node, "stage": stage})
+        w.head("erlamsa_worker_resilience_events_total", "counter",
+               "Resilience events on a fleet worker, by node and kind.")
+        for node, totals in nodes.items():
+            for kind, n in sorted((totals.get("events") or {}).items()):
+                w.sample("erlamsa_worker_resilience_events_total", n,
+                         {"node": node, "kind": kind})
+        w.head("erlamsa_worker_fault_injected_total", "counter",
+               "Chaos-injected failures on a fleet worker, by node and "
+               "site.")
+        for node, totals in nodes.items():
+            for site, n in sorted((totals.get("faults") or {}).items()):
+                w.sample("erlamsa_worker_fault_injected_total", n,
+                         {"node": node, "site": site})
+
+        # worker latency histograms: same canonical cumulative-le shape
+        # as the local families (hist.cumulative_buckets)
+        worker_hists = (
+            ("batch_latency", "erlamsa_worker_batch_latency_seconds"),
+            ("device_step", "erlamsa_worker_device_step_seconds"),
+        )
+        for hist_name, metric in worker_hists:
+            if not any((t.get("hists") or {}).get(hist_name, {}).get(
+                    "count", 0) for t in nodes.values()):
+                continue
+            w.head(metric, "histogram",
+                   f"Log2-bucketed {hist_name.replace('_', ' ')} in "
+                   f"seconds on a fleet worker, by node.")
+            for node, totals in nodes.items():
+                h = (totals.get("hists") or {}).get(hist_name)
+                if not h:
+                    continue
+                for bound, cum in hist.cumulative_buckets(
+                        h.get("counts") or []):
+                    if bound == float("inf"):
+                        le = "+Inf"
+                    else:
+                        le = (repr(int(bound)) if bound == int(bound)
+                              else repr(bound))
+                    w.sample(metric + "_bucket", cum,
+                             {"node": node, "le": le})
+                w.sample(metric + "_sum", h.get("sum", 0.0),
+                         {"node": node})
+                w.sample(metric + "_count", h.get("count", 0),
+                         {"node": node})
+
+
+GLOBAL = Federation()
+
+
+def ingest(node: str, payload: dict) -> None:
+    GLOBAL.ingest(node, payload)
+
+
+def snapshot() -> dict:
+    return GLOBAL.snapshot()
+
+
+def reset() -> None:
+    GLOBAL.reset()

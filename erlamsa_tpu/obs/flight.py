@@ -35,6 +35,26 @@ RING_SIZE = 4096
 #: repeated device probes failing) must not write hundreds of files
 DUMP_DEBOUNCE_S = 5.0
 
+#: callback returning the active trace_id ("" when tracing is off).
+#: Registered by obs/trace.py at import — trace imports flight, so the
+#: reverse dependency has to arrive as a callback, not an import.
+_context_source = None
+
+
+def set_context_source(fn) -> None:
+    global _context_source
+    _context_source = fn
+
+
+def _active_trace_id() -> str:
+    fn = _context_source
+    if fn is None:
+        return ""
+    try:
+        return fn() or ""
+    except Exception:  # lint: broad-except-ok recorder must never raise into callers
+        return ""
+
 
 class FlightRecorder:
     def __init__(self, ring_size: int = RING_SIZE):
@@ -45,6 +65,7 @@ class FlightRecorder:
         self._last_dump = -DUMP_DEBOUNCE_S
         self._dumps = 0
         self._signal_installed = False
+        self._seq = 0  # total entries ever appended (tail_since cursor)
 
     # -- configuration ----------------------------------------------------
 
@@ -81,10 +102,14 @@ class FlightRecorder:
         """Record a resilience/lifecycle event; auto-dump on trip kinds."""
         entry = {"t": round(time.monotonic() - self._t0, 6),
                  "type": "event", "kind": kind}
+        tid = _active_trace_id()
+        if tid:
+            entry["trace"] = tid
         if fields:
             entry.update(fields)
         with self._lock:
             self._ring.append(entry)
+            self._seq += 1
         if kind in TRIP_KINDS:
             self.dump(kind)
 
@@ -95,10 +120,41 @@ class FlightRecorder:
         entry = {"t": round(t0, 6), "type": "span", "name": name,
                  "span_id": span_id, "parent_id": parent_id,
                  "dur": round(dur, 6)}
+        tid = _active_trace_id()
+        if tid:
+            entry["trace"] = tid
         if attrs:
             entry["attrs"] = dict(attrs)
         with self._lock:
             self._ring.append(entry)
+            self._seq += 1
+
+    def ingest(self, entries: list, node: str) -> int:
+        """Fold a worker's shipped flight-ring tail into this ring,
+        node-stamped, so one SIGUSR2 dump captures the fleet. Returns
+        the number folded."""
+        n = 0
+        with self._lock:
+            for entry in entries:
+                if not isinstance(entry, dict):
+                    continue
+                stamped = dict(entry)
+                stamped["node"] = node
+                self._ring.append(stamped)
+                self._seq += 1
+                n += 1
+        return n
+
+    def tail_since(self, seq: int) -> tuple[list, int]:
+        """Entries appended after cursor `seq` (capped at ring size),
+        plus the new cursor — the worker-side telemetry tail. The ring
+        is append-only FIFO, so the last ``total-seq`` appends are
+        exactly the ring's tail slice."""
+        with self._lock:
+            fresh = max(0, self._seq - max(0, int(seq)))
+            fresh = min(fresh, len(self._ring))
+            entries = list(self._ring)[len(self._ring) - fresh:]
+            return entries, self._seq
 
     # -- dumping ----------------------------------------------------------
 
@@ -129,8 +185,12 @@ class FlightRecorder:
                 for entry in entries:
                     f.write(json.dumps(entry) + "\n")
         except OSError as e:
-            from ..services import logger
+            from ..services import logger, metrics
 
+            # counted, not just logged: erlamsa_flight_dump_failed_total
+            # (record_event's flight mirror is a plain append — no
+            # recursion, "flight_dump_failed" is not a trip kind)
+            metrics.GLOBAL.record_event("flight_dump_failed")
             logger.log("error", "flight recorder dump failed: %s", e)
             return None
         from ..services import logger
@@ -168,3 +228,11 @@ def note(kind: str, **fields) -> None:
 
 def dump(reason: str, force: bool = False) -> str | None:
     return GLOBAL.dump(reason, force=force)
+
+
+def ingest(entries: list, node: str) -> int:
+    return GLOBAL.ingest(entries, node)
+
+
+def tail_since(seq: int) -> tuple[list, int]:
+    return GLOBAL.tail_since(seq)

@@ -68,6 +68,14 @@ class Hist:
         return {"bounds": list(BOUNDS), "counts": counts,
                 "sum": total, "count": n}
 
+    def cumulative(self) -> list[tuple[float, int]]:
+        """Spec-compliant Prometheus buckets: ``(le, cumulative_count)``
+        per bound, ending with ``(+Inf, count)``. THE canonical le
+        conversion — obs/prom.py renders local and federated histograms
+        through this shape so the exposition can't drift per call site."""
+        snap = self.snapshot()
+        return cumulative_buckets(snap["counts"])
+
     def quantile(self, q: float) -> float:
         """Estimated q-quantile (upper bound of the bucket holding the
         q-th observation); 0.0 when empty. Good to within one log2
@@ -90,3 +98,18 @@ class Hist:
         p99 (the fields the bench record and faas stats op surface)."""
         return {"count": self._count, "sum": self._sum,
                 "p50": self.quantile(0.5), "p99": self.quantile(0.99)}
+
+
+def cumulative_buckets(counts: list[int]) -> list[tuple[float, int]]:
+    """Fold per-bucket counts (len N_BUCKETS, last = overflow) into
+    cumulative ``(le, count)`` pairs ending ``(+Inf, total)``; tolerates
+    short/long lists from a remote peer by zero-padding/truncating to
+    N_BUCKETS."""
+    counts = (list(counts) + [0] * N_BUCKETS)[:N_BUCKETS]
+    out: list[tuple[float, int]] = []
+    running = 0
+    for bound, c in zip(BOUNDS, counts):
+        running += int(c)
+        out.append((bound, running))
+    out.append((float("inf"), running + int(counts[-1])))
+    return out

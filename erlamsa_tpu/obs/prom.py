@@ -21,6 +21,7 @@ from __future__ import annotations
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 from ..services import metrics
+from . import hist as obs_hist
 
 CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
 
@@ -122,6 +123,15 @@ def render(counters: metrics.Counters | None = None) -> str:
            "Resilience events (retries, breaker transitions, failovers).")
     for kind, n in sorted(resilience["events"].items()):
         w.sample("erlamsa_resilience_events_total", n, {"kind": kind})
+    w.head("erlamsa_flight_dump_failed_total", "counter",
+           "Flight recorder dumps that failed to hit disk.")
+    w.sample("erlamsa_flight_dump_failed_total",
+             resilience["events"].get("flight_dump_failed", 0))
+    w.head("erlamsa_telemetry_lost_total", "counter",
+           "Fleet telemetry exchanges dropped (chaos or wire fault); "
+           "the campaign itself is unaffected.")
+    w.sample("erlamsa_telemetry_lost_total",
+             resilience["events"].get("telemetry_lost", 0))
 
     w.head("erlamsa_mutator_applied_total", "counter",
            "Mutations applied, by mutator registry code.")
@@ -382,13 +392,18 @@ def render(counters: metrics.Counters | None = None) -> str:
         h = c.hists[hist_name].snapshot()
         w.head(metric, "histogram",
                f"Log2-bucketed {hist_name.replace('_', ' ')} in seconds.")
-        cumulative = 0
-        for bound, count in zip(h["bounds"], h["counts"]):
-            cumulative += count
-            w.sample(metric + "_bucket", cumulative, {"le": _fmt(bound)})
-        w.sample(metric + "_bucket", h["count"], {"le": "+Inf"})
+        # canonical cumulative-le conversion (obs/hist.py) — the +Inf
+        # bucket must equal _count, including overflow observations
+        for bound, cum in obs_hist.cumulative_buckets(h["counts"]):
+            w.sample(metric + "_bucket", cum, {"le": _fmt(bound)})
         w.sample(metric + "_sum", h["sum"])
         w.sample(metric + "_count", h["count"])
+
+    # federated worker families (erlamsa_worker_*{node=...}) — lazy
+    # import keeps obs/__init__ jax-and-metrics free
+    from . import federate
+
+    federate.GLOBAL.render_into(w)
 
     return w.text()
 

@@ -63,9 +63,10 @@ class Span:
     replay paths."""
 
     __slots__ = ("tracer", "name", "attrs", "span_id", "parent_id",
-                 "_t0", "_xprof_ctx")
+                 "_t0", "_xprof_ctx", "_remote_parent")
 
-    def __init__(self, tracer: "Tracer", name: str, attrs: dict):
+    def __init__(self, tracer: "Tracer", name: str, attrs: dict,
+                 remote_parent: int = 0):
         self.tracer = tracer
         self.name = name
         self.attrs = attrs
@@ -73,6 +74,7 @@ class Span:
         self.parent_id = 0
         self._t0 = 0.0
         self._xprof_ctx = None
+        self._remote_parent = remote_parent
 
     def annotate(self, **attrs):
         """Attach extra args to the span (merged into the trace event)."""
@@ -83,7 +85,11 @@ class Span:
         tr = self.tracer
         self.span_id = tr._next_id()
         stack = tr._stack()
-        self.parent_id = stack[-1].span_id if stack else 0
+        # a carried remote parent (frame header / cross-thread work item)
+        # only applies at the top of a thread's stack — nested spans keep
+        # parenting locally so in-process structure stays intact
+        self.parent_id = (stack[-1].span_id if stack
+                          else self._remote_parent)
         stack.append(self)
         if tr._xprof:
             try:
@@ -127,14 +133,19 @@ class Tracer:
         self._tls = threading.local()
         self._atexit_installed = False
         self._exported_upto = -1
+        self._trace_id = ""
+        self._nodes: dict[int, str] = {}
 
     # -- configuration ----------------------------------------------------
 
-    def configure(self, path: str | None = None, xprof: str | None = None):
+    def configure(self, path: str | None = None, xprof: str | None = None,
+                  trace_id: str | None = None):
         """Arm tracing (``--trace FILE`` / ``--xprof DIR``). Either
         argument alone enables span collection; export() writes the
         Chrome trace when a path is set. Calling with neither disables
-        tracing again."""
+        tracing again. `trace_id` names the campaign-wide trace every
+        propagated context carries; defaults to a pid-derived id (never
+        wall clock or entropy — same no-wallclock contract as span ids)."""
         with self._lock:
             self._path = path
             self._xprof = xprof
@@ -143,6 +154,9 @@ class Tracer:
             self._dropped = 0
             self._t_base = time.perf_counter()
             self._exported_upto = -1
+            self._trace_id = ((trace_id or f"t{os.getpid():08x}")
+                              if self._enabled else "")
+            self._nodes = {}
         if xprof:
             try:
                 import jax
@@ -173,11 +187,71 @@ class Tracer:
             return _NOOP
         return Span(self, name, attrs)
 
+    def span_remote(self, name: str, trace_id: str = "", parent: int = 0,
+                    **attrs):
+        """Open a span whose parent arrived over the wire (or from
+        another thread's work item). `parent` is the remote span id; it
+        only takes effect when this thread has no live local span, so
+        propagated context never rewires in-process nesting. A foreign
+        `trace_id` is recorded as a span arg for cross-node correlation."""
+        if not self._enabled:
+            return _NOOP
+        if trace_id and trace_id != self._trace_id:
+            attrs["trace_id"] = trace_id
+        return Span(self, name, attrs, remote_parent=int(parent or 0))
+
     def current_span_id(self) -> int:
         """Innermost live span id on this thread (0 = none) — the
         correlation key JSON log lines carry."""
         stack = getattr(self._tls, "stack", None)
         return stack[-1].span_id if stack else 0
+
+    def current_context(self) -> tuple[str, int]:
+        """The ``(trace_id, span_id)`` pair to stamp into an outgoing
+        frame header. ``("", 0)`` when tracing is disabled — callers
+        skip the header keys entirely so the wire bytes are identical
+        with tracing off."""
+        if not self._enabled:
+            return ("", 0)
+        return (self._trace_id, self.current_span_id())
+
+    def trace_id(self) -> str:
+        return self._trace_id if self._enabled else ""
+
+    # -- federation --------------------------------------------------------
+
+    def take_events(self, start: int = 0) -> tuple[list[dict], int]:
+        """Copy out the event tail from index `start` for telemetry
+        shipping; returns ``(events, next_start)``. The event list is
+        append-only between configure() calls, so `next_start` is a
+        stable resume cursor."""
+        with self._lock:
+            return (list(self._events[start:]), len(self._events))
+
+    def ingest(self, events: list, node: str) -> int:
+        """Fold a worker's shipped span events into this tracer so one
+        export covers the fleet. Events stamped with this process's own
+        pid are skipped — in-process loopback workers share GLOBAL and
+        their spans are already here. Returns the number ingested."""
+        if not self._enabled or not events:
+            return 0
+        own = os.getpid()
+        n = 0
+        with self._lock:
+            for ev in events:
+                if not isinstance(ev, dict) or ev.get("pid") == own:
+                    continue
+                try:
+                    pid = int(ev.get("pid", 0))
+                except (TypeError, ValueError):
+                    continue
+                self._nodes.setdefault(pid, node)
+                if len(self._events) < MAX_EVENTS:
+                    self._events.append(ev)
+                    n += 1
+                else:
+                    self._dropped += 1
+        return n
 
     def _stack(self) -> list:
         stack = getattr(self._tls, "stack", None)
@@ -221,23 +295,33 @@ class Tracer:
         with self._lock:
             events = list(self._events)
             dropped = self._dropped
+            nodes = dict(self._nodes)
+            trace_id = self._trace_id
             # atexit backstop after an explicit export with no new spans:
             # nothing to add, and the target dir may already be gone
             # (tests export into a tempdir they then remove)
             if path == self._path and len(events) == self._exported_upto:
                 return path
+        own = os.getpid()
         names = {}
         for ev in events:
-            names.setdefault(ev["tid"], None)
+            if ev.get("pid") == own:
+                names.setdefault(ev["tid"], None)
         meta = [
-            {"name": "thread_name", "ph": "M", "pid": os.getpid(),
+            {"name": "thread_name", "ph": "M", "pid": own,
              "tid": tid, "args": {"name": f"thread-{i}"}}
             for i, tid in enumerate(sorted(names))
+        ]
+        meta += [
+            {"name": "process_name", "ph": "M", "pid": pid,
+             "args": {"name": f"worker:{node}"}}
+            for pid, node in sorted(nodes.items())
         ]
         doc = {
             "traceEvents": meta + events,
             "displayTimeUnit": "ms",
-            "otherData": {"tool": "erlamsa_tpu", "dropped_events": dropped},
+            "otherData": {"tool": "erlamsa_tpu", "dropped_events": dropped,
+                          "trace_id": trace_id},
         }
         tmp = path + ".tmp"
         try:
@@ -273,13 +357,24 @@ class Tracer:
 
 GLOBAL = Tracer()
 
+# flight entries carry the active trace_id (satellite of the fleet
+# telemetry plane); registered as a callback because trace imports
+# flight, so flight cannot import trace back
+flight.set_context_source(lambda: GLOBAL.trace_id())
 
-def configure(path: str | None = None, xprof: str | None = None):
-    GLOBAL.configure(path=path, xprof=xprof)
+
+def configure(path: str | None = None, xprof: str | None = None,
+              trace_id: str | None = None):
+    GLOBAL.configure(path=path, xprof=xprof, trace_id=trace_id)
 
 
 def span(name: str, **attrs):
     return GLOBAL.span(name, **attrs)
+
+
+def span_remote(name: str, trace_id: str = "", parent: int = 0, **attrs):
+    return GLOBAL.span_remote(name, trace_id=trace_id, parent=parent,
+                              **attrs)
 
 
 def enabled() -> bool:
@@ -288,6 +383,22 @@ def enabled() -> bool:
 
 def current_span_id() -> int:
     return GLOBAL.current_span_id()
+
+
+def current_context() -> tuple[str, int]:
+    return GLOBAL.current_context()
+
+
+def trace_id() -> str:
+    return GLOBAL.trace_id()
+
+
+def take_events(start: int = 0) -> tuple[list[dict], int]:
+    return GLOBAL.take_events(start)
+
+
+def ingest(events: list, node: str) -> int:
+    return GLOBAL.ingest(events, node)
 
 
 def export(path: str | None = None) -> str | None:

@@ -99,6 +99,13 @@ Known sites (grep `fault_point(` for the authoritative list):
                      GenEngine.expand): an injected fault degrades
                      generation to the counter-keyed host oracle,
                      byte-identical panels, erlamsa_gen_degraded=1
+    obs.telemetry    the out-of-band shard_telemetry exchange riding a
+                     window fence (services/dist.py request_telemetry):
+                     an injected fault drops the whole exchange before
+                     any frame hits the wire — counted telemetry_lost,
+                     federation data goes stale for one window, and the
+                     campaign output is byte-identical (telemetry is a
+                     pure side channel; tests pin this)
 
 Injected failures raise ``InjectedFault``, an OSError subclass, so they
 flow through exactly the except-clauses that catch real socket/disk

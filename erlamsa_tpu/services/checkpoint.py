@@ -194,7 +194,8 @@ def quarantine_mismatch(path: str) -> bool:
 
 def save_fleet_state(path: str, seed, case_idx: int, scores, seen_hashes,
                      corpus_energies: dict, epoch: int, n_shards: int,
-                     classes, engine: str = "fused") -> None:
+                     classes, engine: str = "fused",
+                     events: dict | None = None) -> None:
     """Fleet-coordinator checkpoint (corpus/fleet.py --shards --state):
     per-case progress plus everything the resumed coordinator needs to
     continue byte-identically — scheduler scores, the global seen-hash
@@ -226,6 +227,14 @@ def save_fleet_state(path: str, seed, case_idx: int, scores, seen_hashes,
         corpus_hits=np.asarray(
             [int(corpus_energies[s][1]) for s in ce_ids], np.int64),
     )
+    if events:
+        # observability carry-over (r18): resilience-event counters
+        # (fence_rejected, telemetry_lost, ...) survive a resume so
+        # scraped counters never go backwards across a restore
+        ev_kinds = sorted(events)
+        fields["events_kinds"] = np.asarray(ev_kinds, "U64")
+        fields["events_counts"] = np.asarray(
+            [int(events[k]) for k in ev_kinds], np.int64)
     fields["checksum"] = _checksum(fields)
 
     def _write():
@@ -273,6 +282,13 @@ def load_fleet_state(path: str, engine: str = "fused") -> dict | None:
             "epoch": int(z["epoch"]),
             "n_shards": int(z["n_shards"]),
             "classes": tuple(int(c) for c in z["classes"]),
+            # optional (absent in pre-r18 checkpoints — membership
+            # check, not indexing, or the broad except would reject
+            # every old checkpoint via KeyError)
+            "events": ({str(k): int(n)
+                        for k, n in zip(z["events_kinds"],
+                                        z["events_counts"])}
+                       if "events_kinds" in z else {}),
         }
     except (OSError, KeyError, ValueError, TypeError, zipfile.BadZipFile,
             zlib.error):

@@ -349,6 +349,10 @@ def build_parser() -> argparse.ArgumentParser:
                      help="json: one object per log line with "
                           "level/ts/component/span_id, correlating logs "
                           "with traces and flight dumps")
+    obs.add_argument("--metrics-out", default=None, metavar="FILE",
+                     help="write the final metrics snapshot as JSON to "
+                          "FILE at exit — the artifact python -m "
+                          "erlamsa_tpu.obs.report --metrics reads")
     return p
 
 
@@ -479,7 +483,11 @@ def main(argv=None) -> int:
     if args.flight_dir:
         flight.configure(args.flight_dir)
     if args.trace or args.xprof:
-        trace.configure(path=args.trace, xprof=args.xprof)
+        # the campaign trace id is seed-derived (no wall clock, no
+        # entropy): a fleet coordinator hands the same id to every
+        # worker frame, so the merged export is one logical trace
+        trace.configure(path=args.trace, xprof=args.xprof,
+                        trace_id="c%08x" % (seed[0] & 0xFFFFFFFF))
     if args.metrics_port:
         from ..obs import prom
 
@@ -490,6 +498,18 @@ def main(argv=None) -> int:
         # atexit hook (armed in trace.configure) backstops service modes
         # that never reach these finallys
         trace.export()
+        if args.metrics_out:
+            import json
+
+            from . import metrics
+
+            try:
+                with open(args.metrics_out, "w") as f:
+                    json.dump(metrics.GLOBAL.snapshot(), f, indent=2,
+                              default=str)
+            except OSError as e:
+                logger.log("warning", "cli: metrics snapshot to %s "
+                           "failed: %s", args.metrics_out, e)
         logger.GLOBAL.flush()
 
     from ..oracle.gen import default_generators

@@ -80,6 +80,7 @@ from __future__ import annotations
 import base64
 import functools
 import json
+import os
 import random as _pyrandom
 import socket
 import struct
@@ -204,7 +205,7 @@ def _shard_frame_send(sock: socket.socket, header: dict,
     loss exactly like a wire fault) and dist.shard.send (the wire, the
     same site the legacy JSON client fires). Returns bytes written."""
     chaos.fault_point("dist.shard.frame")
-    payload = _pack_frame(header, blob)
+    payload = _pack_frame(header, blob)  # lint: span-coverage-ok codec primitive; ShardStream callers carry the span
     chaos.fault_point("dist.shard.send")
     sock.sendall(payload)
     return len(payload)
@@ -214,7 +215,7 @@ def _shard_frame_recv(f) -> tuple[dict, bytes] | None:
     """Coordinator-side framed reply read (fault site dist.shard.recv,
     shared with the legacy JSON client)."""
     chaos.fault_point("dist.shard.recv")
-    return _read_frame(f)
+    return _read_frame(f)  # lint: span-coverage-ok codec primitive; read_reply callers carry the span
 
 
 def _node_frame_send(sock: socket.socket, header: dict,
@@ -224,7 +225,7 @@ def _node_frame_send(sock: socket.socket, header: dict,
     a dist.shard.* chaos spec keeps meaning 'the coordinator's view of
     the wire' with per-invocation counters the r14 tests rely on."""
     chaos.fault_point("dist.send")
-    payload = _pack_frame(header, blob)
+    payload = _pack_frame(header, blob)  # lint: span-coverage-ok codec primitive; ShardHost op handlers carry the span
     sock.sendall(payload)
     return len(payload)
 
@@ -232,7 +233,7 @@ def _node_frame_send(sock: socket.socket, header: dict,
 def _node_frame_recv(f) -> tuple[dict, bytes] | None:
     """Worker-side frame read (site dist.recv, like _recv_json)."""
     chaos.fault_point("dist.recv")
-    return _read_frame(f)
+    return _read_frame(f)  # lint: span-coverage-ok codec primitive; ShardHost op handlers carry the span
 
 
 class TransportTally:
@@ -467,7 +468,7 @@ class ShardStream:
             with self._wlock:
                 if self._sock is None:
                     self._connect()
-                n = _shard_frame_send(self._sock, header, blob)
+                n = _shard_frame_send(self._sock, header, blob)  # lint: span-coverage-ok transport primitive; dispatch spans live in corpus/fleet.py callers
         except StaleEpochError:
             raise
         except (OSError, ValueError) as e:
@@ -488,7 +489,7 @@ class ShardStream:
         tmo = self.timeout if timeout is None else timeout
         try:
             self._sock.settimeout(tmo)
-            got = _shard_frame_recv(self._rfile)
+            got = _shard_frame_recv(self._rfile)  # lint: span-coverage-ok transport primitive; reply-consuming callers carry the span
         except StaleEpochError:
             raise
         except (OSError, ValueError) as e:
@@ -516,7 +517,7 @@ class ShardStream:
         """Awaited send+recv pair — a genuine round trip (lease,
         snapshot, probe, revoke, window sync)."""
         self.send(header, blob)
-        out = self.read_reply(expect, header.get("epoch"),
+        out = self.read_reply(expect, header.get("epoch"),  # lint: span-coverage-ok round-trip callers (fleet.lease/snapshot/probe/revoke) carry the span
                               case=header.get("case"), timeout=timeout)
         if self.tally is not None:
             self.tally.add(round_trips=1)
@@ -530,6 +531,51 @@ class ShardStream:
                 sock.close()
             except OSError:
                 pass
+
+
+def request_telemetry(stream: ShardStream, epoch: int, case: int) -> bool:
+    """Fire the out-of-band shard_telemetry frame right after a window
+    fence (corpus/fleet.py remote_dispatch). The ``obs.telemetry`` chaos
+    site gates the WHOLE exchange: a firing drops the request before any
+    bytes move, the FIFO stream stays aligned, and the only evidence is
+    a telemetry_lost count — the campaign itself must be unaffected.
+    Returns True when the request went out (a matching consume_telemetry
+    is then owed on the reply stream)."""
+    try:
+        chaos.fault_point("obs.telemetry")
+        with trace.span("fleet.telemetry", shard=stream.id, case=case):
+            stream.send({"op": "shard_telemetry", "shard": stream.id,
+                         "epoch": epoch, "case": case})
+        return True
+    except (OSError, ValueError) as e:
+        metrics.GLOBAL.record_event("telemetry_lost")
+        logger.log("warning", "fleet: telemetry request to shard %d "
+                   "dropped: %s", stream.id, e)
+        return False
+
+
+def consume_telemetry(stream: ShardStream, epoch: int, case: int) -> bool:
+    """Read one shard_telemetered reply and fold it into the federation
+    plane (obs/federate.py). Every failure — wire loss, fencing, a
+    malformed payload — degrades to a telemetry_lost count; telemetry
+    must never raise into the campaign's reduce path."""
+    try:
+        with trace.span("fleet.telemetry_fold", shard=stream.id,
+                        case=case):
+            header, blob = stream.read_reply("shard_telemetered", epoch,
+                                             case=case)
+            payload = json.loads(blob.decode()) if blob else {}
+            from ..obs import federate
+
+            federate.GLOBAL.ingest(stream.endpoint(), payload)
+        if stream.tally is not None:
+            stream.tally.add(round_trips=1)
+        return True
+    except (OSError, ValueError, TypeError, KeyError) as e:
+        metrics.GLOBAL.record_event("telemetry_lost")
+        logger.log("warning", "fleet: telemetry from shard %d lost: %s",
+                   stream.id, e)
+        return False
 
 
 class ShardHost:
@@ -550,6 +596,10 @@ class ShardHost:
         self._leases: dict[int, dict] = {}
         self._floor: dict[int, int] = {}
         self._token: dict[int, str] = {}
+        # telemetry ship cursors (flight-ring seq, trace-event index):
+        # process-wide, not per-shard, so a worker hosting several
+        # shards ships each tail entry exactly once
+        self._tele = {"flight": 0, "trace": 0}
 
     def handle(self, msg: dict) -> dict:
         op = msg.get("op")
@@ -666,6 +716,8 @@ class ShardHost:
             return self._step_framed(header, blob)
         if op == "shard_snapshot":
             return self._snapshot_framed(header, blob)
+        if op == "shard_telemetry":
+            return self._telemetry_framed(header)
         if op == "shard_sync":
             shard = int(header.get("shard", -1))
             epoch = int(header.get("epoch", -1))
@@ -716,10 +768,18 @@ class ShardHost:
                 payloads.append(p)
             from ..corpus.fleet import run_remote_slice
 
-            outs, sc_out, applied, shapes = run_remote_slice(
-                tuple(cfg["seed"]), case, int(cfg["batch"]), slots,
-                payloads, header.get("scores", []), cfg["pri"],
-                cfg["classes"], int(cfg["device_max"]))
+            # parent this worker's step span onto the coordinator's
+            # per-case span via the propagated (trace, span) context —
+            # the merged Chrome trace shows one fleet-wide timeline
+            with trace.span_remote(
+                    "shard.step",
+                    trace_id=str(header.get("trace", "")),
+                    parent=int(header.get("span", 0) or 0),
+                    shard=shard, case=case, slots=len(slots)):
+                outs, sc_out, applied, shapes = run_remote_slice(
+                    tuple(cfg["seed"]), case, int(cfg["batch"]), slots,
+                    payloads, header.get("scores", []), cfg["pri"],
+                    cfg["classes"], int(cfg["device_max"]))
         except Exception as e:  # lint: broad-except-ok a worker device failure becomes a protocol-level shard_error the coordinator revokes on, not a dead stream thread
             logger.log("warning", "shard host: framed step failed "
                        "shard=%d case=%d: %s", shard, case, e)
@@ -732,6 +792,42 @@ class ShardHost:
             "applied": [[int(x) for x in row] for row in applied],
             "shapes": [list(sh) for sh in shapes],
         }, b"".join(outs))
+
+    def _telemetry_framed(self, header: dict) -> tuple[dict, bytes]:
+        """Ship this worker's telemetry: cumulative metric totals plus
+        the flight-ring and span-event tails since the last ship. Pure
+        read — fencing applies (a zombie coordinator must not drain the
+        tails the live one is due) but nothing about the campaign state
+        changes, so a lost reply costs stale telemetry for one window
+        and nothing else."""
+        shard = int(header.get("shard", -1))
+        epoch = int(header.get("epoch", -1))
+        _, fenced = self._check_lease(shard, epoch,
+                                      str(header.get("token", "")))
+        if fenced is not None:
+            return fenced, b""
+        with self._lock:
+            fcur, tcur = self._tele["flight"], self._tele["trace"]
+        fl_entries, fnext = flight.GLOBAL.tail_since(fcur)
+        tr_events, tnext = trace.GLOBAL.take_events(tcur)
+        with self._lock:
+            self._tele["flight"] = fnext
+            self._tele["trace"] = tnext
+        payload = {"pid": os.getpid(),
+                   "metrics": metrics.GLOBAL.federation_totals(),
+                   "flight": fl_entries, "trace": tr_events}
+        try:
+            blob = json.dumps(payload, separators=(",", ":"),
+                              default=str).encode()
+        except (TypeError, ValueError):
+            # a non-serializable stowaway in a ring entry must not kill
+            # the stream — degrade to metrics-only for this window
+            blob = json.dumps({"pid": payload["pid"],
+                               "metrics": payload["metrics"]},
+                              separators=(",", ":"), default=str).encode()
+        return ({"op": "shard_telemetered", "shard": shard,
+                 "epoch": epoch,
+                 "case": int(header.get("case", -1))}, blob)
 
     def _snapshot_framed(self, header: dict,
                          blob: bytes) -> tuple[dict, bytes]:
@@ -873,12 +969,12 @@ class ParentServer:
         one-reader split depends on). Runs until clean EOF; transport
         and codec failures ride _handle's logged-drop path."""
         while True:
-            got = _node_frame_recv(f)
+            got = _node_frame_recv(f)  # lint: span-coverage-ok dispatch loop; per-op spans live in ShardHost.handle_frame handlers
             if got is None:
                 return
             header, blob = got
             reply, rblob = self.shards.handle_frame(header, blob)
-            _node_frame_send(conn, reply, rblob)
+            _node_frame_send(conn, reply, rblob)  # lint: span-coverage-ok same handlers carry the span
 
     def route_fuzz(self, data: bytes, timeout: float = 90.0) -> bytes:
         """Route one request: up to MAX_FAILOVER_NODES distinct healthy

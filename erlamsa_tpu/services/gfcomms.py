@@ -28,6 +28,7 @@ import socket
 import threading
 
 from ..models.genfuzz import fuzz_grammar
+from ..obs import trace
 from ..utils.erlrand import ErlRand, gen_urandom_seed
 from . import logger
 
@@ -65,13 +66,15 @@ class GfComms:
                 data = conn.recv(65536)
                 if not data:
                     break
-                if self.external is not None:
-                    out = self.external("tcp", data, session)
-                elif self.grammar is not None:
-                    with self._rlock:
-                        out = fuzz_grammar(self.r, self.grammar, session)
-                else:
-                    out = data
+                with trace.span("gfcomms.request", bytes=len(data)):
+                    if self.external is not None:
+                        out = self.external("tcp", data, session)
+                    elif self.grammar is not None:
+                        with self._rlock:
+                            out = fuzz_grammar(self.r, self.grammar,
+                                               session)
+                    else:
+                        out = data
                 conn.sendall(out)
         except OSError:
             pass
@@ -100,9 +103,11 @@ class GfComms:
                     pass  # nothing else pending
                 finally:
                     conn.setblocking(True)
-                outs, _trunc = self.engine.expand(
-                    conn_id, slots=range(seq, seq + npkts)
-                )
+                with trace.span("gen.expand", conn=conn_id, seq=seq,
+                                pkts=npkts):
+                    outs, _trunc = self.engine.expand(
+                        conn_id, slots=range(seq, seq + npkts)
+                    )
                 seq += npkts
                 for out in outs:
                     conn.sendall(out)

@@ -289,6 +289,52 @@ class Counters:
         # auto-dump the ring inside note()
         flight.GLOBAL.note(kind)
 
+    def event_counts(self) -> dict[str, int]:
+        """Copy of the resilience-event tallies (fence_rejected,
+        telemetry_lost, ...) — the piece the fleet checkpoint persists
+        so counters survive a coordinator resume."""
+        with self._lock:
+            return dict(self.events)
+
+    def restore_event_floor(self, kind: str, floor: int) -> None:
+        """Raise an event counter to at least `floor` (checkpoint
+        restore). Max-merge, never assignment: events recorded between
+        process start and restore must not be erased, and a counter can
+        never go backwards across a resume."""
+        floor = int(floor)
+        with self._lock:
+            if self.events.get(kind, 0) < floor:
+                self.events[kind] = floor
+
+    def federation_totals(self) -> dict:
+        """Cumulative totals a fleet worker ships in its shard_telemetry
+        reply (obs/federate.py re-exposes them node-labeled). Cumulative
+        rather than delta on purpose: a lost or duplicated telemetry
+        frame then means stale data, never corrupted counters."""
+        with self._lock:
+            counters = {
+                "samples": self.samples,
+                "batches": self.batches,
+                "bytes_out": self.bytes_out,
+                "device_s": round(self.device_time, 6),
+                "transport_bytes_sent": self.transport["bytes_sent"],
+                "transport_bytes_recv": self.transport["bytes_recv"],
+                "round_trips": self.transport["round_trips"],
+                "degraded": self.degraded,
+            }
+            events = dict(self.events)
+            faults = dict(self.faults)
+            stages = {k: round(v, 6) for k, v in self.stages.items()}
+        # hists carry their own locks — snapshot outside self._lock
+        hists = {
+            name: {"counts": list(s["counts"]), "sum": s["sum"],
+                   "count": s["count"]}
+            for name, s in ((n, h.snapshot())
+                            for n, h in self.hists.items())
+        }
+        return {"counters": counters, "events": events, "faults": faults,
+                "stages": stages, "hists": hists}
+
     def record_monitor(self, kind: str):
         """One monitor-plane event (spawn/crash/hang bookkeeping)."""
         with self._lock:

@@ -55,6 +55,7 @@ import zlib
 
 from ..constants import COVERAGE_MAP_BYTES, DEFAULT_CM_PORT
 from ..corpus import feedback
+from ..obs import trace
 from . import chaos, logger, metrics
 from .dist import _read_frame
 from .resilience import OPEN, CircuitBreaker
@@ -783,9 +784,16 @@ class CoverageHub:
                 self.counts["torn"] += 1
             metrics.GLOBAL.record_coverage_frame("torn")
             return
-        with self._lock:
-            self.counts["frames"] += 1
-            self._pending.setdefault(case, {})[slot] = blob
+        # accepted frames adopt any sender-carried trace context so a
+        # remote target's coverage delivery lands parented under the
+        # coordinator's case span in the merged fleet trace
+        with trace.span_remote("coverage.ingest",
+                               trace_id=str(header.get("trace", "")),
+                               parent=int(header.get("span", 0) or 0),
+                               case=case, slot=slot):
+            with self._lock:
+                self.counts["frames"] += 1
+                self._pending.setdefault(case, {})[slot] = blob
         metrics.GLOBAL.record_coverage_frame("ok")
         self.breaker.record_success()
 

@@ -244,12 +244,13 @@ class ContinuousEngine:
             req.rid = self._next_rid
             self._next_rid += 1
             self.admitted += 1
-        self._q.put(req)
-        if not req.done.wait(timeout):
-            # the slot itself is NOT leaked: the drain frees it when the
-            # step completes whether or not anyone still waits
-            return b""
-        return req.result
+        with trace.span("serving.request", rid=req.rid, bytes=len(data)):
+            self._q.put(req)
+            if not req.done.wait(timeout):
+                # the slot itself is NOT leaked: the drain frees it when
+                # the step completes whether or not anyone still waits
+                return b""
+            return req.result
 
     # -- engine internals ---------------------------------------------------
 

@@ -20,7 +20,15 @@
 # observability artifacts: the --trace file must be well-formed Chrome
 # trace JSON with corpus spans, and a live GET /metrics scrape must
 # serve Prometheus text with throughput counters and latency histogram
-# buckets (erlamsa_tpu/obs).
+# buckets (erlamsa_tpu/obs). A second leg (r18) runs a two-loopback-
+# worker fleet campaign three times — telemetry dark, tracing +
+# federation on, and with the shard_telemetry exchange chaos-dropped
+# (ERLAMSA_FAULTS="obs.telemetry:*") — and asserts the telemetry plane
+# is strictly out-of-band: all three byte-identical, the lit leg's
+# merged trace parents worker shard.step spans under coordinator
+# fleet.case spans, /metrics grows erlamsa_worker_*{node=...} families
+# for BOTH nodes, the campaign report's stage ledger is populated, and
+# the chaos leg counts telemetry_lost (obs/federate.py, obs/report.py).
 #
 # scripts/tier1.sh --arena-smoke additionally runs a tiny MIXED-SIZE
 # corpus batch (two capacity classes) under BOTH memory layouts
@@ -341,6 +349,97 @@ print(f"OBS_SMOKE={'ok' if ok else 'FAIL'} trace_events={len(xev)} "
       f"trace_ok={trace_ok} prom_ok={prom_ok}")
 sys.exit(0 if ok else 1)
 EOF
+  rc=$?
+fi
+
+if [ $rc -eq 0 ] && [ $obs_smoke -eq 1 ]; then
+  echo "== obs smoke: fleet telemetry plane is strictly out-of-band =="
+  timeout -k 10 600 env JAX_PLATFORMS=cpu python - <<'EOF2'
+import json, os, shutil, sys, tempfile
+
+from erlamsa_tpu.corpus.fleet import run_corpus_fleet
+from erlamsa_tpu.obs import federate, prom, report, trace
+from erlamsa_tpu.services import chaos, metrics
+from erlamsa_tpu.services.dist import ParentServer
+
+SEED = (7, 7, 7)
+# lengths chosen so seed home partitions split 3/3 across two shards:
+# both workers must do real work or the federation check is vacuous
+SEEDS = [b"A" * ln for ln in (30, 60, 90, 120, 150, 180)]
+
+
+def one_run(root, tag, nodes, spec=None):
+    chaos.configure(spec, seed=SEED[0])
+    outdir = os.path.join(root, f"out-{tag}")
+    os.makedirs(outdir, exist_ok=True)
+    stats = {}
+    opts = {
+        "corpus_dir": os.path.join(root, f"corpus-{tag}"),
+        "corpus": list(SEEDS),
+        "seed": SEED,
+        "n": 2,
+        "output": os.path.join(outdir, "%n.out"),
+        "shards": None,
+        "fleet_nodes": nodes,
+        "_stats": stats,
+    }
+    try:
+        rc = run_corpus_fleet(opts, batch=8)
+    finally:
+        chaos.configure(None)
+    blob = b""
+    for i in range(2 * 8):
+        blob += open(os.path.join(outdir, f"{i}.out"), "rb").read()
+    return rc, blob, stats
+
+
+srv1 = ParentServer(0, {"seed": SEED}).serve(block=False)
+srv2 = ParentServer(0, {"seed": SEED}).serve(block=False)
+nodes = [f"127.0.0.1:{srv._srv.getsockname()[1]}" for srv in (srv1, srv2)]
+root = tempfile.mkdtemp(prefix="tier1_obs_fleet_smoke_")
+trace_file = os.path.join(root, "fleet-trace.json")
+try:
+    # 1. telemetry dark: the byte reference
+    rc1, ref, _ = one_run(root, "dark", nodes)
+    # 2. tracing + federation on: bytes must not move
+    trace.configure(path=trace_file, trace_id="tsmoke")
+    rc2, lit, _ = one_run(root, "lit", nodes)
+    trace.export()
+    trace.configure()
+    doc = json.load(open(trace_file))
+    xev = [e for e in doc["traceEvents"] if e.get("ph") == "X"]
+    cases = {e["args"]["span_id"] for e in xev if e["name"] == "fleet.case"}
+    steps = [e for e in xev if e["name"] == "shard.step"]
+    parented = bool(steps) and all(
+        e["args"]["parent_id"] in cases for e in steps)
+    fed = federate.GLOBAL.snapshot()
+    page = prom.render(metrics.Counters())
+    federated = (set(fed["nodes"]) == set(nodes) and all(
+        f'erlamsa_worker_samples_total{{node="{n}"}}' in page
+        for n in nodes))
+    ledger = report.build_report(
+        metrics_snap=metrics.GLOBAL.snapshot(),
+        trace_doc=doc, federation_snap=fed)["stages"]["ledger"]
+    # 3. shard_telemetry chaos-dropped: bytes still must not move
+    federate.GLOBAL.reset()
+    lost0 = metrics.GLOBAL.event_counts().get("telemetry_lost", 0)
+    rc3, dropped, _ = one_run(root, "chaos", nodes, spec="obs.telemetry:*")
+    lost = metrics.GLOBAL.event_counts().get("telemetry_lost", 0) - lost0
+finally:
+    srv1.stop()
+    srv2.stop()
+    shutil.rmtree(root, ignore_errors=True)
+ok = (rc1 == rc2 == rc3 == 0 and ref
+      and lit == ref and dropped == ref
+      and parented and federated and ledger
+      and lost >= 1 and not federate.GLOBAL.nodes())
+print(f"OBS_FLEET_SMOKE={'ok' if ok else 'FAIL'} bytes={len(ref)} "
+      f"identical_traced={lit == ref} identical_dropped={dropped == ref} "
+      f"worker_steps={len(steps)} parented={parented} "
+      f"federated={federated} ledger_rows={len(ledger)} "
+      f"telemetry_lost={lost}")
+sys.exit(0 if ok else 1)
+EOF2
   rc=$?
 fi
 

@@ -1,0 +1,685 @@
+"""Fleet telemetry plane tests (r18): trace-context propagation across
+the shard transport, the shard_telemetry federation protocol, the
+canonical Prometheus exposition of federated worker families, counters
+surviving a coordinator resume, and the campaign report.
+
+The invariant every test here ultimately defends: telemetry is strictly
+OUT-OF-BAND. Outputs at a fixed seed are byte-identical with tracing
+and federation on or off, and a telemetry frame lost to the
+``obs.telemetry`` chaos site costs a ``telemetry_lost`` count and one
+window of stale data — never bytes, never a dead stream.
+
+Fast tests drive ShardHost/ShardStream at the protocol layer (no engine
+compile); the full two-loopback-worker campaign with a merged trace is
+@pytest.mark.slow, same discipline as tests/test_remote_fleet.py."""
+
+import json
+import os
+import re
+import shutil
+
+import pytest
+
+from erlamsa_tpu.obs import federate, flight, hist, prom, report, trace
+from erlamsa_tpu.obs.trace import Tracer
+from erlamsa_tpu.services import chaos, metrics
+from erlamsa_tpu.services.checkpoint import load_fleet_state, save_fleet_state
+from erlamsa_tpu.services.dist import (ParentServer, ShardHost, ShardStream,
+                                       consume_telemetry, request_telemetry)
+
+SEED = (7, 7, 7)
+SEEDS = [bytes([65 + i]) * (30 * (i + 1)) for i in range(6)]
+
+CFG = {"seed": [7, 7, 7], "pri": [1] * 4, "classes": [256],
+       "device_max": 256, "batch": 8}
+
+
+@pytest.fixture(autouse=True)
+def _telemetry_reset():
+    """Tracer, flight ring, chaos and the federation accumulator are all
+    process-global; every test starts and ends dark."""
+    trace.GLOBAL.configure()
+    flight.GLOBAL.configure(None)
+    flight.GLOBAL._last_dump = -flight.DUMP_DEBOUNCE_S
+    federate.GLOBAL.reset()
+    chaos.configure(None)
+    yield
+    trace.GLOBAL.configure()
+    flight.GLOBAL.configure(None)
+    federate.GLOBAL.reset()
+    chaos.configure(None)
+    metrics.GLOBAL.set_degraded(False)
+
+
+@pytest.fixture
+def worker():
+    """One loopback shard worker (a plain ParentServer); yields
+    (server, port)."""
+    srv = ParentServer(0, {"seed": SEED}).serve(block=False)
+    port = srv._srv.getsockname()[1]
+    yield srv, port
+    srv.stop()
+
+
+# ---- trace context propagation ------------------------------------------
+
+
+def test_current_context_dark_then_armed(tmp_path):
+    # dark: ("", 0) — callers must omit the header keys entirely
+    t = Tracer()
+    assert t.current_context() == ("", 0)
+    t.configure(path=str(tmp_path / "t.json"), trace_id="tcamp")
+    tid, span = t.current_context()
+    assert tid == "tcamp" and span == 0
+    with t.span("fleet.case", case=3) as s:
+        tid, span = t.current_context()
+        assert tid == "tcamp" and span == s.span_id
+
+
+def test_span_remote_parents_only_at_stack_top(tmp_path):
+    """A carried remote parent applies at the top of a thread's stack;
+    nested spans keep parenting locally so propagated context never
+    rewires in-process structure."""
+    t = Tracer()
+    t.configure(path=str(tmp_path / "t.json"), trace_id="tcamp")
+    with t.span_remote("shard.step", trace_id="tcamp", parent=77):
+        pass
+    with t.span("fleet.case") as outer:
+        with t.span_remote("coverage.ingest", trace_id="tcamp",
+                           parent=999):
+            pass
+    events, _ = t.take_events()
+    by_name = {e["name"]: e for e in events}
+    assert by_name["shard.step"]["args"]["parent_id"] == 77
+    # nested: the local parent wins over the carried one
+    assert (by_name["coverage.ingest"]["args"]["parent_id"]
+            == outer.span_id)
+    # a matching trace_id is NOT repeated per-span; a foreign one is
+    assert "trace_id" not in by_name["shard.step"]["args"]
+    with t.span_remote("shard.step", trace_id="OTHER", parent=1):
+        pass
+    events, _ = t.take_events()
+    assert events[-1]["args"]["trace_id"] == "OTHER"
+
+
+def test_trace_ingest_merges_foreign_pids_only(tmp_path):
+    """Federated span events fold into the coordinator's tracer and the
+    export names worker processes; same-pid events (in-process loopback
+    workers share GLOBAL) are skipped — no duplicates."""
+    path = str(tmp_path / "merged.json")
+    t = Tracer()
+    t.configure(path=path, trace_id="tfleet")
+    with t.span("fleet.case", case=0):
+        pass
+    own = os.getpid()
+    foreign = {"name": "shard.step", "ph": "X", "ts": 1.0, "dur": 2.0,
+               "pid": own + 1, "tid": 1,
+               "args": {"span_id": 9, "parent_id": 1}}
+    dupe = dict(foreign, pid=own)
+    assert t.ingest([foreign, dupe, "junk"], "10.0.0.2:7777") == 1
+    t.export(path)
+    doc = json.load(open(path))
+    evs = doc["traceEvents"]
+    metas = [e for e in evs if e.get("ph") == "M"
+             and e.get("name") == "process_name"]
+    assert any(m["args"]["name"] == "worker:10.0.0.2:7777"
+               and m["pid"] == own + 1 for m in metas)
+    steps = [e for e in evs if e.get("name") == "shard.step"]
+    assert len(steps) == 1 and steps[0]["pid"] == own + 1
+    assert doc["otherData"]["trace_id"] == "tfleet"
+
+
+def test_take_events_cursor_is_stable(tmp_path):
+    t = Tracer()
+    t.configure(path=str(tmp_path / "unused.json"), trace_id="t")
+    with t.span("a"):
+        pass
+    evs, cur = t.take_events(0)
+    assert len(evs) == 1
+    with t.span("b"):
+        pass
+    fresh, cur2 = t.take_events(cur)
+    assert [e["name"] for e in fresh] == ["b"] and cur2 == cur + 1
+
+
+# ---- flight recorder: trace stamping, tails, dump-failure counter -------
+
+
+def test_flight_entries_carry_trace_id(tmp_path):
+    flight.GLOBAL.note("marker_dark")
+    trace.configure(path=str(tmp_path / "t.json"), trace_id="tstamp")
+    flight.GLOBAL.note("marker_lit")
+    entries = list(flight.GLOBAL._ring)
+    lit = [e for e in entries if e.get("kind") == "marker_lit"][-1]
+    dark = [e for e in entries if e.get("kind") == "marker_dark"][-1]
+    assert lit.get("trace") == "tstamp"
+    assert "trace" not in dark
+
+
+def test_flight_tail_since_and_node_stamped_ingest():
+    entries, cur = flight.GLOBAL.tail_since(0)
+    flight.GLOBAL.note("tail_marker")
+    fresh, cur2 = flight.GLOBAL.tail_since(cur)
+    assert [e["kind"] for e in fresh] == ["tail_marker"]
+    assert cur2 == cur + 1
+    # node-stamped fold: one SIGUSR2 dump covers the fleet
+    n = flight.GLOBAL.ingest([{"type": "event", "kind": "remote_ev"},
+                              "junk"], "10.0.0.2:7777")
+    assert n == 1
+    fresh, _ = flight.GLOBAL.tail_since(cur2)
+    assert fresh[-1]["node"] == "10.0.0.2:7777"
+
+
+def test_flight_dump_failure_is_counted(tmp_path):
+    """A failed ring dump is a counted event (the
+    erlamsa_flight_dump_failed_total family), not just a log line."""
+    d = tmp_path / "flights"
+    flight.GLOBAL.configure(str(d))
+    flight.GLOBAL.note("pre_crash_marker")
+    shutil.rmtree(d)  # the open() in dump now fails with ENOENT
+    before = metrics.GLOBAL.event_counts().get("flight_dump_failed", 0)
+    assert flight.GLOBAL.dump("unit_test", force=True) is None
+    after = metrics.GLOBAL.event_counts().get("flight_dump_failed", 0)
+    assert after == before + 1
+    text = prom.render(metrics.Counters())
+    assert "# TYPE erlamsa_flight_dump_failed_total counter" in text
+
+
+def test_flight_dump_contains_federated_entries(tmp_path):
+    flight.GLOBAL.configure(str(tmp_path))
+    flight.GLOBAL.ingest([{"type": "event", "kind": "worker_ev"}],
+                         "10.0.0.9:1234")
+    path = flight.GLOBAL.dump("unit_test", force=True)
+    lines = [json.loads(ln) for ln in open(path)]
+    assert lines[0]["type"] == "meta"
+    assert any(e.get("kind") == "worker_ev"
+               and e.get("node") == "10.0.0.9:1234" for e in lines[1:])
+
+
+# ---- federation: ingest semantics + prom exposition ---------------------
+
+
+def _worker_totals(samples=100):
+    return {
+        "counters": {"samples": samples, "batches": 5, "bytes_out": 4096,
+                     "device_s": 1.25, "round_trips": 7, "degraded": 0},
+        "events": {"telemetry_lost": 1},
+        "faults": {"shard.step": 2},
+        "stages": {"remote_step": 0.5, "reduce": 0.1},
+        "hists": {"batch_latency": {"counts": [0, 3] + [0] * (
+            hist.N_BUCKETS - 2), "sum": 0.01, "count": 3}},
+    }
+
+
+def test_federation_ingest_idempotent_totals():
+    foreign_pid = os.getpid() + 1
+    payload = {"pid": foreign_pid, "metrics": _worker_totals(100),
+               "flight": [{"type": "event", "kind": "worker_ev"}],
+               "trace": []}
+    federate.GLOBAL.ingest("10.0.0.2:7777", payload)
+    # cumulative totals: re-ingesting a NEWER payload replaces, a lost
+    # frame in between would just have left the old totals standing
+    federate.GLOBAL.ingest("10.0.0.2:7777",
+                           {"pid": foreign_pid,
+                            "metrics": _worker_totals(150)})
+    snap = federate.GLOBAL.snapshot()
+    assert snap["nodes"]["10.0.0.2:7777"]["counters"]["samples"] == 150
+    assert snap["ingests"]["10.0.0.2:7777"] == 2
+    assert federate.GLOBAL.nodes() == ["10.0.0.2:7777"]
+
+
+def test_federation_rejects_malformed_payloads():
+    with pytest.raises(ValueError):
+        federate.GLOBAL.ingest("n", "not a dict")
+    with pytest.raises(ValueError):
+        federate.GLOBAL.ingest("n", {"metrics": [1, 2, 3]})
+    # nothing was folded
+    assert federate.GLOBAL.nodes() == []
+
+
+def test_federation_same_pid_keeps_metrics_only():
+    """An in-process loopback worker shares this process's flight ring
+    and tracer — folding its tails back would duplicate every entry."""
+    _, cur = flight.GLOBAL.tail_since(0)
+    federate.GLOBAL.ingest("127.0.0.1:1", {
+        "pid": os.getpid(), "metrics": _worker_totals(),
+        "flight": [{"type": "event", "kind": "dupe_ev"}]})
+    fresh, _ = flight.GLOBAL.tail_since(cur)
+    assert not any(e.get("kind") == "dupe_ev" for e in fresh)
+    assert federate.GLOBAL.nodes() == ["127.0.0.1:1"]
+
+
+# ---- prometheus exposition: promtool-style strict parse -----------------
+
+_SAMPLE_RE = re.compile(
+    r'^([a-zA-Z_:][a-zA-Z0-9_:]*)'            # metric name
+    r'(\{[a-zA-Z_][a-zA-Z0-9_]*="(?:[^"\\]|\\.)*"'
+    r'(?:,[a-zA-Z_][a-zA-Z0-9_]*="(?:[^"\\]|\\.)*")*\})?'  # labels
+    r' (-?(?:[0-9.]+(?:e-?[0-9]+)?|\+Inf|-Inf|NaN))$')     # value
+
+
+def _promtool_check(text: str) -> None:
+    """promtool-check-metrics-style validation of an exposition page:
+    every sample line parses, every family has exactly one HELP and one
+    TYPE head BEFORE its first sample, histogram buckets are cumulative
+    with +Inf == _count."""
+    helps: set = set()
+    types: dict[str, str] = {}
+    seen_sample_for: set = set()
+    buckets: dict[str, list] = {}
+    counts: dict[str, float] = {}
+
+    def family(stem: str) -> str:
+        for suffix in ("_bucket", "_sum", "_count"):
+            if stem.endswith(suffix):
+                base = stem[: -len(suffix)]
+                if types.get(base) == "histogram":
+                    return base
+        return stem
+
+    for ln in text.splitlines():
+        if not ln:
+            continue
+        if ln.startswith("# HELP "):
+            name = ln.split()[2]
+            assert name not in helps, f"duplicate HELP for {name}"
+            helps.add(name)
+            continue
+        if ln.startswith("# TYPE "):
+            _, _, name, kind = ln.split(None, 3)
+            assert name not in types, f"duplicate TYPE for {name}"
+            assert kind in ("counter", "gauge", "histogram"), ln
+            assert name not in seen_sample_for, \
+                f"TYPE for {name} after its samples"
+            types[name] = kind
+            continue
+        assert not ln.startswith("#"), f"unknown comment: {ln}"
+        m = _SAMPLE_RE.match(ln)
+        assert m, f"unparsable sample line: {ln!r}"
+        stem, labels, value = m.group(1), m.group(2) or "", m.group(3)
+        fam = family(stem)
+        assert fam in types and fam in helps, \
+            f"sample without HELP/TYPE head: {ln}"
+        seen_sample_for.add(fam)
+        val = float(value.replace("+Inf", "inf").replace("-Inf", "-inf"))
+        if stem.endswith("_bucket") and types.get(fam) == "histogram":
+            lm = re.search(r'le="([^"]*)"', labels)
+            assert lm, f"histogram bucket without le: {ln}"
+            series = re.sub(r',?le="[^"]*"', "", labels).replace("{}", "")
+            buckets.setdefault(stem + series, []).append(
+                (float(lm.group(1).replace("+Inf", "inf")), val))
+        elif stem.endswith("_count") and types.get(fam) == "histogram":
+            counts[fam + labels] = val
+    for key, pairs in buckets.items():
+        les = [le for le, _ in pairs]
+        vals = [v for _, v in pairs]
+        assert les == sorted(les), f"le out of order: {key}"
+        assert vals == sorted(vals), f"non-cumulative buckets: {key}"
+        assert les[-1] == float("inf"), f"missing +Inf bucket: {key}"
+        fam_series = key.replace("_bucket", "", 1)
+        assert counts.get(fam_series) == vals[-1], \
+            f"+Inf bucket != _count: {key}"
+
+
+def test_prom_page_passes_promtool_parse():
+    c = metrics.Counters()
+    c.record_batch(8, 0.5, 800)
+    c.record_request(0.2)
+    c.record_event("telemetry_lost")
+    _promtool_check(prom.render(c))
+
+
+def test_federated_worker_families_exposed_and_parse():
+    """The tentpole exposition pin: after one telemetry ingest the
+    /metrics page grows erlamsa_worker_*{node=...} families — rendered
+    through the same canonical cumulative-le shape, one HELP/TYPE head
+    per family with every node's sample under it."""
+    for node in ("10.0.0.2:7777", "10.0.0.3:7777"):
+        federate.GLOBAL.ingest(node, {"pid": os.getpid() + 1,
+                                      "metrics": _worker_totals()})
+    text = prom.render(metrics.Counters())
+    _promtool_check(text)
+    lines = text.splitlines()
+    for expected in [
+        'erlamsa_worker_samples_total{node="10.0.0.2:7777"} 100',
+        'erlamsa_worker_samples_total{node="10.0.0.3:7777"} 100',
+        'erlamsa_worker_device_seconds_total{node="10.0.0.2:7777"} 1.25',
+        'erlamsa_worker_stage_seconds_total{node="10.0.0.2:7777",'
+        'stage="remote_step"} 0.5',
+        'erlamsa_worker_resilience_events_total{node="10.0.0.2:7777",'
+        'kind="telemetry_lost"} 1',
+        'erlamsa_worker_fault_injected_total{node="10.0.0.2:7777",'
+        'site="shard.step"} 2',
+        'erlamsa_worker_batch_latency_seconds_count'
+        '{node="10.0.0.2:7777"} 3',
+    ]:
+        assert expected in lines, f"missing: {expected!r}\n{text}"
+    # exactly one head per family even with two nodes
+    assert text.count("# TYPE erlamsa_worker_samples_total") == 1
+
+
+def test_cumulative_buckets_canonical_shape():
+    counts = [0] * hist.N_BUCKETS
+    counts[0], counts[3], counts[-1] = 2, 1, 4
+    pairs = hist.cumulative_buckets(counts)
+    assert len(pairs) == hist.N_BUCKETS
+    assert pairs[0] == (hist.BOUNDS[0], 2)
+    assert pairs[3] == (hist.BOUNDS[3], 3)
+    assert pairs[-1] == (float("inf"), 7)
+    # remote peers may ship short/long lists: pad/truncate, never raise
+    assert hist.cumulative_buckets([1])[-1] == (float("inf"), 1)
+    assert hist.cumulative_buckets(
+        [1] * (hist.N_BUCKETS + 5))[-1][1] == hist.N_BUCKETS
+
+
+# ---- shard_telemetry protocol (ShardHost, no compute) -------------------
+
+
+def test_shard_host_telemetry_ships_totals_and_tails():
+    h = ShardHost()
+    assert h.handle({"op": "shard_lease", "shard": 0, "epoch": 2,
+                     **CFG})["op"] == "shard_leased"
+    hdr, blob = h.handle_frame({"op": "shard_telemetry", "shard": 0,
+                                "epoch": 2, "case": 3}, b"")
+    assert hdr["op"] == "shard_telemetered"
+    assert hdr["shard"] == 0 and hdr["epoch"] == 2 and hdr["case"] == 3
+    payload = json.loads(blob.decode())
+    assert payload["pid"] == os.getpid()
+    totals = payload["metrics"]
+    for key in ("counters", "events", "faults", "stages", "hists"):
+        assert key in totals
+    assert "samples" in totals["counters"]
+    # the first ship drained the tails; only entries appended after the
+    # cursor ride the next frame — each entry ships exactly once
+    flight.GLOBAL.note("tele_marker")
+    _, blob2 = h.handle_frame({"op": "shard_telemetry", "shard": 0,
+                               "epoch": 2, "case": 4}, b"")
+    tail = json.loads(blob2.decode())["flight"]
+    assert [e.get("kind") for e in tail] == ["tele_marker"]
+
+
+def test_shard_host_telemetry_is_fenced():
+    """A zombie coordinator must not drain the tails the live one is
+    due: stale telemetry frames fence exactly like steps."""
+    h = ShardHost()
+    h.handle({"op": "shard_lease", "shard": 0, "epoch": 5, **CFG})
+    hdr, blob = h.handle_frame({"op": "shard_telemetry", "shard": 0,
+                                "epoch": 4, "case": 0}, b"")
+    assert hdr["op"] == "shard_fenced" and blob == b""
+    assert hdr["got"] == 4 and hdr["have"] == 5
+    # no lease at all -> fenced too
+    h2 = ShardHost()
+    hdr, _ = h2.handle_frame({"op": "shard_telemetry", "shard": 1,
+                              "epoch": 0, "case": 0}, b"")
+    assert hdr["op"] == "shard_fenced" and hdr["have"] == -1
+
+
+def test_request_telemetry_round_trip_feeds_federation(worker):
+    _, port = worker
+    st = ShardStream(0, "127.0.0.1", port, timeout=10.0)
+    try:
+        st.request({"op": "shard_lease", "shard": 0, "epoch": 0, **CFG},
+                   expect="shard_leased")
+        assert request_telemetry(st, 0, 0) is True
+        assert consume_telemetry(st, 0, 0) is True
+        snap = federate.GLOBAL.snapshot()
+        node = f"127.0.0.1:{port}"
+        assert node in snap["nodes"]
+        assert snap["ingests"][node] == 1
+        assert "samples" in snap["nodes"][node]["counters"]
+    finally:
+        st.close()
+
+
+def test_request_telemetry_chaos_drop_is_out_of_band(worker):
+    """The obs.telemetry chaos site drops the WHOLE exchange before any
+    frame hits the wire: a telemetry_lost count is the only evidence,
+    and the FIFO stream stays aligned for campaign traffic."""
+    _, port = worker
+    st = ShardStream(0, "127.0.0.1", port, timeout=10.0)
+    try:
+        st.request({"op": "shard_lease", "shard": 0, "epoch": 0, **CFG},
+                   expect="shard_leased")
+        chaos.configure("obs.telemetry:*", seed=7)
+        before = metrics.GLOBAL.event_counts().get("telemetry_lost", 0)
+        assert request_telemetry(st, 0, 0) is False
+        after = metrics.GLOBAL.event_counts().get("telemetry_lost", 0)
+        assert after == before + 1
+        assert federate.GLOBAL.nodes() == []
+        # the stream is still usable — nothing was written, nothing owed
+        hdr, _ = st.request({"op": "shard_probe", "shard": 0},
+                            expect="shard_alive")
+        assert hdr["op"] == "shard_alive"
+    finally:
+        chaos.configure(None)
+        st.close()
+
+
+# ---- counters survive a coordinator resume ------------------------------
+
+
+def _save_fleet(path, events):
+    import numpy as np
+
+    save_fleet_state(str(path), SEED, case_idx=2,
+                     scores=np.zeros((4, 2), np.int32),
+                     seen_hashes={b"x" * 12}, corpus_energies={},
+                     epoch=3, n_shards=2, classes=(256,), events=events)
+
+
+def test_fleet_checkpoint_round_trips_event_counters(tmp_path):
+    path = tmp_path / "state.npz"
+    _save_fleet(path, {"fence_rejected": 5, "telemetry_lost": 3})
+    st = load_fleet_state(str(path))
+    assert st is not None
+    assert st["events"] == {"fence_rejected": 5, "telemetry_lost": 3}
+    # a pre-r18 checkpoint (no events fields) loads with an empty dict
+    _save_fleet(path, None)
+    st = load_fleet_state(str(path))
+    assert st is not None and st["events"] == {}
+
+
+def test_restore_event_floor_never_goes_backwards():
+    base = metrics.GLOBAL.event_counts().get("telemetry_lost", 0)
+    metrics.GLOBAL.restore_event_floor("telemetry_lost", base + 10)
+    assert metrics.GLOBAL.event_counts()["telemetry_lost"] == base + 10
+    # max-merge: a lower floor (an older checkpoint) changes nothing
+    metrics.GLOBAL.restore_event_floor("telemetry_lost", 1)
+    assert metrics.GLOBAL.event_counts()["telemetry_lost"] == base + 10
+    # events recorded since restore keep counting on top
+    metrics.GLOBAL.record_event("telemetry_lost")
+    assert metrics.GLOBAL.event_counts()["telemetry_lost"] == base + 11
+
+
+# ---- campaign report ----------------------------------------------------
+
+
+def _report_inputs():
+    metrics_snap = {
+        "samples": 64, "batches": 8, "bytes_out": 6400, "wall_s": 2.0,
+        "device_s": 0.5, "samples_per_sec": 32.0, "host_tail_pct": 10.0,
+        "pipeline": {"stages": {"device": 1.5, "write": 0.25,
+                                "coverage": 0.25}, "wall_s": 2.0},
+        "resilience": {"events": {"telemetry_lost": 1}, "faults": {},
+                       "degraded": 0},
+        "fleet_transport": {"bytes_sent": 100, "bytes_recv": 200,
+                            "round_trips": 3},
+        "coverage": {"frames": 4, "folds": 2, "edges": 17,
+                     "new_edges": 17, "stale": 0, "torn": 0,
+                     "distilled": 0, "degraded": 0},
+    }
+    trace_doc = {
+        "traceEvents": [
+            {"name": "process_name", "ph": "M", "pid": 2,
+             "args": {"name": "worker:10.0.0.2:7777"}},
+            {"name": "fleet.case", "ph": "X", "ts": 0.0, "dur": 2000.0,
+             "pid": 1, "tid": 1, "args": {"span_id": 1, "parent_id": 0}},
+            {"name": "shard.step", "ph": "X", "ts": 10.0, "dur": 900.0,
+             "pid": 2, "tid": 1, "args": {"span_id": 9, "parent_id": 1}},
+        ],
+        "otherData": {"trace_id": "tfleet", "dropped_events": 0},
+    }
+    federation_snap = {
+        "nodes": {"10.0.0.2:7777": _worker_totals()},
+        "ingests": {"10.0.0.2:7777": 4},
+    }
+    flight_entries = [{"type": "event", "kind": "worker_ev",
+                       "node": "10.0.0.2:7777"},
+                      {"type": "span", "name": "fleet.case"}]
+    return metrics_snap, trace_doc, federation_snap, flight_entries
+
+
+def test_build_report_sections_and_stage_ledger():
+    snap, trace_doc, fed, fl = _report_inputs()
+    rep = report.build_report(metrics_snap=snap, trace_doc=trace_doc,
+                              flight_entries=fl, federation_snap=fed)
+    ledger = rep["stages"]["ledger"]
+    assert [r["stage"] for r in ledger][0] == "device"
+    assert ledger[0]["share_pct"] == 75.0
+    assert sum(r["seconds"] for r in ledger) == 2.0
+    assert rep["campaign"]["samples"] == 64
+    assert rep["trace"]["worker_nodes"] == ["10.0.0.2:7777"]
+    assert rep["trace"]["spans"]["shard.step"]["count"] == 1
+    assert rep["fleet"]["10.0.0.2:7777"]["telemetry_frames"] == 4
+    assert rep["flight"]["by_node"]["10.0.0.2:7777"] == 1
+    text = report.render_text(rep)
+    assert "stage ledger" in text and "device" in text
+    assert "10.0.0.2:7777" in text and "shard.step" in text
+
+
+def test_report_cli_round_trip(tmp_path, capsys):
+    snap, trace_doc, _, _ = _report_inputs()
+    mpath = tmp_path / "metrics.json"
+    tpath = tmp_path / "trace.json"
+    jout = tmp_path / "report.json"
+    mpath.write_text(json.dumps(snap))
+    tpath.write_text(json.dumps(trace_doc))
+    rc = report.main(["--metrics", str(mpath), "--trace", str(tpath),
+                      "--json", str(jout)])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "campaign report" in out and "stage ledger" in out
+    doc = json.loads(jout.read_text())
+    assert doc["campaign"]["samples"] == 64
+    assert doc["trace"]["trace_id"] == "tfleet"
+
+
+def test_report_cli_reads_flight_jsonl(tmp_path, capsys):
+    fpath = tmp_path / "flightrec.jsonl"
+    with open(fpath, "w") as f:
+        f.write(json.dumps({"type": "meta", "reason": "x",
+                            "entries": 2}) + "\n")
+        f.write(json.dumps({"type": "event", "kind": "fault"}) + "\n")
+        f.write(json.dumps({"type": "span", "name": "fleet.case"}) + "\n")
+    rc = report.main(["--flight", str(fpath)])
+    assert rc == 0
+    assert "flight ring (2 entries)" in capsys.readouterr().out
+
+
+def test_report_cli_requires_an_artifact():
+    with pytest.raises(SystemExit):
+        report.main([])
+    assert report.main(["--metrics", "/nonexistent/m.json"]) == 1
+
+
+# ---- the full fleet campaign (compile-paying, slow) ---------------------
+
+#: seed set chosen so home partitions (partition_of(seed_id) mod 2)
+#: split 3/3 across two shards — BOTH workers must do real work, else
+#: the federation assertions would pass vacuously with one idle node
+FLEET_SEEDS = [b"A" * ln for ln in (30, 60, 90, 120, 150, 180)]
+
+
+def _run_fleet(tmp_path, tag, n, nodes, spec=None):
+    from erlamsa_tpu.corpus.fleet import run_corpus_fleet
+
+    outdir = tmp_path / f"out-{tag}"
+    outdir.mkdir(exist_ok=True)
+    stats: dict = {}
+    opts = {
+        "corpus_dir": str(tmp_path / f"corpus-{tag}"),
+        "corpus": list(FLEET_SEEDS),
+        "seed": SEED,
+        "n": n,
+        "output": str(outdir / "%n.out"),
+        "_stats": stats,
+        "shards": None,
+        "fleet_nodes": nodes,
+    }
+    chaos.configure(spec, seed=SEED[0])
+    try:
+        rc = run_corpus_fleet(opts, batch=8)
+    finally:
+        chaos.configure(None)
+    return rc, stats
+
+
+def _read_blob(tmp_path, tag, n, batch=8):
+    out = b""
+    for i in range(n * batch):
+        out += (tmp_path / f"out-{tag}" / f"{i}.out").read_bytes()
+    return out
+
+
+@pytest.mark.slow
+def test_fleet_campaign_merged_trace_federation_byte_identity(tmp_path):
+    """The r18 acceptance pin, end to end over two loopback workers:
+    (1) telemetry off, (2) tracing + federation on, (3) telemetry
+    chaos-dropped — all three produce byte-identical output; leg (2)
+    additionally yields a merged trace whose worker shard.step spans
+    parent onto coordinator fleet.case spans, a federation snapshot
+    covering both nodes, and erlamsa_worker_* families on /metrics."""
+    srv1 = ParentServer(0, {"seed": SEED}).serve(block=False)
+    srv2 = ParentServer(0, {"seed": SEED}).serve(block=False)
+    p1 = srv1._srv.getsockname()[1]
+    p2 = srv2._srv.getsockname()[1]
+    nodes = [f"127.0.0.1:{p1}", f"127.0.0.1:{p2}"]
+    trace_path = tmp_path / "fleet-trace.json"
+    try:
+        rc, _ = _run_fleet(tmp_path, "dark", n=2, nodes=nodes)
+        assert rc == 0
+        ref = _read_blob(tmp_path, "dark", 2)
+
+        trace.configure(path=str(trace_path), trace_id="tfleet")
+        rc, stats = _run_fleet(tmp_path, "lit", n=2, nodes=nodes)
+        trace.GLOBAL.export()
+        trace.GLOBAL.configure()
+        assert rc == 0 and stats["remote_shards"] == 2
+        assert _read_blob(tmp_path, "lit", 2) == ref
+
+        doc = json.load(open(trace_path))
+        evs = [e for e in doc["traceEvents"] if e.get("ph") == "X"]
+        cases = {e["args"]["span_id"] for e in evs
+                 if e["name"] == "fleet.case"}
+        steps = [e for e in evs if e["name"] == "shard.step"]
+        assert cases and steps
+        # in-process loopback workers share the coordinator's tracer;
+        # the propagated (trace, span) header context still parents
+        # every worker-side step under a coordinator case span
+        assert all(e["args"]["parent_id"] in cases for e in steps)
+
+        snap = federate.GLOBAL.snapshot()
+        assert set(snap["nodes"]) == set(nodes)
+        assert all(n >= 1 for n in snap["ingests"].values())
+        text = prom.render(metrics.Counters())
+        _promtool_check(text)
+        for node in nodes:
+            assert f'erlamsa_worker_samples_total{{node="{node}"}}' in text
+
+        rep = report.build_report(metrics_snap=metrics.GLOBAL.snapshot(),
+                                  trace_doc=doc, federation_snap=snap)
+        assert set(rep["fleet"]) == set(nodes)
+        assert rep["trace"]["spans"]["shard.step"]["count"] == len(steps)
+
+        federate.GLOBAL.reset()
+        before = metrics.GLOBAL.event_counts().get("telemetry_lost", 0)
+        rc, _ = _run_fleet(tmp_path, "chaos", n=2, nodes=nodes,
+                           spec="obs.telemetry:*")
+        assert rc == 0
+        assert _read_blob(tmp_path, "chaos", 2) == ref
+        after = metrics.GLOBAL.event_counts().get("telemetry_lost", 0)
+        assert after > before
+        assert federate.GLOBAL.nodes() == []
+    finally:
+        srv1.stop()
+        srv2.stop()
