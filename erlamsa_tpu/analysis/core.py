@@ -55,8 +55,10 @@ class LintConfig:
     #: first dotted segment(s) that mark a call as obs-rooted after alias
     #: expansion (`from ..obs import trace` -> 'obs.trace.span')
     obs_roots: tuple = ("obs",)
-    #: ops/ scope for the traced-function rules
-    traced_paths: tuple = ("ops/",)
+    #: ops/ scope for the traced-function rules; parallel/spmd.py is
+    #: in scope (r19): its shard_map bodies are traced kernels that run
+    #: under jit on every mesh slot
+    traced_paths: tuple = ("ops/", "parallel/spmd.py")
     #: ops/ modules whose key/data-led functions are traced kernels by
     #: convention (the make_fuzzer/registry calling convention); "*"
     #: means every module in traced_paths
@@ -71,6 +73,8 @@ class LintConfig:
         "tree_mutators",
         # r17 grammar-expansion kernel (gen/ compiler tables -> lax.scan)
         "grammar",
+        # r19 SPMD fleet kernel (parallel/spmd.py shard_map bodies)
+        "spmd",
     )
     #: framed-transport scope for span-coverage: functions here whose
     #: own body touches a frame primitive must open a trace span (or

@@ -23,6 +23,7 @@ from .core import Finding, LintConfig, Module, call_name, functions, \
 #: call names (last dotted segment) that touch the framed wire protocol
 FRAME_OPS = frozenset({
     "_pack_frame", "_read_frame",
+    "_frames_for", "_read_frames",
     "_shard_frame_send", "_shard_frame_recv",
     "_node_frame_send", "_node_frame_recv",
     "read_reply", "request",

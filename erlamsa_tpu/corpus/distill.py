@@ -75,6 +75,20 @@ class CoverageIndex:
         self.folds += 1
         return [int(g) for g in gains]
 
+    def fold_map(self, sid: str, frame: bytes) -> None:
+        """Attribution-only OR of one raw frame (no gain computation,
+        no fault point): the fleet's per-shard ledgers accrue each
+        seed's map on its HOME shard through this, and the window fence
+        OR-reduces the ledger globals against the gating index
+        (corpus/fleet.py). Gains and admission stay with fold_case."""
+        row = np.frombuffer(frame, np.uint8)
+        if row.shape[0] != self.map_bytes:
+            raise ValueError(
+                f"coverage map width {row.shape[0]} != {self.map_bytes}")
+        cur = self.per_seed.get(sid)
+        self.per_seed[sid] = row.copy() if cur is None else cur | row
+        self.global_map |= row
+
     def edges(self) -> int:
         """Total distinct edges observed so far."""
         return int(covops.popcount_np(self.global_map[None])[0])

@@ -143,23 +143,51 @@ def merge_shard_results(parts) -> dict[int, bytes]:
 
 
 def apply_novelty(store, ids, results, seen_hashes, batch,
-                  tallies=None, on_novel=None) -> int:
+                  tallies=None, on_novel=None, slot_gain=None,
+                  dup_of=None) -> int:
     """The reduce step's novelty walk, shared with tests: slots
     0..batch-1 in order, one GLOBAL seen-set — a hash first seen this
     case credits energy exactly once no matter how many shards produced
-    hash-equal offspring. `on_novel(slot, payload)` fires per new hash
-    in the same slot order (the fleet's offspring-adoption hook).
+    hash-equal offspring. `on_novel(slot, payload)` fires per admitted
+    slot in the same slot order (the fleet's offspring-adoption hook).
+
+    slot_gain (r19 fleet coverage): {slot: new-edge count} for slots
+    the coverage fold covered this case — those admit on genuinely-new
+    edges (``new_cov`` energy) while uncovered slots keep the
+    hash-novelty stand-in, exactly the single-device runner's
+    semantics. seen_hashes is still recorded for covered slots so a
+    later degradation cannot re-count their outputs as novel.
+
+    dup_of (r19 --spmd): {slot: earlier slot} duplicate HINTS from the
+    on-device ppermute hash exchange. Every hint is memcmp-verified
+    here before it short-circuits the sha1: equal bytes at a lower slot
+    mean that slot's walk already interned this exact hash (induction
+    over slot order), so skipping is bit-equivalent — and a weak-hash
+    collision simply fails the memcmp and takes the normal path.
     Returns the number of new hashes."""
     new = 0
     for slot in range(batch):
         payload = results.get(slot, b"")
         if tallies is not None:
             tallies["bytes_out"] += len(payload)
-        h = _out_hash(payload)
-        if h not in seen_hashes:
-            seen_hashes.add(h)
+        d = dup_of.get(slot) if dup_of else None
+        if d is not None and payload and results.get(d) == payload:
+            novel_hash = False
+        else:
+            h = _out_hash(payload)
+            novel_hash = h not in seen_hashes
+            if novel_hash:
+                seen_hashes.add(h)
+        if novel_hash:
             new += 1
-            store.apply_event(fb.Event("new_hash", ids[slot]))
+        if slot_gain is not None and slot in slot_gain:
+            admit = slot_gain[slot] > 0
+            kind = "new_cov"
+        else:
+            admit = novel_hash
+            kind = "new_hash"
+        if admit:
+            store.apply_event(fb.Event(kind, ids[slot]))
             if on_novel is not None:
                 on_novel(slot, payload)
     return new
@@ -182,8 +210,57 @@ def _remote_step_for(pri: tuple):
         return step
 
 
+class _DoneStep:
+    """An already-materialized step result dressed in the StepFuture
+    protocol (block/ready/result) — run_remote_slice's spmd leg returns
+    host arrays, not a future, and the per-class force loop should not
+    care which path produced them."""
+
+    def __init__(self, res):
+        self._res = res
+
+    def block(self):
+        return self
+
+    def ready(self) -> bool:
+        return True
+
+    def result(self):
+        return self._res
+
+
+def _panel_future(base, case: int, idx, panel, lens, sc_in, pri,
+                  scan_len: int):
+    """Remote-SPMD leg (r19): split one class panel row-wise across the
+    worker's local devices via parallel/spmd.run_panel — the mesh recipe
+    the coordinator's --spmd mode compiles, re-derived worker-side so
+    remote-SPMD == local-SPMD == 1-shard stays byte-identical (rows are
+    independent and keyed on GLOBAL slots). Returns None when the board
+    has one device or the split fails — the caller's single-device step
+    serves the panel byte-identically."""
+    import jax
+
+    from ..parallel import spmd as spmd_mod
+
+    devs = jax.devices()
+    n = len(devs)
+    while n > 1 and panel.shape[0] % n:
+        n //= 2
+    if n < 2:
+        return None
+    try:
+        out, n_out, sc, applied = spmd_mod.run_panel(
+            devs[:n], base, int(case), idx, panel, lens, sc_in,
+            pri, None, int(scan_len))
+    except Exception:  # lint: broad-except-ok mesh failure degrades to the byte-identical single-device step
+        metrics.GLOBAL.record_event("spmd_panel_fallback")
+        return None
+    return _DoneStep((out, n_out, sc, SimpleNamespace(applied=applied)))
+
+
 def run_remote_slice(seed, case: int, batch: int, slots, payloads,
-                     score_rows, pri, classes, device_max: int):
+                     score_rows, pri, classes, device_max: int,
+                     spmd: bool = False):
     """Worker-side executor for one remote shard's per-case slice
     (called by services/dist.ShardHost under a validated lease).
 
@@ -232,8 +309,11 @@ def run_remote_slice(seed, case: int, batch: int, slots, payloads,
             sc_in = np.asarray(
                 [score_rows[rows[j % k]] for j in range(kp)], np.int32)
             sl = scan_bound(int(lens[:k].max()), cap)
-            fut = step_async(step, base, int(case), idx, panel, lens,
-                             sc_in, scan_len=sl)
+            fut = (_panel_future(base, case, idx, panel, lens, sc_in,
+                                 pri, sl) if spmd else None)
+            if fut is None:
+                fut = step_async(step, base, int(case), idx, panel, lens,
+                                 sc_in, scan_len=sl)
             launched.append((rows, k, cap, sl, kp, fut))
     except BaseException:  # lint: broad-except-ok re-raised after settling in-flight futures
         drain_futures(f for *_g, f in launched)
@@ -382,7 +462,9 @@ def run_corpus_fleet(opts: dict, batch: int = 1024) -> int:
     from .arena import RESERVED_PAGES, DeviceArena, _next_pow2, \
         build_arena_snapshot, fit_page_classes, resolve_classes
 
-    from ..services.checkpoint import (load_fleet_state,
+    from ..parallel import spmd as spmd_mod
+    from ..services.checkpoint import (load_coverage_maps,
+                                       load_fleet_state,
                                        quarantine_mismatch,
                                        save_fleet_state)
     from ..services.dist import (RemoteShardError, ShardStream,
@@ -400,6 +482,21 @@ def run_corpus_fleet(opts: dict, batch: int = 1024) -> int:
     if reduce_mode not in ("overlap", "boundary"):
         raise ValueError(f"--fleet-reduce must be overlap|boundary, "
                          f"got {reduce_mode!r}")
+    # --spmd (r19): fuse the LOCAL shards' per-case class steps into ONE
+    # shard_map-compiled program per capacity class over the device mesh
+    # (parallel/spmd.py) — one dispatch per (case, class) for the whole
+    # board, with the score merge and a duplicate-hash exchange running
+    # as on-device collectives. Remote shards keep the framed-stream
+    # tier; their leases carry the flag so workers mesh their own boards.
+    use_spmd = bool(opts.get("spmd"))
+    # --fleet-rewind: 'slice' (default) replays only the lost shard's
+    # partition slice of the un-merged case after a FleetShardLost;
+    # 'full' restores the r15 whole-window rewind (the identity pin's
+    # reference path — tests pin slice == full bytes)
+    rewind_mode = str(opts.get("fleet_rewind") or "slice")
+    if rewind_mode not in ("slice", "full"):
+        raise ValueError(f"--fleet-rewind must be slice|full, "
+                         f"got {rewind_mode!r}")
     fleet_nodes: list[tuple[str, int]] = []
     for spec in (opts.get("fleet_nodes") or []):
         host, _, port = str(spec).rpartition(":")
@@ -408,9 +505,17 @@ def run_corpus_fleet(opts: dict, batch: int = 1024) -> int:
                 f"--fleet-nodes entry {spec!r} is not host:port")
         fleet_nodes.append((host, int(port)))
     # --fleet-nodes alone sizes the fleet to the worker list; --shards N
-    # with M <= N nodes runs a mixed fleet (M remote + N-M local shards)
-    n_shards = int(raw_shards if raw_shards is not None
-                   else (len(fleet_nodes) or 1))
+    # with M <= N nodes runs a mixed fleet (M remote + N-M local shards);
+    # --spmd alone sizes the fleet to the local board (one mesh slot per
+    # device — the single-program multi-device configuration)
+    if raw_shards is not None:
+        n_shards = int(raw_shards)
+    elif fleet_nodes:
+        n_shards = len(fleet_nodes)
+    elif use_spmd:
+        n_shards = len(jax.devices())
+    else:
+        n_shards = 1
     if n_shards < 1:
         raise ValueError(f"--shards must be >= 1, got {n_shards}")
     if len(fleet_nodes) > n_shards:
@@ -476,6 +581,26 @@ def run_corpus_fleet(opts: dict, batch: int = 1024) -> int:
     bus = opts.get("feedback_bus", fb.GLOBAL)
     consume_feedback = bool(opts.get("feedback"))
 
+    # -- fleet coverage (r19, satellite of the spmd PR): ONE gating
+    # CoverageIndex at the coordinator (the same admission authority as
+    # the single-device runner — adoption must not depend on placement)
+    # plus one attribution-only ledger per shard: a seed's per-seed map
+    # accrues on its HOME shard's ledger, and the window fence
+    # OR-reduces the ledger globals against the gating map. Hub death is
+    # sticky hash-novelty degradation, byte-identical per PR 16.
+    cov_hub = opts.get("coverage_hub")
+    coverage_on = bool(opts.get("coverage")) and cov_hub is not None
+    cov = None
+    cov_ledgers: list = []
+    cov_live = [coverage_on]
+    ledger = fb.SampleLedger()
+    if coverage_on:
+        from .distill import CoverageIndex
+
+        cov = CoverageIndex(map_bytes=cov_hub.map_bytes, use_device=True)
+        cov_ledgers = [CoverageIndex(map_bytes=cov_hub.map_bytes)
+                       for _ in range(n_shards)]
+
     # -- fleet checkpoint (--state): resume or start fresh -------------
     n_cases = int(opts.get("n", 1))
     state_path = opts.get("state_path")
@@ -486,18 +611,28 @@ def run_corpus_fleet(opts: dict, batch: int = 1024) -> int:
     classes_override = None
     if state_path and os.path.exists(state_path):
         st = load_fleet_state(state_path)
+        cov_verdict, cov_snap = "absent", None
+        if st is not None and cov is not None:
+            # kind-stamped coverage fields: "absent" (pre-coverage
+            # checkpoint) resumes with fresh empty coverage; "mismatch"
+            # (width/version/kind) joins the quarantine path below —
+            # folding into maps written under another scheme would
+            # corrupt every later adoption decision
+            cov_verdict, cov_snap = load_coverage_maps(state_path,
+                                                       cov.map_bytes)
         if st is None:
             print("# fleet checkpoint unreadable (or not a fleet "
                   "checkpoint), starting fresh", file=sys.stderr)
         elif (st["seed"] != tuple(opts["seed"])
                 or st["scores"].shape != scores.shape
-                or st["n_shards"] != n_shards):
+                or st["n_shards"] != n_shards
+                or cov_verdict == "mismatch"):
             # a checkpoint from a DIFFERENT run is evidence, not trash:
             # quarantine it to .bak instead of burying it under this
             # run's first save (tests pin both paths)
             quarantine_mismatch(state_path)
-            print("# fleet checkpoint mismatch (seed/shape/shards), "
-                  "starting fresh (original kept as .bak)",
+            print("# fleet checkpoint mismatch (seed/shape/shards/"
+                  "coverage), starting fresh (original kept as .bak)",
                   file=sys.stderr)
         else:
             start_case = st["case_idx"]
@@ -512,6 +647,15 @@ def run_corpus_fleet(opts: dict, batch: int = 1024) -> int:
             # floors so no counter ever reads lower after a restore
             for kind, floor in (st.get("events") or {}).items():
                 metrics.GLOBAL.restore_event_floor(kind, floor)
+            if cov_snap is not None:
+                cov.restore(cov_snap)
+                # rebuild the per-shard attribution ledgers from the
+                # restored per-seed maps: attribution is a pure function
+                # of (sid, n_shards), so the fence invariant (ledger
+                # union == gating map) holds across the resume
+                for sid, row in cov.per_seed.items():
+                    cov_ledgers[partition_of(sid, n_shards)].fold_map(
+                        sid, row.tobytes())
             print(f"# fleet resumed at case {start_case} "
                   f"({len(st['seen'])} seen hashes, "
                   f"{len(st['energies'])} seed energies, "
@@ -555,28 +699,50 @@ def run_corpus_fleet(opts: dict, batch: int = 1024) -> int:
         # pre-crash zombie worker's reply can never pass validation
         placement.restore(resume_epoch)
 
+    def _shard_page_need(shard_id: int) -> int:
+        """Arena page count for one shard: sized for its home partition
+        (fleet capacity scales linearly) with 2x slack for migrated
+        partitions; overflow rides the host-overlay spill path."""
+        home = [sid for sid in store.ids()
+                if partition_of(sid, n_shards) == shard_id]
+        need = sum(max(1, -(-min(len(store.get(sid)), trunc_cap)
+                           // page)) for sid in home)
+        per_opt = opts.get("arena_pages")  # per-shard when given
+        num_pages = int(per_opt or RESERVED_PAGES + max(64, 2 * need))
+        return max(num_pages, RESERVED_PAGES + classes[0] // page)
+
+    # --spmd needs every LOCAL arena tensor the same shape: the fused
+    # program's [N, pages, page] view is a zero-copy assembly of the
+    # per-device tensors. Sizing every member at the fleet max only
+    # moves spill boundaries, which the spill path keeps byte-neutral.
+    local_shard_ids = list(range(len(fleet_nodes), n_shards))
+    uniform_pages = (max(map(_shard_page_need, local_shard_ids))
+                     if use_spmd and local_shard_ids else None)
+
     class _Shard:
-        """One lease-holder: a device slot plus its own paged arena,
-        sized for the shard's home partition (fleet capacity scales
-        linearly) with 2x slack for migrated partitions; overflow rides
-        the arena's host-overlay spill path."""
+        """One lease-holder: a device slot plus its own paged arena (see
+        _shard_page_need; --spmd sizes all local arenas uniformly)."""
 
         def __init__(self, shard_id: int):
             self.id = shard_id
             self.device = devices[shard_id % len(devices)]
-            home = [sid for sid in store.ids()
-                    if partition_of(sid, n_shards) == shard_id]
-            need = sum(max(1, -(-min(len(store.get(sid)), trunc_cap)
-                               // page)) for sid in home)
-            per_opt = opts.get("arena_pages")  # per-shard when given
-            num_pages = int(per_opt or RESERVED_PAGES + max(64, 2 * need))
-            num_pages = max(num_pages, RESERVED_PAGES + classes[0] // page)
+            num_pages = (uniform_pages if uniform_pages is not None
+                         else _shard_page_need(shard_id))
             with jax.default_device(self.device):
                 self.arena = DeviceArena(
                     num_pages, page=page, donate=False, classes=classes,
                     classify=lambda n: bucket_capacity(
                         n, device_max=device_max),
                 )
+            # COMMIT the pages tensor to this shard's slot: arrays born
+            # under default_device are uncommitted, so the first
+            # functional update outside this context (upload/adopt on
+            # the main thread) would silently migrate the arena to
+            # device 0 — fatal for the spmd assembly, which requires
+            # one resident arena per distinct mesh device. A committed
+            # input keeps every downstream jit output on this device.
+            self.arena._arena = jax.device_put(self.arena._arena,
+                                               self.device)
 
     # one token per coordinator campaign: worker-side fence floors are
     # scoped by it, so a fresh campaign's epoch-0 leases are not fenced
@@ -616,6 +782,10 @@ def run_corpus_fleet(opts: dict, batch: int = 1024) -> int:
                 "classes": [int(c) for c in classes],
                 "device_max": int(device_max),
                 "batch": int(batch),
+                # r19: a leased worker meshes its OWN local board when
+                # the coordinator runs --spmd (run_remote_slice re-
+                # derives the panel split; bytes are placement-free)
+                "spmd": bool(use_spmd),
             }
 
         def ensure_lease(self, epoch: int):
@@ -673,13 +843,189 @@ def run_corpus_fleet(opts: dict, batch: int = 1024) -> int:
         for s in range(n_shards)
     }
 
+    # -- SPMD engine (r19, --spmd): one mesh over the local members ----
+    spmd_engine = None
+    spmd_members: dict[int, int] = {}   # shard id -> mesh position
+    local_member_ids: list[int] = []    # mesh position -> shard id
+    if use_spmd:
+        local_member_ids = [s for s in sorted(shards)
+                            if isinstance(shards[s], _Shard)]
+        devs = [shards[s].device for s in local_member_ids]
+        if local_member_ids and len({d.id for d in devs}) == len(devs):
+            spmd_engine = spmd_mod.SpmdEngine(devs, batch,
+                                              mutator_pri=pri, page=page)
+            spmd_members = {s: i for i, s in enumerate(local_member_ids)}
+        else:
+            # more local shards than devices (or none): two mesh slots
+            # cannot share a device, so the classic per-shard dispatch
+            # serves the run byte-identically
+            print("# --spmd: local shards do not map 1:1 onto distinct "
+                  "devices — classic per-shard dispatch", file=sys.stderr)
+
     writer, _mt = out.string_outputs(opts.get("output", "-"))
     stats = opts.get("_stats")
     seen_hashes: set[bytes] = resume_seen
     tallies = {"truncated": 0, "total": 0, "new_hashes": 0, "bytes_out": 0,
                "oracle_cases": 0, "redispatches": 0, "offspring": 0,
-               "rewinds": 0}
+               "rewinds": 0, "slice_rewinds": 0, "cov_maps": 0,
+               "cov_new_edges": 0}
     step_shapes: set[tuple] = set()
+
+    class _SpmdSlice:
+        """One member's view of a fused class launch, dressed in the
+        StepFuture protocol (block/ready/result) so process_case forces
+        spmd and classic entries through ONE code path. Holds its
+        case's plan state directly — a slice kept across a slice-rewind
+        begin_case still resolves against the launch that produced it."""
+
+        def __init__(self, case_state, cap, member, off, k, slots):
+            self._state = case_state
+            self._cap = cap
+            self._member = member
+            self._off = off
+            self._k = k
+            self._slots = slots
+
+        def result(self):
+            res = self._state["results"].get(self._cap)
+            if res is None:
+                raise RuntimeError(
+                    f"spmd class {self._cap} was never launched")
+            if isinstance(res, BaseException):
+                raise res
+            if isinstance(res, dict):   # classic per-member fallback
+                data, lens, sc, meta = res[self._member].result()
+                sl = slice(self._off, self._off + self._k)
+                return (data[sl], lens[sl], sc[sl],
+                        SimpleNamespace(applied=meta.applied[sl]))
+            data, lens, sc, applied = res.member_view(
+                self._member, self._off, self._k)
+            return data, lens, sc, SimpleNamespace(applied=applied)
+
+        def block(self):
+            try:
+                self.result()
+            except Exception:  # lint: broad-except-ok settle-only; result() re-raises at the merge
+                pass
+            return self
+
+        def ready(self) -> bool:
+            return True
+
+        def hints(self) -> dict[int, int]:
+            res = self._state["results"].get(self._cap)
+            if isinstance(res, spmd_mod.SpmdClassResult):
+                return res.dup_hints(self._member, self._off, self._k,
+                                     self._slots)
+            return {}
+
+    class _SpmdPlan:
+        """Per-case staging for the fused dispatch: shard_dispatch banks
+        each local member's class groups here instead of launching one
+        step per (shard, class); launch() then fires ONE compiled
+        program per capacity class across every staged member. In-case
+        redispatch rounds (a member revoked at dispatch time) merge
+        their groups into the same launch, so the one-dispatch-per-
+        (case, class) invariant holds through requeues. A fused-launch
+        failure degrades that class to the classic per-member path,
+        byte-identically (pad rows and scan_len are bit-neutral)."""
+
+        def __init__(self):
+            self.cur = None
+
+        def begin_case(self):
+            self.cur = {"staged": {}, "results": {}, "max_len": {}}
+
+        def stage(self, shard_id: int, cap: int, group: dict,
+                  max_len: int):
+            st = self.cur
+            member = spmd_members[shard_id]
+            key = (cap, member)
+            g0 = st["staged"].get(key)
+            if g0 is None:
+                off = 0
+                st["staged"][key] = group
+            else:
+                off = len(g0["slots"])
+                st["staged"][key] = {
+                    "table": np.concatenate([g0["table"],
+                                             group["table"]]),
+                    "lens": np.concatenate([g0["lens"], group["lens"]]),
+                    "slots": list(g0["slots"]) + list(group["slots"]),
+                    "sc": np.concatenate([g0["sc"], group["sc"]]),
+                    # spill rows index the member's LOCAL row order:
+                    # the appended group's rows sit after g0's
+                    "spill_rows": np.concatenate(
+                        [g0["spill_rows"], group["spill_rows"] + off]),
+                    "spill_panel": np.concatenate(
+                        [g0["spill_panel"], group["spill_panel"]]),
+                }
+            st["max_len"][cap] = max(st["max_len"].get(cap, 0),
+                                     int(max_len))
+            return _SpmdSlice(st, cap, member, off,
+                              len(group["slots"]), group["slots"])
+
+        def launch(self, case: int):
+            st = self.cur
+            arenas = [shards[s].arena._arena for s in local_member_ids]
+            for cap in sorted({c for c, _m in st["staged"]}):
+                groups = [st["staged"].get((cap, m))
+                          for m in range(spmd_engine.n)]
+                sl = scan_bound(st["max_len"][cap], cap)
+                try:
+                    with trace.span("fleet.spmd_dispatch", case=case,
+                                    capacity=cap,
+                                    members=sum(g is not None
+                                                for g in groups)):
+                        res = spmd_engine.run_class(arenas, groups, base,
+                                                    case, cap, sl)
+                    step_shapes.add((res.kp, cap, sl))
+                    st["results"][cap] = res
+                except Exception as e:  # lint: broad-except-ok fused failure degrades to the classic per-member path
+                    spmd_mod.STATS["fallbacks"] += 1
+                    metrics.GLOBAL.record_event("spmd_fallback")
+                    logger.log("warning", "fleet: fused spmd launch "
+                               "failed for class %d at case %d (%s) — "
+                               "classic per-member dispatch", cap,
+                               case, e)
+                    try:
+                        st["results"][cap] = self._classic(cap, groups,
+                                                           case, sl)
+                    except Exception as e2:  # lint: broad-except-ok stored; slices re-raise it into the FleetShardLost path
+                        st["results"][cap] = e2
+
+        def _classic(self, cap: int, groups, case: int, sl: int) -> dict:
+            """Per-member fallback over the staged arrays: the same
+            gather + overlay + step_async recipe as the non-spmd
+            dispatch (uniform scan_len, which is bit-neutral)."""
+            futs: dict[int, object] = {}
+            try:
+                for m, g in enumerate(groups):
+                    if g is None:
+                        continue
+                    sh = shards[local_member_ids[m]]
+                    k = len(g["slots"])
+                    kp = max(8, _next_pow2(k))
+                    pad = np.arange(kp, dtype=np.int32) % k
+                    with jax.default_device(sh.device):
+                        data_dev = sh.arena.gather(g["table"][pad])
+                        if g["spill_rows"].shape[0]:
+                            data_dev = data_dev.at[g["spill_rows"]].set(
+                                g["spill_panel"])
+                        idx = np.concatenate([
+                            np.asarray(g["slots"], np.int32),
+                            batch + np.arange(kp - k, dtype=np.int32),
+                        ]).astype(np.int32)
+                        futs[m] = step_async(step, base, case, idx,
+                                             data_dev, g["lens"][pad],
+                                             g["sc"][pad], scan_len=sl)
+                    step_shapes.add((kp, cap, sl))
+            except BaseException:  # lint: broad-except-ok re-raised after settling in-flight futures
+                drain_futures(futs.values())
+                raise
+            return futs
+
+    spmd_plan = _SpmdPlan()
 
     def remote_dispatch(shard: _Remote, case: int, slots: list[int],
                         ids, samples):
@@ -771,6 +1117,34 @@ def run_corpus_fleet(opts: dict, batch: int = 1024) -> int:
                 arena.maybe_defrag()
                 groups = arena.tables_for(sub_ids, sub_samples, tick=case)
             t_d = time.perf_counter()
+            if spmd_engine is not None and shard.id in spmd_members:
+                # r19 --spmd: bank this member's class groups on the
+                # per-case plan — ONE fused program per class launches
+                # for the whole board after the map loop (plan.launch).
+                # Slot keys, cyclic padding and spill panels match the
+                # per-shard dispatch below, so bytes do too.
+                for g in groups:
+                    k = int(g.rows.shape[0])
+                    g_slots = [slots[int(r)] for r in g.rows]
+                    panel = np.zeros((len(g.spilled), g.capacity),
+                                     np.uint8)
+                    for j, r in enumerate(g.spilled):
+                        s = sub_samples[int(g.rows[r])][:g.capacity]
+                        panel[j, :len(s)] = np.frombuffer(s, np.uint8)
+                    fut = spmd_plan.stage(
+                        shard.id, int(g.capacity),
+                        {"table": np.asarray(g.table, np.int32),
+                         "lens": np.asarray(g.lens, np.int32),
+                         "slots": g_slots,
+                         "sc": scores[np.asarray(g_slots, np.int32)],
+                         "spill_rows": np.asarray(g.spilled, np.int32),
+                         "spill_panel": panel},
+                        int(g.lens.max()))
+                    launched_here.append((g_slots, k, fut))
+                metrics.GLOBAL.record_stage("assemble", t_d - t_a)
+                metrics.GLOBAL.record_stage(
+                    "dispatch", time.perf_counter() - t_d)
+                return launched_here
             try:
                 for g in groups:
                     k = int(g.rows.shape[0])
@@ -990,6 +1364,12 @@ def run_corpus_fleet(opts: dict, batch: int = 1024) -> int:
         # sources for the novelty walk below (arena output buffers are
         # never donated in the fleet, so holding them here is safe)
         devsrc: dict[int, tuple] = {}
+        # score scatters DEFER until every entry forced cleanly: a
+        # FleetShardLost mid-loop must leave the table exactly as the
+        # case's dispatch read it, or the replayed slice (and a full
+        # rewind's re-dispatch) would gather partially-merged rows
+        score_writes: list[tuple] = []
+        dup_of: dict[int, int] = {}
         shard_id = -1
         try:
             for shard_id, slots, rows, fut in work.launched:
@@ -1001,13 +1381,16 @@ def run_corpus_fleet(opts: dict, batch: int = 1024) -> int:
                     outs = unpack(Batch(new_data[:rows], new_lens[:rows]))
                 parts.append({slot: outs[j]
                               for j, slot in enumerate(slots)})
+                if isinstance(fut, _SpmdSlice):
+                    dup_of.update(fut.hints())
                 if adopt_on and isinstance(shards[shard_id], _Shard):
                     # remote shards never register adoption sources:
                     # there is no local device buffer to splice from, so
                     # their offspring take the lazy-upload path
                     for j, slot in enumerate(slots):
                         devsrc[slot] = (shard_id, new_data, j)
-                scores[np.asarray(slots, np.int32)] = new_sc[:rows]
+                score_writes.append((np.asarray(slots, np.int32),
+                                     np.asarray(new_sc[:rows])))
                 applied = meta.applied[:rows].ravel()
                 applied = applied[applied >= 0]
                 if applied.size:
@@ -1026,6 +1409,8 @@ def run_corpus_fleet(opts: dict, batch: int = 1024) -> int:
             if isinstance(e, RemoteShardError) or is_device_error(e):
                 raise FleetShardLost(shard_id, case_i, e) from e
             raise
+        for w_slots, w_sc in score_writes:
+            scores[w_slots] = w_sc
         if work.host_slots:
             tallies["oracle_cases"] += 1
             parts.append(oracle_slots(case_i, ids, work.host_slots))
@@ -1045,6 +1430,71 @@ def run_corpus_fleet(opts: dict, batch: int = 1024) -> int:
         metrics.GLOBAL.record_stage("remote_wait", drain_s)
         device_s = drain_s + (t_r - work.t_map)
         metrics.GLOBAL.observe("batch_latency", device_s)
+
+        # coverage pre-pass (r19 fleet coverage): pull this case's
+        # buffered bitmaps off the hub and fold them into the GATING
+        # index; each frame also ORs onto its seed's HOME shard's
+        # attribution ledger. Runs strictly AFTER the force loop — an
+        # aborted case never consumes its frames, so a rewound replay
+        # folds them identically. Hub death is STICKY (PR 16): the rest
+        # of the run is pure hash-novelty, byte-identically.
+        slot_gain = None
+        if cov is not None and cov_live[0]:
+            if not cov_hub.alive():
+                cov_live[0] = False
+                logger.log("warning", "fleet: coverage hub lost at case "
+                           "%d — degrading to hash-novelty", case_i)
+                metrics.GLOBAL.record_event("coverage_lost")
+                metrics.GLOBAL.set_coverage_degraded(True)
+            else:
+                frames = cov_hub.take(case_i)
+                covered = [s for s in sorted(frames) if s < batch]
+                pairs = [(ledger.resolve(case_i, s) or ids[s], frames[s])
+                         for s in covered]
+                t_f = time.perf_counter()
+                try:
+                    with trace.span_remote("coverage.fold",
+                                           parent=case_parent,
+                                           case=case_i, maps=len(pairs)):
+                        gains = cov.fold_case(pairs)
+                except OSError as e:
+                    # injected coverage.fold fault: the whole case is
+                    # treated as uncovered — observable, never diverging
+                    # from the hash-novelty baseline
+                    logger.log("warning", "fleet: coverage fold failed "
+                               "at case %d (%s) — case uncovered",
+                               case_i, e)
+                    metrics.GLOBAL.record_coverage_frame("faulted")
+                    slot_gain = {}
+                else:
+                    slot_gain = dict(zip(covered, gains))
+                    for sid, frame in pairs:
+                        cov_ledgers[partition_of(sid, n_shards)] \
+                            .fold_map(sid, frame)
+                    if covered:
+                        new_edges = int(sum(gains))
+                        metrics.GLOBAL.record_coverage_fold(
+                            len(pairs), new_edges, cov.edges())
+                        tallies["cov_maps"] += len(pairs)
+                        tallies["cov_new_edges"] += new_edges
+                finally:
+                    metrics.GLOBAL.record_stage(
+                        "coverage", time.perf_counter() - t_f)
+            if cov_live[0] and (case_i + 1) % fleet_window == 0:
+                # window fence: the shard ledgers' globals must
+                # OR-reduce back to the gating map (attribution is a
+                # partition of the folded frames) — a mismatch means an
+                # attribution bug, surfaced as an event, never silently
+                fused_map = np.zeros(cov.map_bytes, np.uint8)
+                for cl in cov_ledgers:
+                    fused_map |= cl.global_map
+                if np.array_equal(fused_map, cov.global_map):
+                    metrics.GLOBAL.record_event("coverage_fence_ok")
+                else:
+                    metrics.GLOBAL.record_event("coverage_fence_mismatch")
+                    logger.log("warning", "fleet: coverage fence "
+                               "mismatch at case %d — shard ledgers do "
+                               "not reassemble the gating map", case_i)
 
         t_h = time.perf_counter()
         before = tallies["bytes_out"]
@@ -1076,7 +1526,8 @@ def run_corpus_fleet(opts: dict, batch: int = 1024) -> int:
                                case=case_i):
             tallies["new_hashes"] += apply_novelty(
                 store, ids, results, seen_hashes, batch, tallies,
-                on_novel=on_novel if adopt_on else None)
+                on_novel=on_novel if adopt_on else None,
+                slot_gain=slot_gain, dup_of=dup_of or None)
         tallies["total"] += len(results)
         metrics.GLOBAL.record_stage("hash", time.perf_counter() - t_h)
         metrics.GLOBAL.record_batch(len(results),
@@ -1116,7 +1567,9 @@ def run_corpus_fleet(opts: dict, batch: int = 1024) -> int:
                 save_fleet_state(state_path, opts["seed"], case_i + 1,
                                  scores, seen_hashes, store.energies(),
                                  placement.epoch, n_shards, classes,
-                                 events=metrics.GLOBAL.event_counts())
+                                 events=metrics.GLOBAL.event_counts(),
+                                 coverage=(cov.snapshot()
+                                           if cov is not None else None))
                 store.save()
             metrics.GLOBAL.record_stage("checkpoint",
                                         time.perf_counter() - t_c)
@@ -1140,6 +1593,73 @@ def run_corpus_fleet(opts: dict, batch: int = 1024) -> int:
         drain_futures(
             f for _sh, _sl, _r, f in work.launched
             if not isinstance(f, (_PendingRemote, _RemoteResult)))
+
+    def patch_case_slices(work, lost_shard: int):
+        """Slice-granular rewind (r19): rebuild ONE case's work item
+        after a shard loss — keep every entry whose reply survived,
+        re-dispatch only the dead slices on the post-revoke placement.
+        Recompute is pure (same GLOBAL slot keys, scores untouched by
+        the aborted merge thanks to the deferred scatter), so the
+        patched case merges byte-identically to a full rewind (tests
+        pin slice == full). Surviving remote streams stay OPEN — their
+        FIFO replies force in kept-entry order. Returns None when
+        nothing is provably dead (the full rewind is always correct)."""
+        dead_slots: list[int] = []
+        kept: list[tuple] = []
+        for ent in work.launched:
+            sh_id, slots_e, _rows_e, f = ent
+            dead = sh_id == lost_shard
+            if isinstance(f, _PendingRemote):
+                dead = dead or (not f.done and not f.stream.connected)
+            elif isinstance(f, _SpmdSlice):
+                # one fused launch serves every member: a lost member
+                # poisons the whole class's program, so every spmd
+                # slice of the case replays (pure recompute)
+                dead = True
+            if dead:
+                dead_slots.extend(slots_e)
+            else:
+                kept.append(ent)
+        if not dead_slots:
+            return None
+        # drain surviving remote replies BEFORE re-dispatching: the
+        # requeue below re-leases surviving shards at the bumped epoch,
+        # and a lease request must not race the undrained step replies
+        # queued ahead of it on the FIFO stream. force() is idempotent
+        # — the drain worker re-reads the cached result at merge time.
+        # A failure here raises into the caller's full-rewind fallback.
+        for _sh, _sl, _r, f in kept:
+            if isinstance(f, _PendingRemote) and not f.done:
+                f.force()
+        ids = work.ids
+        samples = [store.get(sid) for sid in ids]
+        requeue: dict[int, list[int]] = {}
+        host_extra: list[int] = []
+        for slot in dead_slots:
+            owner = placement.owner_of(partition_of(ids[slot], n_shards))
+            if owner is None:
+                host_extra.append(slot)
+            else:
+                requeue.setdefault(owner, []).append(slot)
+        if spmd_engine is not None:
+            spmd_plan.begin_case()
+        new_entries: list[tuple] = []
+        try:
+            for owner, sl in sorted(requeue.items()):
+                new_entries.extend(
+                    (owner, *entry)
+                    for entry in shard_dispatch(shards[owner], work.case,
+                                                sorted(sl), ids, samples))
+            if spmd_engine is not None:
+                spmd_plan.launch(work.case)
+        except BaseException:  # lint: broad-except-ok re-raised after settling; caller falls back to the full rewind
+            drain_futures(
+                f for _sh, _sl, _r, f in new_entries
+                if not isinstance(f, (_PendingRemote, _RemoteResult)))
+            raise
+        work.launched = kept + new_entries
+        work.host_slots = list(work.host_slots) + host_extra
+        return work
 
     metrics.GLOBAL.record_fleet(placement.snapshot())
     if stats is not None:
@@ -1194,6 +1714,9 @@ def run_corpus_fleet(opts: dict, batch: int = 1024) -> int:
                         # the replayed draw off the reference bytes.
                         ids = sched.schedule(case, batch, record=False)
                         samples = [store.get(sid) for sid in ids]
+                    # attribution ledger BEFORE launch: the coverage
+                    # fold resolves (case, slot) -> seed through it
+                    ledger.record(case, ids)
                     metrics.GLOBAL.record_stage(
                         "schedule", time.perf_counter() - t_s)
                     if case not in counted:
@@ -1222,6 +1745,8 @@ def run_corpus_fleet(opts: dict, batch: int = 1024) -> int:
                     launched: list[tuple[int, list[int], int,
                                          object]] = []
                     t_map = time.perf_counter()
+                    if spmd_engine is not None:
+                        spmd_plan.begin_case()
                     try:
                         while pending:
                             shard_id, slots = pending.pop(0)
@@ -1285,6 +1810,11 @@ def run_corpus_fleet(opts: dict, batch: int = 1024) -> int:
                             if not isinstance(f, (_PendingRemote,
                                                   _RemoteResult)))
                         raise
+                    if spmd_engine is not None:
+                        # requeue rounds merged their groups into the
+                        # plan above — this is the case's ONE fused
+                        # launch per staged capacity class
+                        spmd_plan.launch(case)
                     if host_slots:
                         logger.log("warning", "fleet: no live shards at "
                                    "case %d — host oracle serves %d "
@@ -1319,9 +1849,38 @@ def run_corpus_fleet(opts: dict, batch: int = 1024) -> int:
                 # mutate at merges, and none landed past the rewind
                 # point — so the rewound run stays byte-identical.
                 redo = drain.done_case + 1
+                failed = drain.failed_item
                 drain.abandon()
                 if placement.is_live(e.shard):
                     revoke_shard(e.shard, e.case, e.cause)
+                patched = None
+                if (rewind_mode == "slice" and failed is not None
+                        and failed.case == redo):
+                    try:
+                        patched = patch_case_slices(failed, e.shard)
+                    except Exception as pe:  # lint: broad-except-ok slice patch is best-effort; the full rewind below is always correct
+                        logger.log("warning", "fleet: slice patch "
+                                   "failed at case %d (%s) — full "
+                                   "rewind", redo, pe)
+                        patched = None
+                if patched is not None:
+                    # slice-granular rewind: only the dead slices
+                    # recompute; surviving shard replies (and their
+                    # streams) are kept, so the fleet never replays
+                    # work whose results it already holds
+                    tallies["slice_rewinds"] += 1
+                    metrics.GLOBAL.record_event("fleet_slice_rewind")
+                    flight.GLOBAL.note("fleet_slice_rewind",
+                                       shard=e.shard, case=e.case,
+                                       redo=redo)
+                    logger.log("warning", "fleet: shard %d reply lost "
+                               "at case %d — replaying only its slice",
+                               e.shard, redo)
+                    drain = _DrainWorker(process_case, redo,
+                                         discard=discard_work)
+                    drain.submit(patched)
+                    case = redo + 1
+                    continue
                 for sh in shards.values():
                     if isinstance(sh, _Remote):
                         sh.stream.close()
@@ -1370,6 +1929,14 @@ def run_corpus_fleet(opts: dict, batch: int = 1024) -> int:
                      redispatches=tallies["redispatches"],
                      offspring=tallies["offspring"],
                      rewinds=tallies["rewinds"],
+                     slice_rewinds=tallies["slice_rewinds"],
+                     rewind_mode=rewind_mode,
+                     spmd=(spmd_mod.stats_snapshot()
+                           if spmd_engine is not None else None),
+                     coverage_edges=(cov.edges() if cov is not None
+                                     else None),
+                     cov_maps=tallies["cov_maps"],
+                     cov_new_edges=tallies["cov_new_edges"],
                      transport=transport.snapshot(),
                      fleet_window=fleet_window,
                      reduce_mode=reduce_mode,

@@ -123,6 +123,11 @@ class _DrainWorker:
         self._done_case = start_case - 1
         self._abandoned = False
         self.error: BaseException | None = None
+        #: the in-flight item whose processing raised `error` — the
+        #: fleet's slice-granular rewind re-serves only its dead slices
+        #: instead of replaying the whole window (one case is in flight
+        #: at a time, so this is the only outstanding work at an error)
+        self.failed_item = None
         self._t = threading.Thread(target=self._run, name="corpus-drain",
                                    daemon=True)
         self._t.start()
@@ -168,6 +173,7 @@ class _DrainWorker:
                 self._process(item)
             except BaseException as e:  # lint: broad-except-ok surfaced to main via _cv
                 with self._cv:
+                    self.failed_item = item
                     self.error = e
                     self._cv.notify_all()
                 return
@@ -203,11 +209,12 @@ def run_corpus_batch(opts: dict, batch: int = 1024) -> int:
                                 make_class_fuzzer, step_async)
 
     shards = opts.get("shards")
-    if shards is not None or opts.get("fleet_nodes"):
-        # --shards N / --fleet-nodes routes the whole run through the
-        # elastic fleet coordinator (corpus/fleet.py): per-shard arenas
-        # (or remote workers over dist), breaker-aware placement, live
-        # redistribution on shard loss
+    if shards is not None or opts.get("fleet_nodes") or opts.get("spmd"):
+        # --shards N / --fleet-nodes / --spmd routes the whole run
+        # through the elastic fleet coordinator (corpus/fleet.py):
+        # per-shard arenas (or remote workers over dist), breaker-aware
+        # placement, live redistribution on shard loss; --spmd fuses
+        # the local shards into one shard_map program per class
         from .fleet import run_corpus_fleet
 
         return run_corpus_fleet(opts, batch=batch)

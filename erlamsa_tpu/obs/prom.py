@@ -284,6 +284,11 @@ def render(counters: metrics.Counters | None = None) -> str:
                "window sync) — fire-and-forget steps excluded.")
         w.sample("erlamsa_fleet_round_trips_total",
                  transport["round_trips"])
+        w.head("erlamsa_fleet_frame_bytes_max", "gauge",
+               "Largest physical frame on any shard stream — bounded "
+               "by ERLAMSA_FRAME_CHUNK via continuation frames.")
+        w.sample("erlamsa_fleet_frame_bytes_max",
+                 transport.get("frame_bytes_max", 0))
 
     serving = snap.get("serving")
     if serving:

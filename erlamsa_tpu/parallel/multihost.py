@@ -27,6 +27,8 @@ process), so corpus IO also scales with hosts.
 
 from __future__ import annotations
 
+import os
+
 import jax
 import numpy as np
 
@@ -34,6 +36,28 @@ from .mesh import batch_sharding, lens_sharding, make_mesh, scores_sharding
 
 
 _initialized = False
+
+
+def force_host_devices_env(n: int, env: dict | None = None) -> dict:
+    """Child-process environment that makes the CPU backend expose `n`
+    devices — the harness every SPMD identity test and the tier-1
+    --spmd-smoke leg stand on (parallel/spmd.py's N-device programs are
+    verified on any box this way). Appends (never clobbers) the flag to
+    XLA_FLAGS, stripping a previous force-device setting first, and pins
+    JAX_PLATFORMS=cpu so the forced topology is the one jax sees. Must
+    take effect BEFORE jax initializes in the child — mutating the
+    parent's env after import does nothing, which is why this returns an
+    env dict for subprocess use instead of calling jax.config."""
+    e = dict(os.environ if env is None else env)
+    flags = [f for f in e.get("XLA_FLAGS", "").split()
+             if not f.startswith("--xla_force_host_platform_device_count")]
+    flags.append(f"--xla_force_host_platform_device_count={int(n)}")
+    e["XLA_FLAGS"] = " ".join(flags)
+    e["JAX_PLATFORMS"] = "cpu"
+    # a leaked pool target would route the forced-device child onto a
+    # remote backend and defeat the point
+    e.pop("PALLAS_AXON_POOL_IPS", None)
+    return e
 
 
 def init(coordinator: str, num_processes: int, process_id: int,

@@ -1,0 +1,416 @@
+"""Single-program multi-device fleet step (r19).
+
+The classic fleet (corpus/fleet.py) dispatches one compiled step per
+(shard, capacity class) and merges host-side: N local devices cost N
+dispatches per case plus a Python reduce over N result buffers. This
+module compiles the whole local board into ONE program per capacity
+class with `shard_map` over a 1-D device mesh (the DrJAX MapReduce
+recipe, PAPERS.md arxiv 2403.07128):
+
+  map     every mesh slot owns its shard's paged arena tensor
+          (uint8[num_pages, page], all shards sized to the SAME page
+          count so the [N, P, page] global view is a zero-copy
+          assembly of the per-device tensors) and runs the standard
+          gather -> fuzz_batch -> score step on its rows, keyed by
+          GLOBAL slot index exactly like the per-shard step.
+  reduce  the per-slot score rows scatter into a zero [batch, M]
+          table (pad rows carry out-of-range slots and self-drop) and
+          `lax.psum` over the mesh replicates the merged table — the
+          host-side score merge becomes one collective. A weak per-row
+          output hash rides a `lax.ppermute` ring (N-1 hops) so every
+          device sees every (hash, slot) pair and emits `dup_of`
+          hints: the earliest lower slot with an equal hash. The host
+          novelty walk memcmp-verifies each hint and skips the sha1
+          for confirmed duplicates — sha1-12 novelty stays the
+          authority, so a hash collision degrades to the normal path
+          instead of corrupting the seen-set.
+
+Byte-identity (the fleet's headline contract) is preserved by
+construction: row outputs are a pure function of (seed, case, slot),
+row padding is cyclic with out-of-range slot indices exactly like the
+per-shard dispatch, the spill overlay writes the same zero-padded
+panels, and `slices=0` / uniform `scan_len` are documented bit-neutral
+perf knobs of fuzz_batch. tests/test_spmd.py pins N in {1,2,4,8}
+forced-host-device runs against the single-device runner.
+
+The cross-host tier is unchanged: FleetPlacement still leases remote
+shards, and `run_remote_slice` (services/dist.py) re-derives the same
+mesh recipe via `run_panel` when its worker owns several local
+devices, so remote-SPMD == local-SPMD == 1-shard.
+
+Verified on CPU via ``xla_force_host_platform_device_count`` (see
+parallel/multihost.py `force_host_devices_env`).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+try:  # moved out of experimental in newer jax
+    from jax.experimental.shard_map import shard_map
+except ImportError:  # pragma: no cover - newer jax
+    from jax import shard_map  # type: ignore
+
+from ..ops import prng
+from ..ops.pipeline import fuzz_batch, resolve_priorities
+
+#: compile/dispatch-count probe (tier1 --spmd-smoke and tests assert on
+#: it): `programs` counts distinct compiled fused programs, `dispatches`
+#: counts fused launches — one per (case, capacity class) for the whole
+#: local board — and `fallbacks` counts classes served by the classic
+#: per-member path after a fused-launch failure.
+STATS = {"programs": 0, "dispatches": 0, "fallbacks": 0, "panel_dispatches": 0}
+
+
+def reset_stats():
+    for k in STATS:
+        STATS[k] = 0
+
+
+def stats_snapshot() -> dict:
+    return dict(STATS)
+
+
+# two odd 32-bit constants (splitmix64 / murmur3 finalizer multipliers):
+# the weak commutative row hash only feeds dup HINTS, every hint is
+# memcmp-verified host-side before it short-circuits anything
+_HASH_MUL = 0x9E3779B1
+_HASH_LEN = 0x85EBCA6B
+
+
+def _row_hashes(out, n_out):
+    """Weak uint32 hash per output row, masked at each row's true
+    length: position-weighted byte sum folded with the length. Cheap
+    enough to ride the ppermute ring; collisions are survivable by
+    design (hints are verified)."""
+    width = out.shape[-1]
+    pos = jnp.arange(width, dtype=jnp.uint32)
+    w = pos * jnp.uint32(_HASH_MUL) + jnp.uint32(1)
+    mask = pos[None, :] < n_out.astype(jnp.uint32)[:, None]
+    contrib = jnp.where(mask, (out.astype(jnp.uint32) + 1) * w[None, :],
+                        jnp.uint32(0))
+    h = contrib.sum(axis=1, dtype=jnp.uint32)
+    return h ^ (n_out.astype(jnp.uint32) * jnp.uint32(_HASH_LEN))
+
+
+def _dup_hints(h, idx, batch, n_devices):
+    """All-to-all (hash, slot) exchange over a ppermute ring, then per
+    local row the earliest strictly-lower global slot with an equal
+    hash (-1 = none). Pad rows carry slots >= batch so they can never
+    be hinted as duplicate targets."""
+    perm = [(i, (i + 1) % n_devices) for i in range(n_devices)]
+    hs = [h]
+    js = [idx]
+    ch, ci = h, idx
+    for _ in range(n_devices - 1):
+        ch = lax.ppermute(ch, "shard", perm)
+        ci = lax.ppermute(ci, "shard", perm)
+        hs.append(ch)
+        js.append(ci)
+    flat_h = jnp.concatenate(hs)
+    flat_i = jnp.concatenate(js)
+    eq = (flat_h[None, :] == h[:, None]) & (flat_i[None, :] < idx[:, None])
+    cand = jnp.where(eq, flat_i[None, :], jnp.int32(batch))
+    dmin = cand.min(axis=1)
+    return jnp.where(dmin < batch, dmin, jnp.int32(-1))
+
+
+def _shard_class_body(key, case_idx, pages, table, lens, idx, scores,
+                      ov_rows, ov_panel, pri, pat_pri, *, batch,
+                      n_devices, scan_len, enable_sizer, enable_csum,
+                      enable_len, enable_fuse):
+    """Per-device body under shard_map: gather this slot's rows out of
+    its arena partition, run the standard class step keyed on GLOBAL
+    slot indices, then reduce scores (psum) and exchange output hashes
+    (ppermute) on-device. Every block arrives with a leading length-1
+    mesh axis (sharded in_specs keep it); replicated inputs (key, case,
+    priorities) arrive whole."""
+    pages = pages[0]
+    table = table[0]
+    lens = lens[0]
+    idx = idx[0]
+    scores = scores[0]
+    ov_rows = ov_rows[0]
+    ov_panel = ov_panel[0]
+    data = pages[table].reshape(table.shape[0], -1)
+    if ov_rows.shape[0]:
+        # spill overlay: same zero-padded host panels the per-shard
+        # dispatch writes; members without spills carry out-of-range
+        # row ids and self-drop
+        data = data.at[ov_rows].set(ov_panel, mode="drop")
+    ckey = prng.case_key(key, case_idx)
+    keys = jax.vmap(lambda i: jax.random.fold_in(ckey, i))(idx)
+    # slices=0: bit-neutral (fuzz_batch docstring) and the rounds-sorted
+    # path is single-device machinery the mesh step does not want
+    out, n_out, sc, meta = fuzz_batch(
+        keys, data, lens, scores, pri, pat_pri, engine="fused",
+        enable_sizer=enable_sizer, enable_csum=enable_csum, slices=0,
+        scan_len=scan_len, enable_len=enable_len, enable_fuse=enable_fuse)
+    # on-device score reduce: scatter each row at its global slot (pad
+    # rows carry slots >= batch and self-drop), then one psum — the
+    # merged table replaces the host-side per-shard scatter loop
+    merged = jnp.zeros((batch, sc.shape[-1]), sc.dtype)
+    merged = merged.at[idx].set(sc, mode="drop")
+    merged = lax.psum(merged, "shard")
+    dup = _dup_hints(_row_hashes(out, n_out), idx, batch, n_devices)
+    return (out[None], n_out[None], sc[None], meta.applied[None],
+            merged, dup[None])
+
+
+def _panel_body(key, case_idx, data, lens, idx, scores, pri, pat_pri, *,
+                scan_len, enable_sizer, enable_csum, enable_len,
+                enable_fuse):
+    """Worker-side mesh body (run_panel): the remote slice's padded
+    panel splits row-wise across the worker's local devices; rows are
+    independent and keyed on GLOBAL slots, so the split is byte-neutral
+    and no collectives are needed — the coordinator still owns the
+    cross-shard reduce. Blocks arrive rank-preserved (a [kp/N, cap]
+    slice of the panel), so no mesh-axis squeeze here."""
+    ckey = prng.case_key(key, case_idx)
+    keys = jax.vmap(lambda i: jax.random.fold_in(ckey, i))(idx)
+    out, n_out, sc, meta = fuzz_batch(
+        keys, data, lens, scores, pri, pat_pri, engine="fused",
+        enable_sizer=enable_sizer, enable_csum=enable_csum, slices=0,
+        scan_len=scan_len, enable_len=enable_len, enable_fuse=enable_fuse)
+    return out, n_out, sc, meta.applied
+
+
+class SpmdClassResult:
+    """One fused class launch, not yet forced: per-member device blocks
+    stay on their devices (adoption splices from them), host views
+    materialize at force(). Exposes the classic per-entry result
+    protocol through member_view()."""
+
+    def __init__(self, engine, members, out, n_out, sc, applied, merged,
+                 dup, kp):
+        self._engine = engine
+        self._members = members  # member index order == mesh order
+        self._out = out
+        self._n_out = n_out
+        self._sc = sc
+        self._applied = applied
+        self._merged = merged
+        self._dup = dup
+        self.kp = int(kp)
+        self._forced = None
+
+    def force(self):
+        """Block on the program and build host views (drain thread).
+        Device errors surface here, exactly like a classic future's
+        force."""
+        if self._forced is None:
+            blocks = {}
+            for s in self._out.addressable_shards:
+                dev = list(s.data.devices())[0]
+                blocks[dev] = s.data[0]
+            out_blocks = [blocks[d] for d in self._engine.devices]
+            self._forced = {
+                "out": out_blocks,
+                "n_out": np.asarray(self._n_out),
+                "sc": np.asarray(self._sc),
+                "applied": np.asarray(self._applied),
+                "merged": np.asarray(self._merged),
+                "dup": np.asarray(self._dup),
+            }
+        return self._forced
+
+    def member_view(self, member: int, off: int, k: int):
+        """(data, lens, sc_rows, applied) for `k` rows starting at
+        `off` in one member's padded panel — data stays a device array
+        on that member's device (adoption source), the rest are host
+        arrays. Scores come from the psum-merged table: the producing
+        member wrote the only non-zero contribution for its slots, so
+        the merged rows equal the per-shard rows bit-for-bit."""
+        f = self.force()
+        data = f["out"][member][off:off + k]
+        lens = f["n_out"][member][off:off + k]
+        applied = f["applied"][member][off:off + k]
+        sc = f["sc"][member][off:off + k]
+        return data, lens, sc, applied
+
+    def dup_hints(self, member: int, off: int, k: int,
+                  slots) -> dict[int, int]:
+        """{slot: earlier slot with an equal weak hash} for one
+        member's real rows; callers memcmp-verify before acting."""
+        f = self.force()
+        row = f["dup"][member]
+        hints: dict[int, int] = {}
+        for j in range(k):
+            d = int(row[off + j])
+            if d >= 0:
+                hints[int(slots[j])] = d
+        return hints
+
+
+class SpmdEngine:
+    """One mesh + one program cache per fleet campaign: `run_class`
+    launches the fused gather->mutate->score->reduce program for one
+    capacity class across every local member in a single dispatch."""
+
+    def __init__(self, devices, batch: int, mutator_pri=None,
+                 pattern_pri=None, page: int = 256):
+        devices = list(devices)
+        if len(set(d.id for d in devices)) != len(devices):
+            raise ValueError("spmd mesh needs distinct devices, got "
+                             f"{[d.id for d in devices]}")
+        self.devices = devices
+        self.n = len(devices)
+        self.mesh = Mesh(np.asarray(devices), ("shard",))
+        self.batch = int(batch)
+        self.page = int(page)
+        pri, pat_pri, flags = resolve_priorities(mutator_pri, pattern_pri,
+                                                 "fused")
+        self._pri = jnp.asarray(pri)
+        self._pat = jnp.asarray(pat_pri)
+        self._flags = flags
+        self._sh3 = NamedSharding(self.mesh, P("shard", None, None))
+        self._sh2 = NamedSharding(self.mesh, P("shard", None))
+        self._programs: dict[tuple, object] = {}
+
+    def _program(self, kp: int, cap: int, num_pages: int, sp: int,
+                 sw: int, scan_len: int):
+        key = (kp, cap, num_pages, sp, sw, scan_len)
+        prog = self._programs.get(key)
+        if prog is not None:
+            return prog
+        body = partial(_shard_class_body, batch=self.batch,
+                       n_devices=self.n, scan_len=scan_len,
+                       **self._flags)
+        mapped = shard_map(
+            body, mesh=self.mesh,
+            in_specs=(P(), P(), P("shard", None, None),
+                      P("shard", None, None), P("shard", None),
+                      P("shard", None), P("shard", None, None),
+                      P("shard", None), P("shard", None, None),
+                      P(), P()),
+            out_specs=(P("shard", None, None), P("shard", None),
+                       P("shard", None, None), P("shard", None, None),
+                       P(), P("shard", None)),
+            check_rep=False)
+        prog = jax.jit(mapped)
+        self._programs[key] = prog
+        STATS["programs"] += 1
+        return prog
+
+    def assemble_pages(self, arenas):
+        """Zero-copy global view over the per-member arena tensors:
+        every member's uint8[P, page] (uniform P by fleet sizing)
+        becomes one row of a [N, P, page] sharded array."""
+        shapes = {tuple(a.shape) for a in arenas}
+        if len(shapes) != 1:
+            raise ValueError(f"spmd arenas must share a shape, got {shapes}")
+        num_pages, page = arenas[0].shape
+        return jax.make_array_from_single_device_arrays(
+            (self.n, num_pages, page), self._sh3,
+            [a[None] for a in arenas]), int(num_pages)
+
+    def run_class(self, arenas, groups, base, case: int, cap: int,
+                  scan_len: int) -> SpmdClassResult:
+        """One fused dispatch for one capacity class.
+
+        arenas: per-member device tensors (mesh order). groups: per
+        member, None or a dict with keys table int32[k, pp], lens
+        int32[k], slots (k global slot ids), sc int32[k, M],
+        spill_rows int32[s], spill_panel uint8[s, cap]. Row padding is
+        cyclic per member (identical to the per-shard dispatch); empty
+        members run all-pad rows against the zero page."""
+        n = self.n
+        pp = cap // self.page
+        ks = [len(g["slots"]) if g else 0 for g in groups]
+        kp = max(8, 1 << max(0, (max(ks) - 1)).bit_length())
+        sp = max([g["spill_rows"].shape[0] for g in groups if g] + [0])
+        sw = next(g["sc"].shape[1] for g in groups if g)
+        table = np.zeros((n, kp, pp), np.int32)
+        lens = np.zeros((n, kp), np.int32)
+        idx = np.tile(self.batch + np.arange(kp, dtype=np.int32), (n, 1))
+        sc = np.zeros((n, kp, sw), np.int32)
+        ov_rows = np.full((n, max(sp, 1)), kp, np.int32)
+        ov_panel = np.zeros((n, max(sp, 1), cap), np.uint8)
+        for i, g in enumerate(groups):
+            if not g:
+                continue
+            k = ks[i]
+            pad = np.arange(kp, dtype=np.int32) % k
+            table[i] = g["table"][pad]
+            lens[i] = g["lens"][pad]
+            idx[i, :k] = np.asarray(g["slots"], np.int32)
+            sc[i] = g["sc"][pad]
+            s = g["spill_rows"].shape[0]
+            if s:
+                ov_rows[i, :s] = g["spill_rows"]
+                ov_panel[i, :s] = g["spill_panel"]
+        if sp == 0:
+            ov_rows = ov_rows[:, :0]
+            ov_panel = ov_panel[:, :0]
+        pages, num_pages = self.assemble_pages(arenas)
+        prog = self._program(kp, cap, num_pages, ov_rows.shape[1], sw,
+                             scan_len)
+        out, n_out, sc_o, applied, merged, dup = prog(
+            base, case,
+            pages,
+            jax.device_put(table, self._sh3),
+            jax.device_put(lens, self._sh2),
+            jax.device_put(idx, self._sh2),
+            jax.device_put(sc, self._sh3),
+            jax.device_put(ov_rows, self._sh2),
+            jax.device_put(ov_panel, self._sh3),
+            self._pri, self._pat)
+        STATS["dispatches"] += 1
+        members = list(range(n))
+        return SpmdClassResult(self, members, out, n_out, sc_o, applied,
+                               merged, dup, kp)
+
+
+# -- worker-side panel mesh (remote SPMD) --------------------------------
+
+_PANEL_PROGRAMS: dict[tuple, object] = {}
+
+
+def run_panel(devices, base, case: int, idx, data, lens, sc, pri,
+              pat_pri, scan_len: int):
+    """Remote-worker mesh step: split one class panel's rows across the
+    worker's local devices with the SAME body as the per-class step —
+    rows are independent and keyed by the global slots in `idx`, so
+    sharding them is byte-neutral by the pad_batch argument. Requires
+    rows % len(devices) == 0 (callers fall back to the single-device
+    step otherwise). Returns (out, n_out, sc, applied) host arrays."""
+    devices = list(devices)
+    n = len(devices)
+    kp, cap = data.shape
+    if n < 2 or kp % n:
+        raise ValueError(f"panel of {kp} rows does not split over "
+                         f"{n} devices")
+    pri_np, pat_np, flags = resolve_priorities(
+        None if pri is None else [int(x) for x in np.asarray(pri)],
+        None if pat_pri is None else [int(x) for x in np.asarray(pat_pri)],
+        "fused")
+    mesh_key = (tuple(d.id for d in devices), pri_np.tobytes(),
+                pat_np.tobytes(), kp, cap, sc.shape[1], int(scan_len))
+    prog = _PANEL_PROGRAMS.get(mesh_key)
+    if prog is None:
+        mesh = Mesh(np.asarray(devices), ("shard",))
+        body = partial(_panel_body, scan_len=int(scan_len), **flags)
+        mapped = shard_map(
+            body, mesh=mesh,
+            in_specs=(P(), P(), P("shard", None), P("shard",),
+                      P("shard",), P("shard", None), P(), P()),
+            out_specs=(P("shard", None), P("shard",), P("shard", None),
+                       P("shard", None)),
+            check_rep=False)
+        prog = jax.jit(mapped)
+        _PANEL_PROGRAMS[mesh_key] = prog
+        STATS["programs"] += 1
+    out, n_out, sc_o, applied = prog(
+        base, int(case), jnp.asarray(data), jnp.asarray(lens),
+        jnp.asarray(idx), jnp.asarray(sc), jnp.asarray(pri_np),
+        jnp.asarray(pat_np))
+    STATS["panel_dispatches"] += 1
+    return (np.asarray(out), np.asarray(n_out), np.asarray(sc_o),
+            np.asarray(applied))

@@ -73,6 +73,24 @@ def fsync_dir(path: str) -> None:
 COVERAGE_STATE_VERSION = 1
 
 
+def _coverage_fields(coverage: dict) -> dict:
+    """Kind-stamped, versioned coverage-map fields shared by the
+    single-device and fleet checkpoints: load_coverage_maps reads both
+    (it keys on the cov_* names, not the checkpoint kind)."""
+    width = len(coverage["global"])
+    cov_ids = list(coverage["ids"])
+    cov_maps = (np.asarray(coverage["maps"], np.uint8)
+                if cov_ids else np.zeros((0, width), np.uint8))
+    return dict(
+        cov_kind=np.asarray("edges", "U8"),
+        cov_version=np.asarray(COVERAGE_STATE_VERSION, np.int64),
+        cov_map_bytes=np.asarray(width, np.int64),
+        cov_ids=np.asarray(cov_ids, "U64"),
+        cov_maps=cov_maps,
+        cov_global=np.asarray(coverage["global"], np.uint8),
+    )
+
+
 def save_state(path: str, seed, case_idx: int, scores,
                host_scores: dict | None = None,
                host_scores_post: dict | None = None,
@@ -125,18 +143,7 @@ def save_state(path: str, seed, case_idx: int, scores,
             ),
         )
     if coverage is not None:
-        width = len(coverage["global"])
-        cov_ids = list(coverage["ids"])
-        cov_maps = (np.asarray(coverage["maps"], np.uint8)
-                    if cov_ids else np.zeros((0, width), np.uint8))
-        fields.update(
-            cov_kind=np.asarray("edges", "U8"),
-            cov_version=np.asarray(COVERAGE_STATE_VERSION, np.int64),
-            cov_map_bytes=np.asarray(width, np.int64),
-            cov_ids=np.asarray(cov_ids, "U64"),
-            cov_maps=cov_maps,
-            cov_global=np.asarray(coverage["global"], np.uint8),
-        )
+        fields.update(_coverage_fields(coverage))
     fields["checksum"] = _checksum(fields)
 
     def _write():
@@ -195,7 +202,8 @@ def quarantine_mismatch(path: str) -> bool:
 def save_fleet_state(path: str, seed, case_idx: int, scores, seen_hashes,
                      corpus_energies: dict, epoch: int, n_shards: int,
                      classes, engine: str = "fused",
-                     events: dict | None = None) -> None:
+                     events: dict | None = None,
+                     coverage: dict | None = None) -> None:
     """Fleet-coordinator checkpoint (corpus/fleet.py --shards --state):
     per-case progress plus everything the resumed coordinator needs to
     continue byte-identically — scheduler scores, the global seen-hash
@@ -235,6 +243,10 @@ def save_fleet_state(path: str, seed, case_idx: int, scores, seen_hashes,
         fields["events_kinds"] = np.asarray(ev_kinds, "U64")
         fields["events_counts"] = np.asarray(
             [int(events[k]) for k in ev_kinds], np.int64)
+    if coverage is not None:
+        # r19 fleet coverage: same kind-stamped fields as save_state —
+        # load_coverage_maps reads them off either checkpoint kind
+        fields.update(_coverage_fields(coverage))
     fields["checksum"] = _checksum(fields)
 
     def _write():

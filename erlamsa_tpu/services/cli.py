@@ -208,6 +208,17 @@ def build_parser() -> argparse.ArgumentParser:
                         "fixed -s; a lost shard redistributes across "
                         "survivors instead of falling back to the host "
                         "(default: single-device runner)")
+    p.add_argument("--spmd", action="store_true",
+                   help="single-program fleet (parallel/spmd.py): run "
+                        "every local shard's gather→mutate→score as ONE "
+                        "shard_map-compiled program over the device mesh "
+                        "with on-device novelty/score reduce — one "
+                        "dispatch per (case, capacity class) instead of "
+                        "one per shard. Without --shards the fleet is "
+                        "sized to jax.devices(); byte-identical to "
+                        "--shards N and to the single-device runner at "
+                        "a fixed -s. Verify on any box with XLA_FLAGS="
+                        "--xla_force_host_platform_device_count=8")
     p.add_argument("--arena-pages", type=int, default=None, metavar="N",
                    help="arena page count (default: 2x the pages the "
                         "store needs, min 64 — eviction/spill handle "
@@ -291,6 +302,14 @@ def build_parser() -> argparse.ArgumentParser:
                         "folds case N's reduce into the drain worker "
                         "while case N+1 maps; 'boundary' is the lockstep "
                         "fallback — both are byte-identical")
+    p.add_argument("--fleet-rewind", choices=("slice", "full"),
+                   default="slice",
+                   help="FleetShardLost replay granularity: 'slice' "
+                        "(default) re-dispatches only the lost shard's "
+                        "slice of the aborted case to the post-migration "
+                        "owners (surviving streams stay open); 'full' "
+                        "replays the whole case from scratch — both are "
+                        "byte-identical at a fixed -s")
     p.add_argument("--node", default=None, help="join a parent node host:port")
     p.add_argument("--svcport", type=int, default=17771,
                    help="distribution/control port")
@@ -359,14 +378,16 @@ def build_parser() -> argparse.ArgumentParser:
 def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
 
-    if ((args.shards is not None or args.fleet_nodes)
-            and (args.struct_kernels or args.struct != "off")):
+    fleet_mode = (args.shards is not None or args.fleet_nodes
+                  or args.spmd)
+    if fleet_mode and (args.struct_kernels or args.struct != "off"):
         # hard error, not a printed notice: nobody should believe struct
         # kernels ran fleet-wide when the overlay is single-device only
         raise SystemExit(
             "erlamsa-tpu: --struct is single-device only (the span-splice "
-            "overlay routes against one arena): drop --shards/--fleet-nodes "
-            "to run the struct overlay, or drop --struct to run the fleet")
+            "overlay routes against one arena): drop --shards/"
+            "--fleet-nodes/--spmd to run the struct overlay, or drop "
+            "--struct to run the fleet")
 
     if args.distill and not args.coverage:
         raise SystemExit("erlamsa-tpu: --distill requires --coverage "
@@ -374,11 +395,15 @@ def main(argv=None) -> int:
     if args.coverage and not args.feedback:
         raise SystemExit("erlamsa-tpu: --coverage requires --feedback "
                          "(coverage gates the feedback runner's adoption)")
-    if args.coverage and (args.shards is not None or args.fleet_nodes):
+    # r19: --coverage composes with the fleet (per-shard attribution
+    # ledgers + window-fence OR-reduce, corpus/fleet.py); only the
+    # end-of-run distillation still needs the single-device runner
+    if args.distill and fleet_mode:
         raise SystemExit(
-            "erlamsa-tpu: --coverage is single-device only (the hub's "
-            "sample ledger maps (case, slot) against one schedule): drop "
-            "--shards/--fleet-nodes to run with coverage")
+            "erlamsa-tpu: --distill is single-device only (set-cover "
+            "runs over the runner's end-of-run tensor): drop --shards/"
+            "--fleet-nodes/--spmd to distill, or drop --distill to run "
+            "the fleet with coverage")
 
     gen_opts = None
     if args.gen:
@@ -404,13 +429,13 @@ def main(argv=None) -> int:
                 f"README.md, 'Generation-based fuzzing')")
         gen_opts = {"grammar": grammar, "compiled": compiled,
                     "label": label, "n": gen_count}
-    if args.gen and (args.shards is not None or args.fleet_nodes):
+    if args.gen and fleet_mode:
         # hard error, not a silent ignore: generation is single-device
         # first (one panel seeds one store before the campaign starts)
         raise SystemExit(
             "erlamsa-tpu: --gen is single-device only for now: drop "
-            "--shards/--fleet-nodes to run generate-then-mutate, or drop "
-            "--gen to run the fleet")
+            "--shards/--fleet-nodes/--spmd to run generate-then-mutate, "
+            "or drop --gen to run the fleet")
     if args.gfcomms is not None and not args.gen:
         raise SystemExit("erlamsa-tpu: --gfcomms requires --gen GRAMMAR "
                          "(the grammar to serve)")
@@ -542,10 +567,12 @@ def main(argv=None) -> int:
         "pipeline": args.pipeline,
         "layout": args.layout,
         "shards": args.shards,
+        "spmd": args.spmd,
         "fleet_nodes": ([s for s in args.fleet_nodes.split(",") if s]
                         if args.fleet_nodes else None),
         "fleet_window": args.fleet_window,
         "fleet_reduce": args.fleet_reduce,
+        "fleet_rewind": args.fleet_rewind,
         "arena_pages": args.arena_pages,
         "arena_page": args.arena_page,
         "arena_classes": args.arena_classes,

@@ -198,24 +198,76 @@ def _read_frame(f) -> tuple[dict, bytes] | None:
     return json.loads(hdr), blob
 
 
+#: physical frame payload ceiling (r19): a blob larger than this is
+#: split into continuation frames so one oversized step/snapshot can
+#: never monopolize a stream's socket buffer for seconds — the max
+#: PHYSICAL frame on the wire stays bounded and observable
+#: (TransportTally.frame_bytes_max / erlamsa_fleet_frame_bytes_max)
+FRAME_CHUNK = int(os.environ.get("ERLAMSA_FRAME_CHUNK", str(4 << 20)))
+
+
+def _frames_for(header: dict, blob: bytes = b"") -> list[bytes]:
+    """Split one LOGICAL frame into its physical wire frames. Blobs at
+    or under FRAME_CHUNK ride a single frame byte-identical to the r15
+    codec; larger blobs become a first frame carrying the header plus a
+    ``_cont`` count and chunk 0, then ``{"op": "_cont", "i": k}``
+    continuation frames with the remaining chunks. Deterministic in
+    (header, blob), so the receive side can re-run it to reproduce the
+    exact wire length for transport accounting."""
+    if len(blob) <= FRAME_CHUNK:
+        return [_pack_frame(header, blob)]  # lint: span-coverage-ok codec primitive; send/recv wrapper callers carry the span
+    parts = [blob[i:i + FRAME_CHUNK]
+             for i in range(0, len(blob), FRAME_CHUNK)]
+    frames = [_pack_frame({**header, "_cont": len(parts) - 1}, parts[0])]  # lint: span-coverage-ok codec primitive; send/recv wrapper callers carry the span
+    frames.extend(_pack_frame({"op": "_cont", "i": i}, p)  # lint: span-coverage-ok codec primitive; send/recv wrapper callers carry the span
+                  for i, p in enumerate(parts[1:], 1))
+    return frames
+
+
+def _read_frames(f) -> tuple[dict, bytes] | None:
+    """Read one LOGICAL frame: the r15 single-frame read plus r19
+    continuation reassembly. Continuations must arrive in order on the
+    same stream (frames are never interleaved within one connection);
+    any gap or mislabel raises ValueError like a garbled frame."""
+    got = _read_frame(f)  # lint: span-coverage-ok codec primitive; send/recv wrapper callers carry the span
+    if got is None:
+        return None
+    header, blob = got
+    more = int(header.pop("_cont", 0))
+    if more <= 0:
+        return header, blob
+    chunks = [blob]
+    for i in range(1, more + 1):
+        nxt = _read_frame(f)  # lint: span-coverage-ok codec primitive; send/recv wrapper callers carry the span
+        if (nxt is None or nxt[0].get("op") != "_cont"
+                or int(nxt[0].get("i", -1)) != i):
+            raise ValueError("truncated chunked frame")
+        chunks.append(nxt[1])
+    return header, b"".join(chunks)
+
+
 def _shard_frame_send(sock: socket.socket, header: dict,
-                      blob: bytes = b"") -> int:
-    """Coordinator -> worker framed transmission. Two fault sites:
+                      blob: bytes = b"") -> tuple[int, int]:
+    """Coordinator -> worker framed transmission. Two fault sites, each
+    fired ONCE per LOGICAL frame regardless of chunking (the r14
+    per-invocation chaos counters keep counting sends, not chunks):
     dist.shard.frame (the codec — a frame-level fault reads as a shard
     loss exactly like a wire fault) and dist.shard.send (the wire, the
-    same site the legacy JSON client fires). Returns bytes written."""
+    same site the legacy JSON client fires). Returns (total bytes
+    written, largest physical frame)."""
     chaos.fault_point("dist.shard.frame")
-    payload = _pack_frame(header, blob)  # lint: span-coverage-ok codec primitive; ShardStream callers carry the span
+    parts = _frames_for(header, blob)  # lint: span-coverage-ok codec primitive; ShardStream callers carry the span
     chaos.fault_point("dist.shard.send")
-    sock.sendall(payload)
-    return len(payload)
+    sock.sendall(b"".join(parts))
+    return sum(len(p) for p in parts), max(len(p) for p in parts)
 
 
 def _shard_frame_recv(f) -> tuple[dict, bytes] | None:
     """Coordinator-side framed reply read (fault site dist.shard.recv,
-    shared with the legacy JSON client)."""
+    shared with the legacy JSON client; fires once per logical frame —
+    continuation reads ride the same invocation)."""
     chaos.fault_point("dist.shard.recv")
-    return _read_frame(f)  # lint: span-coverage-ok codec primitive; read_reply callers carry the span
+    return _read_frames(f)  # lint: span-coverage-ok codec primitive; read_reply callers carry the span
 
 
 def _node_frame_send(sock: socket.socket, header: dict,
@@ -225,7 +277,8 @@ def _node_frame_send(sock: socket.socket, header: dict,
     a dist.shard.* chaos spec keeps meaning 'the coordinator's view of
     the wire' with per-invocation counters the r14 tests rely on."""
     chaos.fault_point("dist.send")
-    payload = _pack_frame(header, blob)  # lint: span-coverage-ok codec primitive; ShardHost op handlers carry the span
+    parts = _frames_for(header, blob)  # lint: span-coverage-ok codec primitive; ShardHost op handlers carry the span
+    payload = b"".join(parts)
     sock.sendall(payload)
     return len(payload)
 
@@ -233,7 +286,7 @@ def _node_frame_send(sock: socket.socket, header: dict,
 def _node_frame_recv(f) -> tuple[dict, bytes] | None:
     """Worker-side frame read (site dist.recv, like _recv_json)."""
     chaos.fault_point("dist.recv")
-    return _read_frame(f)  # lint: span-coverage-ok codec primitive; ShardHost op handlers carry the span
+    return _read_frames(f)  # lint: span-coverage-ok codec primitive; ShardHost op handlers carry the span
 
 
 class TransportTally:
@@ -249,20 +302,30 @@ class TransportTally:
         self.bytes_sent = 0
         self.bytes_recv = 0
         self.round_trips = 0
+        #: largest PHYSICAL frame seen in either direction (max-merge,
+        #: r19): with chunked continuation frames this stays bounded by
+        #: FRAME_CHUNK + header overhead — the observable proof that no
+        #: oversized step/snapshot monopolized a stream
+        self.frame_bytes_max = 0
 
-    def add(self, sent: int = 0, recv: int = 0, round_trips: int = 0):
+    def add(self, sent: int = 0, recv: int = 0, round_trips: int = 0,
+            frame_bytes: int = 0):
         with self._lock:
             self.bytes_sent += int(sent)
             self.bytes_recv += int(recv)
             self.round_trips += int(round_trips)
+            if int(frame_bytes) > self.frame_bytes_max:
+                self.frame_bytes_max = int(frame_bytes)
         metrics.GLOBAL.record_transport(sent=sent, recv=recv,
-                                        round_trips=round_trips)
+                                        round_trips=round_trips,
+                                        frame_bytes=frame_bytes)
 
     def snapshot(self) -> dict:
         with self._lock:
             return {"bytes_sent": self.bytes_sent,
                     "bytes_recv": self.bytes_recv,
-                    "round_trips": self.round_trips}
+                    "round_trips": self.round_trips,
+                    "frame_bytes_max": self.frame_bytes_max}
 
 
 def validate_shard_reply(resp: dict | None, shard: int, epoch: int | None,
@@ -313,7 +376,8 @@ def validate_shard_reply(resp: dict | None, shard: int, epoch: int | None,
 
 #: the per-lease configuration keys a shard_lease ships to the worker —
 #: everything run_remote_slice needs to reproduce the local bytes
-LEASE_CFG_KEYS = ("seed", "pri", "classes", "device_max", "batch")
+LEASE_CFG_KEYS = ("seed", "pri", "classes", "device_max", "batch",
+                  "spmd")
 
 
 def new_campaign_token() -> str:
@@ -367,7 +431,7 @@ class RemoteShard:
         configuration the worker caches for the lease's lifetime."""
         msg = {"op": "shard_lease", "shard": self.id, "epoch": int(epoch),
                "token": self.token}
-        msg.update({k: cfg[k] for k in LEASE_CFG_KEYS})
+        msg.update({k: cfg.get(k) for k in LEASE_CFG_KEYS})
         return self._call(msg, "shard_leased")
 
     def probe(self) -> dict:
@@ -468,7 +532,7 @@ class ShardStream:
             with self._wlock:
                 if self._sock is None:
                     self._connect()
-                n = _shard_frame_send(self._sock, header, blob)  # lint: span-coverage-ok transport primitive; dispatch spans live in corpus/fleet.py callers
+                n, fmax = _shard_frame_send(self._sock, header, blob)  # lint: span-coverage-ok transport primitive; dispatch spans live in corpus/fleet.py callers
         except StaleEpochError:
             raise
         except (OSError, ValueError) as e:
@@ -476,7 +540,7 @@ class ShardStream:
             raise RemoteShardError(
                 f"shard {self.id} @{self.endpoint()}: {e}") from e
         if self.tally is not None:
-            self.tally.add(sent=n)
+            self.tally.add(sent=n, frame_bytes=fmax)
 
     def read_reply(self, expect: str, epoch: int | None,
                    case: int | None = None,
@@ -504,11 +568,12 @@ class ShardStream:
         header, blob = got
         if self.tally is not None:
             # exact: the worker packs replies with the same compact
-            # separators, so re-encoding reproduces the wire length
-            hlen = len(json.dumps(header,
-                                  separators=(",", ":")).encode())
-            self.tally.add(recv=len(FRAME_MAGIC) + _FRAME_HDR.size
-                           + hlen + len(blob))
+            # separators AND the same deterministic chunk split, so
+            # re-running the splitter reproduces the wire length and
+            # the largest physical frame the reply actually used
+            parts = _frames_for(header, blob)  # lint: span-coverage-ok accounting re-split, no wire traffic; reply-consuming callers carry the span
+            self.tally.add(recv=sum(len(p) for p in parts),
+                           frame_bytes=max(len(p) for p in parts))
         validate_shard_reply(header, self.id, epoch, expect, case=case)
         return header, blob
 
@@ -668,7 +733,8 @@ class ShardHost:
                 outs, sc_out, applied, shapes = run_remote_slice(
                     tuple(cfg["seed"]), case, int(cfg["batch"]), slots,
                     payloads, msg.get("scores", []), cfg["pri"],
-                    cfg["classes"], int(cfg["device_max"]))
+                    cfg["classes"], int(cfg["device_max"]),
+                    spmd=bool(cfg.get("spmd")))
             except Exception as e:  # lint: broad-except-ok a worker device failure becomes a protocol-level shard_error the coordinator revokes on, not a dead handler thread
                 logger.log("warning", "shard host: step failed shard=%d "
                            "case=%d: %s", shard, case, e)
@@ -779,7 +845,8 @@ class ShardHost:
                 outs, sc_out, applied, shapes = run_remote_slice(
                     tuple(cfg["seed"]), case, int(cfg["batch"]), slots,
                     payloads, header.get("scores", []), cfg["pri"],
-                    cfg["classes"], int(cfg["device_max"]))
+                    cfg["classes"], int(cfg["device_max"]),
+                    spmd=bool(cfg.get("spmd")))
         except Exception as e:  # lint: broad-except-ok a worker device failure becomes a protocol-level shard_error the coordinator revokes on, not a dead stream thread
             logger.log("warning", "shard host: framed step failed "
                        "shard=%d case=%d: %s", shard, case, e)

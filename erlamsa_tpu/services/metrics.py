@@ -107,7 +107,7 @@ class Counters:
         # round trips — the erlamsa_fleet_transport_bytes_total{dir}
         # and erlamsa_fleet_round_trips_total counters in /metrics
         self.transport = {"bytes_sent": 0, "bytes_recv": 0,
-                          "round_trips": 0}
+                          "round_trips": 0, "frame_bytes_max": 0}
         # reduce-overlap ratio (corpus/fleet.py): fraction of the
         # host-side merge hidden behind remote shard compute —
         # gauge-style, set not summed
@@ -239,13 +239,17 @@ class Counters:
             t["rejected"] += rejected
 
     def record_transport(self, sent: int = 0, recv: int = 0,
-                         round_trips: int = 0):
+                         round_trips: int = 0, frame_bytes: int = 0):
         """Fleet transport deltas (framed shard streams): raw wire bytes
-        by direction, plus awaited round trips."""
+        by direction, plus awaited round trips. ``frame_bytes`` is the
+        largest physical frame of the call and max-merges (r19 chunked
+        continuation frames keep it bounded by FRAME_CHUNK)."""
         with self._lock:
             self.transport["bytes_sent"] += int(sent)
             self.transport["bytes_recv"] += int(recv)
             self.transport["round_trips"] += int(round_trips)
+            if int(frame_bytes) > self.transport["frame_bytes_max"]:
+                self.transport["frame_bytes_max"] = int(frame_bytes)
 
     def set_reduce_overlap(self, ratio: float):
         """Fraction of the fleet's host-side merge hidden behind shard

@@ -60,6 +60,16 @@
 # trips bounded by shards*(ceil(cases/W)+3) (corpus/fleet.py,
 # services/dist.py, services/checkpoint.py).
 #
+# scripts/tier1.sh --spmd-smoke additionally runs the r19 fused fleet
+# on a FORCED 8-device CPU board (a subprocess under
+# XLA_FLAGS=--xla_force_host_platform_device_count=8): one corpus
+# campaign three ways — single-device runner, classic 8-shard fleet,
+# and --spmd (one shard_map-compiled gather→mutate→score→reduce
+# program over the whole board) — and asserts the r19 contract: all
+# three byte-identical, exactly ONE fused dispatch per (case,
+# capacity class) with ONE compiled program (the compile-count probe:
+# parallel/spmd.py STATS), and zero per-shard fallbacks.
+#
 # scripts/tier1.sh --serve-smoke additionally boots the faas server
 # with the continuous-batching engine (services/serving.py), checks one
 # request answers byte-identically to a flush-mode server at the same
@@ -109,6 +119,7 @@ obs_smoke=0
 arena_smoke=0
 fleet_smoke=0
 dist_fleet_smoke=0
+spmd_smoke=0
 serve_smoke=0
 struct_smoke=0
 monitor_smoke=0
@@ -123,6 +134,7 @@ while [ $# -gt 0 ]; do
     --arena-smoke) arena_smoke=1; shift ;;
     --fleet-smoke) fleet_smoke=1; shift ;;
     --dist-fleet-smoke) dist_fleet_smoke=1; shift ;;
+    --spmd-smoke) spmd_smoke=1; shift ;;
     --serve-smoke) serve_smoke=1; shift ;;
     --struct-smoke) struct_smoke=1; shift ;;
     --gen-smoke) gen_smoke=1; shift ;;
@@ -592,6 +604,73 @@ print(f"DIST_FLEET_SMOKE={'ok' if ok else 'FAIL'} bytes={len(blob1)} "
       f"round_trips={rt6}<=bound={rt_bound} "
       f"migrations={kinds} redispatches={st3['redispatches']} "
       f"start_case={st5.get('start_case')}")
+sys.exit(0 if ok else 1)
+EOF2
+  rc=$?
+fi
+
+if [ $rc -eq 0 ] && [ $spmd_smoke -eq 1 ]; then
+  echo "== spmd smoke: fused 8-device fleet identity + one-dispatch-per-case probe =="
+  timeout -k 10 900 env JAX_PLATFORMS=cpu \
+    XLA_FLAGS=--xla_force_host_platform_device_count=8 python - <<'EOF2'
+import os, shutil, sys, tempfile
+
+from erlamsa_tpu.corpus.runner import run_corpus_batch
+from erlamsa_tpu.parallel import spmd as spmd_mod
+from erlamsa_tpu.services import chaos
+
+SEED = (11, 22, 33)
+# one capacity class: the dispatch count is exactly cases x 1
+SEEDS = [b"alpha seed one", b"bravo seed two!", b"dd",
+         b"echo echo x", b"golf golf golf", b"hotel hotel"]
+N = 2
+
+
+def one_run(root, tag, opts_extra):
+    chaos.configure(None)
+    outdir = os.path.join(root, f"out-{tag}")
+    os.makedirs(outdir)
+    stats = {}
+    opts = {
+        "corpus_dir": os.path.join(root, f"corpus-{tag}"),
+        "corpus": list(SEEDS),
+        "feedback": True,
+        "seed": SEED,
+        "n": N,
+        "output": os.path.join(outdir, "%n.out"),
+        "_stats": stats,
+    }
+    opts.update(opts_extra)
+    rc = run_corpus_batch(opts, batch=8)
+    blob = b""
+    for i in range(N * 8):
+        blob += open(os.path.join(outdir, f"{i}.out"), "rb").read()
+    return rc, blob, stats
+
+
+import jax
+assert len(jax.devices()) == 8, jax.devices()
+root = tempfile.mkdtemp(prefix="tier1_spmd_smoke_")
+try:
+    rc1, blob1, _ = one_run(root, "single",
+                            {"pipeline": "sync", "layout": "arena"})
+    rc2, blob2, st2 = one_run(root, "sh8", {"shards": 8})
+    spmd_mod.reset_stats()
+    rc3, blob3, st3 = one_run(root, "spmd", {"spmd": True})
+finally:
+    shutil.rmtree(root, ignore_errors=True)
+sp = st3["spmd"]
+ok = (rc1 == rc2 == rc3 == 0 and blob1
+      and blob2 == blob1 and blob3 == blob1
+      and st3["fleet"]["shards"] == 8
+      and st3["oracle_cases"] == 0 and st3["migrations"] == []
+      and sp["fallbacks"] == 0
+      and sp["dispatches"] == N      # ONE dispatch per (case, class)
+      and sp["programs"] == 1)       # ONE compile serves every case
+print(f"SPMD_SMOKE={'ok' if ok else 'FAIL'} bytes={len(blob1)} "
+      f"identical_8shard={blob2 == blob1} identical_spmd={blob3 == blob1} "
+      f"dispatches={sp['dispatches']} programs={sp['programs']} "
+      f"fallbacks={sp['fallbacks']}")
 sys.exit(0 if ok else 1)
 EOF2
   rc=$?

@@ -748,7 +748,11 @@ def test_mid_window_reply_loss_rewinds_byte_identically(tmp_path):
                             opts_extra={"fleet_nodes": nodes,
                                         "fleet_window": 2})
         assert rc == 0
-        assert st["rewinds"] >= 1
+        # r19: the default rewind mode is slice-granular — a lost reply
+        # whose case is the first un-merged one replays only the dead
+        # shard's slice (slice_rewinds); any other shape falls back to
+        # the full pipeline rewind (rewinds). Either way it replayed.
+        assert st["rewinds"] + st["slice_rewinds"] >= 1
         assert [m["kind"] for m in st["migrations"]][0] == "revoke"
         assert _read_blob(tmp_path, "lost", 2) == ref
     finally:

@@ -1,0 +1,548 @@
+"""SPMD fleet tests (r19): the shard_map-fused gather→mutate→score
+path (--spmd), chunked continuation frames on the dist streams,
+slice-granular rewind, and the fleet coverage merge.
+
+Fast tests never pay an engine compile: frame codec and chaos
+semantics run at the protocol layer, apply_novelty extensions are
+pure, coverage-merge rides the pre-compile host-oracle path (the
+gating CoverageIndex's fold kernel is a tiny fixed-shape op), and the
+fuzzlint closure check is pure AST. Anything that dispatches a real
+spmd program (the N-device byte-identity pins) is @pytest.mark.slow.
+
+The conftest forces an 8-device CPU board
+(xla_force_host_platform_device_count), which is exactly the harness
+parallel/multihost.force_host_devices_env builds for subprocess legs.
+"""
+
+import io
+import os
+import socket
+
+import numpy as np
+import pytest
+
+import erlamsa_tpu
+from erlamsa_tpu.corpus import feedback as fb
+from erlamsa_tpu.corpus.fleet import apply_novelty
+from erlamsa_tpu.corpus.store import CorpusStore
+from erlamsa_tpu.parallel import multihost
+from erlamsa_tpu.parallel import spmd as spmd_mod
+from erlamsa_tpu.services import chaos, dist, metrics
+from erlamsa_tpu.services.chaos import InjectedFault
+from erlamsa_tpu.services.dist import (LEASE_CFG_KEYS, ParentServer,
+                                       TransportTally, _frames_for,
+                                       _pack_frame, _read_frames,
+                                       _shard_frame_recv,
+                                       _shard_frame_send)
+
+SEED = (7, 7, 7)
+#: six seeds of distinct sizes spanning two capacity classes (the
+#: test_fleet.py corpus — exercises multi-class spmd dispatch)
+SEEDS = [bytes([65 + i]) * (30 * (i + 1)) for i in range(6)]
+#: six tiny seeds in ONE capacity class: with batch 8 the member group
+#: size never exceeds 8, so every case compiles the same program and
+#: dispatch counts are exactly pinnable (dispatches == cases)
+SEEDS_1CLASS = [b"alpha seed one", b"bravo seed two!", b"dd",
+                b"echo echo x", b"golf golf golf", b"hotel hotel"]
+SEED_1CLASS = (11, 22, 33)
+
+
+@pytest.fixture(autouse=True)
+def _chaos_disarmed():
+    chaos.configure(None)
+    yield
+    chaos.configure(None)
+    metrics.GLOBAL.set_degraded(False)
+    metrics.GLOBAL.set_coverage_degraded(False)
+
+
+# ---- chunked continuation frames (satellite: streamed panels) -----------
+
+
+def test_frames_small_blob_is_single_frame_passthrough():
+    """Blobs at or under FRAME_CHUNK must produce the exact r15 frame —
+    the chunked codec is wire-compatible with old captures."""
+    hdr = {"op": "shard_step", "case": 3}
+    blob = b"x" * 100
+    parts = _frames_for(dict(hdr), blob)
+    assert parts == [_pack_frame(hdr, blob)]
+    got = _read_frames(io.BytesIO(parts[0]))
+    assert got == (hdr, blob)
+
+
+def test_frames_chunked_roundtrip_bounded_and_ordered(monkeypatch):
+    monkeypatch.setattr(dist, "FRAME_CHUNK", 16)
+    hdr = {"op": "shard_step", "case": 1}
+    blob = bytes(range(50))
+    parts = _frames_for(dict(hdr), blob)
+    assert len(parts) == 4  # ceil(50/16)
+    # every physical frame is bounded: chunk + magic/len + json header
+    assert all(len(p) <= 16 + 12 + 120 for p in parts)
+    got = _read_frames(io.BytesIO(b"".join(parts)))
+    assert got is not None
+    rh, rb = got
+    assert rb == blob
+    # the continuation plumbing never leaks into the logical header
+    assert rh == hdr and "_cont" not in rh
+    # a dropped continuation is a garbled stream, never a short read
+    with pytest.raises(ValueError, match="truncated chunked frame"):
+        _read_frames(io.BytesIO(b"".join(parts[:-1])))
+    # reordered continuations are equally fatal
+    bad = b"".join([parts[0], parts[2], parts[1], parts[3]])
+    with pytest.raises(ValueError, match="truncated chunked frame"):
+        _read_frames(io.BytesIO(bad))
+
+
+def test_shard_frame_send_chunks_over_socket(monkeypatch):
+    monkeypatch.setattr(dist, "FRAME_CHUNK", 16)
+    hdr = {"op": "shard_step", "case": 0}
+    blob = bytes(range(200)) * 2
+    a, b = socket.socketpair()
+    try:
+        total, fmax = _shard_frame_send(a, dict(hdr), blob)
+        parts = _frames_for(dict(hdr), blob)
+        assert total == sum(len(p) for p in parts)
+        assert fmax == max(len(p) for p in parts)
+        assert fmax < total  # it really chunked
+        f = b.makefile("rb")
+        got = _shard_frame_recv(f)
+        assert got == (hdr, blob)
+    finally:
+        a.close()
+        b.close()
+
+
+def test_frame_chaos_fires_once_per_logical_send(monkeypatch):
+    """dist.shard.frame counts LOGICAL sends, not chunks: a :x2 spec
+    kills exactly the first two send calls even when each call would
+    put several physical frames on the wire."""
+    monkeypatch.setattr(dist, "FRAME_CHUNK", 8)
+    blob = b"q" * 40  # 5 chunks per logical frame
+    chaos.configure("dist.shard.frame:x2", seed=7)
+    a, b = socket.socketpair()
+    try:
+        with pytest.raises(InjectedFault):
+            _shard_frame_send(a, {"op": "shard_step"}, blob)
+        with pytest.raises(InjectedFault):
+            _shard_frame_send(a, {"op": "shard_step"}, blob)
+        # healed: the third logical send delivers every chunk
+        _shard_frame_send(a, {"op": "shard_step", "case": 2}, blob)
+        got = _shard_frame_recv(b.makefile("rb"))
+        assert got == ({"op": "shard_step", "case": 2}, blob)
+    finally:
+        chaos.configure(None)
+        a.close()
+        b.close()
+
+
+def test_transport_tally_tracks_frame_bytes_max():
+    t = TransportTally()
+    t.add(sent=500, frame_bytes=300)
+    t.add(recv=900, frame_bytes=120)  # smaller: max-merge keeps 300
+    t.add(sent=10, round_trips=1, frame_bytes=301)
+    snap = t.snapshot()
+    assert snap["frame_bytes_max"] == 301
+    assert snap["bytes_sent"] == 510 and snap["round_trips"] == 1
+    # the mirror into process metrics renders as a prom gauge
+    from erlamsa_tpu.obs import prom
+
+    text = prom.render()
+    line = [ln for ln in text.splitlines()
+            if ln.startswith("erlamsa_fleet_frame_bytes_max ")]
+    assert line and float(line[0].split()[1]) >= 301
+
+
+def test_lease_cfg_ships_spmd_flag():
+    """run_remote_slice re-derives the worker mesh from the lease: the
+    spmd flag must ride the lease config keys."""
+    assert "spmd" in LEASE_CFG_KEYS
+
+
+# ---- apply_novelty extensions (pure reduce-side semantics) --------------
+
+
+def test_apply_novelty_dup_hint_must_survive_memcmp(tmp_path):
+    """On-device ppermute duplicate hints are HINTS: an honest hint
+    (equal bytes at a lower slot) skips the sha1 without changing the
+    count; a colliding (lying) hint fails the memcmp and takes the
+    normal hash path — bytes and events match the hint-free walk."""
+    def walk(tag, dup_of):
+        store = CorpusStore(str(tmp_path / tag))
+        sid, _ = store.add(b"seed", origin="direct")
+        results = {0: b"unique a", 1: b"same", 2: b"same", 3: b"unique b"}
+        new = apply_novelty(store, [sid] * 4, results, set(), batch=4,
+                            dup_of=dup_of)
+        return new, store.meta(sid)["events"].get("new_hash", 0)
+
+    ref = walk("plain", None)
+    honest = walk("honest", {2: 1})  # slot 2 really equals slot 1
+    lying = walk("lying", {3: 0})    # slot 3 does NOT equal slot 0
+    assert honest == ref == lying == (3, 3)
+
+
+def test_apply_novelty_slot_gain_gates_covered_slots(tmp_path):
+    store = CorpusStore(str(tmp_path / "c"))
+    sid, _ = store.add(b"seed", origin="direct")
+    seen: set = set()
+    results = {0: b"lights edges", 1: b"no new edges", 2: b"uncovered"}
+    # slot 0 covered with gain, slot 1 covered without, slot 2 uncovered
+    apply_novelty(store, [sid] * 3, results, seen, batch=3,
+                  slot_gain={0: 4, 1: 0})
+    ev = store.meta(sid)["events"]
+    assert ev.get("new_cov", 0) == 1   # only the gaining covered slot
+    assert ev.get("new_hash", 0) == 1  # only the uncovered slot
+    # covered slots still interned their hashes: after degradation the
+    # same payloads are NOT re-counted as novel
+    assert apply_novelty(store, [sid] * 3, results, seen, batch=3) == 0
+
+
+# ---- forced-host-device harness -----------------------------------------
+
+
+def test_force_host_devices_env_builds_child_env():
+    parent = {"XLA_FLAGS": "--xla_abc=1 "
+                           "--xla_force_host_platform_device_count=2",
+              "PALLAS_AXON_POOL_IPS": "10.0.0.1",
+              "PATH": "/bin"}
+    e = multihost.force_host_devices_env(4, env=parent)
+    assert e["XLA_FLAGS"].split() == [
+        "--xla_abc=1", "--xla_force_host_platform_device_count=4"]
+    assert e["JAX_PLATFORMS"] == "cpu"
+    assert "PALLAS_AXON_POOL_IPS" not in e and e["PATH"] == "/bin"
+    # the parent mapping is never mutated
+    assert parent["PALLAS_AXON_POOL_IPS"] == "10.0.0.1"
+    assert "force_host_platform_device_count=2" in parent["XLA_FLAGS"]
+
+
+# ---- fuzzlint closure (satellite: spmd bodies are traced scope) ---------
+
+
+def test_spmd_bodies_are_in_traced_lint_closure():
+    """parallel/spmd.py is a kernel module for the traced-host-sync
+    rule: the shard_map bodies (key-led functions) are jit roots and
+    their module-local helpers join the closure — a host sync slipped
+    into a collective body becomes a lint finding, not a silent 8x
+    slowdown."""
+    from erlamsa_tpu.analysis.core import DEFAULT_CONFIG, Module, run_lint
+    from erlamsa_tpu.analysis.rules_device import _traced_functions
+
+    path = os.path.join(os.path.dirname(erlamsa_tpu.__file__),
+                        "parallel", "spmd.py")
+    with open(path) as f:
+        src = f.read()
+    mod = Module(path, "parallel/spmd.py", src)
+    names = {fn.name for fn in _traced_functions(mod, DEFAULT_CONFIG)}
+    assert {"_shard_class_body", "_panel_body",
+            "_row_hashes", "_dup_hints"} <= names
+    # and the module is clean under the full default rule set
+    assert run_lint([path]) == []
+
+
+# ---- end-to-end harness -------------------------------------------------
+
+
+def _run_fleet(tmp_path, tag, spec=None, n=2, batch=8, seeds=SEEDS,
+               seed=SEED, opts_extra=None):
+    """One corpus run (fleet or single-device by opts) into per-case
+    output files; returns (rc, concatenated bytes, stats)."""
+    from erlamsa_tpu.corpus.runner import run_corpus_batch
+
+    chaos.configure(spec, seed=seed[0])
+    outdir = tmp_path / f"out-{tag}"
+    outdir.mkdir(exist_ok=True)
+    stats: dict = {}
+    opts = {
+        "corpus_dir": str(tmp_path / f"corpus-{tag}"),
+        "corpus": list(seeds),
+        "seed": seed,
+        "n": n,
+        "feedback": True,
+        "output": str(outdir / "%n.out"),
+        "_stats": stats,
+    }
+    if opts_extra:
+        opts.update(opts_extra)
+    try:
+        rc = run_corpus_batch(opts, batch=batch)
+    finally:
+        chaos.configure(None)
+    blob = b""
+    for i in range(n * batch):
+        p = outdir / f"{i}.out"
+        blob += (p.read_bytes() if p.exists() else b"<missing>")
+    return rc, blob, stats
+
+
+# ---- fleet coverage merge (fast: pre-compile oracle path) ---------------
+
+
+def _hub_frame(case, slot, blob, epoch=0):
+    import zlib
+
+    return _pack_frame({"op": "cov", "case": case, "slot": slot,
+                        "epoch": epoch, "crc": zlib.crc32(blob)}, blob)
+
+
+def _start_hub():
+    from erlamsa_tpu.services.monitors import CoverageHub
+
+    return CoverageHub(port=0).start()
+
+
+def _feed_hub(hub, frames):
+    import time
+
+    with socket.create_connection((hub.host, hub.port), timeout=5) as s:
+        for case, slot, blob in frames:
+            s.sendall(_hub_frame(case, slot, blob))
+    deadline = time.monotonic() + 15
+    while time.monotonic() < deadline:
+        if hub.pending_frames() >= len(frames):
+            return
+        time.sleep(0.05)
+    raise AssertionError("hub never ingested the frames")
+
+
+def test_fleet_coverage_merges_shard_maps_at_fence(tmp_path):
+    """--coverage now composes with the fleet: frames fold into ONE
+    gating index at the coordinator, per-seed attribution lands on the
+    owning shard's ledger, and the window fence OR-reduces the ledgers
+    back to the gating map (coverage_fence_ok). Total-loss chaos keeps
+    the whole run on the pre-compile oracle path."""
+    hub = _start_hub()
+    mb = hub.map_bytes
+    full = bytes([0xFF] * 4) + bytes(mb - 4)
+    frames = [(0, 0, full)]
+    frames += [(0, s, bytes(mb)) for s in range(1, 8)]
+    frames += [(1, s, bytes(mb)) for s in range(8)]
+    _feed_hub(hub, frames)
+    ev0 = metrics.GLOBAL.snapshot()["resilience"]["events"]
+    fence0 = ev0.get("coverage_fence_ok", 0)
+    mis0 = ev0.get("coverage_fence_mismatch", 0)
+    try:
+        rc, blob, st = _run_fleet(tmp_path, "cov", spec="shard.step:*",
+                                  opts_extra={"shards": 2,
+                                              "coverage": True,
+                                              "coverage_hub": hub})
+    finally:
+        hub.stop()
+        hub.join(timeout=10)
+    assert rc == 0 and blob
+    assert st["oracle_cases"] == 2  # really the pre-compile path
+    assert st["coverage_edges"] == 32  # the one edge-lighting frame
+    assert st["cov_maps"] == len(frames)
+    assert st["cov_new_edges"] == 32
+    ev = metrics.GLOBAL.snapshot()["resilience"]["events"]
+    # one fence per case at the default window of 1, all clean
+    assert ev.get("coverage_fence_ok", 0) >= fence0 + 2
+    assert ev.get("coverage_fence_mismatch", 0) == mis0
+
+
+def test_fleet_coverage_hub_death_degrades_byte_identically(tmp_path):
+    """PR 16's degradation contract holds fleet-wide: a dead hub flips
+    the campaign to sticky hash-novelty and the bytes match the
+    coverage-off run exactly."""
+    rc, ref, _ = _run_fleet(tmp_path, "plain", spec="shard.step:*",
+                            opts_extra={"shards": 2})
+    assert rc == 0
+    chaos.configure("monitor.ingest:*", seed=7)
+    hub = _start_hub()
+    try:
+        import time
+
+        with socket.create_connection((hub.host, hub.port),
+                                      timeout=5) as s:
+            for i in range(6):  # fault storm trips the ingest breaker
+                s.sendall(_hub_frame(0, i, bytes(hub.map_bytes)))
+        deadline = time.monotonic() + 15
+        while hub.alive() and time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert not hub.alive()
+        chaos.configure(None)
+        rc, blob, st = _run_fleet(tmp_path, "dead", spec="shard.step:*",
+                                  opts_extra={"shards": 2,
+                                              "coverage": True,
+                                              "coverage_hub": hub})
+    finally:
+        chaos.configure(None)
+        hub.stop()
+        hub.join(timeout=10)
+    assert rc == 0
+    assert blob == ref  # degradation never changes bytes
+    ev = metrics.GLOBAL.snapshot()["resilience"]["events"]
+    assert ev.get("coverage_lost", 0) >= 1
+    assert metrics.GLOBAL.snapshot()["coverage"]["degraded"]
+
+
+# ---- --spmd: fast oracle-path pins --------------------------------------
+
+
+def test_spmd_flag_total_loss_oracle_identity_and_sizing(tmp_path):
+    """--spmd never changes the byte contract even when every shard is
+    dead before a single compile; bare --spmd sizes the fleet to the
+    local board (one mesh slot per device)."""
+    rc, ref, _ = _run_fleet(tmp_path, "classic", spec="shard.step:*",
+                            opts_extra={"shards": 2})
+    rc2, blob, st = _run_fleet(tmp_path, "spmd2", spec="shard.step:*",
+                               opts_extra={"shards": 2, "spmd": True})
+    assert rc == rc2 == 0 and blob == ref
+    assert st["oracle_cases"] == 2
+    # bare --spmd: fleet == the 8-device forced board
+    rc3, blob3, st3 = _run_fleet(tmp_path, "spmd8", spec="shard.step:*",
+                                 opts_extra={"spmd": True})
+    assert rc3 == 0 and st3["fleet"]["shards"] == 8
+    assert blob3 == ref  # shard count never changes bytes either
+
+
+# ---- --spmd: compiled identity pins (slow) ------------------------------
+
+
+@pytest.mark.slow
+def test_spmd_device_count_byte_identity_and_dispatch_pin(tmp_path):
+    """THE r19 acceptance pin: --spmd over N ∈ {1, 2, 4, 8} mesh
+    members is byte-identical to the single-device runner, with
+    exactly ONE fused dispatch per (case, capacity class) and zero
+    per-shard fallbacks. Single-class seeds make the count exact:
+    dispatches == cases, one compiled program per run."""
+    n = 2
+    rc, ref, _ = _run_fleet(tmp_path, "runner", n=n, seeds=SEEDS_1CLASS,
+                            seed=SEED_1CLASS,
+                            opts_extra={"pipeline": "sync",
+                                        "layout": "arena"})
+    assert rc == 0
+    for shards in (1, 2, 4, 8):
+        spmd_mod.reset_stats()
+        rc, blob, st = _run_fleet(tmp_path, f"spmd{shards}", n=n,
+                                  seeds=SEEDS_1CLASS, seed=SEED_1CLASS,
+                                  opts_extra={"shards": shards,
+                                              "spmd": True})
+        assert rc == 0
+        assert blob == ref, f"--spmd --shards {shards} diverged"
+        sp = st["spmd"]
+        assert sp["fallbacks"] == 0
+        assert sp["dispatches"] == n  # one per (case, class): 1 class
+        assert sp["programs"] == 1   # every case reuses the program
+        assert st["oracle_cases"] == 0 and st["migrations"] == []
+
+
+@pytest.mark.slow
+def test_spmd_multi_class_identity(tmp_path):
+    """Two capacity classes: the fused path dispatches once per class
+    present in each case's schedule and still matches the classic
+    per-shard fleet byte-for-byte."""
+    n = 2
+    rc, ref, _ = _run_fleet(tmp_path, "classic", n=n,
+                            opts_extra={"shards": 2})
+    assert rc == 0
+    spmd_mod.reset_stats()
+    rc, blob, st = _run_fleet(tmp_path, "spmd", n=n,
+                              opts_extra={"shards": 2, "spmd": True})
+    assert rc == 0 and blob == ref
+    sp = st["spmd"]
+    assert sp["fallbacks"] == 0
+    # >= one class per case, <= both classes every case
+    assert n <= sp["dispatches"] <= 2 * n
+
+
+@pytest.mark.slow
+def test_spmd_checkpoint_resume_byte_identity(tmp_path):
+    """A --spmd campaign killed mid-run resumes from the fleet
+    checkpoint onto the fused path and finishes byte-identical to the
+    uninterrupted run (score carry + seen-set restore across the
+    resume boundary)."""
+    rc, ref, _ = _run_fleet(tmp_path, "ref", n=3, seeds=SEEDS_1CLASS,
+                            seed=SEED_1CLASS,
+                            opts_extra={"shards": 2, "spmd": True})
+    assert rc == 0
+    state = str(tmp_path / "state.npz")
+    extra = {"shards": 2, "spmd": True, "state_path": state}
+    rc, _, _ = _run_fleet(tmp_path, "res", n=2, seeds=SEEDS_1CLASS,
+                          seed=SEED_1CLASS, opts_extra=extra)
+    assert rc == 0 and os.path.exists(state)
+    spmd_mod.reset_stats()
+    rc, blob, st = _run_fleet(tmp_path, "res", n=3, seeds=SEEDS_1CLASS,
+                              seed=SEED_1CLASS, opts_extra=extra)
+    assert rc == 0 and st["start_case"] == 2
+    assert st["spmd"]["fallbacks"] == 0
+    assert st["spmd"]["dispatches"] == 1  # only the resumed case
+    assert blob == ref
+
+
+# ---- remote tier: slice vs full rewind + chunked wire (slow) ------------
+
+
+@pytest.mark.slow
+def test_remote_rewind_modes_and_chunked_frames_byte_identity(
+        tmp_path, monkeypatch):
+    """The r19 remote-tier triangle, one worker pair for every leg:
+
+    - slice (default): a reply lost after dispatch replays ONLY the
+      dead shard's slice (slice_rewinds, surviving streams kept)
+    - full: the same fault under --fleet-rewind full takes the r15
+      whole-pipeline rewind (rewinds)
+    - frame kill: a dist.shard.frame fault on a step send is a
+      DISPATCH failure — in-case redispatch, no rewind at all
+    - chunked: FRAME_CHUNK forced tiny streams every panel as
+      continuation frames, physical frame size provably bounded
+    - remote spmd: the lease's spmd flag makes the worker mesh its
+      own board (run_panel) — same bytes as every other leg
+
+    All five produce the clean run's bytes."""
+    srv1 = ParentServer(0, {"seed": SEED}).serve(block=False)
+    srv2 = ParentServer(0, {"seed": SEED}).serve(block=False)
+    nodes = [f"127.0.0.1:{srv1._srv.getsockname()[1]}",
+             f"127.0.0.1:{srv2._srv.getsockname()[1]}"]
+    n = 2
+    try:
+        rc, ref, _ = _run_fleet(tmp_path, "clean", n=n,
+                                opts_extra={"fleet_nodes": nodes})
+        assert rc == 0
+
+        # reply loss -> slice rewind (skip 2 leases + 2 snapshots)
+        rc, blob, st = _run_fleet(
+            tmp_path, "slice", n=n, spec="dist.shard.recv:s4x1",
+            opts_extra={"fleet_nodes": nodes, "fleet_window": 2})
+        assert rc == 0 and blob == ref
+        assert st["slice_rewinds"] >= 1 and st["rewind_mode"] == "slice"
+
+        # same fault, full rewind mode
+        rc, blob, st = _run_fleet(
+            tmp_path, "full", n=n, spec="dist.shard.recv:s4x1",
+            opts_extra={"fleet_nodes": nodes, "fleet_window": 2,
+                        "fleet_rewind": "full"})
+        assert rc == 0 and blob == ref
+        assert st["rewinds"] >= 1 and st["slice_rewinds"] == 0
+
+        # frame fault on a step send: dispatch failure, not a rewind
+        # (skip shard 0's lease + snapshot sends — the 3rd coordinator
+        # frame send is its first shard_step; with the default window
+        # of 1 the 5th would be the post-fence telemetry frame, whose
+        # loss reads as a reply loss and rewinds the slice instead)
+        rc, blob, st = _run_fleet(
+            tmp_path, "frame", n=n, spec="dist.shard.frame:s2x1",
+            opts_extra={"fleet_nodes": nodes})
+        assert rc == 0 and blob == ref
+        assert st["redispatches"] >= 1
+        assert st["rewinds"] == 0 and st["slice_rewinds"] == 0
+
+        # tiny FRAME_CHUNK: every panel streams chunked, bounded
+        monkeypatch.setattr(dist, "FRAME_CHUNK", 512)
+        rc, blob, st = _run_fleet(tmp_path, "chunk", n=n,
+                                  opts_extra={"fleet_nodes": nodes})
+        monkeypatch.setattr(dist, "FRAME_CHUNK", 4 << 20)
+        assert rc == 0 and blob == ref
+        fmax = st["transport"]["frame_bytes_max"]
+        # chunking bounds the BLOB per physical frame; the JSON header
+        # rides the first frame whole (step/snapshot headers carry
+        # slot/sid/score lists — ~1.1KB at batch 8, never megabytes)
+        assert 0 < fmax <= 512 + 12 + 2048
+
+        # remote spmd: worker meshes its own 8-device board
+        spmd_mod.reset_stats()
+        rc, blob, st = _run_fleet(tmp_path, "rspmd", n=n,
+                                  opts_extra={"fleet_nodes": nodes,
+                                              "spmd": True})
+        assert rc == 0 and blob == ref
+    finally:
+        srv1.stop()
+        srv2.stop()
