@@ -311,7 +311,7 @@ def _run_mixed_arena_stage(batch_n: int, cases: int, t0: float,
 def _run_fleet_stage(batch_n: int, seed_len: int, cases: int, t0: float,
                      shards: int, spec: str | None = None,
                      nodes: list | None = None, state: bool = False,
-                     window: int = 1):
+                     window: int = 1, churn: list | None = None):
     """Sharded corpus fleet (corpus/fleet.py, `--shards N`): the same
     mixed-length seed set as the corpus stage, mapped across N per-shard
     arenas and reduced at the coordinator. At the fixed bench seed every
@@ -325,7 +325,9 @@ def _run_fleet_stage(batch_n: int, seed_len: int, cases: int, t0: float,
     the first len(nodes) shard ids to remote workers (cross-host path;
     loopback on this host); `state` enables the per-case fleet
     checkpoint so its cost shows up in the warm rate; `window` sets the
-    framed-stream sync window (r15 --fleet-window). Returns
+    framed-stream sync window (r15 --fleet-window); `churn` is an r20
+    membership schedule (join/drain/kill events applied at window
+    fences — the churn stage prices elastic membership). Returns
     (warm_samples_per_sec, stats dict); stats carries the migration log
     and per-case finish_times the caller derives recovery time from."""
     import shutil
@@ -356,6 +358,8 @@ def _run_fleet_stage(batch_n: int, seed_len: int, cases: int, t0: float,
         }
         if state:
             opts["state_path"] = os.path.join(tmpdir, "state.npz")
+        if churn:
+            opts["churn_schedule"] = [dict(ev) for ev in churn]
         rc = run_corpus_batch(opts, batch=batch_n)
     finally:
         chaos.configure(None)
@@ -794,6 +798,64 @@ def child_main() -> None:
             _write_result(line)
         except Exception as e:  # noqa: BLE001 — earlier numbers stand
             _phase(f"dist-fleet stage FAILED: {type(e).__name__}: {e}", t0)
+
+    # churn stage (r20): elastic membership under a deterministic storm
+    # — one graceful drain, one hot-join (a loopback worker filling the
+    # drained slot), one hard kill, all landing at window fences of a
+    # 4-shard campaign that stays byte-identical to the static fleet.
+    # The recovery number per event kind is the fence-case wall time
+    # minus the median inter-case time: what ONE membership change of
+    # that kind costs the campaign. ERLAMSA_BENCH_CHURN=0 skips
+    # (default on: it rides the fleet stage's warm caches).
+    if os.environ.get("ERLAMSA_BENCH_CHURN", "1") != "0":
+        try:
+            from erlamsa_tpu.services.dist import ParentServer
+
+            churn_cases = max(6, ITERS // 3)
+            joiner = ParentServer(0, {"seed": (1, 2, 3)}).serve(
+                block=False)
+            try:
+                jport = joiner._srv.getsockname()[1]
+                base_sps, _ = _run_fleet_stage(
+                    BATCH, SEED_LEN, churn_cases, t0, shards=4)
+                sched = [
+                    {"case": 2, "kind": "drain", "shard": 3},
+                    {"case": 3, "kind": "join", "host": "127.0.0.1",
+                     "port": jport},
+                    {"case": 4, "kind": "kill", "shard": 2},
+                ]
+                churn_sps, cstats = _run_fleet_stage(
+                    BATCH, SEED_LEN, churn_cases, t0, shards=4,
+                    churn=sched)
+            finally:
+                joiner.stop()
+            ft = cstats["finish_times"]
+            gaps = sorted(ft[i + 1] - ft[i] for i in range(len(ft) - 1))
+            median_gap = gaps[len(gaps) // 2]
+            recovery = {
+                ev["kind"]: round(ft[ev["case"]] - ft[ev["case"] - 1]
+                                  - median_gap, 3)
+                for ev in sched if 0 < ev["case"] < len(ft)
+            }
+            record["churn_samples_per_sec"] = round(churn_sps, 1)
+            record["churn_overhead"] = round(
+                1.0 - churn_sps / base_sps, 3) if base_sps else None
+            record["churn_recovery_s"] = recovery
+            record["churn_membership"] = [
+                e["kind"] for e in cstats.get(
+                    "membership", {}).get("events", [])
+            ]
+            record["churn_slice_rewinds"] = cstats.get("slice_rewinds", 0)
+            _phase(
+                f"churn stage: {churn_sps:,.0f} samples/s under storm "
+                f"({record['churn_overhead']:.1%} overhead), recovery "
+                + ", ".join(f"{k}={v:+.3f}s"
+                            for k, v in recovery.items()), t0,
+            )
+            line = json.dumps(record)
+            _write_result(line)
+        except Exception as e:  # noqa: BLE001 — earlier numbers stand
+            _phase(f"churn stage FAILED: {type(e).__name__}: {e}", t0)
 
     # service-layer stage (BASELINE configs 4/5): FaaS concurrency +
     # live-proxy stream via bin/load_bench.py. Modest defaults keep the

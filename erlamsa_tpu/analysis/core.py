@@ -101,6 +101,7 @@ class LintConfig:
         "monitor.spawn", "monitor.ingest", "coverage.fold",
         "gen.expand",
         "obs.telemetry",
+        "fleet.join", "fleet.drain",
     )
 
     def in_scope(self, rel: str, prefixes: tuple) -> bool:

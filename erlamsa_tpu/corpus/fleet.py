@@ -102,6 +102,41 @@ shard, closes every stream, and replays — the replayed schedule draws
 identically (energies unchanged since the last merged case), so the
 rewound run stays byte-identical to the clean one.
 
+Elastic membership (r20): the worker set is a RUNTIME variable, not a
+launch constant. The logical shard count stays fixed (that is what the
+PRNG streams and partition_of key on), but which physical worker
+tenants each remote slot changes mid-campaign:
+
+  hot-join   ``--fleet-accept PORT`` opens a membership listener; a
+             worker started with ``--fleet-join COORD:PORT`` announces
+             itself and is ADMITTED AT THE NEXT WINDOW FENCE (the only
+             point with zero steps in flight) into the lowest vacant
+             slot (``--fleet-expect K`` reserves K remote slots, the
+             un-named ones starting VACANT) or a dead slot. Admission
+             bumps every fencing epoch and warm-starts the new tenant
+             via the r15 snapshot path — and because placement is pure
+             and streams are counter-keyed, the campaign is
+             byte-identical to a static fleet of the same shard count
+             no matter WHEN the join lands.
+  drain      a worker SIGTERM'd under ``--fleet-worker`` stamps
+             ``draining: true`` on its replies; the coordinator hands
+             its partitions back at the next fence with a
+             ``fleet_drain`` op (lease dropped, fence floor raised so a
+             re-join must lease strictly above it) — a PLANNED
+             departure: no FleetShardLost, no rewind, survivor streams
+             stay up. ``fleet.join``/``fleet.drain`` chaos sites
+             degrade a faulted handshake to the existing paths
+             (join aborted / crash-revoke), byte-identically.
+  ledger     every join/drain/evict/readmit/vacate bumps a monotonic
+             generation (parallel/shards.MembershipLedger), rides
+             ``--state`` checkpoints (with the per-slot backend map,
+             so a resume mid-churn rebinds the same tenants), and is
+             exported as erlamsa_fleet_membership_* plus flight
+             breadcrumbs. A deterministic churn schedule
+             (opts["churn_schedule"], parallel/shards.
+             make_churn_schedule) replays join/drain/kill storms
+             case-keyed for the soak tests and the bench churn stage.
+
 Still single-device only: the --struct overlay (a hard error here, not
 a silent ignore).
 """
@@ -117,7 +152,8 @@ from types import SimpleNamespace
 import numpy as np
 
 from ..obs import flight, trace
-from ..parallel.shards import FleetPlacement, partition_of
+from ..parallel.shards import (FleetPlacement, MembershipLedger,
+                               partition_of)
 from ..services import chaos, logger, metrics, out
 from . import feedback as fb
 from .assembler import bucket_capacity
@@ -467,9 +503,9 @@ def run_corpus_fleet(opts: dict, batch: int = 1024) -> int:
                                        load_fleet_state,
                                        quarantine_mismatch,
                                        save_fleet_state)
-    from ..services.dist import (RemoteShardError, ShardStream,
-                                 TransportTally, new_campaign_token,
-                                 request_telemetry)
+    from ..services.dist import (MembershipListener, RemoteShardError,
+                                 ShardStream, TransportTally,
+                                 new_campaign_token, request_telemetry)
 
     raw_shards = opts.get("shards")
     # --fleet-window W: steps in flight per shard between sync barriers
@@ -504,25 +540,50 @@ def run_corpus_fleet(opts: dict, batch: int = 1024) -> int:
             raise ValueError(
                 f"--fleet-nodes entry {spec!r} is not host:port")
         fleet_nodes.append((host, int(port)))
-    # --fleet-nodes alone sizes the fleet to the worker list; --shards N
-    # with M <= N nodes runs a mixed fleet (M remote + N-M local shards);
-    # --spmd alone sizes the fleet to the local board (one mesh slot per
-    # device — the single-program multi-device configuration)
+    # --fleet-expect K (r20): reserve K REMOTE shard slots. The first
+    # len(fleet_nodes) bind at start; the rest start VACANT and await a
+    # hot-join. The LOGICAL shard count (what partition_of and the PRNG
+    # streams key on) is fixed at launch either way — elasticity changes
+    # tenancy, never the stream keying, which is the byte-identity
+    # contract.
+    fleet_expect = int(opts.get("fleet_expect") or 0)
+    if fleet_expect < 0:
+        raise ValueError(f"--fleet-expect must be >= 0, "
+                         f"got {fleet_expect}")
+    remote_slots = max(len(fleet_nodes), fleet_expect)
+    # --fleet-nodes alone sizes the fleet to the worker list (plus any
+    # vacant --fleet-expect slots); --shards N with M <= N remote slots
+    # runs a mixed fleet (M remote + N-M local shards); --spmd alone
+    # sizes the fleet to the local board (one mesh slot per device — the
+    # single-program multi-device configuration)
     if raw_shards is not None:
         n_shards = int(raw_shards)
-    elif fleet_nodes:
-        n_shards = len(fleet_nodes)
+    elif remote_slots:
+        n_shards = remote_slots
     elif use_spmd:
         n_shards = len(jax.devices())
     else:
         n_shards = 1
     if n_shards < 1:
         raise ValueError(f"--shards must be >= 1, got {n_shards}")
-    if len(fleet_nodes) > n_shards:
+    if remote_slots > n_shards:
         raise ValueError(
-            f"--fleet-nodes names {len(fleet_nodes)} workers but --shards "
-            f"is {n_shards}; drop --shards to size the fleet from the "
-            f"node list, or raise it to at least the node count")
+            f"--fleet-nodes/--fleet-expect name {remote_slots} remote "
+            f"slots but --shards is {n_shards}; drop --shards to size "
+            f"the fleet from the remote slots, or raise it to at least "
+            f"the slot count")
+    # deterministic churn schedule (tests/bench): case-keyed
+    # join/drain/kill events consumed at the window fence — sorted so
+    # consumption order is a pure function of the schedule, never of
+    # arrival timing
+    churn_schedule = sorted(
+        (dict(ev) for ev in (opts.get("churn_schedule") or [])),
+        key=lambda ev: int(ev.get("case", 0)))
+    for ev in churn_schedule:
+        if ev.get("kind") not in ("join", "drain", "kill"):
+            raise ValueError(
+                f"churn_schedule kind must be join|drain|kill, "
+                f"got {ev.get('kind')!r}")
     if str(opts.get("struct") or "off") != "off":
         # the struct overlay (ops/structure.py) is routed per scheduled
         # case against one arena; sharding it means per-shard span panels
@@ -608,6 +669,7 @@ def run_corpus_fleet(opts: dict, batch: int = 1024) -> int:
     start_case = 0
     resume_seen: set[bytes] = set()
     resume_epoch = None
+    resume_membership = None
     classes_override = None
     if state_path and os.path.exists(state_path):
         st = load_fleet_state(state_path)
@@ -641,6 +703,7 @@ def run_corpus_fleet(opts: dict, batch: int = 1024) -> int:
             if st["energies"]:
                 store.restore_energies(st["energies"])
             resume_epoch = st["epoch"]
+            resume_membership = st.get("membership")
             classes_override = st["classes"]
             # event counters (fence_rejected, telemetry_lost, ...) are
             # monotone across a resume: max-merge the checkpointed
@@ -715,7 +778,7 @@ def run_corpus_fleet(opts: dict, batch: int = 1024) -> int:
     # program's [N, pages, page] view is a zero-copy assembly of the
     # per-device tensors. Sizing every member at the fleet max only
     # moves spill boundaries, which the spill path keeps byte-neutral.
-    local_shard_ids = list(range(len(fleet_nodes), n_shards))
+    local_shard_ids = list(range(remote_slots, n_shards))
     uniform_pages = (max(map(_shard_page_need, local_shard_ids))
                      if use_spmd and local_shard_ids else None)
 
@@ -771,6 +834,8 @@ def run_corpus_fleet(opts: dict, batch: int = 1024) -> int:
 
         def __init__(self, shard_id: int, host: str, port: int):
             self.id = shard_id
+            self.host = host
+            self.port = int(port)
             self.stream = ShardStream(shard_id, host, port,
                                       timeout=fleet_timeout,
                                       token=fleet_token, tally=transport)
@@ -834,14 +899,80 @@ def run_corpus_fleet(opts: dict, batch: int = 1024) -> int:
                                epoch=int(epoch), seeds=len(snap.sids),
                                bytes=int(snap.pages.nbytes))
 
-    # the FIRST len(fleet_nodes) shard ids are remote, the rest local —
+    # the FIRST remote_slots shard ids are remote (the trailing ones
+    # possibly VACANT, awaiting a hot-join), the rest local —
     # partition_of is shard-count-keyed only, so the mix never changes
-    # WHAT any slot computes, only where
-    shards: dict[int, object] = {
-        s: (_Remote(s, *fleet_nodes[s]) if s < len(fleet_nodes)
-            else _Shard(s))
-        for s in range(n_shards)
-    }
+    # WHAT any slot computes, only where. A checkpoint's membership
+    # record wins over --fleet-nodes: the backend each slot held at the
+    # kill is the one the resume re-binds (r20), so a campaign resumed
+    # mid-churn re-derives the same placement the dead coordinator held.
+    members = MembershipLedger()
+
+    def _backend_for(s: int):
+        if resume_membership is not None:
+            backends = resume_membership.get("backends") or []
+            if s < len(backends):
+                b = backends[s]
+                if b == "local":
+                    return _Shard(s)
+                if not b:
+                    return None
+                host, _, port = b.rpartition(":")
+                return _Remote(s, host, int(port))
+        if s < len(fleet_nodes):
+            return _Remote(s, *fleet_nodes[s])
+        if s < remote_slots:
+            return None  # vacant: reserved for a hot-join
+        return _Shard(s)
+
+    shards: dict[int, object] = {s: _backend_for(s)
+                                 for s in range(n_shards)}
+    if resume_membership is not None:
+        members.restore(resume_membership.get("generation", 0),
+                        resume_membership.get("events") or [])
+        # vacancies restore through placement silently — their history
+        # is already in the restored ledger events
+        for s, sh in shards.items():
+            if sh is None:
+                placement.vacate(s, start_case)
+    else:
+        for s, sh in shards.items():
+            if sh is None:
+                entry = placement.vacate(s, start_case)
+                members.record("vacant", s, start_case,
+                               entry["epoch"])
+
+    # hot-join intake (r20): --fleet-accept opens a listener; announced
+    # candidates are admitted ONLY at the window fence (never mid-case).
+    # Tests may pass a pre-built listener via opts["membership_listener"].
+    listener = opts.get("membership_listener")
+    if listener is None and opts.get("fleet_accept") is not None:
+        listener = MembershipListener(int(opts["fleet_accept"]))
+
+    def membership_state() -> dict:
+        """Checkpointable membership record: ledger snapshot plus the
+        per-slot backend binding ("host:port" | "local" | "" vacant) and
+        liveness — enough for a resume to re-bind exactly the tenancy
+        the dead coordinator held (r20)."""
+        snap = members.snapshot()
+        snap["backends"] = [
+            ("" if shards[s] is None
+             else f"{shards[s].host}:{shards[s].port}"
+             if isinstance(shards[s], _Remote) else "local")
+            for s in range(n_shards)]
+        snap["live"] = [placement.is_live(s) for s in range(n_shards)]
+        return snap
+
+    def record_membership():
+        """Publish the ledger to /metrics (erlamsa_fleet_membership_*)."""
+        metrics.GLOBAL.record_membership({
+            "generation": members.generation,
+            "events": members.counts(),
+            "vacant": sum(1 for s in range(n_shards)
+                          if shards[s] is None),
+        })
+
+    record_membership()
 
     # -- SPMD engine (r19, --spmd): one mesh over the local members ----
     spmd_engine = None
@@ -1273,7 +1404,9 @@ def run_corpus_fleet(opts: dict, batch: int = 1024) -> int:
                            shard=shard_id, case=case, epoch=entry["epoch"],
                            moved={str(k): v
                                   for k, v in entry["moved"].items()})
+        members.record("evict", shard_id, case, entry["epoch"])
         metrics.GLOBAL.record_fleet(placement.snapshot())
+        record_membership()
         return entry
 
     def try_readmit(shard_id: int, case: int) -> bool:
@@ -1331,8 +1464,164 @@ def run_corpus_fleet(opts: dict, batch: int = 1024) -> int:
                            shard=shard_id, case=case, epoch=entry["epoch"],
                            moved={str(k): v
                                   for k, v in entry["moved"].items()})
+        members.record("readmit", shard_id, case, entry["epoch"])
         metrics.GLOBAL.record_fleet(placement.snapshot())
+        record_membership()
         return True
+
+    def graceful_drain(shard_id: int, case: int) -> bool:
+        """Planned departure (r20): take the shard out of the live set
+        WITHOUT the crash machinery — no breaker trip, no slice rewind
+        (the fence runs on quiescent streams, so nothing is in flight
+        to lose). The worker gets a fleet_drain handshake (best-effort:
+        it is already out of the live set when the request leaves, so a
+        worker that dies mid-goodbye degrades to a log line, never a
+        double migration), and the slot becomes VACANT — joinable by
+        the next hot-join candidate. The fleet.drain fault site
+        abandons the polite handoff and falls back to the revoke path:
+        a drain dying half-way is exactly a shard loss, outputs
+        unchanged."""
+        sh = shards.get(shard_id)
+        if sh is None or not placement.is_live(shard_id):
+            return False
+        if spmd_engine is not None and shard_id in spmd_members:
+            # a mesh member's arena is part of the fused program's
+            # zero-copy assembly — elastic departure of mesh slots is
+            # future work (ROADMAP item 1 carried notes)
+            logger.log("warning", "fleet: shard %d is an SPMD mesh "
+                       "member — drain refused", shard_id)
+            return False
+        try:
+            chaos.fault_point("fleet.drain")
+        except OSError:
+            metrics.GLOBAL.record_event("fleet_drain_faulted")
+            revoke_shard(shard_id, case, "drain handoff faulted")
+            return True
+        entry = placement.drain(shard_id, case)
+        if isinstance(sh, _Remote):
+            try:
+                with trace.span("fleet.drain", shard=shard_id,
+                                case=case):
+                    sh.stream.request(
+                        {"op": "fleet_drain", "shard": shard_id,
+                         "epoch": entry["epoch"]},
+                        expect="fleet_drained",
+                        timeout=min(fleet_timeout, 10.0))
+            except (OSError, RemoteShardError) as e:
+                logger.log("warning", "fleet: drain handshake with "
+                           "shard %d failed (%s) — it is already out "
+                           "of the live set", shard_id, e)
+            sh.stream.close()
+        shards[shard_id] = None
+        logger.log("warning", "fleet: shard %d drained at case %d "
+                   "(planned departure — partitions handed back, no "
+                   "rewind)", shard_id, case)
+        metrics.GLOBAL.record_event("shard_drained")
+        flight.GLOBAL.note("shard_membership", change="drain",
+                           shard=shard_id, case=case,
+                           epoch=entry["epoch"])
+        members.record("drain", shard_id, case, entry["epoch"])
+        metrics.GLOBAL.record_fleet(placement.snapshot())
+        record_membership()
+        return True
+
+    def admit_join(ev: dict, case: int) -> bool:
+        """Hot-join admission (r20): bind an announced worker to the
+        lowest vacant slot (else replace the lowest provably-dead
+        remote backend), bump the fencing epoch via placement.join, and
+        let ensure_lease warm-start it lazily at its first dispatch.
+        Campaign byte-identity holds because the LOGICAL shard count is
+        fixed — admission changes tenancy, never stream keying. The
+        fleet.join fault site aborts the admit before any state moves:
+        the candidate stays out (it may re-announce), placement and
+        outputs are byte-identical to a run it never contacted."""
+        host = str(ev.get("host") or "127.0.0.1")
+        port = int(ev.get("port") or 0)
+        who = f"{host}:{port}"
+        slot = next((s for s in range(n_shards)
+                     if shards[s] is None), None)
+        if slot is None:
+            slot = next((s for s in range(remote_slots)
+                         if not placement.is_live(s)
+                         and isinstance(shards[s], _Remote)), None)
+
+        def reject(reason: str) -> bool:
+            logger.log("warning", "fleet: hot-join from %s rejected "
+                       "(%s)", who, reason)
+            metrics.GLOBAL.record_event("fleet_join_rejected")
+            members.record("join_rejected",
+                           -1 if slot is None else slot, case,
+                           placement.epoch)
+            record_membership()
+            return False
+
+        try:
+            chaos.fault_point("fleet.join")
+        except OSError:
+            return reject("injected fault")
+        tok = str(ev.get("token") or "")
+        if tok and tok != fleet_token:
+            return reject("campaign token mismatch")
+        if ev.get("classes") is not None and (
+                [int(c) for c in ev["classes"]]
+                != [int(c) for c in classes]):
+            return reject("capacity-class mismatch")
+        if not 0 < port < 65536:
+            return reject(f"bad announce port {port}")
+        if slot is None:
+            return reject("no vacant or replaceable shard slot")
+        old = shards[slot]
+        if isinstance(old, _Remote):
+            # replacing a dead backend: kill its stream first so a
+            # zombie reply can never land on the fresh tenant's slot
+            old.stream.close()
+        shards[slot] = _Remote(slot, host, port)
+        entry = placement.join(slot, case)
+        logger.log("warning", "fleet: worker %s hot-joined as shard "
+                   "%d at case %d (epoch %d)", who, slot, case,
+                   entry["epoch"])
+        metrics.GLOBAL.record_event("fleet_joined")
+        flight.GLOBAL.note("shard_membership", change="join",
+                           shard=slot, case=case, epoch=entry["epoch"],
+                           worker=who)
+        members.record("join", slot, case, entry["epoch"])
+        metrics.GLOBAL.record_fleet(placement.snapshot())
+        record_membership()
+        return True
+
+    def membership_fence(case: int) -> None:
+        """The single admission point for ALL membership change (r20):
+        runs at the top of the case loop strictly AFTER
+        wait_done(case-1), when every step reply has been consumed —
+        streams are quiescent, so a drain can never strand an in-flight
+        reply (zero slice rewinds on planned departure, by
+        construction). Processing order is deterministic: scheduled
+        churn events first (schedule order), then reply-header drain
+        requests (shard-id order), then listener announcements (arrival
+        order). Placement is a pure function of the live SET, so none
+        of this ordering can change campaign bytes — only tenancy."""
+        while (churn_schedule
+               and int(churn_schedule[0].get("case", 0)) <= case):
+            ev = churn_schedule.pop(0)
+            kind = ev["kind"]
+            if kind == "kill":
+                s = int(ev["shard"])
+                if placement.is_live(s) and shards.get(s) is not None:
+                    revoke_shard(s, case, "churn-schedule kill")
+            elif kind == "drain":
+                graceful_drain(int(ev["shard"]), case)
+            else:
+                admit_join(ev, case)
+        for s in sorted(shards):
+            sh = shards[s]
+            if (isinstance(sh, _Remote) and sh.stream.draining
+                    and placement.is_live(s)):
+                # the worker stamped "draining" on a reply header
+                # (SIGTERM): honor it now that its window is merged
+                graceful_drain(s, case)
+        if listener is not None:
+            for ev in listener.take():
+                admit_join(ev, case)
 
     def process_case(work):
         """Reduce for one case — runs ON THE DRAIN WORKER, strictly in
@@ -1569,7 +1858,8 @@ def run_corpus_fleet(opts: dict, batch: int = 1024) -> int:
                                  placement.epoch, n_shards, classes,
                                  events=metrics.GLOBAL.event_counts(),
                                  coverage=(cov.snapshot()
-                                           if cov is not None else None))
+                                           if cov is not None else None),
+                                 membership=membership_state())
                 store.save()
             metrics.GLOBAL.record_stage("checkpoint",
                                         time.perf_counter() - t_c)
@@ -1681,6 +1971,10 @@ def run_corpus_fleet(opts: dict, batch: int = 1024) -> int:
                     if placement.dead() and case >= probe_at:
                         probe_at = case + DEVICE_PROBE_EVERY
                         for s in placement.dead():
+                            if shards.get(s) is None:
+                                # vacant slot: fills by hot-join at the
+                                # membership fence, not by probing
+                                continue
                             try_readmit(s, case)
 
                     # the schedule is energy-weighted: case N+1 cannot
@@ -1695,6 +1989,12 @@ def run_corpus_fleet(opts: dict, batch: int = 1024) -> int:
                     if w > 0.05:
                         flight.GLOBAL.note("fleet_window_stall",
                                            case=case, waited=round(w, 4))
+
+                    # -- membership fence (r20): the ONLY place the
+                    # fleet composition changes. Case `case - 1` is
+                    # fully merged and every reply consumed, so
+                    # joins/drains land on quiescent streams.
+                    membership_fence(case)
 
                     # per-case umbrella span: remote shard.step spans
                     # and the drain worker's reduce-side spans parent
@@ -1898,8 +2198,11 @@ def run_corpus_fleet(opts: dict, batch: int = 1024) -> int:
         for sh in shards.values():
             if isinstance(sh, _Remote):
                 sh.stream.close()
+        if listener is not None and not opts.get("membership_listener"):
+            listener.close()
 
     store.save()
+    record_membership()
     dt = time.perf_counter() - t0
     metrics.GLOBAL.record_pipeline_wall(dt)
     metrics.GLOBAL.record_fleet(placement.snapshot())
@@ -1931,6 +2234,9 @@ def run_corpus_fleet(opts: dict, batch: int = 1024) -> int:
                      rewinds=tallies["rewinds"],
                      slice_rewinds=tallies["slice_rewinds"],
                      rewind_mode=rewind_mode,
+                     membership=membership_state(),
+                     vacant=sum(1 for sh in shards.values()
+                                if sh is None),
                      spmd=(spmd_mod.stats_snapshot()
                            if spmd_engine is not None else None),
                      coverage_edges=(cov.edges() if cov is not None

@@ -270,6 +270,23 @@ def render(counters: metrics.Counters | None = None) -> str:
             w.sample("erlamsa_fleet_shard_live",
                      1 if lease["live"] else 0, {"shard": sid})
 
+    membership = snap.get("fleet_membership")
+    if membership:
+        w.head("erlamsa_fleet_membership_generation", "counter",
+               "Membership ledger generation: bumps on every "
+               "join/drain/evict/readmit/vacate event.")
+        w.sample("erlamsa_fleet_membership_generation",
+                 membership.get("generation", 0))
+        w.head("erlamsa_fleet_membership_events_total", "counter",
+               "Membership events recorded, by kind.")
+        for kind, n in sorted((membership.get("events") or {}).items()):
+            w.sample("erlamsa_fleet_membership_events_total", n,
+                     {"kind": kind})
+        w.head("erlamsa_fleet_membership_vacant", "gauge",
+               "Remote shard slots currently without a tenant worker.")
+        w.sample("erlamsa_fleet_membership_vacant",
+                 membership.get("vacant", 0))
+
     transport = snap.get("fleet_transport")
     if transport and (transport["bytes_sent"] or transport["bytes_recv"]
                       or transport["round_trips"]):

@@ -33,6 +33,7 @@ breaker state and /metrics.
 
 from __future__ import annotations
 
+import hashlib
 import random
 
 from ..services.resilience import HealthTable
@@ -152,8 +153,8 @@ class FleetPlacement:
         self._owner = assign_partitions(self.n_shards, self._live)
         moved = {p: s for p, s in self._owner.items() if old[p] != s}
         self.epoch += 1
-        if kind == "readmit":
-            # a re-admitted shard's lease is re-granted at the NEW epoch:
+        if kind in ("readmit", "join"):
+            # a (re-)admitted shard's lease is granted at the NEW epoch:
             # anything still in flight from its previous life is fenced
             self.lease_epoch[shard] = self.epoch
         entry = {"case": int(case), "epoch": self.epoch, "kind": kind,
@@ -177,6 +178,36 @@ class FleetPlacement:
         self._live.add(shard)
         return self._migrate(case, "readmit", shard)
 
+    def drain(self, shard: int, case: int) -> dict:
+        """Planned departure (r20 graceful drain): the shard leaves the
+        live set and its partitions redistribute exactly like a revoke —
+        but its breaker records NO failure (a drained worker is healthy,
+        just gone) and the coordinator never probes it for re-admission.
+        The pure assignment makes drain-then-join converge to the same
+        placement a crash-then-readmit would, so the membership *kind*
+        is pure bookkeeping — bytes never depend on it."""
+        self._live.discard(shard)
+        return self._migrate(case, "drain", shard)
+
+    def join(self, shard: int, case: int) -> dict:
+        """Hot-join (r20): a new worker takes over shard slot `shard`
+        (previously vacant, drained, or dead). Readmit semantics — the
+        slot enters the live set and its lease is granted at the bumped
+        epoch, strictly above any floor a previous tenant's drain or
+        revoke fence left behind — but logged as its own kind so the
+        ledger distinguishes elastic scale-up from crash recovery."""
+        self.health.report(shard, ok=True)
+        self._live.add(shard)
+        return self._migrate(case, "join", shard)
+
+    def vacate(self, shard: int, case: int) -> dict:
+        """Mark a shard slot VACANT (no backend bound yet): used at
+        start for `--fleet-expect` slots awaiting their first hot-join,
+        and at resume for slots whose checkpointed backend is gone. No
+        breaker failure — vacancy is an expected state, not a fault."""
+        self._live.discard(shard)
+        return self._migrate(case, "vacant", shard)
+
     # -- observability ---------------------------------------------------
 
     def snapshot(self) -> dict:
@@ -199,3 +230,77 @@ class FleetPlacement:
                 for s in range(self.n_shards)
             },
         }
+
+
+class MembershipLedger:
+    """Monotonic membership history for one campaign (r20 elastic
+    membership): every join/drain/evict/vacate bumps a generation
+    counter and appends an event. The ledger is DERIVED observability
+    state riding the placement transitions — bytes never read it — but
+    it persists through ``--state`` checkpoints so a resumed campaign
+    reports a continuous membership history, and it feeds the
+    ``erlamsa_fleet_membership_*`` metrics and flight breadcrumbs.
+
+    Event kinds: ``join`` (hot-join admitted), ``drain`` (graceful
+    departure), ``evict`` (crash revoke), ``readmit`` (probe recovery),
+    ``vacant`` (slot awaiting its first tenant), ``join_rejected``
+    (handshake refused or chaos-aborted)."""
+
+    KINDS = ("join", "drain", "evict", "readmit", "vacant",
+             "join_rejected")
+
+    def __init__(self):
+        self.generation = 0
+        self.events: list[dict] = []
+
+    def record(self, kind: str, shard: int, case: int,
+               epoch: int) -> dict:
+        self.generation += 1
+        ev = {"gen": self.generation, "kind": str(kind),
+              "shard": int(shard), "case": int(case),
+              "epoch": int(epoch)}
+        self.events.append(ev)
+        return ev
+
+    def counts(self) -> dict[str, int]:
+        """Event totals by kind (prom counter fodder)."""
+        out: dict[str, int] = {}
+        for ev in self.events:
+            out[ev["kind"]] = out.get(ev["kind"], 0) + 1
+        return out
+
+    def snapshot(self) -> dict:
+        return {"generation": self.generation,
+                "events": [dict(ev) for ev in self.events]}
+
+    def restore(self, generation: int, events: list[dict]) -> None:
+        """Resume from a checkpoint: adopt the saved history verbatim.
+        The generation counter continues PAST the saved value — a
+        post-resume event can never reuse a pre-crash generation."""
+        self.generation = max(self.generation, int(generation))
+        self.events = [dict(ev) for ev in events]
+
+
+def make_churn_schedule(seed: int, n_cases: int, slots: list[int],
+                        kinds: tuple = ("drain", "kill"),
+                        events: int = 4) -> list[dict]:
+    """Deterministic churn-storm schedule (r20 soak harness): draw
+    `events` membership events purely from sha256(seed, counter) — no
+    RNG state, no wall clock — so the same arguments always reproduce
+    the same storm, and a storm that exposes a bug is a unit test, not
+    a flake. Cases land in [1, n_cases); each event targets one of
+    `slots`. "join" events carry no endpoint — the harness binds them
+    to a candidate worker (host/port) before handing the schedule to
+    the coordinator."""
+    if n_cases < 2 or not slots or events < 1:
+        return []
+    out = []
+    for i in range(int(events)):
+        h = hashlib.sha256(f"churn:{int(seed)}:{i}".encode()).digest()
+        out.append({
+            "case": 1 + int.from_bytes(h[:4], "big") % (n_cases - 1),
+            "kind": kinds[int.from_bytes(h[4:8], "big") % len(kinds)],
+            "shard": slots[int.from_bytes(h[8:12], "big") % len(slots)],
+        })
+    return sorted(out, key=lambda ev: (ev["case"], str(ev["kind"]),
+                                       ev["shard"]))

@@ -106,6 +106,17 @@ Known sites (grep `fault_point(` for the authoritative list):
                      federation data goes stale for one window, and the
                      campaign output is byte-identical (telemetry is a
                      pure side channel; tests pin this)
+    fleet.join       hot-join admission at the window fence
+                     (corpus/fleet.py): an injected fault aborts the
+                     admit — the candidate stays out (join_rejected,
+                     it may re-announce), placement and outputs are
+                     byte-identical to a run it never contacted
+    fleet.drain      graceful-drain handoff at the window fence
+                     (corpus/fleet.py): an injected fault abandons the
+                     polite handoff and falls back to the crash path
+                     (revoke + redistribute) — a drain dying half-way
+                     degrades to exactly the PR 11 loss semantics,
+                     outputs unchanged
 
 Injected failures raise ``InjectedFault``, an OSError subclass, so they
 flow through exactly the except-clauses that catch real socket/disk

@@ -203,7 +203,8 @@ def save_fleet_state(path: str, seed, case_idx: int, scores, seen_hashes,
                      corpus_energies: dict, epoch: int, n_shards: int,
                      classes, engine: str = "fused",
                      events: dict | None = None,
-                     coverage: dict | None = None) -> None:
+                     coverage: dict | None = None,
+                     membership: dict | None = None) -> None:
     """Fleet-coordinator checkpoint (corpus/fleet.py --shards --state):
     per-case progress plus everything the resumed coordinator needs to
     continue byte-identically — scheduler scores, the global seen-hash
@@ -247,6 +248,31 @@ def save_fleet_state(path: str, seed, case_idx: int, scores, seen_hashes,
         # r19 fleet coverage: same kind-stamped fields as save_state —
         # load_coverage_maps reads them off either checkpoint kind
         fields.update(_coverage_fields(coverage))
+    if membership is not None:
+        # r20 elastic membership: the ledger (generation + event
+        # history) and the per-shard backend map ride the checkpoint so
+        # a resume mid-churn reconstructs WHO was serving each slot —
+        # "host:port" for a remote tenant, "local" for a device shard,
+        # "" for a vacant slot — and continues the membership history
+        # instead of forgetting every join/drain that already happened
+        evs = membership.get("events") or []
+        fields["membership_generation"] = np.asarray(
+            int(membership.get("generation", 0)), np.int64)
+        fields["membership_ev_kinds"] = np.asarray(
+            [str(e["kind"]) for e in evs], "U16")
+        fields["membership_ev_gens"] = np.asarray(
+            [int(e["gen"]) for e in evs], np.int64)
+        fields["membership_ev_shards"] = np.asarray(
+            [int(e["shard"]) for e in evs], np.int64)
+        fields["membership_ev_cases"] = np.asarray(
+            [int(e["case"]) for e in evs], np.int64)
+        fields["membership_ev_epochs"] = np.asarray(
+            [int(e["epoch"]) for e in evs], np.int64)
+        fields["membership_backends"] = np.asarray(
+            [str(b) for b in membership.get("backends") or []], "U64")
+        fields["membership_live"] = np.asarray(
+            [1 if x else 0 for x in membership.get("live") or []],
+            np.int64)
     fields["checksum"] = _checksum(fields)
 
     def _write():
@@ -301,6 +327,21 @@ def load_fleet_state(path: str, engine: str = "fused") -> dict | None:
                         for k, n in zip(z["events_kinds"],
                                         z["events_counts"])}
                        if "events_kinds" in z else {}),
+            # optional (absent pre-r20): membership ledger + backend map
+            "membership": ({
+                "generation": int(z["membership_generation"]),
+                "events": [
+                    {"gen": int(g), "kind": str(k), "shard": int(s),
+                     "case": int(c), "epoch": int(e)}
+                    for g, k, s, c, e in zip(
+                        z["membership_ev_gens"], z["membership_ev_kinds"],
+                        z["membership_ev_shards"],
+                        z["membership_ev_cases"],
+                        z["membership_ev_epochs"])
+                ],
+                "backends": [str(b) for b in z["membership_backends"]],
+                "live": [bool(x) for x in z["membership_live"]],
+            } if "membership_generation" in z else None),
         }
     except (OSError, KeyError, ValueError, TypeError, zipfile.BadZipFile,
             zlib.error):

@@ -290,7 +290,28 @@ def build_parser() -> argparse.ArgumentParser:
                         "fixed -s (corpus/fleet.py)")
     p.add_argument("--fleet-worker", type=int, default=None, metavar="PORT",
                    help="serve fleet shard leases on PORT (the worker "
-                        "half of --fleet-nodes) and block")
+                        "half of --fleet-nodes) and block; SIGTERM "
+                        "requests a graceful drain: the worker finishes "
+                        "its in-flight window, hands its partitions "
+                        "back, and exits without a rewind")
+    p.add_argument("--fleet-join", default=None, metavar="HOST:PORT",
+                   help="hot-join (r20): announce this --fleet-worker "
+                        "to the coordinator's --fleet-accept listener; "
+                        "admission lands at the next window fence and "
+                        "the campaign stays byte-identical to a static "
+                        "fleet of the same logical shard count")
+    p.add_argument("--fleet-accept", type=int, default=None,
+                   metavar="PORT",
+                   help="coordinator half of --fleet-join: listen for "
+                        "worker announcements on PORT and admit them "
+                        "into vacant shard slots at window fences")
+    p.add_argument("--fleet-expect", type=int, default=0, metavar="K",
+                   help="reserve K remote shard slots at launch; slots "
+                        "beyond --fleet-nodes start VACANT (their "
+                        "partitions serve from survivors) and fill via "
+                        "--fleet-join. The logical shard count — and "
+                        "therefore every campaign byte — is fixed "
+                        "regardless of when workers arrive")
     p.add_argument("--fleet-window", type=int, default=1, metavar="W",
                    help="framed shard-stream window: steps in flight "
                         "per remote shard between sync barriers (default "
@@ -379,7 +400,13 @@ def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
 
     fleet_mode = (args.shards is not None or args.fleet_nodes
-                  or args.spmd)
+                  or args.spmd or args.fleet_expect)
+    if args.fleet_join and args.fleet_worker is None:
+        raise SystemExit(
+            "erlamsa-tpu: --fleet-join announces a worker, so it "
+            "requires --fleet-worker PORT (0 picks an ephemeral port)")
+    if args.fleet_expect < 0:
+        raise SystemExit("erlamsa-tpu: --fleet-expect must be >= 0")
     if fleet_mode and (args.struct_kernels or args.struct != "off"):
         # hard error, not a printed notice: nobody should believe struct
         # kernels ran fleet-wide when the overlay is single-device only
@@ -573,6 +600,8 @@ def main(argv=None) -> int:
         "fleet_window": args.fleet_window,
         "fleet_reduce": args.fleet_reduce,
         "fleet_rewind": args.fleet_rewind,
+        "fleet_accept": args.fleet_accept,
+        "fleet_expect": args.fleet_expect,
         "arena_pages": args.arena_pages,
         "arena_page": args.arena_page,
         "arena_classes": args.arena_classes,
@@ -661,10 +690,11 @@ def main(argv=None) -> int:
         finally:
             _finish()
 
-    if args.fleet_worker:
+    if args.fleet_worker is not None:
         from .dist import run_shard_worker
 
-        return run_shard_worker(args.fleet_worker, opts)
+        return run_shard_worker(args.fleet_worker, opts,
+                                join=args.fleet_join)
 
     if args.node:
         from .dist import run_node

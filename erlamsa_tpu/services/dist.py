@@ -82,6 +82,7 @@ import functools
 import json
 import os
 import random as _pyrandom
+import signal
 import socket
 import struct
 import threading
@@ -115,6 +116,14 @@ class StaleEpochError(RemoteShardError):
     current lease — either the worker fenced a stale coordinator
     request, or the coordinator rejected a stale (zombie) worker reply.
     The carried data is dropped, never merged."""
+
+
+class WorkerClosing(RemoteShardError):
+    """The worker announced a GRACEFUL shutdown (`worker_closing` frame,
+    r20): it is closing its streams on purpose, not dying on the wire.
+    Flows into the same revoke/redispatch path as any shard loss (it is
+    a RemoteShardError), but coordinators log and count it distinctly so
+    operators can tell a drain from a network partition."""
 
 
 def _send_json(sock: socket.socket, obj: dict):
@@ -346,6 +355,14 @@ def validate_shard_reply(resp: dict | None, shard: int, epoch: int | None,
         raise StaleEpochError(
             f"shard {shard}: worker fenced the request "
             f"(lease epoch {resp.get('have')}, sent {resp.get('got')})")
+    if op == "worker_closing":
+        metrics.GLOBAL.record_event("worker_closing")
+        flight.GLOBAL.note("worker_closing", shard=int(shard))
+        logger.log("warning", "fleet: shard %d announced a graceful "
+                   "shutdown (worker_closing) — planned departure, not "
+                   "a wire loss", shard)
+        raise WorkerClosing(
+            f"shard {shard}: worker closing (graceful shutdown)")
     if op == "shard_error":
         raise RemoteShardError(
             f"shard {shard}: worker step failed: {resp.get('error')}")
@@ -508,6 +525,13 @@ class ShardStream:
         #: reset when the sync ack is consumed; the coordinator reads it
         #: to decide when the window is full
         self.unsynced = 0
+        #: sticky drain announcement (r20): set when any reply header
+        #: carries ``"draining": true`` — the worker received SIGTERM
+        #: and wants a graceful drain. The reduce thread sets it, the
+        #: coordinator reads it at the next window fence (a bool under
+        #: the GIL; no lock needed). Never reset — a draining worker's
+        #: backend is dropped at the fence, or replaced on re-join.
+        self.draining = False
 
     def endpoint(self) -> str:
         return f"{self.host}:{self.port}"
@@ -566,6 +590,8 @@ class ShardStream:
                 f"shard {self.id} @{self.endpoint()}: peer closed "
                 "mid-stream")
         header, blob = got
+        if header.get("draining"):
+            self.draining = True
         if self.tally is not None:
             # exact: the worker packs replies with the same compact
             # separators AND the same deterministic chunk split, so
@@ -665,6 +691,16 @@ class ShardHost:
         # process-wide, not per-shard, so a worker hosting several
         # shards ships each tail entry exactly once
         self._tele = {"flight": 0, "trace": 0}
+        #: graceful-drain request (r20): set by SIGTERM in the worker
+        #: entrypoint. While set, every framed reply is stamped
+        #: ``"draining": true`` so the coordinator learns of the wish at
+        #: the next reply it reads — the worker cannot send unsolicited
+        #: frames on the FIFO stream, so the flag rides the replies.
+        self.draining = threading.Event()
+        #: set once a requested drain completed (fleet_drain consumed
+        #: the last lease while `draining` was up) — the worker
+        #: entrypoint exits on it
+        self.drained = threading.Event()
 
     def handle(self, msg: dict) -> dict:
         op = msg.get("op")
@@ -711,6 +747,28 @@ class ShardHost:
             logger.log("info", "shard host: lease revoked shard=%d, "
                        "fenced below epoch %d", shard, epoch)
             return {"op": "shard_revoked", "shard": shard, "epoch": epoch}
+        if op == "fleet_drain":
+            # graceful departure (r20): drop the lease AND raise the
+            # fence floor to the drain epoch — exactly the revoke fence.
+            # A later re-join of this worker must lease strictly above
+            # this floor (the placement join() bumps the epoch first),
+            # so a zombie of the drained life can never pass validation.
+            with self._lock:
+                if self._token.get(shard, token) != token:
+                    # a stale campaign's drain is harmless to ack — the
+                    # current campaign's floors are untouched
+                    return {"op": "fleet_drained", "shard": shard,
+                            "epoch": epoch}
+                self._leases.pop(shard, None)
+                self._floor[shard] = max(self._floor.get(shard, 0), epoch)
+                self._token[shard] = token
+                remaining = len(self._leases)
+            logger.log("info", "shard host: lease drained shard=%d, "
+                       "fenced below epoch %d (%d lease(s) left)",
+                       shard, epoch, remaining)
+            if self.draining.is_set() and remaining == 0:
+                self.drained.set()
+            return {"op": "fleet_drained", "shard": shard, "epoch": epoch}
         if op == "shard_step":
             with self._lock:
                 lease = self._leases.get(shard)
@@ -777,6 +835,17 @@ class ShardHost:
         everything else (lease, revoke, probe) reuses the JSON handler
         with an empty reply blob, so both transports share one lease
         table and one fencing discipline."""
+        reply, rblob = self._dispatch_frame(header, blob)
+        if self.draining.is_set():
+            # piggyback the drain wish on every reply (transport
+            # metadata only — validate_shard_reply ignores extra keys,
+            # and the coordinator acts on it at its window fence, so
+            # sample bytes never depend on when the flag appears)
+            reply["draining"] = True
+        return reply, rblob
+
+    def _dispatch_frame(self, header: dict,
+                        blob: bytes) -> tuple[dict, bytes]:
         op = header.get("op")
         if op == "shard_step":
             return self._step_framed(header, blob)
@@ -996,14 +1065,22 @@ class ParentServer:
         self.opts = opts
         self.shards = ShardHost()  # fleet shard-lease handshake host
         self._stop = threading.Event()
+        # open peer connections (conn -> framed?), tracked so stop()
+        # can announce worker_closing instead of silently dropping them
+        self._conns: dict[socket.socket, bool] = {}
+        self._conns_lock = threading.Lock()
 
     def _handle(self, conn: socket.socket, addr):
         f = conn.makefile("rb")
+        with self._conns_lock:
+            self._conns[conn] = False
         try:
             # one-byte sniff routes the connection: FRAME_MAGIC's first
             # byte (0x8f) can never begin a JSON line, so framed fleet
             # streams and legacy JSON peers share this listener
             if f.peek(1)[:1] == FRAME_MAGIC[:1]:
+                with self._conns_lock:
+                    self._conns[conn] = True
                 self._handle_frames(conn, f)
                 return
             while True:
@@ -1014,7 +1091,8 @@ class ParentServer:
                     self.pool.join(addr[0], int(msg.get("port", 0)))
                     _send_json(conn, {"op": "joined"})
                 elif msg.get("op") in ("shard_lease", "shard_step",
-                                       "shard_revoke", "shard_probe"):
+                                       "shard_revoke", "shard_probe",
+                                       "fleet_drain"):
                     _send_json(conn, self.shards.handle(msg))
                 elif msg.get("op") == "fuzz":
                     data = base64.b64decode(msg.get("data", ""))
@@ -1028,6 +1106,8 @@ class ParentServer:
             logger.log("warning", "dist: dropping connection from %s:%d: %s",
                        addr[0], addr[1], e)
         finally:
+            with self._conns_lock:
+                self._conns.pop(conn, None)
             conn.close()
 
     def _handle_frames(self, conn: socket.socket, f):
@@ -1105,11 +1185,37 @@ class ParentServer:
         return self
 
     def stop(self):
+        """Shut the listener down and announce it. Every still-open peer
+        gets an explicit ``worker_closing`` frame (or JSON line) before
+        its socket closes (r20) — a coordinator mid-stream sees a
+        protocol-level verdict (dist.WorkerClosing) instead of a bare
+        connection reset, so logs and metrics distinguish a planned
+        shutdown from network loss. Best-effort: a peer that is already
+        gone, or racing a concurrent reply write, degrades to the old
+        silent-close behavior."""
         self._stop.set()
         try:
             self._srv.close()
         except OSError:
             pass
+        with self._conns_lock:
+            peers = list(self._conns.items())
+        for conn, framed in peers:
+            try:
+                # dist.send fault = the goodbye never leaves: the peer
+                # sees the pre-r20 silent close, nothing worse
+                chaos.fault_point("dist.send")
+                if framed:
+                    conn.sendall(_pack_frame({"op": "worker_closing"}))  # lint: span-coverage-ok best-effort shutdown courtesy, no reply expected
+                else:
+                    conn.sendall(json.dumps({"op": "worker_closing"})
+                                 .encode() + b"\n")
+            except OSError:
+                pass
+            try:
+                conn.close()
+            except OSError:
+                pass
 
 
 def remote_fuzz(host: str, port: int, data: bytes, timeout: float = 90.0,
@@ -1182,13 +1288,207 @@ def run_node(host: str, port: int, opts: dict) -> int:
     return WorkerNode(host, port, opts).start(block=True)
 
 
-def run_shard_worker(port: int, opts: dict) -> int:
-    """`--fleet-worker PORT`: serve fleet shard leases on this host. A
-    plain ParentServer — the shard protocol rides the same listener as
-    join/fuzz (framed streams AND legacy JSON, routed by first-byte
-    sniff), so one process can serve both roles; the ShardHost keeps
-    the lease table and the compute is rebuilt per step from the shipped
-    request (stateless worker: a restart costs a re-lease plus a
-    snapshot re-ship, nothing else)."""
-    logger.log("info", "fleet shard worker on :%d", port)
-    return ParentServer(port, opts).serve(block=True)
+class MembershipListener:
+    """Coordinator-side hot-join intake (`--fleet-accept PORT`, r20): a
+    tiny TCP listener that accepts ``fleet_join`` announcements from
+    workers (framed or JSON-lines, one-byte sniff like ParentServer),
+    acks them, and queues the candidate for the fleet coordinator to
+    ADMIT AT ITS NEXT WINDOW FENCE. Admission is deliberately deferred:
+    the fence is the only point with zero steps in flight, so joining
+    there re-derives placement without fencing live work — and because
+    placement is pure and PRNG streams are counter-keyed, WHEN a join
+    lands can shift which worker serves which slots but never the bytes.
+
+    The listener thread only parses and queues; it never touches the
+    placement table (single-threaded by design, like the arena)."""
+
+    def __init__(self, port: int = 0):
+        srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        srv.bind(("0.0.0.0", int(port)))
+        srv.listen(16)
+        self._srv = srv
+        self._stop = threading.Event()
+        self._lock = threading.Lock()
+        self._pending: list[dict] = []
+        supervise("fleet-membership-accept", self._loop)
+        logger.log("info", "fleet membership listener on :%d", self.port)
+
+    @property
+    def port(self) -> int:
+        return self._srv.getsockname()[1]
+
+    def _loop(self):
+        while not self._stop.is_set():
+            try:
+                conn, addr = self._srv.accept()
+            except OSError:
+                break
+            threading.Thread(target=self._intake, args=(conn, addr),
+                             daemon=True).start()
+
+    def _intake(self, conn: socket.socket, addr):
+        """Parse one fleet_join announcement and ack it. The handshake
+        carries the worker's capabilities (serve port, spmd flag,
+        optional classes, optional campaign token) — capability
+        VALIDATION happens at the admit fence, not here; the listener's
+        ack only means 'queued'."""
+        try:
+            # dist.recv fault = the announcement drops on the floor;
+            # the announcer's retry loop (announce_fleet_join) covers it
+            chaos.fault_point("dist.recv")
+            conn.settimeout(10.0)
+            f = conn.makefile("rb")
+            framed = f.peek(1)[:1] == FRAME_MAGIC[:1]
+            if framed:
+                got = _read_frames(f)  # lint: span-coverage-ok join intake handshake; the admit fence in corpus/fleet.py carries the span
+                header = got[0] if got else None
+            else:
+                line = f.readline(MAX_LINE + 1)
+                header = json.loads(line) if line else None
+            if header is None or header.get("op") != "fleet_join":
+                raise ProtocolError(
+                    f"expected fleet_join, got {str(header)[:80]}")
+            ev = {
+                "host": str(header.get("host") or addr[0]),
+                "port": int(header.get("port", 0)),
+                "spmd": bool(header.get("spmd")),
+                "classes": header.get("classes"),
+                "token": str(header.get("token", "")),
+            }
+            if not (0 < ev["port"] < 65536):
+                raise ProtocolError(f"bad join port {ev['port']}")
+            # queue BEFORE acking: an announcer that saw the ack must be
+            # visible to the very next fence take()
+            with self._lock:
+                self._pending.append(ev)
+            ack = {"op": "fleet_join_ack", "port": ev["port"]}
+            if framed:
+                conn.sendall(_pack_frame(ack))  # lint: span-coverage-ok join intake handshake; the admit fence carries the span
+            else:
+                conn.sendall(json.dumps(ack).encode() + b"\n")
+            metrics.GLOBAL.record_event("fleet_join_announced")
+            logger.log("info", "fleet: join announced from %s:%d "
+                       "(spmd=%s) — queued for the next fence",
+                       ev["host"], ev["port"], ev["spmd"])
+        except (OSError, ValueError) as e:
+            logger.log("warning", "fleet: dropping join announcement "
+                       "from %s: %s", addr[0], e)
+        finally:
+            conn.close()
+
+    def take(self) -> list[dict]:
+        """Drain the pending-join queue (fence-time, coordinator
+        thread). Arrival order is preserved."""
+        with self._lock:
+            out, self._pending = self._pending, []
+        return out
+
+    def close(self):
+        self._stop.set()
+        try:
+            # shutdown BEFORE close: a plain close() while the accept
+            # thread is blocked in the syscall leaves the kernel socket
+            # alive (the in-flight accept pins it), silently accepting
+            # joins after the coordinator stopped listening
+            self._srv.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        try:
+            self._srv.close()
+        except OSError:
+            pass
+
+
+def announce_fleet_join(host: str, port: int, my_port: int,
+                        caps: dict | None = None, attempts: int = 40,
+                        delay: float = 0.25) -> dict:
+    """Worker -> coordinator hot-join handshake (`--fleet-join`): send
+    one framed ``fleet_join`` frame carrying this worker's serve port
+    and capabilities, wait for the ack. Retries cover the races a real
+    elastic deploy hits (worker up before the coordinator's listener, a
+    coordinator restarting between campaigns). Raises RemoteShardError
+    once the attempts are exhausted."""
+    msg = {"op": "fleet_join", "port": int(my_port), **(caps or {})}
+    last: Exception | None = None
+    for _ in range(max(1, attempts)):
+        try:
+            chaos.fault_point("dist.send")
+            with socket.create_connection((host, int(port)),
+                                          timeout=10.0) as s:
+                s.sendall(_pack_frame(msg))  # lint: span-coverage-ok one-shot handshake; the admit fence carries the span
+                resp = _read_frames(s.makefile("rb"))  # lint: span-coverage-ok one-shot handshake; the admit fence carries the span
+            if resp is None or resp[0].get("op") != "fleet_join_ack":
+                raise ProtocolError(
+                    f"bad fleet_join ack: {str(resp and resp[0])[:80]}")
+            logger.log("info", "fleet: join announced to %s:%d "
+                       "(serving on :%d)", host, port, my_port)
+            return resp[0]
+        except (OSError, ValueError) as e:
+            last = e
+            time.sleep(delay)
+    raise RemoteShardError(
+        f"fleet join to {host}:{port} failed after {attempts} "
+        f"attempts: {last}")
+
+
+def run_shard_worker(port: int, opts: dict,
+                     join: str | None = None) -> int:
+    """`--fleet-worker PORT` / `--fleet-join COORD:PORT`: serve fleet
+    shard leases on this host. A plain ParentServer — the shard protocol
+    rides the same listener as join/fuzz (framed streams AND legacy
+    JSON, routed by first-byte sniff), so one process can serve both
+    roles; the ShardHost keeps the lease table and the compute is
+    rebuilt per step from the shipped request (stateless worker: a
+    restart costs a re-lease plus a snapshot re-ship, nothing else).
+
+    r20 lifecycle: with `join=COORD:PORT` the worker binds an ephemeral
+    (or given) port first, then announces itself to the coordinator's
+    membership listener — admission happens at the coordinator's next
+    window fence. SIGTERM requests a GRACEFUL DRAIN instead of dying:
+    replies start carrying ``draining: true``, the coordinator hands the
+    partitions back with a ``fleet_drain`` fence at its next window
+    boundary, and only then does this process stop its listener (with
+    worker_closing courtesy frames) and exit — zero rewinds, zero
+    replayed cases."""
+    srv = ParentServer(port, opts)
+    srv.serve(block=False)
+    my_port = srv._srv.getsockname()[1]
+    logger.log("info", "fleet shard worker on :%d", my_port)
+
+    def _sigterm(_signum, _frame):
+        logger.log("info", "fleet worker :%d: SIGTERM — requesting "
+                   "graceful drain", my_port)
+        metrics.GLOBAL.record_event("worker_drain_requested")
+        srv.shards.draining.set()
+
+    try:
+        signal.signal(signal.SIGTERM, _sigterm)
+    except ValueError:
+        pass  # not the main thread (tests drive shards.draining directly)
+    if join:
+        host, _, cport = str(join).rpartition(":")
+        announce_fleet_join(host or "127.0.0.1", int(cport), my_port,
+                            caps={"spmd": bool(opts.get("spmd")),
+                                  "token": str(opts.get("fleet_token")
+                                               or "")})
+    try:
+        while not srv.shards.drained.wait(0.2):
+            if not srv.shards.draining.is_set():
+                continue
+            # a drain is also complete when there is nothing to hand
+            # back: SIGTERM on an idle worker (no lease held), or the
+            # campaign already ended — the coordinator closed its
+            # persistent streams at teardown without a fence, so no
+            # fleet_drain will ever arrive for the stale lease
+            with srv._conns_lock:
+                attached = bool(srv._conns)
+            if not srv.shards._leases or not attached:
+                break
+        logger.log("info", "fleet worker :%d: drain complete — exiting",
+                   my_port)
+    except KeyboardInterrupt:
+        pass
+    finally:
+        srv.stop()
+    return 0

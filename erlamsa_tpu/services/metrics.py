@@ -89,6 +89,10 @@ class Counters:
         # FleetPlacement.snapshot(): shards/live/epoch/migrations plus
         # per-shard lease + breaker state) — gauge-style, set not summed
         self.fleet: dict | None = None
+        # latest membership-ledger snapshot (r20 elastic membership:
+        # generation counter + join/drain/evict event totals + vacancy)
+        # — gauge-style like the placement snapshot above
+        self.membership: dict | None = None
         # latest serving-engine snapshot (services/serving.py stats() /
         # TpuBatcher.stats(): mode/slots/fill_efficiency/steps_per_request/
         # compiles) — gauge-style, set not summed
@@ -220,6 +224,14 @@ class Counters:
         per-shard breaker state, migration epoch."""
         with self._lock:
             self.fleet = dict(stats)
+
+    def record_membership(self, snap: dict):
+        """Latest membership-ledger state (r20): ``generation``
+        (monotonic), ``events`` totals by kind (join/drain/evict/...),
+        and ``vacant`` (remote slots with no tenant). Renders as the
+        erlamsa_fleet_membership_* family in /metrics."""
+        with self._lock:
+            self.membership = dict(snap)
 
     def record_serving(self, stats: dict):
         """Latest serving-engine snapshot (continuous or flush)."""
@@ -473,6 +485,8 @@ class Counters:
                 "truncated": self.truncated,
                 "arena": dict(self.arena) if self.arena else None,
                 "fleet": dict(self.fleet) if self.fleet else None,
+                "fleet_membership": (dict(self.membership)
+                                     if self.membership else None),
                 "fleet_transport": dict(self.transport),
                 "serving": dict(self.serving) if self.serving else None,
                 "rejected": dict(self.rejected),

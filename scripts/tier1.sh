@@ -107,6 +107,17 @@
 # the same campaign under an injected gen.expand fault must degrade to
 # the host oracle with output bytes identical to the unfaulted run.
 #
+# scripts/tier1.sh --churn-smoke additionally exercises the r20
+# elastic-membership plane end to end on loopback: a static local
+# 2-shard campaign is the byte reference; the same campaign then runs
+# against one CLI worker subprocess named at launch plus one vacant
+# --fleet-expect slot filled MID-CAMPAIGN by a second worker subprocess
+# hot-joining over --fleet-join/--fleet-accept, while the first worker
+# is SIGTERMed mid-run and must drain gracefully (exit 0, zero slice
+# rewinds, a membership ledger recording both the join and the drain) —
+# with output bytes and the final corpus store byte-identical to the
+# static reference (corpus/fleet.py, services/dist.py).
+#
 # The gate starts with fuzzlint (erlamsa_tpu/analysis): pure-AST
 # invariant checks (determinism, device purity, lock discipline,
 # resilience coverage) over the whole package in ~2s. Opt out with
@@ -124,6 +135,7 @@ serve_smoke=0
 struct_smoke=0
 monitor_smoke=0
 gen_smoke=0
+churn_smoke=0
 lint=1
 while [ $# -gt 0 ]; do
   case "$1" in
@@ -138,6 +150,7 @@ while [ $# -gt 0 ]; do
     --serve-smoke) serve_smoke=1; shift ;;
     --struct-smoke) struct_smoke=1; shift ;;
     --gen-smoke) gen_smoke=1; shift ;;
+    --churn-smoke) churn_smoke=1; shift ;;
     --lint) lint=1; shift ;;
     --no-lint) lint=0; shift ;;
     *) break ;;
@@ -1025,6 +1038,147 @@ print(f"GEN_SMOKE={'ok' if ok else 'FAIL'} identity={ident} "
       f"degraded_fault={g2.get('degraded')}")
 sys.exit(0 if ok else 1)
 EOF
+  rc=$?
+fi
+
+if [ $rc -eq 0 ] && [ $churn_smoke -eq 1 ]; then
+  echo "== churn smoke: hot-join + SIGTERM drain must be byte-identical to the static fleet =="
+  timeout -k 10 600 env JAX_PLATFORMS=cpu python - <<'EOF2'
+import os, shutil, signal, socket, subprocess, sys, tempfile, threading, time
+
+from erlamsa_tpu.corpus.fleet import run_corpus_fleet
+
+SEED = (7, 7, 7)
+SEEDS = [bytes([65 + i]) * (30 * (i + 1)) for i in range(6)]
+N, BATCH = 4, 8
+
+
+def free_port():
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def wait_listening(port, timeout=120.0):
+    t0 = time.monotonic()
+    while time.monotonic() - t0 < timeout:
+        try:
+            socket.create_connection(("127.0.0.1", port), timeout=2).close()
+            return True
+        except OSError:
+            time.sleep(0.2)
+    return False
+
+
+def make_opts(root, tag, opts_extra):
+    outdir = os.path.join(root, f"out-{tag}")
+    os.makedirs(outdir, exist_ok=True)
+    opts = {
+        "corpus_dir": os.path.join(root, f"corpus-{tag}"),
+        "corpus": list(SEEDS),
+        "seed": SEED,
+        "n": N,
+        "output": os.path.join(outdir, "%n.out"),
+        "shards": None,
+        "_stats": {},
+    }
+    opts.update(opts_extra)
+    return opts
+
+
+def read_outputs(root, tag):
+    outdir = os.path.join(root, f"out-{tag}")
+    blob = b"".join(
+        open(os.path.join(outdir, f"{i}.out"), "rb").read()
+        for i in range(N * BATCH))
+    store = open(os.path.join(root, f"corpus-{tag}", "corpus.json"),
+                 "rb").read()
+    return blob, store
+
+
+def one_run(root, tag, opts_extra):
+    opts = make_opts(root, tag, opts_extra)
+    rc = run_corpus_fleet(opts, batch=BATCH)
+    blob, store = read_outputs(root, tag)
+    return rc, blob, store, opts["_stats"]
+
+
+def spawn_worker(*extra):
+    return subprocess.Popen(
+        [sys.executable, "-m", "erlamsa_tpu", *extra],
+        cwd=os.getcwd(), env={**os.environ, "JAX_PLATFORMS": "cpu"})
+
+
+root = tempfile.mkdtemp(prefix="tier1_churn_smoke_")
+w1 = w2 = None
+try:
+    # reference: static local 2-shard campaign
+    rc_ref, ref, store_ref, _ = one_run(root, "static", {"shards": 2})
+    assert rc_ref == 0
+
+    # churn leg: worker 1 named at launch, slot 1 vacant until worker 2
+    # hot-joins mid-campaign; worker 1 is SIGTERMed once output starts
+    # flowing and must drain at a window fence without a single rewind
+    w1_port, accept_port = free_port(), free_port()
+    w1 = spawn_worker("--fleet-worker", str(w1_port))
+    assert wait_listening(w1_port), "worker 1 never came up"
+    w2 = spawn_worker("--fleet-worker", "0",
+                      "--fleet-join", f"127.0.0.1:{accept_port}")
+
+    copts = make_opts(root, "churn", {
+        "fleet_nodes": [f"127.0.0.1:{w1_port}"],
+        "fleet_expect": 2,
+        "fleet_accept": accept_port,
+    })
+    st = copts["_stats"]
+    result = {}
+
+    def coordinator():
+        result["rc"] = run_corpus_fleet(copts, batch=BATCH)
+
+    t = threading.Thread(target=coordinator)
+    t.start()
+    # SIGTERM as soon as the FIRST case merges (finish_times is
+    # appended in place): the remaining window fences must see the
+    # draining stamp on worker 1's replies and hand its slots back
+    t0 = time.monotonic()
+    while t.is_alive() and time.monotonic() - t0 < 400:
+        if st.get("finish_times"):
+            break
+        time.sleep(0.2)
+    w1.send_signal(signal.SIGTERM)  # graceful drain, not a kill
+    t.join(500)
+    rc_c = result.get("rc", 1)
+    churn, store_c = read_outputs(root, "churn")
+
+    def graceful_exit(w, label):
+        try:
+            return w.wait(timeout=120)
+        except subprocess.TimeoutExpired:
+            print(f"{label} did not exit after drain — killing")
+            w.kill()
+            return -9
+
+    w1_rc = graceful_exit(w1, "worker 1")
+    w2.send_signal(signal.SIGTERM)  # idle by now: drain-complete exit
+    w2_rc = graceful_exit(w2, "worker 2")
+finally:
+    for w in (w1, w2):
+        if w is not None and w.poll() is None:
+            w.kill()
+    shutil.rmtree(root, ignore_errors=True)
+
+kinds = [e["kind"] for e in st.get("membership", {}).get("events", [])]
+ok = (rc_c == 0 and ref and churn == ref and store_c == store_ref
+      and st["slice_rewinds"] == 0 and st["rewinds"] == 0
+      and "join" in kinds and "drain" in kinds
+      and w1_rc == 0 and w2_rc == 0)
+print(f"CHURN_SMOKE={'ok' if ok else 'FAIL'} bytes={len(ref)} "
+      f"identical={churn == ref} store_identical={store_c == store_ref} "
+      f"membership={kinds} slice_rewinds={st.get('slice_rewinds')} "
+      f"rewinds={st.get('rewinds')} worker_rcs=({w1_rc},{w2_rc})")
+sys.exit(0 if ok else 1)
+EOF2
   rc=$?
 fi
 

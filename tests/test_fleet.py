@@ -327,3 +327,207 @@ def test_fleet_capacity_classes_are_global(tmp_path):
     widths = {shape[1] for shape in stats["step_shapes"]}
     assert widths == {bucket_capacity(len(s)) for s in SEEDS}
     assert len(widths) == 2  # SEEDS span two classes by construction
+
+
+# ---- elastic membership (r20): transitions, ledger, churn schedule ------
+
+
+def test_placement_drain_leaves_breaker_closed():
+    """Graceful drain is a PLANNED departure: the shard leaves the live
+    set and its partitions redistribute like a revoke, but the breaker
+    records no failure — drained workers are healthy, just gone."""
+    p = FleetPlacement(4, failure_threshold=1)
+    entry = p.drain(1, case=3)
+    assert entry["kind"] == "drain" and entry["case"] == 3
+    assert entry["epoch"] == 1 and entry["moved"] == {1: 0}
+    assert p.dead() == [1] and p.owner_of(1) == 0
+    assert p.snapshot()["leases"]["1"]["breaker"] == CLOSED
+    # drain-then-join converges to the same placement readmit would:
+    # assignment is a pure function of the live set
+    assert p.partitions_of(0) == [0, 1]
+
+
+def test_placement_join_epoch_clears_drain_floor():
+    """ISSUE satellite: a worker that re-joins after a graceful drain
+    must lease at an epoch strictly above its drain-time fence floor —
+    otherwise the worker-side floor its own drain raised would fence
+    the fresh lease and the rejoin would serve nothing."""
+    p = FleetPlacement(4, failure_threshold=1)
+    drain_epoch = p.drain(2, case=1)["epoch"]
+    entry = p.join(2, case=5)
+    assert entry["kind"] == "join" and entry["epoch"] > drain_epoch
+    # the join stamps the slot's lease epoch (readmit semantics)
+    assert p.lease_epoch_of(2) == entry["epoch"]
+    assert p.live() == [0, 1, 2, 3]
+    assert p.owner_of(2) == 2
+
+
+def test_placement_vacate_reserves_slot_without_fault():
+    p = FleetPlacement(3, failure_threshold=1)
+    entry = p.vacate(2, case=0)
+    assert entry["kind"] == "vacant"
+    assert p.dead() == [2] and p.owner_of(2) in (0, 1)
+    assert p.snapshot()["leases"]["2"]["breaker"] == CLOSED
+    # a later hot-join fills the vacancy at a strictly higher epoch
+    assert p.join(2, case=4)["epoch"] > entry["epoch"]
+
+
+def test_membership_ledger_generation_and_restore():
+    from erlamsa_tpu.parallel.shards import MembershipLedger
+
+    led = MembershipLedger()
+    assert led.generation == 0 and led.counts() == {}
+    e1 = led.record("vacant", 2, 0, 1)
+    e2 = led.record("join", 2, 3, 5)
+    led.record("drain", 0, 4, 6)
+    assert (e1["gen"], e2["gen"]) == (1, 2) and led.generation == 3
+    assert led.counts() == {"vacant": 1, "join": 1, "drain": 1}
+    # resume adopts the history verbatim; generation stays monotonic
+    snap = led.snapshot()
+    fresh = MembershipLedger()
+    fresh.restore(snap["generation"], snap["events"])
+    assert fresh.generation == 3 and fresh.counts() == led.counts()
+    assert fresh.record("evict", 1, 5, 7)["gen"] == 4
+
+
+def test_make_churn_schedule_is_deterministic():
+    from erlamsa_tpu.parallel.shards import make_churn_schedule
+
+    a = make_churn_schedule(11, 8, [0, 1], ("drain", "kill"), 5)
+    b = make_churn_schedule(11, 8, [0, 1], ("drain", "kill"), 5)
+    assert a == b and len(a) == 5
+    assert all(1 <= ev["case"] < 8 for ev in a)
+    assert all(ev["kind"] in ("drain", "kill") for ev in a)
+    assert all(ev["shard"] in (0, 1) for ev in a)
+    assert a == sorted(a, key=lambda ev: ev["case"])
+    # a different seed draws a different storm
+    assert make_churn_schedule(12, 8, [0, 1], ("drain", "kill"), 5) != a
+    # degenerate inputs collapse to "no churn", never an error
+    assert make_churn_schedule(11, 1, [0], events=3) == []
+    assert make_churn_schedule(11, 8, [], events=3) == []
+
+
+def test_membership_snapshot_renders_in_prom_text():
+    from erlamsa_tpu.obs import prom
+
+    metrics.GLOBAL.record_membership(
+        {"generation": 7, "events": {"join": 2, "drain": 1},
+         "vacant": 1})
+    text = prom.render()
+    assert "erlamsa_fleet_membership_generation 7" in text
+    assert ('erlamsa_fleet_membership_events_total{kind="drain"} 1'
+            in text)
+    assert ('erlamsa_fleet_membership_events_total{kind="join"} 2'
+            in text)
+    assert "erlamsa_fleet_membership_vacant 1" in text
+
+
+# ---- churn-storm soak (fast — pre-compile oracle path) ------------------
+
+
+def test_fleet_graceful_drain_is_byte_identical_no_rewind(tmp_path):
+    """ISSUE acceptance (fast leg): a graceful drain at the case-0
+    fence — while the shard is still live — hands partitions back with
+    ZERO rewinds of either granularity, and the campaign bytes are
+    identical to the static fleet. The drained slot's breaker records
+    no failure and the coordinator never probes it again."""
+    rc0, base, _ = _run_fleet(tmp_path, "static", shards=2,
+                              spec="shard.step:*")
+    ring_before = len(flight.GLOBAL._ring)
+    rc, blob, stats = _run_fleet(
+        tmp_path, "drained", shards=2, spec="shard.step:*",
+        opts_extra={"churn_schedule": [
+            {"case": 0, "kind": "drain", "shard": 0}]})
+    assert rc0 == rc == 0 and blob == base
+    assert stats["rewinds"] == 0 and stats["slice_rewinds"] == 0
+    kinds = [e["kind"] for e in stats["membership"]["events"]]
+    assert kinds == ["drain", "evict"]  # shard 1 died to shard.step:*
+    assert stats["membership"]["generation"] == 2
+    assert stats["vacant"] == 1  # the drained slot is joinable now
+    snap = metrics.GLOBAL.snapshot()
+    assert snap["resilience"]["events"].get("shard_drained", 0) >= 1
+    assert snap["fleet_membership"]["events"].get("drain", 0) >= 1
+    notes = [e for e in list(flight.GLOBAL._ring)[ring_before:]
+             if e.get("kind") == "shard_membership"]
+    assert any(n["change"] == "drain" for n in notes)
+
+
+def test_fleet_drain_fault_degrades_to_revoke_byte_identically(tmp_path):
+    """ISSUE acceptance: an injected fleet.drain fault abandons the
+    polite handoff and falls back to the crash path (revoke +
+    redistribute) — same bytes, the event ledger just says evict."""
+    rc0, base, _ = _run_fleet(tmp_path, "plain", shards=2,
+                              spec="shard.step:*")
+    rc, blob, stats = _run_fleet(
+        tmp_path, "dfault", shards=2,
+        spec="shard.step:*,fleet.drain:*",
+        opts_extra={"churn_schedule": [
+            {"case": 0, "kind": "drain", "shard": 0}]})
+    assert rc0 == rc == 0 and blob == base
+    kinds = [e["kind"] for e in stats["membership"]["events"]]
+    assert kinds[0] == "evict"  # the drain degraded to a revoke
+    ev = metrics.GLOBAL.snapshot()["resilience"]["events"]
+    assert ev.get("fleet_drain_faulted", 0) >= 1
+
+
+def test_fleet_churn_storm_schedules_are_byte_identical(tmp_path):
+    """ISSUE acceptance: two DIFFERENT deterministic churn storms
+    (seed-derived drain/kill schedules) both produce campaigns
+    byte-identical to the static fleet, and each storm replays
+    byte-for-byte from its own schedule."""
+    from erlamsa_tpu.parallel.shards import make_churn_schedule
+
+    rc0, base, _ = _run_fleet(tmp_path, "calm", shards=2, n=4,
+                              spec="shard.step:*")
+    assert rc0 == 0
+    blobs = {}
+    for storm_seed in (31, 32):
+        sched = make_churn_schedule(storm_seed, 4, [0, 1],
+                                    ("drain", "kill"), 4)
+        assert sched  # a storm that draws no events tests nothing
+        rc, blob, stats = _run_fleet(
+            tmp_path, f"storm{storm_seed}", shards=2, n=4,
+            spec="shard.step:*",
+            opts_extra={"churn_schedule": [dict(ev) for ev in sched]})
+        assert rc == 0 and blob == base
+        assert stats["membership"]["generation"] >= 1
+        blobs[storm_seed] = (blob, stats["membership"]["events"])
+        # replay: the same storm reproduces the same membership history
+        rc2, blob2, stats2 = _run_fleet(
+            tmp_path, f"storm{storm_seed}r", shards=2, n=4,
+            spec="shard.step:*",
+            opts_extra={"churn_schedule": [dict(ev) for ev in sched]})
+        assert rc2 == 0 and blob2 == blob
+        assert stats2["membership"]["events"] == \
+            stats["membership"]["events"]
+
+
+def test_fleet_expect_reserves_vacant_slots_byte_identically(tmp_path):
+    """--fleet-expect K at a fixed --shards only changes TENANCY: the
+    vacant slots' partitions serve from survivors (here: the oracle,
+    everything is down) and the bytes match the all-local static
+    fleet. The vacancy is visible in the ledger and /metrics."""
+    rc0, base, _ = _run_fleet(tmp_path, "full", shards=2,
+                              spec="shard.step:*")
+    rc, blob, stats = _run_fleet(tmp_path, "vac", shards=2,
+                                 spec="shard.step:*",
+                                 opts_extra={"fleet_expect": 1})
+    assert rc0 == rc == 0 and blob == base
+    kinds = [e["kind"] for e in stats["membership"]["events"]]
+    assert kinds[0] == "vacant" and stats["vacant"] == 1
+    snap = metrics.GLOBAL.snapshot()
+    assert snap["fleet_membership"]["vacant"] == 1
+    assert snap["fleet_membership"]["events"].get("vacant", 0) == 1
+
+
+def test_fleet_expect_validation(tmp_path):
+    with pytest.raises(ValueError, match="fleet-expect"):
+        _run_fleet(tmp_path, "neg", shards=2,
+                   opts_extra={"fleet_expect": -1})
+    with pytest.raises(ValueError, match="remote"):
+        _run_fleet(tmp_path, "big", shards=2,
+                   opts_extra={"fleet_expect": 3})
+    with pytest.raises(ValueError, match="join|drain|kill"):
+        _run_fleet(tmp_path, "badkind", shards=2,
+                   opts_extra={"churn_schedule": [
+                       {"case": 1, "kind": "explode", "shard": 0}]})

@@ -469,7 +469,7 @@ def test_fleet_nodes_spec_validation(tmp_path):
     base = {"seed": SEED, "corpus_dir": str(tmp_path / "c")}
     with pytest.raises(ValueError, match="host:port"):
         run_corpus_fleet({**base, "fleet_nodes": ["nonsense"]})
-    with pytest.raises(ValueError, match="--fleet-nodes names"):
+    with pytest.raises(ValueError, match="remote slots"):
         run_corpus_fleet({**base, "shards": 1,
                           "fleet_nodes": ["h:1", "h:2"]})
 
@@ -755,6 +755,428 @@ def test_mid_window_reply_loss_rewinds_byte_identically(tmp_path):
         assert st["rewinds"] + st["slice_rewinds"] >= 1
         assert [m["kind"] for m in st["migrations"]][0] == "revoke"
         assert _read_blob(tmp_path, "lost", 2) == ref
+    finally:
+        srv1.stop()
+        srv2.stop()
+
+
+# ---- elastic membership (r20): drain/join protocol layer ----------------
+
+
+def test_shard_host_fleet_drain_raises_floor_for_rejoin():
+    """ISSUE satellite: the PR 14 zombie-rejection discipline extended
+    to drain->rejoin. A graceful drain drops the lease AND raises the
+    fence floor to the drain epoch, so a rejoin of the same worker must
+    lease strictly above its drain-time floor — zombies of the drained
+    life can never pass validation."""
+    h = ShardHost()
+    a = {"token": "aaaa" * 8}
+    assert h.handle({"op": "shard_lease", "shard": 0, "epoch": 2,
+                     **a, **CFG})["op"] == "shard_leased"
+    r = h.handle({"op": "fleet_drain", "shard": 0, "epoch": 5, **a})
+    assert r["op"] == "fleet_drained" and r["epoch"] == 5
+    # the drained life's in-flight zombie step is fenced, not computed
+    r = h.handle({"op": "shard_step", "shard": 0, "epoch": 2, **a,
+                  "case": 0, "slots": [0], "data": [], "scores": []})
+    assert r["op"] == "shard_fenced"
+    # a rejoin BELOW the drain floor is fenced with the floor echoed
+    fenced = h.handle({"op": "shard_lease", "shard": 0, "epoch": 4,
+                       **a, **CFG})
+    assert fenced["op"] == "shard_fenced" and fenced["have"] == 5
+    # the coordinator's placement.join grants strictly above the drain
+    # epoch, so the real rejoin lands here:
+    assert h.handle({"op": "shard_lease", "shard": 0, "epoch": 6,
+                     **a, **CFG})["op"] == "shard_leased"
+    # a FRESH campaign (new token) is never fenced by the old floor
+    b = {"token": "bbbb" * 8}
+    assert h.handle({"op": "shard_lease", "shard": 0, "epoch": 0,
+                     **b, **CFG})["op"] == "shard_leased"
+    # ...and a zombie drain from campaign A cannot fence campaign B
+    assert h.handle({"op": "fleet_drain", "shard": 0, "epoch": 9,
+                     **a})["op"] == "fleet_drained"
+    assert h.handle({"op": "shard_lease", "shard": 0, "epoch": 1,
+                     **b, **CFG})["op"] == "shard_leased"
+
+
+def test_shard_host_draining_stamps_replies_and_latches_drained():
+    """SIGTERM sets ShardHost.draining; every framed reply then carries
+    a ``draining`` stamp (the FIFO stream cannot carry unsolicited
+    frames, so the announcement rides reply headers), and the drained
+    latch fires when the LAST lease is drained."""
+    h = ShardHost()
+    h.handle({"op": "shard_lease", "shard": 0, "epoch": 1, **CFG})
+    r, _ = h.handle_frame({"op": "shard_probe", "shard": 0}, b"")
+    assert r["op"] == "shard_alive" and "draining" not in r
+    h.draining.set()
+    r, _ = h.handle_frame({"op": "shard_probe", "shard": 0}, b"")
+    assert r["op"] == "shard_alive" and r["draining"] is True
+    assert not h.drained.is_set()
+    h.handle({"op": "fleet_drain", "shard": 0, "epoch": 2})
+    assert h.drained.is_set()
+
+
+def test_shard_stream_drain_stamp_is_sticky(worker):
+    """The coordinator's reduce thread sets stream.draining when any
+    reply header carries the stamp; the flag survives later clean
+    replies (the fence, not the reader, clears the membership)."""
+    from erlamsa_tpu.services.dist import ShardStream
+
+    srv, port = worker
+    stream = ShardStream(0, "127.0.0.1", port, timeout=5.0)
+    try:
+        stream.request({"op": "shard_probe", "shard": 0},
+                       expect="shard_alive")
+        assert stream.draining is False
+        srv.shards.draining.set()
+        stream.request({"op": "shard_probe", "shard": 0},
+                       expect="shard_alive")
+        assert stream.draining is True
+        srv.shards.draining.clear()
+        stream.request({"op": "shard_probe", "shard": 0},
+                       expect="shard_alive")
+        assert stream.draining is True  # sticky until the fence acts
+    finally:
+        stream.close()
+
+
+def test_validate_shard_reply_worker_closing_is_distinct():
+    """ISSUE satellite: a worker announcing shutdown maps to
+    WorkerClosing — a RemoteShardError subclass (it still rides the
+    revoke path) that logs/counts as a planned departure, never a bare
+    wire loss."""
+    from erlamsa_tpu.services.dist import WorkerClosing
+
+    assert issubclass(WorkerClosing, RemoteShardError)
+    ev0 = metrics.GLOBAL.snapshot()["resilience"]["events"].get(
+        "worker_closing", 0)
+    with pytest.raises(WorkerClosing):
+        validate_shard_reply({"op": "worker_closing", "shard": 3},
+                             3, 1, "shard_result")
+    ev = metrics.GLOBAL.snapshot()["resilience"]["events"]
+    assert ev.get("worker_closing", 0) == ev0 + 1
+
+
+def test_parent_server_stop_announces_worker_closing(worker):
+    """ISSUE satellite fix: worker shutdown used to just drop sockets;
+    now every open peer gets an explicit worker_closing frame before
+    the close, so a coordinator mid-stream sees the protocol verdict
+    instead of a connection reset."""
+    from erlamsa_tpu.services.dist import ShardStream, WorkerClosing
+
+    srv, port = worker
+    stream = ShardStream(0, "127.0.0.1", port, timeout=5.0)
+    try:
+        stream.request({"op": "shard_probe", "shard": 0},
+                       expect="shard_alive")
+        srv.stop()
+        with pytest.raises(WorkerClosing):
+            stream.read_reply("shard_alive", None, timeout=5.0)
+    finally:
+        stream.close()
+
+
+def test_membership_listener_announce_roundtrip():
+    """--fleet-join handshake: the announcement is queued for the fence
+    BEFORE the ack goes out, capability fields ride the event, and a
+    dead coordinator port exhausts the announcer's retries loudly."""
+    from erlamsa_tpu.services.dist import (MembershipListener,
+                                           announce_fleet_join)
+
+    lst = MembershipListener(0)
+    try:
+        ack = announce_fleet_join(
+            "127.0.0.1", lst.port, 4567,
+            caps={"spmd": True, "token": "tttt" * 8},
+            attempts=5, delay=0.05)
+        assert ack["op"] == "fleet_join_ack" and ack["port"] == 4567
+        evs = lst.take()
+        assert len(evs) == 1
+        ev = evs[0]
+        assert ev["port"] == 4567 and ev["spmd"] is True
+        assert ev["token"] == "tttt" * 8 and ev["host"]
+        assert lst.take() == []  # take() drains
+        dead_port = lst.port
+    finally:
+        lst.close()
+    with pytest.raises(RemoteShardError, match="join"):
+        announce_fleet_join("127.0.0.1", dead_port, 4567, attempts=2,
+                            delay=0.01)
+
+
+def test_membership_listener_rejects_garbage_announcement():
+    from erlamsa_tpu.services.dist import MembershipListener
+
+    lst = MembershipListener(0)
+    try:
+        with socket.create_connection(("127.0.0.1", lst.port),
+                                      timeout=5.0) as s:
+            s.sendall(b'{"op": "fuzz", "data": ""}\n')
+            # the listener drops the conn without acking
+            assert s.recv(64) == b""
+        assert lst.take() == []
+    finally:
+        lst.close()
+
+
+# ---- frame codec at the chunk boundary (r20 satellite) ------------------
+
+
+def test_frame_chunk_boundary_counts_and_sites(monkeypatch):
+    """ISSUE satellite: a panel of exactly FRAME_CHUNK bytes rides ONE
+    physical frame; CHUNK+1 splits into exactly two; both roundtrip
+    byte-identically, and the dist.shard.frame/send chaos sites fire
+    once per LOGICAL frame regardless of chunking."""
+    import io
+
+    from erlamsa_tpu.services import dist as dist_mod
+
+    monkeypatch.setattr(dist_mod, "FRAME_CHUNK", 64)
+    at = bytes(range(64))            # exactly CHUNK
+    over = bytes(range(64)) + b"!"   # CHUNK + 1
+    frames = dist_mod._frames_for({"op": "shard_step"}, at)
+    assert len(frames) == 1
+    hdr, got = dist_mod._read_frames(io.BytesIO(b"".join(frames)))
+    assert got == at and "_cont" not in hdr
+    frames = dist_mod._frames_for({"op": "shard_step"}, over)
+    assert len(frames) == 2
+    hdr, got = dist_mod._read_frames(io.BytesIO(b"".join(frames)))
+    assert got == over
+    # chaos counters: one firing opportunity per LOGICAL frame — the
+    # second physical chunk must NOT advance the site counters
+    a, b = socket.socketpair()
+    try:
+        inj = chaos.configure("dist.shard.frame:s9x1,dist.shard.send:s9x1",
+                              seed=1)
+        wire = dist_mod._frames_for({"op": "x"}, over)
+        sent, fmax = dist_mod._shard_frame_send(a, {"op": "x"}, over)
+        assert sent == sum(len(p) for p in wire)
+        assert fmax == max(len(p) for p in wire)
+        inv = inj.stats()["invocations"]
+        assert inv == {"dist.shard.frame": 1, "dist.shard.send": 1}
+    finally:
+        chaos.configure(None)
+        a.close()
+        b.close()
+
+
+# ---- elastic membership: coordinator end-to-end (fast, oracle path) -----
+
+
+def test_hot_join_via_schedule_is_byte_identical(tmp_path, worker):
+    """ISSUE acceptance (fast leg): a hot-join admitted at the fence
+    into a --fleet-expect vacancy leaves campaign bytes identical to
+    the static fleet of the same logical shard count. On the oracle
+    path the joined worker is immediately evicted by the armed
+    shard.step fault — which is exactly the point: admission changes
+    tenancy, never bytes."""
+    _, port = worker
+    rc, _ = _run_fleet(tmp_path, "static", n=3, state=False)
+    assert rc == 0
+    ref = _read_blob(tmp_path, "static", 3)
+    rc, stats = _run_fleet(
+        tmp_path, "joined", n=3, state=False,
+        opts_extra={"fleet_expect": 1, "churn_schedule": [
+            {"case": 1, "kind": "join", "host": "127.0.0.1",
+             "port": port}]})
+    assert rc == 0 and _read_blob(tmp_path, "joined", 3) == ref
+    kinds = [e["kind"] for e in stats["membership"]["events"]]
+    assert "vacant" in kinds and "join" in kinds
+    join_ev = next(e for e in stats["membership"]["events"]
+                   if e["kind"] == "join")
+    assert join_ev["shard"] == 0 and join_ev["case"] == 1
+    backends = stats["membership"]["backends"]
+    assert backends[0] == f"127.0.0.1:{port}"
+    ev = metrics.GLOBAL.snapshot()["resilience"]["events"]
+    assert ev.get("fleet_joined", 0) >= 1
+
+
+def test_hot_join_fault_rejects_byte_identically(tmp_path, worker):
+    """An injected fleet.join fault aborts the admit before any state
+    moves: the candidate stays out, the ledger says join_rejected, and
+    the bytes match a run it never contacted."""
+    _, port = worker
+    rc, _ = _run_fleet(tmp_path, "plain", n=3, state=False)
+    ref = _read_blob(tmp_path, "plain", 3)
+    rc, stats = _run_fleet(
+        tmp_path, "jfault", n=3, state=False,
+        spec="shard.step:*,fleet.join:*",
+        opts_extra={"fleet_expect": 1, "churn_schedule": [
+            {"case": 1, "kind": "join", "host": "127.0.0.1",
+             "port": port}]})
+    assert rc == 0 and _read_blob(tmp_path, "jfault", 3) == ref
+    kinds = [e["kind"] for e in stats["membership"]["events"]]
+    assert "join_rejected" in kinds and "join" not in kinds
+    # the slot is still vacant — a later announce could fill it
+    assert stats["vacant"] == 1
+
+
+def test_hot_join_token_mismatch_rejected(tmp_path, worker):
+    """A candidate carrying ANOTHER campaign's token must not be bound
+    to a slot — its snapshots and floors belong to a different world."""
+    _, port = worker
+    rc, stats = _run_fleet(
+        tmp_path, "badtok", n=2, state=False,
+        opts_extra={"fleet_expect": 1, "fleet_token": "gggg" * 8,
+                    "churn_schedule": [
+                        {"case": 0, "kind": "join", "host": "127.0.0.1",
+                         "port": port, "token": "zzzz" * 8}]})
+    assert rc == 0
+    kinds = [e["kind"] for e in stats["membership"]["events"]]
+    assert "join_rejected" in kinds and "join" not in kinds
+
+
+def test_hot_join_via_listener_is_byte_identical(tmp_path, worker):
+    """The full announce path: a worker announces to the coordinator's
+    MembershipListener (as --fleet-join does); the fence takes the
+    queued event and admits it — bytes identical to the static
+    fleet."""
+    from erlamsa_tpu.services.dist import (MembershipListener,
+                                           announce_fleet_join)
+
+    _, port = worker
+    rc, _ = _run_fleet(tmp_path, "lref", n=3, state=False)
+    ref = _read_blob(tmp_path, "lref", 3)
+    lst = MembershipListener(0)
+    try:
+        announce_fleet_join("127.0.0.1", lst.port, port, attempts=5,
+                            delay=0.05)
+        rc, stats = _run_fleet(
+            tmp_path, "ljoin", n=3, state=False,
+            opts_extra={"fleet_expect": 1,
+                        "membership_listener": lst})
+        assert rc == 0 and _read_blob(tmp_path, "ljoin", 3) == ref
+        kinds = [e["kind"] for e in stats["membership"]["events"]]
+        assert "join" in kinds
+    finally:
+        lst.close()
+
+
+def test_fleet_resume_mid_churn_byte_identity(tmp_path):
+    """ISSUE acceptance: a coordinator killed MID-CHURN (after a
+    graceful drain landed, before a scheduled kill) and resumed from
+    --state replays the remaining storm and finishes byte-identical to
+    both the uninterrupted churn run and the static fleet. The resumed
+    membership ledger carries the pre-kill history forward."""
+    sched = [{"case": 0, "kind": "drain", "shard": 0},
+             {"case": 2, "kind": "kill", "shard": 1}]
+    rc, _ = _run_fleet(tmp_path, "cstatic", n=4, state=False)
+    assert rc == 0
+    ref = _read_blob(tmp_path, "cstatic", 4)
+    rc, _ = _run_fleet(tmp_path, "cfull", n=4, state=False,
+                       opts_extra={"churn_schedule":
+                                   [dict(e) for e in sched]})
+    assert rc == 0 and _read_blob(tmp_path, "cfull", 4) == ref
+    # leg 1: killed after 2 of 4 cases, drain already in the ledger
+    rc, st1 = _run_fleet(tmp_path, "cres", n=2,
+                         opts_extra={"churn_schedule":
+                                     [dict(e) for e in sched]})
+    assert rc == 0
+    kinds1 = [e["kind"] for e in st1["membership"]["events"]]
+    assert kinds1[0] == "drain"
+    # leg 2: resume; the drained slot stays vacant (checkpoint wins),
+    # the case-2 kill fires post-resume, bytes match the full run
+    rc, st2 = _run_fleet(tmp_path, "cres", n=4,
+                         opts_extra={"churn_schedule":
+                                     [dict(e) for e in sched]})
+    assert rc == 0 and st2["start_case"] == 2
+    assert _read_blob(tmp_path, "cres", 4) == ref
+    kinds2 = [e["kind"] for e in st2["membership"]["events"]]
+    assert kinds2[:len(kinds1)] == kinds1
+    assert st2["membership"]["generation"] > st1["membership"]["generation"]
+    assert st2["membership"]["backends"][0] == ""  # still drained
+
+
+def test_fleet_checkpoint_membership_roundtrip(tmp_path):
+    """save_fleet_state/load_fleet_state carry the membership record:
+    generation, the full event history, per-slot backends and
+    liveness — absent on pre-r20 checkpoints (loads as None)."""
+    path = str(tmp_path / "m.npz")
+    membership = {
+        "generation": 5,
+        "events": [{"gen": 1, "kind": "vacant", "shard": 1, "case": 0,
+                    "epoch": 1},
+                   {"gen": 5, "kind": "join", "shard": 1, "case": 3,
+                    "epoch": 4}],
+        "backends": ["local", "10.0.0.9:4242"],
+        "live": [True, True],
+    }
+    save_fleet_state(path, SEED, 3, np.zeros((2, 4), np.float32),
+                     {b"h" * 12}, {}, 4, 2, [256],
+                     membership=membership)
+    st = load_fleet_state(path)
+    assert st["membership"]["generation"] == 5
+    assert st["membership"]["events"] == membership["events"]
+    assert st["membership"]["backends"] == membership["backends"]
+    assert st["membership"]["live"] == [True, True]
+    # a pre-r20 checkpoint simply has no membership record
+    save_fleet_state(path, SEED, 3, np.zeros((2, 4), np.float32),
+                     {b"h" * 12}, {}, 4, 2, [256])
+    assert load_fleet_state(path)["membership"] is None
+
+
+# ---- elastic membership: live drain + rewind under churn (slow) ---------
+
+
+@pytest.mark.slow
+def test_remote_graceful_drain_byte_identity_no_rewind(tmp_path):
+    """ISSUE acceptance (compile tier): draining a LIVE remote worker
+    mid-campaign hands its partitions back at the fence with zero
+    rewinds of either granularity and byte-identical outputs; the
+    drained worker's host reports the lease gone and the worker-side
+    drained latch stays unset (other leases may persist) while the
+    coordinator records the planned departure."""
+    srv1 = ParentServer(0, {"seed": SEED}).serve(block=False)
+    srv2 = ParentServer(0, {"seed": SEED}).serve(block=False)
+    p1 = srv1._srv.getsockname()[1]
+    p2 = srv2._srv.getsockname()[1]
+    nodes = [f"127.0.0.1:{p1}", f"127.0.0.1:{p2}"]
+    try:
+        rc, _ = _run_fleet(tmp_path, "ref", n=4, spec=None, shards=2,
+                           state=False)
+        assert rc == 0
+        ref = _read_blob(tmp_path, "ref", 4)
+        rc, st = _run_fleet(
+            tmp_path, "drain", n=4, spec=None, shards=None, state=False,
+            opts_extra={"fleet_nodes": nodes, "churn_schedule": [
+                {"case": 2, "kind": "drain", "shard": 0}]})
+        assert rc == 0 and _read_blob(tmp_path, "drain", 4) == ref
+        assert st["rewinds"] == 0 and st["slice_rewinds"] == 0
+        kinds = [e["kind"] for e in st["membership"]["events"]]
+        assert kinds == ["drain"]
+        assert not srv1.shards._leases  # the lease was handed back
+    finally:
+        srv1.stop()
+        srv2.stop()
+
+
+@pytest.mark.slow
+def test_rewind_modes_byte_identical_under_churn(tmp_path):
+    """ISSUE satellite: slice-granular and full-case rewind replay
+    byte-identically while the membership is churning — a reply lost
+    mid-window (injected dist.shard.recv fault) races a scheduled
+    graceful drain and both land on the same output bytes."""
+    srv1 = ParentServer(0, {"seed": SEED}).serve(block=False)
+    srv2 = ParentServer(0, {"seed": SEED}).serve(block=False)
+    p1 = srv1._srv.getsockname()[1]
+    p2 = srv2._srv.getsockname()[1]
+    nodes = [f"127.0.0.1:{p1}", f"127.0.0.1:{p2}"]
+    try:
+        rc, _ = _run_fleet(tmp_path, "calm", n=3, spec=None, shards=2,
+                           state=False)
+        assert rc == 0
+        ref = _read_blob(tmp_path, "calm", 3)
+        for mode in ("slice", "full"):
+            rc, st = _run_fleet(
+                tmp_path, f"storm-{mode}", n=3,
+                spec="dist.shard.recv:s4x1", shards=None, state=False,
+                opts_extra={"fleet_nodes": nodes, "fleet_window": 2,
+                            "fleet_rewind": mode,
+                            "churn_schedule": [
+                                {"case": 2, "kind": "drain",
+                                 "shard": 1}]})
+            assert rc == 0
+            assert _read_blob(tmp_path, f"storm-{mode}", 3) == ref
+            assert st["rewinds"] + st["slice_rewinds"] >= 1
     finally:
         srv1.stop()
         srv2.stop()
